@@ -1,0 +1,38 @@
+"""seldon_core_tpu — a TPU-native inference-graph serving framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capabilities of Seldon Core
+(reference: santi81/seldon-core).  Users describe a runtime inference graph of
+MODEL / ROUTER / COMBINER / TRANSFORMER / OUTPUT_TRANSFORMER units; the
+framework serves it over REST and gRPC with a `SeldonMessage`-compatible tensor
+API.  Unlike the reference's Java microservice mesh (one engine pod fanning out
+HTTP/gRPC hops per graph node), this framework *compiles* the inference graph:
+when every node is a pure JAX callable the whole graph lowers to one XLA
+program on a TPU mesh — ensembles fan out across chips and reduce over ICI,
+routing happens via `lax.switch`, and network hops exist only at ingress.
+
+Layout:
+  messages        core data plane (SeldonMessage, Meta, Feedback, codecs)
+  graph/          graph spec (CRD-equivalent), defaulting/validation,
+                  host interpreter + compiled-graph executor, built-in units
+  runtime/        model-wrapper runtime, REST/gRPC servers, engine service,
+                  internal clients, batching
+  gateway/        ingress gateway (auth, deployment routing, firehose log)
+  operator/       deployment materializer (local process equivalent of the
+                  reference's k8s operator)
+  parallel/       device-mesh management, ensemble sharding, ring attention,
+                  collectives
+  models/         example / judged-workload model families
+  ops/            Pallas TPU kernels
+  utils/          metrics, puid, tracing, config
+"""
+
+__version__ = "0.1.0"
+
+from seldon_core_tpu.messages import (  # noqa: F401
+    DefaultData,
+    Feedback,
+    Meta,
+    SeldonMessage,
+    SeldonMessageList,
+    Status,
+)

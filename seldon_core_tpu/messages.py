@@ -1,0 +1,473 @@
+"""Core data-plane messages for the TPU inference-graph framework.
+
+Re-designs the reference wire contract (reference: proto/prediction.proto:12-69)
+as Python dataclasses whose tensor payloads are *device-resident arrays* rather
+than `repeated double` protos: the array is materialised to JSON/proto only at
+a network edge, so an in-process graph hop moves zero bytes host-side.
+
+Semantics mirrored from the reference:
+  * ``SeldonMessage{status, meta, data_oneof{data|binData|strData}}``
+    (proto/prediction.proto:12-22)
+  * ``DefaultData{names, tensor|ndarray}`` oneof — and the rule that a
+    response preserves the *kind* of the request payload
+    (engine PredictorUtils.java:127-166 ``updateData``)
+  * ``Tensor{shape:int32[], values:double[]}`` flattened row-major
+    (proto/prediction.proto:31-34)
+  * ``Meta{puid, tags, routing}`` — tags merge across graph nodes, routing
+    records the branch each ROUTER chose (proto/prediction.proto:19-24,
+    engine PredictiveUnitBean.java:252-264)
+  * ``Feedback{request, response, reward, truth}`` (proto/prediction.proto:55-60)
+  * puid: 130-bit random, base32, lowercase (engine PredictionService.java:52-58)
+
+JSON field names are camelCase, matching the reference's protobuf JsonFormat
+output, so clients of the reference can talk to this framework unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import secrets
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "Status",
+    "Meta",
+    "DefaultData",
+    "SeldonMessage",
+    "SeldonMessageList",
+    "Feedback",
+    "SeldonMessageError",
+    "new_puid",
+]
+
+ArrayLike = Any  # np.ndarray | jax.Array | nested lists
+
+
+class SeldonMessageError(ValueError):
+    """Malformed message payload (maps to a FAILURE Status at the edge)."""
+
+
+# ---------------------------------------------------------------------------
+# puid
+# ---------------------------------------------------------------------------
+
+_BASE32 = "abcdefghijklmnopqrstuvwxyz234567"
+
+
+def new_puid() -> str:
+    """130-bit random id, base32 lowercase — same shape as the reference's
+    ``PuidGenerator`` (engine PredictionService.java:52-58)."""
+    n = secrets.randbits(130)
+    chars = []
+    for _ in range(26):  # 26 * 5 = 130 bits
+        chars.append(_BASE32[n & 31])
+        n >>= 5
+    return "".join(reversed(chars))
+
+
+# ---------------------------------------------------------------------------
+# Status
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Status:
+    """Mirrors ``Status{code, info, reason, status}`` (proto/prediction.proto:24-29)."""
+
+    code: int = 200
+    info: str = ""
+    reason: str = ""
+    status: str = "SUCCESS"  # SUCCESS | FAILURE
+
+    @staticmethod
+    def failure(info: str, code: int = 400, reason: str = "") -> "Status":
+        return Status(code=code, info=info, reason=reason, status="FAILURE")
+
+    def to_json_dict(self) -> dict:
+        out: dict = {"code": self.code, "status": self.status}
+        if self.info:
+            out["info"] = self.info
+        if self.reason:
+            out["reason"] = self.reason
+        return out
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any]) -> "Status":
+        try:
+            return Status(
+                code=int(d.get("code", 0) or 0),
+                info=str(d.get("info", "") or ""),
+                reason=str(d.get("reason", "") or ""),
+                status=str(d.get("status", "SUCCESS") or "SUCCESS"),
+            )
+        except (TypeError, ValueError, AttributeError) as e:
+            raise SeldonMessageError(f"malformed status: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# Meta
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Meta:
+    """Request metadata carried across every graph hop.
+
+    ``routing`` maps node-name -> child index chosen by that ROUTER (-1 means
+    broadcast); the feedback pass replays it so only the branch that served a
+    request gets trained (engine PredictiveUnitBean.java:141-149).
+    ``tags`` accumulate across nodes (later writers win on key conflict,
+    engine PredictiveUnitBean.java:252-264).
+    """
+
+    puid: str = ""
+    tags: dict = field(default_factory=dict)
+    routing: dict = field(default_factory=dict)  # node name -> int branch
+    requestPath: dict = field(default_factory=dict)  # node name -> impl id
+
+    def merged_with(self, other: "Meta") -> "Meta":
+        """Merge semantics of the reference engine: child meta merged into
+        parent, other's entries winning (PredictiveUnitBean.java:252-264)."""
+        return Meta(
+            puid=other.puid or self.puid,
+            tags={**self.tags, **other.tags},
+            routing={**self.routing, **other.routing},
+            requestPath={**self.requestPath, **other.requestPath},
+        )
+
+    def to_json_dict(self) -> dict:
+        out: dict = {"puid": self.puid}
+        if self.tags:
+            out["tags"] = dict(self.tags)
+        if self.routing:
+            out["routing"] = {k: int(v) for k, v in self.routing.items()}
+        if self.requestPath:
+            out["requestPath"] = dict(self.requestPath)
+        return out
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any]) -> "Meta":
+        try:
+            return Meta(
+                puid=str(d.get("puid", "") or ""),
+                tags=dict(d.get("tags", {}) or {}),
+                routing={k: int(v) for k, v in (d.get("routing", {}) or {}).items()},
+                requestPath=dict(d.get("requestPath", {}) or {}),
+            )
+        except (TypeError, ValueError, AttributeError) as e:
+            raise SeldonMessageError(f"malformed meta: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# DefaultData — the tensor payload
+# ---------------------------------------------------------------------------
+
+
+def _to_numpy(arr: ArrayLike) -> np.ndarray:
+    """Materialise to host numpy (only used at serialization edges)."""
+    if isinstance(arr, np.ndarray):
+        return arr
+    # jax.Array exposes __array__; nested lists go through np.asarray too.
+    return np.asarray(arr)
+
+
+@dataclass
+class DefaultData:
+    """Named tensor payload.
+
+    ``kind`` is "tensor" (flat values + shape) or "ndarray" (nested lists) —
+    the JSON oneof of the reference (proto/prediction.proto:38-45).  The
+    in-memory representation is always a single array (numpy or jax); ``kind``
+    only controls the wire form, and is preserved request->response like the
+    reference's ``updateData`` (engine PredictorUtils.java:127-166).
+    """
+
+    array: ArrayLike = None
+    names: list = field(default_factory=list)
+    kind: str = "tensor"  # "tensor" | "ndarray"
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_array(
+        arr: ArrayLike, names: Optional[Sequence[str]] = None, kind: str = "tensor"
+    ) -> "DefaultData":
+        return DefaultData(array=arr, names=list(names or []), kind=kind)
+
+    # -- access -------------------------------------------------------------
+
+    def numpy(self) -> np.ndarray:
+        if self.array is None:
+            raise SeldonMessageError("DefaultData has no array payload")
+        return _to_numpy(self.array)
+
+    @property
+    def shape(self):
+        return tuple(np.shape(self.array)) if self.array is not None else ()
+
+    def with_array(self, arr: ArrayLike, names: Optional[Sequence[str]] = None) -> "DefaultData":
+        """New payload keeping this payload's wire kind (and names unless
+        overridden) — response-preserves-request-kind rule."""
+        return DefaultData(
+            array=arr,
+            names=list(names) if names is not None else list(self.names),
+            kind=self.kind,
+        )
+
+    # -- codecs -------------------------------------------------------------
+
+    def to_json_dict(self) -> dict:
+        out: dict = {}
+        if self.names:
+            out["names"] = list(self.names)
+        a = self.numpy()
+        if self.kind == "ndarray":
+            out["ndarray"] = a.tolist()
+        else:
+            out["tensor"] = {
+                "shape": [int(s) for s in a.shape],
+                "values": a.reshape(-1).astype(np.float64).tolist(),
+            }
+        return out
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any], dtype=np.float64) -> "DefaultData":
+        names = list(d.get("names", []) or [])
+        if "tensor" in d:
+            t = d["tensor"]
+            if not isinstance(t, Mapping) or "values" not in t:
+                raise SeldonMessageError("data.tensor must have 'shape' and 'values'")
+            values = np.asarray(t.get("values", []), dtype=dtype)
+            shape = [int(s) for s in t.get("shape", [values.size])]
+            try:
+                arr = values.reshape(shape)
+            except ValueError as e:
+                raise SeldonMessageError(f"tensor shape {shape} != #values {values.size}") from e
+            return DefaultData(array=arr, names=names, kind="tensor")
+        if "ndarray" in d:
+            try:
+                arr = np.asarray(d["ndarray"], dtype=dtype)
+            except (ValueError, TypeError):
+                # ragged / mixed-type ndarray: keep as object array (the
+                # reference's ListValue permits heterogenous entries)
+                arr = np.asarray(d["ndarray"], dtype=object)
+            return DefaultData(array=arr, names=names, kind="ndarray")
+        raise SeldonMessageError("data must contain 'tensor' or 'ndarray'")
+
+
+# ---------------------------------------------------------------------------
+# SeldonMessage
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SeldonMessage:
+    """The unit of exchange on every graph hop (proto/prediction.proto:12-22).
+
+    Exactly one of ``data`` / ``bin_data`` / ``str_data`` is set (the data
+    oneof); all three may be None for metadata-only messages (e.g. feedback
+    acks).
+    """
+
+    data: Optional[DefaultData] = None
+    bin_data: Optional[bytes] = None
+    str_data: Optional[str] = None
+    meta: Meta = field(default_factory=Meta)
+    status: Optional[Status] = None
+
+    # -- construction helpers ----------------------------------------------
+
+    @staticmethod
+    def from_array(
+        arr: ArrayLike,
+        names: Optional[Sequence[str]] = None,
+        kind: str = "tensor",
+        meta: Optional[Meta] = None,
+    ) -> "SeldonMessage":
+        return SeldonMessage(
+            data=DefaultData.from_array(arr, names, kind), meta=meta or Meta()
+        )
+
+    @staticmethod
+    def failure(info: str, code: int = 400, meta: Optional[Meta] = None) -> "SeldonMessage":
+        return SeldonMessage(status=Status.failure(info, code=code), meta=meta or Meta())
+
+    # -- oneof accessors ----------------------------------------------------
+
+    @property
+    def data_kind(self) -> str:
+        if self.data is not None:
+            return "data"
+        if self.bin_data is not None:
+            return "binData"
+        if self.str_data is not None:
+            return "strData"
+        return "empty"
+
+    def array(self) -> np.ndarray:
+        if self.data is None:
+            raise SeldonMessageError("message has no DefaultData payload")
+        return self.data.numpy()
+
+    def names(self) -> list:
+        return list(self.data.names) if self.data is not None else []
+
+    def with_array(self, arr: ArrayLike, names: Optional[Sequence[str]] = None) -> "SeldonMessage":
+        """Response builder: new array, preserved payload kind/meta."""
+        if self.data is not None:
+            new_data = self.data.with_array(arr, names)
+        else:
+            new_data = DefaultData.from_array(arr, names)
+        return SeldonMessage(data=new_data, meta=self.meta, status=self.status)
+
+    # -- codecs -------------------------------------------------------------
+
+    def to_json_dict(self) -> dict:
+        out: dict = {"meta": self.meta.to_json_dict()}
+        if self.status is not None:
+            out["status"] = self.status.to_json_dict()
+        if self.data is not None:
+            out["data"] = self.data.to_json_dict()
+        elif self.bin_data is not None:
+            import base64
+
+            out["binData"] = base64.b64encode(self.bin_data).decode("ascii")
+        elif self.str_data is not None:
+            out["strData"] = self.str_data
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), separators=(",", ":"))
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any], dtype=np.float64) -> "SeldonMessage":
+        if not isinstance(d, Mapping):
+            raise SeldonMessageError("SeldonMessage JSON must be an object")
+        # Protobuf JsonFormat treats explicit nulls as absent fields — do the same.
+        meta = d.get("meta") or {}
+        if not isinstance(meta, Mapping):
+            raise SeldonMessageError("meta must be an object")
+        status = d.get("status")
+        if status is not None and not isinstance(status, Mapping):
+            raise SeldonMessageError("status must be an object")
+        msg = SeldonMessage(
+            meta=Meta.from_json_dict(meta),
+            status=Status.from_json_dict(status) if status is not None else None,
+        )
+        if d.get("data") is not None:
+            data = d["data"]
+            if not isinstance(data, Mapping):
+                raise SeldonMessageError("data must be an object")
+            msg.data = DefaultData.from_json_dict(data, dtype=dtype)
+        elif d.get("binData") is not None:
+            import base64
+            import binascii
+
+            try:
+                msg.bin_data = base64.b64decode(d["binData"], validate=True)
+            except (binascii.Error, TypeError, ValueError) as e:
+                raise SeldonMessageError(f"binData is not valid base64: {e}") from e
+        elif d.get("strData") is not None:
+            msg.str_data = str(d["strData"])
+        return msg
+
+    @staticmethod
+    def from_json(s: Union[str, bytes], dtype=np.float64) -> "SeldonMessage":
+        try:
+            d = json.loads(s)
+        except json.JSONDecodeError as e:
+            raise SeldonMessageError(f"invalid JSON: {e}") from e
+        return SeldonMessage.from_json_dict(d, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# SeldonMessageList / Feedback
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SeldonMessageList:
+    """COMBINER input: one message per child branch (proto/prediction.proto:51-53)."""
+
+    messages: list = field(default_factory=list)
+
+    def to_json_dict(self) -> dict:
+        return {"seldonMessages": [m.to_json_dict() for m in self.messages]}
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any], dtype=np.float64) -> "SeldonMessageList":
+        if not isinstance(d, Mapping):
+            raise SeldonMessageError("SeldonMessageList JSON must be an object")
+        return SeldonMessageList(
+            messages=[
+                SeldonMessage.from_json_dict(m, dtype=dtype)
+                for m in d.get("seldonMessages", []) or []
+            ]
+        )
+
+    @staticmethod
+    def from_json(s: Union[str, bytes], dtype=np.float64) -> "SeldonMessageList":
+        try:
+            d = json.loads(s)
+        except json.JSONDecodeError as e:
+            raise SeldonMessageError(f"invalid JSON: {e}") from e
+        return SeldonMessageList.from_json_dict(d, dtype=dtype)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), separators=(",", ":"))
+
+
+@dataclass
+class Feedback:
+    """Online-learning signal (proto/prediction.proto:55-60): the original
+    request/response pair plus a scalar reward and optional ground truth."""
+
+    request: Optional[SeldonMessage] = None
+    response: Optional[SeldonMessage] = None
+    reward: float = 0.0
+    truth: Optional[SeldonMessage] = None
+
+    def to_json_dict(self) -> dict:
+        out: dict = {"reward": float(self.reward)}
+        if self.request is not None:
+            out["request"] = self.request.to_json_dict()
+        if self.response is not None:
+            out["response"] = self.response.to_json_dict()
+        if self.truth is not None:
+            out["truth"] = self.truth.to_json_dict()
+        return out
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any], dtype=np.float64) -> "Feedback":
+        if not isinstance(d, Mapping):
+            raise SeldonMessageError("Feedback JSON must be an object")
+
+        def _msg(key: str) -> Optional[SeldonMessage]:
+            v = d.get(key)
+            return SeldonMessage.from_json_dict(v, dtype=dtype) if v is not None else None
+
+        try:
+            reward = float(d.get("reward", 0.0) or 0.0)
+        except (TypeError, ValueError) as e:
+            raise SeldonMessageError(f"malformed reward: {e}") from e
+        return Feedback(
+            request=_msg("request"),
+            response=_msg("response"),
+            reward=reward,
+            truth=_msg("truth"),
+        )
+
+    @staticmethod
+    def from_json(s: Union[str, bytes], dtype=np.float64) -> "Feedback":
+        try:
+            d = json.loads(s)
+        except json.JSONDecodeError as e:
+            raise SeldonMessageError(f"invalid JSON: {e}") from e
+        return Feedback.from_json_dict(d, dtype=dtype)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), separators=(",", ":"))

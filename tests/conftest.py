@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU platform so multi-chip
+sharding tests run without TPU hardware (the reference's minikube-based
+multi-node strategy, SURVEY.md §4, mapped to JAX's host-platform device
+simulation).
+
+Note: the environment's sitecustomize imports jax at interpreter startup, so
+env vars (JAX_PLATFORMS / XLA_FLAGS) are too late here — we must use
+jax.config.update before any backend is initialised.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses we spawn
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]
