@@ -1,0 +1,16 @@
+"""Inference-graph layer: spec (CRD-equivalent), defaulting/validation,
+built-in units, host interpreter and compiled-graph executor."""
+
+from seldon_core_tpu.graph.spec import (  # noqa: F401
+    ComponentBinding,
+    Endpoint,
+    EndpointType,
+    GraphSpecError,
+    Parameter,
+    PredictiveUnit,
+    PredictorSpec,
+    SeldonDeploymentSpec,
+    UnitImplementation,
+    UnitMethod,
+    UnitType,
+)

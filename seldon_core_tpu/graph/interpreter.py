@@ -1,0 +1,434 @@
+"""Host-mode graph interpreter — the engine's request loop.
+
+Async recursive evaluation of the inference graph with exactly the reference
+engine's semantics (engine PredictiveUnitBean.java:58-168):
+
+    transform_input -> route (-1 = broadcast) -> children concurrently
+        -> aggregate -> transform_output
+
+with per-node routing recorded into ``meta.routing``, tags merged across
+nodes (later writers win), and the feedback pass replaying ``meta.routing``
+so only the branch that served a request is trained.
+
+This interpreter is the *host* path: any node may be an in-process JAX unit
+or a remote microservice (a ``NodeRuntime``).  When every node is in-process
+and pure, use ``graph.compiled.CompiledGraph`` instead, which lowers the whole
+recursion into one XLA program — this module is then only the fallback for
+graphs that genuinely span processes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.messages import Feedback, Meta, SeldonMessage, Status
+from seldon_core_tpu.graph.spec import (
+    ComponentBinding,
+    GraphSpecError,
+    PredictiveUnit,
+    PredictorSpec,
+    UnitImplementation,
+    UnitMethod,
+    UnitType,
+)
+from seldon_core_tpu.graph.units import (
+    Unit,
+    UNIT_REGISTRY,
+    normalize_output,
+    resolve_unit_class,
+)
+from seldon_core_tpu.graph.spec import params_to_kwargs
+
+__all__ = [
+    "NodeRuntime",
+    "InProcessNodeRuntime",
+    "GraphExecutor",
+    "methods_for",
+    "unit_rngs",
+]
+
+
+def unit_rngs(names, rng=None):
+    """Deterministic per-unit PRNG keys, shared convention between the host
+    interpreter and the compiled executor so routing decisions are identical
+    in both modes for a given seed."""
+    import jax
+
+    if rng is None:
+        rng = jax.random.key(0)
+    ordered = sorted(names)
+    keys = jax.random.split(rng, max(len(ordered), 1))
+    return {name: keys[i] for i, name in enumerate(ordered)}
+
+
+# ---------------------------------------------------------------------------
+# Method dispatch table (engine PredictorConfigBean.java:33-82)
+# ---------------------------------------------------------------------------
+
+_TYPE_METHODS = {
+    UnitType.MODEL: [UnitMethod.TRANSFORM_INPUT],
+    UnitType.ROUTER: [UnitMethod.ROUTE, UnitMethod.SEND_FEEDBACK],
+    UnitType.COMBINER: [UnitMethod.AGGREGATE],
+    UnitType.TRANSFORMER: [UnitMethod.TRANSFORM_INPUT],
+    UnitType.OUTPUT_TRANSFORMER: [UnitMethod.TRANSFORM_OUTPUT],
+}
+
+_IMPL_TYPES = {
+    UnitImplementation.SIMPLE_MODEL: UnitType.MODEL,
+    UnitImplementation.SIMPLE_ROUTER: UnitType.ROUTER,
+    UnitImplementation.RANDOM_ABTEST: UnitType.ROUTER,
+    UnitImplementation.AVERAGE_COMBINER: UnitType.COMBINER,
+}
+
+
+def effective_type(node: PredictiveUnit) -> Optional[UnitType]:
+    if node.type is not None:
+        return node.type
+    return _IMPL_TYPES.get(node.implementation)
+
+
+def methods_for(node: PredictiveUnit) -> List[UnitMethod]:
+    """Explicit ``methods`` win; otherwise the type's default set."""
+    if node.methods is not None:
+        return list(node.methods)
+    t = effective_type(node)
+    return list(_TYPE_METHODS.get(t, []))
+
+
+def pythonize_tags(tags: Dict[str, Any]) -> Dict[str, Any]:
+    """Convert traced/array tag values to JSON-safe python values."""
+    out: Dict[str, Any] = {}
+    for k, v in (tags or {}).items():
+        a = np.asarray(v)
+        if a.ndim == 0:
+            out[k] = a.item()
+        else:
+            out[k] = a.tolist()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Node runtimes
+# ---------------------------------------------------------------------------
+
+
+class NodeRuntime:
+    """Transport-agnostic node interface: what the engine's
+    ``InternalPredictionService`` is to the reference (per-node outbound
+    calls, engine InternalPredictionService.java:132-203)."""
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        raise NotImplementedError
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        raise NotImplementedError
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        raise NotImplementedError
+
+    async def route(self, msg: SeldonMessage) -> int:
+        raise NotImplementedError
+
+    async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
+        raise NotImplementedError
+
+    async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        raise NotImplementedError
+
+
+class InProcessNodeRuntime(NodeRuntime):
+    """A graph node backed by an in-process JAX ``Unit``.
+
+    Holds the unit's state pytree and threads it through every call — the
+    functional replacement for the reference wrappers' mutable user objects
+    (wrappers/python/persistence.py kept those alive via Redis pickling; here
+    state is an explicit pytree, checkpointable via orbax)."""
+
+    def __init__(self, node: PredictiveUnit, unit: Unit, rng=None):
+        self.node = node
+        self.unit = unit
+        self.state = unit.init_state(rng)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _respond(self, req: SeldonMessage, y, tags) -> SeldonMessage:
+        names = self.unit.class_names if self.unit.class_names is not None else None
+        resp = req.with_array(y, names=names)
+        all_tags = dict(self.unit.static_tags or {})
+        all_tags.update(pythonize_tags(tags))
+        if all_tags:
+            resp.meta = Meta(
+                puid=req.meta.puid,
+                tags={**req.meta.tags, **all_tags},
+                routing=dict(req.meta.routing),
+                requestPath=dict(req.meta.requestPath),
+            )
+        return resp
+
+    def _input_array(self, msg: SeldonMessage):
+        return jnp.asarray(msg.array())
+
+    def _call(self, method: str, msg: SeldonMessage, X):
+        """Dispatch to the unit; units with ``accepts_names = True`` (the
+        reference-style user-object adapter) also receive feature names."""
+        fn = getattr(self.unit, method)
+        if getattr(self.unit, "accepts_names", False):
+            return fn(self.state, X, msg.names())
+        return fn(self.state, X)
+
+    # -- NodeRuntime API ----------------------------------------------------
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        out = self._call("predict", msg, self._input_array(msg))
+        y, self.state, tags = normalize_output(out, self.state)
+        return self._respond(msg, y, tags)
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        out = self._call("transform_input", msg, self._input_array(msg))
+        y, self.state, tags = normalize_output(out, self.state)
+        return self._respond(msg, y, tags)
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        out = self._call("transform_output", msg, self._input_array(msg))
+        y, self.state, tags = normalize_output(out, self.state)
+        return self._respond(msg, y, tags)
+
+    async def route(self, msg: SeldonMessage) -> int:
+        out = self._call("route", msg, self._input_array(msg))
+        branch, self.state, _ = normalize_output(out, self.state)
+        return int(np.asarray(branch))
+
+    async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
+        arrays = [jnp.asarray(m.array()) for m in msgs]
+        shapes = {tuple(a.shape) for a in arrays}
+        if len(shapes) != 1:
+            # the reference's per-row shape check (AverageCombinerUnit.java:44-68)
+            raise GraphSpecError(
+                f"combiner {self.node.name!r}: child output shapes differ: {sorted(shapes)}"
+            )
+        if getattr(self.unit, "accepts_names", False):
+            out = self.unit.aggregate(
+                self.state, jnp.stack(arrays, axis=0), [m.names() for m in msgs]
+            )
+        else:
+            out = self.unit.aggregate(self.state, jnp.stack(arrays, axis=0))
+        y, self.state, tags = normalize_output(out, self.state)
+        return self._respond(msgs[0], y, tags)
+
+    async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        X = None
+        names: list = []
+        if feedback.request is not None and feedback.request.data is not None:
+            X = jnp.asarray(feedback.request.array())
+            names = feedback.request.names()
+        truth = None
+        if feedback.truth is not None and feedback.truth.data is not None:
+            truth = jnp.asarray(feedback.truth.array())
+        if getattr(self.unit, "accepts_names", False):
+            self.state = self.unit.send_feedback(
+                self.state, X, branch, feedback.reward, truth, names
+            )
+        else:
+            self.state = self.unit.send_feedback(
+                self.state, X, branch, feedback.reward, truth
+            )
+
+
+# ---------------------------------------------------------------------------
+# Graph executor
+# ---------------------------------------------------------------------------
+
+
+def _impl_unit(node: PredictiveUnit) -> Optional[Unit]:
+    """Instantiate a hardcoded implementation (the engine's built-in beans)."""
+    if node.implementation is UnitImplementation.UNKNOWN_IMPLEMENTATION:
+        return None
+    cls = UNIT_REGISTRY.get(node.implementation.value)
+    if cls is None:
+        raise GraphSpecError(f"no registered unit for {node.implementation.value}")
+    return cls(**params_to_kwargs(node.parameters))
+
+
+class GraphExecutor:
+    """Builds per-node runtimes from a PredictorSpec and executes the graph —
+    the reference's PredictorBean + PredictiveUnitBean pair
+    (engine PredictorBean.java:50-80, PredictiveUnitBean.java:58-168)."""
+
+    def __init__(
+        self,
+        predictor: PredictorSpec,
+        extra_runtimes: Optional[Dict[str, NodeRuntime]] = None,
+        rng=None,
+    ):
+        self.predictor = predictor
+        self.runtimes: Dict[str, NodeRuntime] = {}
+        comp_map = predictor.component_map()
+        rngs = unit_rngs([u.name for u in predictor.graph.walk()], rng)
+        for node in predictor.graph.walk():
+            if extra_runtimes and node.name in extra_runtimes:
+                self.runtimes[node.name] = extra_runtimes[node.name]
+                continue
+            unit = _impl_unit(node)
+            if unit is not None:
+                self.runtimes[node.name] = InProcessNodeRuntime(
+                    node, unit, rngs[node.name]
+                )
+                continue
+            binding = comp_map.get(node.name)
+            if binding is None:
+                raise GraphSpecError(
+                    f"node {node.name!r} has no implementation, binding, or runtime"
+                )
+            if binding.runtime == "inprocess":
+                cls = resolve_unit_class(binding.class_path)
+                params = params_to_kwargs(binding.parameters or node.parameters)
+                self.runtimes[node.name] = InProcessNodeRuntime(
+                    node, cls(**params), rngs[node.name]
+                )
+            else:
+                # remote runtimes are attached by the engine service
+                # (runtime/client.py) via extra_runtimes
+                raise GraphSpecError(
+                    f"node {node.name!r} is remote ({binding.runtime}) but no "
+                    f"remote runtime was provided"
+                )
+
+    # -- predict path -------------------------------------------------------
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        out = await self._get_output(self.predictor.graph, msg)
+        # puid is preserved onto the final response (PredictionService.java:69-90)
+        out.meta.puid = msg.meta.puid
+        if out.status is None:
+            out.status = Status()
+        return out
+
+    async def _get_output(
+        self, node: PredictiveUnit, msg: SeldonMessage
+    ) -> SeldonMessage:
+        methods = methods_for(node)
+        rt = self.runtimes[node.name]
+
+        # 1. transform input (MODEL dispatches its predict here, mirroring
+        #    InternalPredictionService.transformInput's type switch,
+        #    engine InternalPredictionService.java:132-161)
+        if UnitMethod.TRANSFORM_INPUT in methods:
+            if effective_type(node) is UnitType.MODEL:
+                msg = await rt.predict(msg)
+            else:
+                msg = await rt.transform_input(msg)
+
+        # 2. route + children (engine PredictiveUnitBean.java:91-112)
+        if node.children:
+            if UnitMethod.ROUTE in methods:
+                branch = await rt.route(msg)
+                if branch >= len(node.children) or branch < -1:
+                    # routing sanity check (PredictiveUnitBean.java:244-250);
+                    # -1 means broadcast, other negatives are bugs (python
+                    # negative indexing must never pick a child silently)
+                    raise GraphSpecError(
+                        f"router {node.name!r} chose branch {branch} but has "
+                        f"{len(node.children)} children"
+                    )
+                msg.meta.routing[node.name] = branch
+                selected = node.children if branch == -1 else [node.children[branch]]
+            else:
+                selected = node.children
+
+            child_msgs = await asyncio.gather(
+                *[self._get_output(c, _fork_message(msg)) for c in selected]
+            )
+
+            # 3. merge (engine PredictiveUnitBean.java:115-124)
+            if UnitMethod.AGGREGATE in methods:
+                merged_meta = msg.meta
+                for cm in child_msgs:
+                    merged_meta = merged_meta.merged_with(cm.meta)
+                out = await rt.aggregate(list(child_msgs))
+                out.meta = merged_meta.merged_with(out.meta)
+            else:
+                if len(child_msgs) != 1:
+                    raise GraphSpecError(
+                        f"node {node.name!r} fanned out to {len(child_msgs)} children "
+                        f"but has no AGGREGATE method to merge them"
+                    )
+                out = child_msgs[0]
+                out.meta = msg.meta.merged_with(out.meta)
+        else:
+            out = msg
+
+        # 4. transform output (engine PredictiveUnitBean.java:115-124)
+        if UnitMethod.TRANSFORM_OUTPUT in methods:
+            out = await rt.transform_output(out)
+        return out
+
+    # -- feedback path ------------------------------------------------------
+
+    async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+        await self._send_feedback(self.predictor.graph, feedback)
+        ack = SeldonMessage(status=Status())
+        if feedback.response is not None:
+            ack.meta.puid = feedback.response.meta.puid
+        return ack
+
+    async def _send_feedback(self, node: PredictiveUnit, feedback: Feedback) -> None:
+        methods = methods_for(node)
+        rt = self.runtimes[node.name]
+        routing = (
+            feedback.response.meta.routing if feedback.response is not None else {}
+        )
+        branch = int(routing.get(node.name, -1))
+
+        if UnitMethod.SEND_FEEDBACK in methods:
+            await rt.send_feedback(feedback, branch)
+
+        if not node.children:
+            return
+        if UnitMethod.ROUTE in methods:
+            # replay the recorded route: only the serving branch trains
+            # (engine PredictiveUnitBean.java:141-149)
+            if branch >= len(node.children) or branch < -1:
+                raise GraphSpecError(
+                    f"feedback routing for {node.name!r} names branch {branch} "
+                    f"but node has {len(node.children)} children"
+                )
+            selected = node.children if branch == -1 else [node.children[branch]]
+        else:
+            selected = node.children
+        await asyncio.gather(*[self._send_feedback(c, feedback) for c in selected])
+
+    # -- state access (persistence / compiled-mode handoff) -----------------
+
+    def states(self) -> Dict[str, Any]:
+        return {
+            name: rt.state
+            for name, rt in self.runtimes.items()
+            if isinstance(rt, InProcessNodeRuntime) and rt.state is not None
+        }
+
+    def load_states(self, states: Dict[str, Any]) -> None:
+        for name, st in states.items():
+            rt = self.runtimes.get(name)
+            if isinstance(rt, InProcessNodeRuntime):
+                rt.state = st
+
+
+def _fork_message(msg: SeldonMessage) -> SeldonMessage:
+    """Child calls get their own meta copy so sibling branches can't race on
+    the shared dicts; merge happens explicitly afterwards."""
+    return SeldonMessage(
+        data=msg.data,
+        bin_data=msg.bin_data,
+        str_data=msg.str_data,
+        meta=Meta(
+            puid=msg.meta.puid,
+            tags=dict(msg.meta.tags),
+            routing=dict(msg.meta.routing),
+            requestPath=dict(msg.meta.requestPath),
+        ),
+        status=msg.status,
+    )

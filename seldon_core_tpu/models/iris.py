@@ -1,0 +1,89 @@
+"""Iris classifier — the reference's canonical single-MODEL REST workload
+(examples/models/sklearn_iris/IrisClassifier.py:1-9: joblib-loaded sklearn
+model answering predict_proba).
+
+TPU-native version: a softmax-regression trained in JAX at construction time
+on the classic iris dataset (bundled with scikit-learn, no network).  Serving
+is a single fused matmul + softmax."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
+
+__all__ = ["IrisClassifier"]
+
+
+def _load_iris():
+    try:
+        from sklearn.datasets import load_iris
+
+        ds = load_iris()
+        return (
+            np.asarray(ds.data, np.float32),
+            np.asarray(ds.target, np.int32),
+            [str(n) for n in ds.target_names],
+        )
+    except Exception:  # pragma: no cover - sklearn always present in CI image
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(150, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.int32)
+        return X, y, ["t:0", "t:1", "t:2"]
+
+
+@register_unit("IrisClassifier")
+class IrisClassifier(Unit):
+    """Multinomial logistic regression; `predict` returns class probabilities
+    (the reference's predict_proba contract)."""
+
+    def __init__(self, steps: int = 200, lr: float = 0.5, seed: int = 0):
+        X, y, names = _load_iris()
+        self.class_names = names
+        # standardise features; keep the scaler in the unit for serving
+        self._mu = X.mean(axis=0)
+        self._sigma = X.std(axis=0) + 1e-6
+        Xn = (X - self._mu) / self._sigma
+        n_classes = int(y.max()) + 1
+
+        def loss(params):
+            logits = Xn @ params["w"] + params["b"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(logp[jnp.arange(Xn.shape[0]), y])
+
+        key = jax.random.key(seed)
+        params = {
+            "w": 0.01 * jax.random.normal(key, (Xn.shape[1], n_classes), jnp.float32),
+            "b": jnp.zeros((n_classes,), jnp.float32),
+        }
+
+        @jax.jit
+        def fit(params):
+            def step(p, _):
+                g = jax.grad(loss)(p)
+                return (
+                    jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g),
+                    None,
+                )
+
+            params, _ = jax.lax.scan(step, params, None, length=steps)
+            return params
+
+        self._params = jax.device_get(fit(params))
+        self._train_accuracy = float(
+            np.mean(np.argmax(Xn @ self._params["w"] + self._params["b"], axis=1) == y)
+        )
+
+    def init_state(self, rng):
+        return {
+            "w": jnp.asarray(self._params["w"]),
+            "b": jnp.asarray(self._params["b"]),
+            "mu": jnp.asarray(self._mu),
+            "sigma": jnp.asarray(self._sigma),
+        }
+
+    def predict(self, state, X):
+        Xn = (X - state["mu"]) / state["sigma"]
+        return jax.nn.softmax(Xn @ state["w"] + state["b"], axis=-1)

@@ -1,0 +1,73 @@
+"""Epsilon-greedy multi-armed-bandit router — parity with the reference's
+canonical ROUTER example (examples/routers/epsilon_greedy/EpsilonGreedy.py:12-61):
+
+  * ``route``: with probability 1-epsilon exploit the best branch, otherwise
+    explore uniformly among the *other* branches (the reference never
+    explores the current best).
+  * ``send_feedback``: reward in [0,1] over a batch of n rows counts as
+    ``int(reward*n)`` successes / rest failures on the routed branch; the
+    best branch is argmax of Laplace-smoothed success ratio
+    ``(success+1)/(tries+1)``.
+
+TPU-native: all state (success/tries counters + PRNG key) is an explicit
+pytree; route and feedback are pure and traceable, so the whole bandit runs
+inside the compiled graph program and online learning is an on-device state
+transition replayed from ``meta.routing`` (engine PredictiveUnitBean.java:141-149).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
+
+__all__ = ["EpsilonGreedyRouter"]
+
+
+@register_unit("EpsilonGreedyRouter")
+class EpsilonGreedyRouter(Unit):
+    def __init__(self, n_branches: int = None, epsilon: float = 0.1, seed: int = 0):
+        if n_branches is None:
+            raise ValueError("n_branches parameter must be given")
+        self.n = int(n_branches)
+        self.epsilon = float(epsilon)
+        self.seed = int(seed)
+
+    def init_state(self, rng):
+        if rng is None:
+            rng = jax.random.key(self.seed)
+        return {
+            "success": jnp.zeros((self.n,), jnp.float32),
+            "tries": jnp.zeros((self.n,), jnp.float32),
+            "key": rng,
+        }
+
+    def _best(self, state):
+        return jnp.argmax((state["success"] + 1.0) / (state["tries"] + 1.0)).astype(
+            jnp.int32
+        )
+
+    def route(self, state, X):
+        key, k_explore, k_choice = jax.random.split(state["key"], 3)
+        best = self._best(state)
+        # uniform pick among branches != best:
+        # draw in [0, n-2] and shift past `best`
+        other = jax.random.randint(k_choice, (), 0, max(self.n - 1, 1), jnp.int32)
+        other = other + (other >= best).astype(jnp.int32)
+        explore = jax.random.uniform(k_explore) <= self.epsilon
+        branch = jnp.where(explore, other, best)
+        return branch, UnitAux(state={**state, "key": key})
+
+    def send_feedback(self, state, X, branch, reward, truth):
+        branch = jnp.asarray(branch, jnp.int32)  # host mode passes python ints
+        n_rows = jnp.float32(X.shape[0]) if X is not None else jnp.float32(1.0)
+        n_success = jnp.floor(reward * n_rows)
+        onehot = jax.nn.one_hot(branch, self.n, dtype=jnp.float32)
+        # branch may be -1 (feedback without recorded routing): no-op then
+        valid = (branch >= 0).astype(jnp.float32)
+        return {
+            "success": state["success"] + valid * onehot * n_success,
+            "tries": state["tries"] + valid * onehot * n_rows,
+            "key": state["key"],
+        }

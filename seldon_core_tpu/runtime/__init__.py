@@ -1,0 +1,2 @@
+"""Serving runtime: engine service, REST/gRPC servers, remote-node clients,
+model-wrapper microservice launcher, batching."""

@@ -1,0 +1,131 @@
+"""Remote-node clients — the engine's outbound dispatch.
+
+The reference's ``InternalPredictionService`` builds a NEW gRPC channel per
+call and posts form-encoded JSON per node hop (engine
+InternalPredictionService.java:211-285, a known inefficiency).  Here each
+remote node gets ONE pooled ``aiohttp`` session (keep-alive) reused across
+requests, with a per-node deadline budget like the reference's 5 s gRPC
+deadline (InternalPredictionService.java:77) and model-identity headers
+(``Seldon-model-name`` etc., InternalPredictionService.java:73-75).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.graph.interpreter import NodeRuntime
+from seldon_core_tpu.graph.spec import ComponentBinding, PredictiveUnit
+from seldon_core_tpu.messages import (
+    Feedback,
+    SeldonMessage,
+    SeldonMessageError,
+    SeldonMessageList,
+)
+
+__all__ = ["RestNodeRuntime", "RemoteCallError"]
+
+DEFAULT_TIMEOUT_S = 5.0  # reference TIMEOUT, InternalPredictionService.java:77
+
+
+class RemoteCallError(RuntimeError):
+    def __init__(self, node: str, path: str, detail: str):
+        super().__init__(f"remote node {node!r} {path}: {detail}")
+        self.node = node
+
+
+class RestNodeRuntime(NodeRuntime):
+    """REST microservice client for one graph node (internal API of
+    docs/reference/internal-api.md: /predict, /route, /aggregate,
+    /transform-input, /transform-output, /send-feedback)."""
+
+    def __init__(
+        self,
+        node: PredictiveUnit,
+        binding: ComponentBinding,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+        retries: int = 3,
+    ):
+        import aiohttp
+
+        self.node = node
+        self.binding = binding
+        self.base = f"http://{binding.host or 'localhost'}:{binding.port}"
+        self.timeout_s = timeout_s
+        self.retries = retries
+        image, _, version = (binding.image or "").partition(":")
+        self._headers = {
+            "Seldon-model-name": node.name,
+            "Seldon-model-image": image,
+            "Seldon-model-version": version,
+        }
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def _get_session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s),
+                headers=self._headers,
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def _post(self, path: str, payload: str) -> SeldonMessage:
+        import aiohttp
+
+        session = await self._get_session()
+        last_err = "unknown"
+        for attempt in range(self.retries):  # apife HttpRetryHandler.java:34-45
+            try:
+                async with session.post(
+                    self.base + path, data={"json": payload, "isDefault": "false"}
+                ) as resp:
+                    body = await resp.text()
+                    if resp.status != 200:
+                        raise RemoteCallError(
+                            self.node.name, path, f"HTTP {resp.status}: {body[:200]}"
+                        )
+                    try:
+                        return SeldonMessage.from_json(body)
+                    except SeldonMessageError as e:
+                        raise RemoteCallError(
+                            self.node.name, path, f"bad response: {e}"
+                        ) from e
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                last_err = f"{type(e).__name__}: {e}"
+                await asyncio.sleep(0.01 * (attempt + 1))
+        raise RemoteCallError(self.node.name, path, f"retries exhausted: {last_err}")
+
+    # -- NodeRuntime API ----------------------------------------------------
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._post("/predict", msg.to_json())
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._post("/transform-input", msg.to_json())
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        return await self._post("/transform-output", msg.to_json())
+
+    async def route(self, msg: SeldonMessage) -> int:
+        resp = await self._post("/route", msg.to_json())
+        # branch index extracted from the returned tensor, reference-style
+        # (engine PredictiveUnitBean.java:227-237)
+        try:
+            return int(np.asarray(resp.array()).ravel()[0])
+        except (SeldonMessageError, IndexError, ValueError) as e:
+            raise RemoteCallError(self.node.name, "/route", f"bad branch: {e}") from e
+
+    async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
+        payload = SeldonMessageList(messages=msgs).to_json()
+        return await self._post("/aggregate", payload)
+
+    async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        await self._post("/send-feedback", feedback.to_json())

@@ -1,0 +1,157 @@
+"""Engine service — the per-predictor orchestrator.
+
+The reference injects one Java engine pod per predictor that interprets the
+graph over the network (engine PredictionService.java:69-90,
+PredictiveUnitBean.java:58-168).  This engine instead *chooses an execution
+strategy* per graph:
+
+  * every node in-process + pure  ->  ``CompiledGraph`` — the whole graph is
+    one jitted XLA program on the TPU; per-request overhead is one device
+    dispatch.
+  * any remote/impure node        ->  host ``GraphExecutor`` with async
+    fan-out; remote nodes get pooled REST/gRPC clients (runtime/client.py).
+
+Request handling mirrors the reference: puid assigned if absent and restored
+onto the response (PredictionService.java:52-90), pause/ready gating for
+graceful drain (engine RestClientController.java:57-99), feedback counters
+(PredictiveUnitBean.java:239-242).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from seldon_core_tpu.graph.compiled import CompiledGraph
+from seldon_core_tpu.graph.interpreter import GraphExecutor, NodeRuntime
+from seldon_core_tpu.graph.spec import (
+    GraphSpecError,
+    PredictorSpec,
+    SeldonDeploymentSpec,
+)
+from seldon_core_tpu.messages import (
+    Feedback,
+    SeldonMessage,
+    SeldonMessageError,
+    new_puid,
+)
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+__all__ = ["EngineService"]
+
+
+class EngineService:
+    """One engine per predictor; thread-safe for a single asyncio loop."""
+
+    def __init__(
+        self,
+        deployment: SeldonDeploymentSpec,
+        predictor_name: Optional[str] = None,
+        extra_runtimes: Optional[Dict[str, NodeRuntime]] = None,
+        rng=None,
+        force_host: bool = False,
+    ):
+        self.deployment = deployment
+        self.predictor: PredictorSpec = deployment.predictor(predictor_name)
+        self.metrics = MetricsRegistry(
+            deployment_name=deployment.name,
+            predictor_name=self.predictor.name,
+            project_name=str(deployment.annotations.get("project_name", "")),
+        )
+        self.paused = False
+        # compiled-mode state advances via read-modify-write of
+        # CompiledGraph.states; serialize device dispatches so concurrent
+        # requests can't double-spend a PRNG key or drop a bandit update
+        self._device_lock = asyncio.Lock()
+        self.mode = "host"
+        self.compiled: Optional[CompiledGraph] = None
+        self.executor: Optional[GraphExecutor] = None
+        if not force_host and not extra_runtimes:
+            try:
+                self.compiled = CompiledGraph(self.predictor, rng=rng)
+                self.mode = "compiled"
+            except GraphSpecError:
+                pass
+        if self.compiled is None:
+            self.executor = GraphExecutor(
+                self.predictor, extra_runtimes=extra_runtimes, rng=rng
+            )
+
+    # ------------------------------------------------------------------
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        if not msg.meta.puid:
+            msg.meta.puid = new_puid()
+        with self.metrics.time_server("predictions", "POST") as code:
+            try:
+                if self.compiled is not None:
+                    # device dispatch is synchronous but brief; keep the loop
+                    # responsive by running it in the default executor
+                    async with self._device_lock:
+                        resp = await asyncio.get_running_loop().run_in_executor(
+                            None, self.compiled.predict, msg
+                        )
+                else:
+                    resp = await self.executor.predict(msg)
+            except (SeldonMessageError, GraphSpecError) as e:
+                code["code"] = "400"
+                return SeldonMessage.failure(str(e), code=400, meta=msg.meta)
+            resp.meta.puid = msg.meta.puid
+            return resp
+
+    async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+        with self.metrics.time_server("feedback", "POST") as code:
+            try:
+                if self.compiled is not None:
+                    routing = (
+                        feedback.response.meta.routing
+                        if feedback.response is not None
+                        else {}
+                    )
+                    X = None
+                    if feedback.request is not None and feedback.request.data is not None:
+                        X = feedback.request.array()
+                    truth = None
+                    if feedback.truth is not None and feedback.truth.data is not None:
+                        truth = feedback.truth.array()
+                    async with self._device_lock:
+                        await asyncio.get_running_loop().run_in_executor(
+                            None,
+                            lambda: self.compiled.feedback_arrays(
+                                X, routing, feedback.reward, truth
+                            ),
+                        )
+                    ack = SeldonMessage()
+                    if feedback.response is not None:
+                        ack.meta.puid = feedback.response.meta.puid
+                else:
+                    ack = await self.executor.send_feedback(feedback)
+            except (SeldonMessageError, GraphSpecError) as e:
+                code["code"] = "400"
+                return SeldonMessage.failure(str(e), code=400)
+        self.metrics.record_feedback(feedback.reward)
+        return ack
+
+    # -- admin (engine RestClientController.java:57-99) -----------------
+
+    def ready(self) -> bool:
+        return not self.paused
+
+    def pause(self) -> None:
+        self.paused = True
+
+    def unpause(self) -> None:
+        self.paused = False
+
+    # -- state persistence handoff --------------------------------------
+
+    def states(self):
+        if self.compiled is not None:
+            return dict(self.compiled.states)
+        return self.executor.states()
+
+    def load_states(self, states) -> None:
+        if self.compiled is not None:
+            self.compiled.states.update(states)
+        else:
+            self.executor.load_states(states)

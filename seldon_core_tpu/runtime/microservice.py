@@ -1,0 +1,189 @@
+"""Model-wrapper microservice — serve any user model as a graph node.
+
+Parity with the reference's python wrapper CLI (wrappers/python/
+microservice.py:138-188)::
+
+    python -m seldon_core_tpu.runtime.microservice MyModule:MyModel REST \
+        --service-type MODEL --parameters '[{"name":"x","value":"1","type":"INT"}]'
+
+Env contract (injected by defaulting, graph/defaulting.py):
+  PREDICTIVE_UNIT_SERVICE_PORT (default 5000, microservice.py:14-15)
+  PREDICTIVE_UNIT_PARAMETERS   (JSON list of typed parameters)
+  PREDICTIVE_UNIT_ID / PREDICTOR_ID / SELDON_DEPLOYMENT_ID
+
+Two kinds of user class are accepted:
+  * a ``seldon_core_tpu`` ``Unit`` subclass (JAX-first, traceable), or
+  * a reference-style plain object — ``predict(X, feature_names)``,
+    ``route(features, feature_names)``, ``send_feedback(features,
+    feature_names, routing, reward, truth)``, ``aggregate(features_list,
+    names_list)``, ``transform_input/transform_output(X, names)``,
+    ``score(X, names)`` for OUTLIER_DETECTOR — wrapped by
+    ``UserObjectUnit`` (host-mode only, like every reference wrapper).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Any, List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.graph.interpreter import InProcessNodeRuntime
+from seldon_core_tpu.graph.spec import (
+    Parameter,
+    PredictiveUnit,
+    UnitType,
+    params_to_kwargs,
+)
+from seldon_core_tpu.graph.units import Unit, UnitAux, resolve_unit_class
+
+__all__ = ["UserObjectUnit", "build_unit", "build_runtime", "main"]
+
+SERVICE_TYPES = ("MODEL", "ROUTER", "TRANSFORMER", "COMBINER", "OUTLIER_DETECTOR")
+
+_SERVICE_UNIT_TYPE = {
+    "MODEL": UnitType.MODEL,
+    "ROUTER": UnitType.ROUTER,
+    "TRANSFORMER": UnitType.TRANSFORMER,
+    "COMBINER": UnitType.COMBINER,
+    "OUTLIER_DETECTOR": UnitType.TRANSFORMER,
+}
+
+
+class UserObjectUnit(Unit):
+    """Adapter giving reference-style user objects the Unit protocol."""
+
+    pure = False  # arbitrary Python; host interpreter only
+    accepts_names = True
+
+    def __init__(self, user_object: Any, service_type: str = "MODEL"):
+        self.user = user_object
+        self.service_type = service_type
+        self.class_names = list(getattr(user_object, "class_names", None) or []) or None
+
+    # NB: signatures carry the extra `names` arg (accepts_names = True)
+
+    def predict(self, state, X, names):
+        return np.asarray(self.user.predict(np.asarray(X), names))
+
+    def transform_input(self, state, X, names):
+        if self.service_type == "OUTLIER_DETECTOR":
+            # score + tag, pass data through (outlier_detector_microservice.py:36-56)
+            scores = np.asarray(self.user.score(np.asarray(X), names))
+            return np.asarray(X), UnitAux(tags={"outlierScore": scores})
+        if hasattr(self.user, "transform_input"):
+            return np.asarray(self.user.transform_input(np.asarray(X), names))
+        # reference transformer falls back to predict when only that exists
+        return np.asarray(self.user.predict(np.asarray(X), names))
+
+    def transform_output(self, state, X, names):
+        return np.asarray(self.user.transform_output(np.asarray(X), names))
+
+    def route(self, state, X, names):
+        return int(self.user.route(np.asarray(X), names))
+
+    def aggregate(self, state, Ys, names_list):
+        arrays = [np.asarray(y) for y in Ys]
+        return np.asarray(self.user.aggregate(arrays, names_list))
+
+    def send_feedback(self, state, X, branch, reward, truth, names):
+        if hasattr(self.user, "send_feedback"):
+            X_np = np.asarray(X) if X is not None else None
+            truth_np = np.asarray(truth) if truth is not None else None
+            if self.service_type == "ROUTER":
+                # reference router passes the routed branch
+                # (router_microservice.py:93-125)
+                self.user.send_feedback(X_np, names, int(branch), reward, truth_np)
+            else:
+                self.user.send_feedback(X_np, names, reward, truth_np)
+        return state
+
+
+def build_unit(user_class, parameters: List[Parameter], service_type: str) -> Unit:
+    kwargs = params_to_kwargs(parameters)
+    obj = user_class(**kwargs)
+    if isinstance(obj, Unit):
+        return obj
+    return UserObjectUnit(obj, service_type)
+
+
+def build_runtime(
+    class_path: str,
+    service_type: str = "MODEL",
+    parameters: Optional[List[Parameter]] = None,
+    unit_name: Optional[str] = None,
+    rng=None,
+) -> InProcessNodeRuntime:
+    """Load a user class and wrap it as a servable node runtime."""
+    if service_type not in SERVICE_TYPES:
+        raise ValueError(f"unknown service type {service_type!r}")
+    cls = resolve_unit_class(class_path)
+    parameters = parameters or _env_parameters()
+    unit = build_unit(cls, parameters, service_type)
+    node = PredictiveUnit(
+        name=unit_name or os.environ.get("PREDICTIVE_UNIT_ID", class_path),
+        type=_SERVICE_UNIT_TYPE[service_type],
+    )
+    return InProcessNodeRuntime(node, unit, rng)
+
+
+def _env_parameters() -> List[Parameter]:
+    raw = os.environ.get("PREDICTIVE_UNIT_PARAMETERS", "[]")
+    try:
+        return [Parameter.from_json_dict(p) for p in json.loads(raw)]
+    except (json.JSONDecodeError, TypeError) as e:
+        raise ValueError(f"bad PREDICTIVE_UNIT_PARAMETERS: {e}") from e
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    parser = argparse.ArgumentParser(description="seldon_core_tpu unit microservice")
+    parser.add_argument("interface_name", help="module:Class or registered unit name")
+    parser.add_argument("api", nargs="?", default="REST", choices=["REST", "GRPC"])
+    parser.add_argument("--service-type", default="MODEL", choices=SERVICE_TYPES)
+    parser.add_argument("--parameters", default=None, help="JSON typed parameter list")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument(
+        "--persistence", type=int, default=0,
+        help="1: periodically checkpoint unit state (orbax), restore on boot",
+    )
+    args = parser.parse_args(argv)
+
+    params = (
+        [Parameter.from_json_dict(p) for p in json.loads(args.parameters)]
+        if args.parameters
+        else _env_parameters()
+    )
+    port = args.port or int(os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "5000"))
+    runtime = build_runtime(
+        args.interface_name, args.service_type, params
+    )
+
+    if args.api == "GRPC":
+        try:
+            from seldon_core_tpu.runtime.grpc_server import serve_unit_grpc
+        except ImportError as e:
+            raise SystemExit(f"GRPC serving unavailable: {e}") from e
+
+        asyncio.run(serve_unit_grpc(runtime, args.host, port, persistence=args.persistence))
+    else:
+        from seldon_core_tpu.runtime.rest import make_unit_app, serve_app
+
+        async def run():
+            background = []  # strong refs: create_task alone is GC-collectable
+            if args.persistence:
+                from seldon_core_tpu.runtime.persistence import restore_runtime, persist_loop
+
+                restore_runtime(runtime)
+                background.append(asyncio.create_task(persist_loop(runtime)))
+            await serve_app(make_unit_app(runtime), args.host, port)
+            await asyncio.Event().wait()  # serve forever
+
+        asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

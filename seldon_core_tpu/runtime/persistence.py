@@ -1,0 +1,116 @@
+"""Unit-state persistence — checkpoint/restore of unit state pytrees.
+
+The reference keeps mutable user-model state alive by pickling the whole user
+object to Redis every ``push_frequency`` seconds (wrappers/python/
+persistence.py:23-58, key ``persistence_{deployment}_{predictor}_{unit}``).
+Here unit state is an explicit pytree, so persistence is a snapshot of
+arrays — no arbitrary object pickling of user code, and PRNG keys are
+serialised via ``jax.random.key_data`` so bandit determinism survives a
+restart.
+
+Layout: ``$SELDON_TPU_STATE_DIR/{deployment}_{predictor}_{unit}.ckpt`` (a
+single .npz file per unit).  Frequency via ``$PERSISTENCE_FREQUENCY``
+(seconds, default 60 like the reference)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = [
+    "state_to_host",
+    "state_from_host",
+    "save_state",
+    "load_state",
+    "restore_runtime",
+    "persist_loop",
+    "checkpoint_path",
+]
+
+_KEY_PREFIX = "__prngkey__:"
+
+
+def _is_key(leaf) -> bool:
+    try:
+        return jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def state_to_host(state) -> Dict[str, np.ndarray]:
+    """Flatten a state pytree to a {path: ndarray} dict (npz-safe)."""
+    flat: Dict[str, np.ndarray] = {}
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves_with_paths:
+        key = jax.tree_util.keystr(path)
+        if _is_key(leaf):
+            flat[_KEY_PREFIX + key] = np.asarray(jax.random.key_data(leaf))
+        else:
+            flat[key] = np.asarray(leaf)
+    return flat
+
+
+def state_from_host(flat: Dict[str, np.ndarray], like) -> Any:
+    """Rebuild a pytree with the structure of ``like`` from a flat dict."""
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    new_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = jax.tree_util.keystr(path)
+        if _KEY_PREFIX + key in flat:
+            new_leaves.append(jax.random.wrap_key_data(flat[_KEY_PREFIX + key]))
+        elif key in flat:
+            new_leaves.append(
+                np.asarray(flat[key]).astype(np.asarray(leaf).dtype, copy=False)
+            )
+        else:
+            new_leaves.append(leaf)  # missing in checkpoint: keep current
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def checkpoint_path(unit_name: str) -> str:
+    base = os.environ.get("SELDON_TPU_STATE_DIR", os.path.expanduser("~/.seldon_tpu_state"))
+    dep = os.environ.get("SELDON_DEPLOYMENT_ID", "local")
+    pred = os.environ.get("PREDICTOR_ID", "default")
+    os.makedirs(base, exist_ok=True)
+    return os.path.join(base, f"{dep}_{pred}_{unit_name}.ckpt.npz")
+
+
+def save_state(unit_name: str, state) -> Optional[str]:
+    if state is None:
+        return None
+    path = checkpoint_path(unit_name)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **state_to_host(state))
+    os.replace(tmp, path)
+    return path
+
+
+def load_state(unit_name: str, like) -> Any:
+    path = checkpoint_path(unit_name)
+    if not os.path.exists(path):
+        return like
+    with np.load(path) as data:
+        return state_from_host(dict(data), like)
+
+
+def restore_runtime(runtime) -> None:
+    """Restore-on-boot (microservice.py:157-159 in the reference)."""
+    runtime.state = load_state(runtime.node.name, runtime.state)
+
+
+async def persist_loop(runtime, frequency_s: Optional[float] = None) -> None:
+    """Background checkpoint thread equivalent (persistence.py:34-58)."""
+    freq = frequency_s or float(os.environ.get("PERSISTENCE_FREQUENCY", "60"))
+    while True:
+        await asyncio.sleep(freq)
+        try:
+            save_state(runtime.node.name, runtime.state)
+        except Exception:  # keep serving even if checkpointing fails
+            import logging
+
+            logging.getLogger(__name__).exception("state checkpoint failed")

@@ -1,0 +1,192 @@
+"""REST servers (aiohttp) — external prediction API + internal microservice API.
+
+External (per-predictor engine, mirroring engine RestClientController.java):
+  POST /api/v0.1/predictions   JSON body or form field ``json=``
+  POST /api/v0.1/feedback
+  GET  /ping /ready /pause /unpause (admin drain,
+       engine RestClientController.java:57-99)
+  GET  /prometheus             metric exposition
+
+Internal (single-unit microservice, mirroring wrappers/python/
+model_microservice.py REST routes):
+  POST /predict /transform-input /transform-output /route /aggregate
+       /send-feedback
+
+Both accept the reference's form-encoded ``json=`` convention
+(engine InternalPredictionService.java:240-242) as well as a plain JSON body.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from seldon_core_tpu.graph.interpreter import InProcessNodeRuntime
+from seldon_core_tpu.graph.spec import GraphSpecError
+from seldon_core_tpu.messages import (
+    Feedback,
+    SeldonMessage,
+    SeldonMessageError,
+    SeldonMessageList,
+)
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
+
+__all__ = ["make_engine_app", "make_unit_app", "serve_app"]
+
+
+async def _payload_text(request: web.Request) -> str:
+    """JSON body or form-encoded ``json=`` field.  curl sends
+    ``application/x-www-form-urlencoded`` by default even for raw JSON
+    bodies, so a form without a ``json`` field falls back to the raw body."""
+    body = await request.read()
+    ctype = request.content_type or ""
+    if "form" in ctype:
+        from urllib.parse import parse_qs
+
+        form = parse_qs(body.decode("utf-8", "replace"), keep_blank_values=True)
+        if "json" in form:
+            return form["json"][0]
+    return body.decode("utf-8", "replace")
+
+
+def _msg_response(msg: SeldonMessage, status: int = 200) -> web.Response:
+    return web.Response(
+        text=msg.to_json(), status=status, content_type="application/json"
+    )
+
+
+def _error_response(info: str, code: int = 400) -> web.Response:
+    return _msg_response(SeldonMessage.failure(info, code=code), status=code)
+
+
+# ---------------------------------------------------------------------------
+# Engine app
+# ---------------------------------------------------------------------------
+
+
+def make_engine_app(engine: EngineService) -> web.Application:
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+
+    async def predictions(request: web.Request) -> web.Response:
+        try:
+            msg = SeldonMessage.from_json(await _payload_text(request))
+        except SeldonMessageError as e:
+            return _error_response(str(e))
+        resp = await engine.predict(msg)
+        status = 200 if resp.status is None or resp.status.status == "SUCCESS" else resp.status.code
+        return _msg_response(resp, status=status or 200)
+
+    async def feedback(request: web.Request) -> web.Response:
+        try:
+            fb = Feedback.from_json(await _payload_text(request))
+        except SeldonMessageError as e:
+            return _error_response(str(e))
+        ack = await engine.send_feedback(fb)
+        status = 200 if ack.status is None or ack.status.status == "SUCCESS" else ack.status.code
+        return _msg_response(ack, status=status or 200)
+
+    async def ping(_): return web.Response(text="pong")
+
+    async def ready(_):
+        if engine.ready():
+            return web.Response(text="ready")
+        return web.Response(text="paused", status=503)
+
+    async def pause(_):
+        engine.pause()
+        return web.Response(text="paused")
+
+    async def unpause(_):
+        engine.unpause()
+        return web.Response(text="unpaused")
+
+    async def prometheus(_):
+        # CONTENT_TYPE_LATEST carries the exposition-format version parameter;
+        # aiohttp's content_type= kwarg rejects parameters, so set the header
+        return web.Response(
+            body=engine.metrics.exposition(),
+            headers={"Content-Type": CONTENT_TYPE_LATEST},
+        )
+
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_get("/ping", ping)
+    app.router.add_get("/ready", ready)
+    app.router.add_get("/pause", pause)
+    app.router.add_get("/unpause", unpause)
+    app.router.add_get("/prometheus", prometheus)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# Unit (microservice) app
+# ---------------------------------------------------------------------------
+
+
+def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
+    """Serve one unit over the internal microservice API — what
+    ``microservice.py <UserClass> REST`` builds in the reference."""
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+
+    def handler(method_name):
+        async def handle(request: web.Request) -> web.Response:
+            try:
+                text = await _payload_text(request)
+                if method_name == "aggregate":
+                    msgs = SeldonMessageList.from_json(text)
+                    resp = await runtime.aggregate(msgs.messages)
+                elif method_name == "send_feedback":
+                    fb = Feedback.from_json(text)
+                    routing = (
+                        fb.response.meta.routing if fb.response is not None else {}
+                    )
+                    branch = int(routing.get(runtime.node.name, -1))
+                    await runtime.send_feedback(fb, branch)
+                    resp = SeldonMessage()
+                elif method_name == "route":
+                    msg = SeldonMessage.from_json(text)
+                    branch = await runtime.route(msg)
+                    # branch wrapped as 1x1 tensor like the reference wrapper
+                    # (wrappers/python/router_microservice.py:39-56)
+                    import numpy as np
+
+                    resp = msg.with_array(np.array([[branch]], dtype=np.float64))
+                else:
+                    msg = SeldonMessage.from_json(text)
+                    resp = await getattr(runtime, method_name)(msg)
+            except (SeldonMessageError, GraphSpecError) as e:
+                return _error_response(str(e))
+            except NotImplementedError as e:
+                return _error_response(str(e), code=501)
+            return _msg_response(resp)
+
+        return handle
+
+    app.router.add_post("/predict", handler("predict"))
+    app.router.add_post("/transform-input", handler("transform_input"))
+    app.router.add_post("/transform-output", handler("transform_output"))
+    app.router.add_post("/route", handler("route"))
+    app.router.add_post("/aggregate", handler("aggregate"))
+    app.router.add_post("/send-feedback", handler("send_feedback"))
+
+    async def ping(_): return web.Response(text="pong")
+
+    app.router.add_get("/ping", ping)
+    return app
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+async def serve_app(app: web.Application, host: str, port: int):
+    """Start an app; returns the runner (caller is responsible for cleanup)."""
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

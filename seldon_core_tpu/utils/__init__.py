@@ -1,0 +1,1 @@
+"""Utilities: metrics, puid, config."""

@@ -1,0 +1,151 @@
+"""Prometheus metrics with the reference's metric families so existing
+Grafana dashboards keep working (engine application.properties:24-27,
+SeldonRestTemplateExchangeTagsProvider.java:84-161, monitoring/grafana/
+configs/predictions-analytics-dashboard.json):
+
+  * seldon_api_engine_server_requests_duration_seconds   (histogram)
+  * seldon_api_engine_client_requests_duration_seconds   (per-node histogram)
+  * seldon_api_ingress_server_requests_duration_seconds  (gateway histogram)
+  * seldon_api_model_feedback_total / seldon_api_model_feedback_reward_total
+
+All tagged with deployment_name / predictor_name / model_name / model_image /
+model_version / project_name where applicable."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Histogram,
+        generate_latest,
+        CONTENT_TYPE_LATEST,
+    )
+
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+    CONTENT_TYPE_LATEST = "text/plain"
+
+__all__ = ["MetricsRegistry", "CONTENT_TYPE_LATEST"]
+
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+
+class MetricsRegistry:
+    """Per-process metric registry; a null object when prometheus_client is
+    unavailable so serving never depends on it."""
+
+    def __init__(self, deployment_name: str = "", predictor_name: str = "",
+                 project_name: str = ""):
+        self.deployment_name = deployment_name
+        self.predictor_name = predictor_name
+        self.project_name = project_name
+        if not HAVE_PROMETHEUS:
+            self.registry = None
+            return
+        self.registry = CollectorRegistry()
+        common = ["deployment_name", "predictor_name", "project_name"]
+        self.server_requests = Histogram(
+            "seldon_api_engine_server_requests_duration_seconds",
+            "Engine request latency",
+            common + ["service", "method", "code"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.client_requests = Histogram(
+            "seldon_api_engine_client_requests_duration_seconds",
+            "Per-node dispatch latency",
+            common + ["model_name", "model_image", "model_version", "method"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.ingress_requests = Histogram(
+            "seldon_api_ingress_server_requests_duration_seconds",
+            "Gateway request latency",
+            common + ["service", "method", "code"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.feedback_total = Counter(
+            "seldon_api_model_feedback_total",
+            "Feedback events",
+            common,
+            registry=self.registry,
+        )
+        self.feedback_reward_total = Counter(
+            "seldon_api_model_feedback_reward_total",
+            "Accumulated feedback reward",
+            common,
+            registry=self.registry,
+        )
+
+    def _common(self):
+        return {
+            "deployment_name": self.deployment_name,
+            "predictor_name": self.predictor_name,
+            "project_name": self.project_name,
+        }
+
+    @contextmanager
+    def time_server(self, service: str, method: str):
+        start = time.perf_counter()
+        code_holder = {"code": "200"}
+        try:
+            yield code_holder
+        except Exception:
+            code_holder["code"] = "500"
+            raise
+        finally:
+            if self.registry is not None:
+                self.server_requests.labels(
+                    **self._common(), service=service, method=method,
+                    code=code_holder["code"],
+                ).observe(time.perf_counter() - start)
+
+    @contextmanager
+    def time_client(self, model_name: str, method: str, model_image: str = "",
+                    model_version: str = ""):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self.registry is not None:
+                self.client_requests.labels(
+                    **self._common(), model_name=model_name,
+                    model_image=model_image, model_version=model_version,
+                    method=method,
+                ).observe(time.perf_counter() - start)
+
+    @contextmanager
+    def time_ingress(self, service: str, method: str):
+        start = time.perf_counter()
+        code_holder = {"code": "200"}
+        try:
+            yield code_holder
+        except Exception:
+            code_holder["code"] = "500"
+            raise
+        finally:
+            if self.registry is not None:
+                self.ingress_requests.labels(
+                    **self._common(), service=service, method=method,
+                    code=code_holder["code"],
+                ).observe(time.perf_counter() - start)
+
+    def record_feedback(self, reward: float) -> None:
+        if self.registry is not None:
+            self.feedback_total.labels(**self._common()).inc()
+            self.feedback_reward_total.labels(**self._common()).inc(max(reward, 0.0))
+
+    def exposition(self) -> bytes:
+        if self.registry is None:
+            return b""
+        return generate_latest(self.registry)

@@ -1,0 +1,431 @@
+"""Graph execution tests — host interpreter + compiled executor.
+
+Mirrors the reference's engine unit tests (AverageCombinerTest.java,
+RandomABTestUnitTest.java, SimpleModelUnitTest.java) plus compiled/host
+parity checks the reference has no equivalent of."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.compiled import CompiledGraph
+from seldon_core_tpu.graph.interpreter import GraphExecutor
+from seldon_core_tpu.graph.spec import (
+    GraphSpecError,
+    SeldonDeploymentSpec,
+)
+from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+
+
+# ---------------------------------------------------------------------------
+# test fixtures: tiny pure units
+# ---------------------------------------------------------------------------
+
+
+@register_unit("test.Scale")
+class ScaleUnit(Unit):
+    def __init__(self, factor: float = 2.0):
+        self.factor = factor
+
+    def predict(self, state, X):
+        return X * self.factor
+
+
+@register_unit("test.AddTag")
+class AddTagUnit(Unit):
+    """Transformer that tags the batch mean (outlier-detector shape)."""
+
+    def transform_input(self, state, X):
+        return X, UnitAux(tags={"batch_mean": jnp.mean(X)})
+
+
+@register_unit("test.CountingRouter")
+class CountingRouter(Unit):
+    """Feedback-counting router: routes to argmax of per-branch reward."""
+
+    def __init__(self, n_branches: int = 2):
+        self.n = n_branches
+
+    def init_state(self, rng):
+        return {
+            "rewards": jnp.zeros((self.n,), jnp.float32),
+            "counts": jnp.zeros((self.n,), jnp.float32),
+        }
+
+    def route(self, state, X):
+        return jnp.argmax(state["rewards"]).astype(jnp.int32)
+
+    def send_feedback(self, state, X, branch, reward, truth):
+        onehot = jax.nn.one_hot(branch, self.n, dtype=jnp.float32)
+        return {
+            "rewards": state["rewards"] + onehot * reward,
+            "counts": state["counts"] + onehot,
+        }
+
+
+def graph_json(graph, components=None):
+    spec = {
+        "spec": {
+            "name": "t",
+            "predictors": [
+                {"name": "p", "graph": graph, "components": components or []}
+            ],
+        }
+    }
+    return SeldonDeploymentSpec.from_json_dict(spec)
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# host interpreter
+# ---------------------------------------------------------------------------
+
+
+def test_simple_model_host():
+    """SIMPLE_MODEL stub returns [0.1, 0.9, 0.5] / class0..2
+    (engine SimpleModelUnitTest.java:43-119)."""
+    spec = graph_json({"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"})
+    ex = GraphExecutor(spec.predictor())
+    req = SeldonMessage.from_array(np.zeros((2, 4)))
+    req.meta.puid = "pp"
+    resp = run(ex.predict(req))
+    np.testing.assert_allclose(resp.array(), [[0.1, 0.9, 0.5]] * 2, atol=1e-6)
+    assert resp.names() == ["class0", "class1", "class2"]
+    assert resp.meta.puid == "pp"
+    assert resp.status.status == "SUCCESS"
+
+
+def test_average_combiner_host():
+    """Mean over children (engine AverageCombinerTest.java:41-228)."""
+    g = {
+        "name": "comb",
+        "type": "COMBINER",
+        "implementation": "AVERAGE_COMBINER",
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "2.0", "type": "FLOAT"}]},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "4.0", "type": "FLOAT"}]},
+    ]
+    ex = GraphExecutor(graph_json(g, comps).predictor())
+    resp = run(ex.predict(SeldonMessage.from_array(np.ones((1, 3)))))
+    np.testing.assert_allclose(resp.array(), [[3.0, 3.0, 3.0]], atol=1e-6)
+
+
+def test_abtest_routing_deterministic_host():
+    """Seeded AB test is deterministic and records meta.routing
+    (engine RandomABTestUnitTest.java:42-103)."""
+    g = {
+        "name": "ab",
+        "implementation": "RANDOM_ABTEST",
+        "type": "ROUTER",
+        "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "1.0", "type": "FLOAT"}]},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "-1.0", "type": "FLOAT"}]},
+    ]
+
+    def route_seq(seed):
+        ex = GraphExecutor(graph_json(g, comps).predictor(), rng=jax.random.key(seed))
+        seq = []
+        for _ in range(20):
+            resp = run(ex.predict(SeldonMessage.from_array(np.ones((1, 2)))))
+            seq.append(resp.meta.routing["ab"])
+        return seq
+
+    s_a, s_b = route_seq(7), route_seq(7)
+    assert s_a == s_b  # deterministic for fixed seed
+    assert set(s_a) == {0, 1}  # both branches exercised at ratio 0.5
+
+
+def test_tags_merge_host():
+    g = {
+        "name": "outlier",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "type": "MODEL"}],
+    }
+    comps = [
+        {"name": "outlier", "runtime": "inprocess", "class_path": "test.AddTag"},
+        {"name": "m", "runtime": "inprocess", "class_path": "test.Scale"},
+    ]
+    ex = GraphExecutor(graph_json(g, comps).predictor())
+    resp = run(ex.predict(SeldonMessage.from_array(np.full((1, 2), 3.0))))
+    assert resp.meta.tags["batch_mean"] == pytest.approx(3.0)
+    np.testing.assert_allclose(resp.array(), [[6.0, 6.0]], atol=1e-6)
+
+
+def test_feedback_routed_branch_only_host():
+    """Feedback replays meta.routing: only the serving branch trains
+    (engine PredictiveUnitBean.java:141-149)."""
+    g = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "r", "runtime": "inprocess", "class_path": "test.CountingRouter"},
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale"},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale"},
+    ]
+    ex = GraphExecutor(graph_json(g, comps).predictor())
+    req = SeldonMessage.from_array(np.ones((1, 2)))
+    resp = run(ex.predict(req))
+    assert resp.meta.routing["r"] == 0  # argmax of zeros -> 0
+
+    fb = Feedback(request=req, response=resp, reward=5.0)
+    run(ex.send_feedback(fb))
+    state = ex.states()["r"]
+    np.testing.assert_allclose(state["rewards"], [5.0, 0.0])
+    np.testing.assert_allclose(state["counts"], [1.0, 0.0])
+
+    # reward on branch 0 keeps routing there; feedback with routing=1 trains s2
+    resp.meta.routing["r"] = 1
+    run(ex.send_feedback(Feedback(request=req, response=resp, reward=9.0)))
+    state = ex.states()["r"]
+    np.testing.assert_allclose(state["rewards"], [5.0, 9.0])
+    resp2 = run(ex.predict(req))
+    assert resp2.meta.routing["r"] == 1  # learned preference
+
+
+def test_mismatched_combiner_shapes_host():
+    g = {
+        "name": "comb",
+        "implementation": "AVERAGE_COMBINER",
+        "type": "COMBINER",
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "sm", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+        ],
+    }
+    comps = [{"name": "s1", "runtime": "inprocess", "class_path": "test.Scale"}]
+    ex = GraphExecutor(graph_json(g, comps).predictor())
+    with pytest.raises(GraphSpecError, match="shapes differ"):
+        run(ex.predict(SeldonMessage.from_array(np.ones((1, 2)))))
+
+
+# ---------------------------------------------------------------------------
+# compiled executor
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_combiner_matches_host():
+    g = {
+        "name": "comb",
+        "implementation": "AVERAGE_COMBINER",
+        "type": "COMBINER",
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "2.0", "type": "FLOAT"}]},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "4.0", "type": "FLOAT"}]},
+    ]
+    pred = graph_json(g, comps).predictor()
+    cg = CompiledGraph(pred)
+    x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+    y, routing, tags = cg.predict_arrays(x)
+    np.testing.assert_allclose(np.asarray(y), x * 3.0, rtol=1e-6)
+    assert routing == {} and tags == {}
+
+    host = GraphExecutor(pred)
+    resp = run(host.predict(SeldonMessage.from_array(x)))
+    np.testing.assert_allclose(np.asarray(y), resp.array(), rtol=1e-6)
+
+
+def test_compiled_abtest_parity_with_host():
+    """Same seed => identical routing decisions in compiled and host mode."""
+    g = {
+        "name": "ab",
+        "implementation": "RANDOM_ABTEST",
+        "type": "ROUTER",
+        "parameters": [{"name": "ratioA", "value": "0.4", "type": "FLOAT"}],
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "1.0", "type": "FLOAT"}]},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "-1.0", "type": "FLOAT"}]},
+    ]
+    pred = graph_json(g, comps).predictor()
+    x = np.ones((1, 2), np.float32)
+
+    cg = CompiledGraph(pred, rng=jax.random.key(3))
+    compiled_seq = [cg.predict_arrays(x)[1]["ab"] for _ in range(12)]
+
+    host = GraphExecutor(pred, rng=jax.random.key(3))
+    host_seq = [
+        run(host.predict(SeldonMessage.from_array(x))).meta.routing["ab"]
+        for _ in range(12)
+    ]
+    assert compiled_seq == host_seq
+    assert set(compiled_seq) == {0, 1}
+
+
+def test_compiled_routing_executes_single_branch():
+    """Outputs match the routed child exactly (lax.switch semantics)."""
+    g = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "r", "runtime": "inprocess", "class_path": "test.CountingRouter"},
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "10.0", "type": "FLOAT"}]},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale",
+         "parameters": [{"name": "factor", "value": "-10.0", "type": "FLOAT"}]},
+    ]
+    cg = CompiledGraph(graph_json(g, comps).predictor())
+    x = np.ones((2, 2), np.float32)
+    y, routing, _ = cg.predict_arrays(x)
+    assert routing == {"r": 0}
+    np.testing.assert_allclose(np.asarray(y), x * 10.0)
+
+    # on-device feedback flips the learned preference to branch 1
+    cg.feedback_arrays(x, {"r": 1}, reward=7.0)
+    y2, routing2, _ = cg.predict_arrays(x)
+    assert routing2 == {"r": 1}
+    np.testing.assert_allclose(np.asarray(y2), x * -10.0)
+    np.testing.assert_allclose(np.asarray(cg.states["r"]["rewards"]), [0.0, 7.0])
+
+
+def test_compiled_tags_flow():
+    g = {
+        "name": "outlier",
+        "type": "TRANSFORMER",
+        "children": [{"name": "m", "type": "MODEL"}],
+    }
+    comps = [
+        {"name": "outlier", "runtime": "inprocess", "class_path": "test.AddTag"},
+        {"name": "m", "runtime": "inprocess", "class_path": "test.Scale"},
+    ]
+    cg = CompiledGraph(graph_json(g, comps).predictor())
+    y, _, tags = cg.predict_arrays(np.full((1, 4), 2.0, np.float32))
+    assert float(tags["batch_mean"]) == pytest.approx(2.0)
+    np.testing.assert_allclose(np.asarray(y), [[4.0] * 4])
+
+
+def test_compiled_message_api():
+    spec = graph_json({"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"})
+    cg = CompiledGraph(spec.predictor())
+    req = SeldonMessage.from_json('{"data":{"ndarray":[[0,0]]},"meta":{"puid":"x"}}')
+    resp = cg.predict(req)
+    assert resp.meta.puid == "x"
+    assert resp.names() == ["class0", "class1", "class2"]
+    d = json.loads(resp.to_json())
+    assert d["data"]["ndarray"] == [[pytest.approx(0.1), pytest.approx(0.9), pytest.approx(0.5)]]
+
+
+@register_unit("test.BadRouter")
+class BadRouter(Unit):
+    """Returns an out-of-range branch."""
+
+    def __init__(self, branch: int = 5):
+        self.branch = branch
+
+    def route(self, state, X):
+        return jnp.int32(self.branch)
+
+
+@pytest.mark.parametrize("bad_branch", [5, -2])
+def test_invalid_branch_raises_both_modes(bad_branch):
+    """Out-of-range and negative (non-broadcast) branches raise in BOTH
+    execution modes instead of silently picking a child."""
+    g = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "s1", "type": "MODEL"},
+            {"name": "s2", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "r", "runtime": "inprocess", "class_path": "test.BadRouter",
+         "parameters": [{"name": "branch", "value": str(bad_branch), "type": "INT"}]},
+        {"name": "s1", "runtime": "inprocess", "class_path": "test.Scale"},
+        {"name": "s2", "runtime": "inprocess", "class_path": "test.Scale"},
+    ]
+    pred = graph_json(g, comps).predictor()
+    with pytest.raises(GraphSpecError, match="children"):
+        run(GraphExecutor(pred).predict(SeldonMessage.from_array(np.ones((1, 2)))))
+    with pytest.raises(GraphSpecError, match="children"):
+        CompiledGraph(pred).predict_arrays(np.ones((1, 2), np.float32))
+
+
+@register_unit("test.NamedModel")
+class NamedModel(Unit):
+    def __init__(self, label: str = "x", factor: float = 1.0):
+        self.class_names = [f"{label}:0", f"{label}:1"]
+        self.factor = factor
+
+    def predict(self, state, X):
+        return X[:, :2] * self.factor
+
+
+def test_compiled_output_names_follow_routing():
+    """data.names come from the unit that served the request, per-mode parity
+    (review finding: first-in-walk-order names mislabel routed responses)."""
+    g = {
+        "name": "r",
+        "type": "ROUTER",
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "type": "MODEL"},
+        ],
+    }
+    comps = [
+        {"name": "r", "runtime": "inprocess", "class_path": "test.CountingRouter"},
+        {"name": "a", "runtime": "inprocess", "class_path": "test.NamedModel",
+         "parameters": [{"name": "label", "value": "a", "type": "STRING"}]},
+        {"name": "b", "runtime": "inprocess", "class_path": "test.NamedModel",
+         "parameters": [{"name": "label", "value": "b", "type": "STRING"}]},
+    ]
+    pred = graph_json(g, comps).predictor()
+    cg = CompiledGraph(pred)
+    x = np.ones((1, 2), np.float32)
+    resp = cg.predict(SeldonMessage.from_array(x))
+    assert resp.names() == ["a:0", "a:1"]  # routed to branch 0
+    cg.feedback_arrays(x, {"r": 1}, reward=5.0)
+    resp = cg.predict(SeldonMessage.from_array(x))
+    assert resp.names() == ["b:0", "b:1"]  # now routed to branch 1
+
+
+def test_compiled_rejects_remote_nodes():
+    g = {"name": "m", "type": "MODEL"}
+    comps = [{"name": "m", "runtime": "grpc", "image": "x:1"}]
+    with pytest.raises(GraphSpecError, match="in-process"):
+        CompiledGraph(graph_json(g, comps).predictor())

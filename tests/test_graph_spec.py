@@ -1,0 +1,156 @@
+"""Graph spec + defaulting/validation tests — the reference operator's
+golden-file tests re-imagined (cluster-manager SeldonDeploymentDefaultingTest.java,
+SeldonDeploymentValidationTest.java)."""
+
+import json
+import pathlib
+
+import pytest
+
+from seldon_core_tpu.graph.defaulting import (
+    ENV_PARAMETERS,
+    ENV_SERVICE_PORT,
+    PU_PORT_BASE,
+    default_and_validate,
+    defaulting,
+    validate,
+)
+from seldon_core_tpu.graph.spec import (
+    EndpointType,
+    GraphSpecError,
+    Parameter,
+    PredictiveUnit,
+    SeldonDeploymentSpec,
+    UnitImplementation,
+    UnitType,
+)
+
+RES = pathlib.Path(__file__).parent / "resources"
+
+
+def load(name):
+    return SeldonDeploymentSpec.from_json((RES / name).read_text())
+
+
+def test_parse_reference_style_deployment():
+    spec = load("model_simple.json")
+    assert spec.name == "simple-deployment"
+    assert spec.metadata_name == "simple-example"
+    assert spec.oauth_key == "key"
+    p = spec.predictor()
+    assert p.name == "main"
+    assert p.graph.type is UnitType.MODEL
+    assert p.components[0].image == "myorg/classifier:0.1"
+    assert p.components[0].runtime == "rest"  # reference containers default to REST
+
+
+def test_defaulting_assigns_ports_and_endpoints():
+    """Port-from-base + endpoint back-fill (SeldonDeploymentOperatorImpl.java:346-387)."""
+    spec = default_and_validate(load("model_simple.json"))
+    binding = spec.predictor().components[0]
+    unit = spec.predictor().graph
+    assert binding.port == PU_PORT_BASE
+    assert unit.endpoint.service_port == PU_PORT_BASE
+    assert unit.endpoint.type is EndpointType.REST
+    assert binding.env[ENV_SERVICE_PORT] == str(PU_PORT_BASE)
+    assert binding.env["PREDICTIVE_UNIT_ID"] == "classifier"
+    assert binding.env["SELDON_DEPLOYMENT_ID"] == "simple-deployment"
+
+
+def test_defaulting_tpu_graph():
+    spec = default_and_validate(load("model_tpu_abtest.json"))
+    p = spec.predictor()
+    a, b = p.component_map()["mnist-a"], p.component_map()["mnist-b"]
+    # inprocess unit: no port, endpoint cleared (compiled into the engine program)
+    assert a.port == 0
+    assert p.graph.children[0].endpoint is None
+    # grpc unit: unique port, GRPC endpoint
+    assert b.port == PU_PORT_BASE
+    assert p.graph.children[1].endpoint.type is EndpointType.GRPC
+    # router params propagate as JSON env on bindings with params
+    ab = p.graph
+    assert ab.implementation is UnitImplementation.RANDOM_ABTEST
+    assert ab.parameters[0].typed_value() == 0.5
+
+
+def test_unique_ports_across_predictors():
+    spec = load("model_simple.json")
+    # clone the predictor to simulate a canary (two predictors, same graph)
+    import copy
+
+    p2 = copy.deepcopy(spec.predictors[0])
+    p2.name = "canary"
+    spec.predictors.append(p2)
+    defaulting(spec)
+    ports = [
+        c.port for p in spec.predictors for c in p.components if c.runtime != "inprocess"
+    ]
+    assert len(ports) == len(set(ports)) == 2
+
+
+def test_validation_rejects_unbound_model():
+    """MODEL leaf with no binding and no hardcoded impl is invalid
+    (SeldonDeploymentOperatorImpl.java:390-413)."""
+    with pytest.raises(GraphSpecError, match="no matching component binding"):
+        default_and_validate(load("model_invalid_graph.json"))
+
+
+def test_validation_rejects_unit_with_nothing_declared():
+    spec = load("model_simple.json")
+    g = spec.predictor().graph
+    g.type = None
+    g.methods = None
+    with pytest.raises(GraphSpecError, match="must declare type"):
+        validate(spec)
+
+
+def test_validation_abtest_structure():
+    spec = load("model_tpu_abtest.json")
+    spec.predictor().graph.children.pop()  # only 1 child now
+    with pytest.raises(GraphSpecError, match="exactly 2 children"):
+        default_and_validate(spec)
+    spec2 = load("model_tpu_abtest.json")
+    spec2.predictor().graph.parameters = []
+    with pytest.raises(GraphSpecError, match="ratioA"):
+        default_and_validate(spec2)
+
+
+def test_validation_duplicate_unit_names():
+    spec = load("model_tpu_abtest.json")
+    spec.predictor().graph.children[1].name = "mnist-a"
+    with pytest.raises(GraphSpecError, match="duplicate unit names"):
+        validate(spec)
+
+
+def test_validation_inprocess_needs_class_path():
+    spec = load("model_tpu_abtest.json")
+    spec.predictor().component_map()["mnist-a"].class_path = ""
+    with pytest.raises(GraphSpecError, match="class_path"):
+        default_and_validate(spec)
+
+
+def test_roundtrip_spec_json():
+    spec = default_and_validate(load("model_tpu_abtest.json"))
+    back = SeldonDeploymentSpec.from_json(spec.to_json())
+    assert back.name == spec.name
+    assert back.predictor().graph.to_json_dict() == spec.predictor().graph.to_json_dict()
+
+
+def test_parameter_typing():
+    assert Parameter("x", "3", "INT").typed_value() == 3
+    assert Parameter("x", "0.25", "FLOAT").typed_value() == 0.25
+    assert Parameter("x", "true", "BOOL").typed_value() is True
+    assert Parameter("x", "False", "BOOL").typed_value() is False
+    assert Parameter("x", "s", "STRING").typed_value() == "s"
+    with pytest.raises(GraphSpecError):
+        Parameter("x", "abc", "INT").typed_value()
+    with pytest.raises(GraphSpecError):
+        Parameter("x", "1", "WAT").typed_value()
+
+
+def test_graph_walk_and_find():
+    spec = load("model_tpu_abtest.json")
+    g = spec.predictor().graph
+    assert [u.name for u in g.walk()] == ["ab", "mnist-a", "mnist-b"]
+    assert g.find("mnist-b").type is UnitType.MODEL
+    assert g.find("nope") is None

@@ -1,0 +1,228 @@
+"""Serving integration tests: engine REST API end-to-end over loopback,
+remote unit microservices, mixed in-process/remote graphs.  This reproduces
+the reference's in-process stub-graph integration environment
+(engine TestRestClientController.java:49-103) without containers."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import aiohttp
+
+from seldon_core_tpu.graph.spec import Parameter, SeldonDeploymentSpec
+from seldon_core_tpu.graph.defaulting import default_and_validate
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.client import RestNodeRuntime
+from seldon_core_tpu.runtime.microservice import build_runtime
+from seldon_core_tpu.runtime.rest import make_engine_app, make_unit_app, serve_app
+
+
+def deployment(graph, components=None, name="dep"):
+    return SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": name,
+                "predictors": [
+                    {"name": "p", "graph": graph, "components": components or []}
+                ],
+            }
+        }
+    )
+
+
+async def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+SIMPLE = {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
+
+
+def test_engine_rest_predict_roundtrip():
+    async def run():
+        engine = EngineService(deployment(SIMPLE))
+        assert engine.mode == "compiled"
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                # JSON body
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    data='{"data":{"ndarray":[[1,2]]}}',
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                assert d["data"]["ndarray"][0] == [
+                    pytest.approx(0.1), pytest.approx(0.9), pytest.approx(0.5)]
+                assert d["data"]["names"] == ["class0", "class1", "class2"]
+                assert len(d["meta"]["puid"]) == 26  # assigned
+
+                # reference form-encoded convention
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    data={"json": '{"data":{"ndarray":[[1,2]]}}'},
+                ) as r:
+                    assert r.status == 200
+
+                # malformed payload -> FAILURE status, 400
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    data="not json",
+                ) as r:
+                    assert r.status == 400
+                    d = json.loads(await r.text())
+                    assert d["status"]["status"] == "FAILURE"
+
+                # admin drain cycle (engine RestClientController.java:57-99)
+                for path, expect in [
+                    ("/ping", 200), ("/ready", 200), ("/pause", 200),
+                    ("/ready", 503), ("/unpause", 200), ("/ready", 200),
+                ]:
+                    async with s.get(f"http://127.0.0.1:{port}{path}") as r:
+                        assert r.status == expect, path
+
+                # prometheus exposition carries reference metric families
+                async with s.get(f"http://127.0.0.1:{port}/prometheus") as r:
+                    text = await r.text()
+                    assert "seldon_api_engine_server_requests_duration_seconds" in text
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_unit_microservice_and_remote_graph():
+    """A remote MODEL node served by the unit microservice, orchestrated by
+    an engine in host mode over HTTP — the reference's engine->wrapper hop."""
+
+    async def run():
+        # unit microservice: MNIST model
+        params = [
+            Parameter("hidden", "32", "INT"),
+            Parameter("seed", "0", "INT"),
+        ]
+        runtime = build_runtime("MnistClassifier", "MODEL", params, unit_name="m")
+        port = await _free_port()
+        unit_runner = await serve_app(make_unit_app(runtime), "127.0.0.1", port)
+
+        graph = {"name": "m", "type": "MODEL"}
+        comps = [{"name": "m", "runtime": "rest", "host": "127.0.0.1", "port": port}]
+        spec = deployment(graph, comps)
+        default_and_validate(spec)
+        # defaulting must not clobber the explicit host/port
+        binding = spec.predictor().component_map()["m"]
+        assert binding.port == port
+
+        node = spec.predictor().graph
+        engine = EngineService(
+            spec,
+            extra_runtimes={"m": RestNodeRuntime(node, binding)},
+        )
+        assert engine.mode == "host"
+        eport = await _free_port()
+        engine_runner = await serve_app(make_engine_app(engine), "127.0.0.1", eport)
+        try:
+            async with aiohttp.ClientSession() as s:
+                x = np.zeros((2, 784)).tolist()
+                async with s.post(
+                    f"http://127.0.0.1:{eport}/api/v0.1/predictions",
+                    json={"data": {"ndarray": x}},
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                probs = np.asarray(d["data"]["ndarray"])
+                assert probs.shape == (2, 10)
+                np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-3)
+                assert d["data"]["names"] == [f"class:{i}" for i in range(10)]
+        finally:
+            await engine_runner.cleanup()
+            for rt in engine.runtimes_to_close() if hasattr(engine, "runtimes_to_close") else []:
+                await rt.close()
+            await unit_runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_unit_microservice_router_and_feedback():
+    """Remote ROUTER over the internal API: /route returns a 1x1 tensor
+    branch, /send-feedback replays routing (router_microservice.py:39-125)."""
+
+    async def run():
+        params = [Parameter("n_branches", "2", "INT"), Parameter("seed", "0", "INT")]
+        runtime = build_runtime("EpsilonGreedyRouter", "ROUTER", params, unit_name="r")
+        port = await _free_port()
+        runner = await serve_app(make_unit_app(runtime), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/route",
+                    data={"json": '{"data":{"ndarray":[[1,2]]}}'},
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                branch = int(np.asarray(d["data"]["ndarray"]).ravel()[0])
+                assert branch in (0, 1)
+
+                fb = {
+                    "request": {"data": {"ndarray": [[1, 2]]}},
+                    "response": {"meta": {"routing": {"r": 1}}},
+                    "reward": 1.0,
+                }
+                async with s.post(
+                    f"http://127.0.0.1:{port}/send-feedback", json=fb
+                ) as r:
+                    assert r.status == 200
+                tries = np.asarray(runtime.state["tries"])
+                np.testing.assert_allclose(tries, [0.0, 1.0])
+
+                # unimplemented method -> 501, typed failure
+                async with s.post(
+                    f"http://127.0.0.1:{port}/aggregate",
+                    json={"seldonMessages": []},
+                ) as r:
+                    assert r.status in (400, 501)
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_reference_style_user_object():
+    """A plain reference-style class (predict(X, names)) wraps and serves."""
+
+    class MeanClassifier:
+        class_names = ["mean"]
+
+        def predict(self, X, names):
+            return np.mean(X, axis=1, keepdims=True)
+
+    import seldon_core_tpu.graph.units as units_mod
+
+    units_mod.UNIT_REGISTRY["test.MeanClassifier"] = MeanClassifier
+
+    async def run():
+        runtime = build_runtime("test.MeanClassifier", "MODEL", [], unit_name="mc")
+        port = await _free_port()
+        runner = await serve_app(make_unit_app(runtime), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/predict",
+                    json={"data": {"names": ["a", "b"], "ndarray": [[2.0, 4.0]]}},
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                assert d["data"]["ndarray"] == [[3.0]]
+                assert d["data"]["names"] == ["mean"]
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
