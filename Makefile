@@ -1,0 +1,10 @@
+proto:
+	protoc -I proto --python_out=seldon_core_tpu/proto_gen proto/prediction.proto
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+.PHONY: proto test bench

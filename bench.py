@@ -1,0 +1,193 @@
+"""Benchmark — MNIST inference-graph serving on the real TPU chip.
+
+Reproduces the shape of the reference's published benchmark (256 concurrent
+locust clients firing at the engine + stub model, docs/benchmarking.md:20-36,
+12,088.95 req/s REST) against this framework's engine: K concurrent clients
+issue predict requests through the full data plane (JSON wire parse ->
+micro-batched compiled-graph dispatch on TPU -> JSON response), except the
+model is a REAL MNIST MLP, not a stub.
+
+NOTE on this environment: the TPU is reached through a relay that costs
+~65 ms per device->host readback RPC regardless of size.  Micro-batching
+amortises that fixed cost across concurrent requests (the same way the
+production design amortises PCIe/dispatch overhead), so throughput is the
+meaningful headline here; single-request p50 is floored by the relay RPC,
+not by the framework (aux key ``relay_floor_ms`` reports the measured floor
+of a bare 1-element readback for comparison).
+
+Prints ONE JSON line: metric=mnist_graph_qps (256 clients), vs_baseline =
+qps / 12088.95 (the reference's REST number on its stub model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+REFERENCE_REST_QPS = 12088.95  # docs/benchmarking.md:44
+NORTH_STAR_P50_MS = 5.0  # BASELINE.md
+
+
+def _deployment(graph, components=None, name="bench"):
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+    return SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": name,
+                "predictors": [
+                    {"name": "p", "graph": graph, "components": components or []}
+                ],
+            }
+        }
+    )
+
+
+def _mnist_graph(n_members: int, hidden: int = 256):
+    if n_members == 1:
+        return (
+            {"name": "m0", "type": "MODEL"},
+            [
+                {
+                    "name": "m0",
+                    "runtime": "inprocess",
+                    "class_path": "MnistClassifier",
+                    "parameters": [
+                        {"name": "hidden", "value": str(hidden), "type": "INT"}
+                    ],
+                }
+            ],
+        )
+    children = [{"name": f"m{i}", "type": "MODEL"} for i in range(n_members)]
+    comps = [
+        {
+            "name": f"m{i}",
+            "runtime": "inprocess",
+            "class_path": "MnistClassifier",
+            "parameters": [
+                {"name": "hidden", "value": str(hidden), "type": "INT"},
+                {"name": "seed", "value": str(i), "type": "INT"},
+            ],
+        }
+        for i in range(n_members)
+    ]
+    return (
+        {
+            "name": "ens",
+            "type": "COMBINER",
+            "implementation": "AVERAGE_COMBINER",
+            "children": children,
+        },
+        comps,
+    )
+
+
+def _relay_floor_ms() -> float:
+    """Fixed cost of one tiny device->host readback in this environment."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.zeros((1, 8), jnp.float32)
+    np.asarray(f(x))
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        lat.append(time.perf_counter() - t0)
+    return float(np.percentile(lat, 50) * 1e3)
+
+
+async def _client_load(engine, payload: str, n_clients: int, duration_s: float):
+    """K concurrent clients, each a closed loop: request -> response -> next.
+    Returns (completed, latencies)."""
+    from seldon_core_tpu.messages import SeldonMessage
+
+    latencies = []
+    completed = 0
+    stop = time.perf_counter() + duration_s
+
+    async def client():
+        nonlocal completed
+        while time.perf_counter() < stop:
+            t0 = time.perf_counter()
+            msg = SeldonMessage.from_json(payload)
+            resp = await engine.predict(msg)
+            resp.to_json()
+            latencies.append(time.perf_counter() - t0)
+            completed += 1
+
+    await asyncio.gather(*[client() for _ in range(n_clients)])
+    return completed, np.asarray(latencies)
+
+
+async def _bench_engine(spec, payload, n_clients, duration_s, **engine_kwargs):
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    engine = EngineService(spec, **engine_kwargs)
+    # warm-up (compile + relay)
+    await _client_load(engine, payload, min(8, n_clients), 2.0)
+    completed, lat = await _client_load(engine, payload, n_clients, duration_s)
+    wall = duration_s
+    return {
+        "qps": completed / wall,
+        "p50_ms": float(np.percentile(lat, 50) * 1e3) if len(lat) else float("nan"),
+        "p99_ms": float(np.percentile(lat, 99) * 1e3) if len(lat) else float("nan"),
+        "mode": engine.mode,
+        "batched": engine.batcher is not None,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--clients", type=int, default=256)
+    parser.add_argument("--duration", type=float, default=None)
+    args = parser.parse_args()
+    duration = args.duration or (3.0 if args.smoke else 15.0)
+    clients = args.clients if not args.smoke else min(args.clients, 64)
+
+    x = np.zeros((1, 784), dtype=np.float64)
+    payload = json.dumps({"data": {"ndarray": x.tolist()}})
+
+    relay_floor = _relay_floor_ms()
+
+    async def run_all():
+        g, c = _mnist_graph(1)
+        single = await _bench_engine(
+            _deployment(g, c), payload, clients, duration, max_wait_ms=3.0
+        )
+        g, c = _mnist_graph(4)
+        ens4 = await _bench_engine(
+            _deployment(g, c), payload, clients, max(duration / 2, 3.0),
+            max_wait_ms=3.0,
+        )
+        return single, ens4
+
+    single, ens4 = asyncio.run(run_all())
+
+    import jax
+
+    result = {
+        "metric": "mnist_graph_qps",
+        "value": round(single["qps"], 1),
+        "unit": "req/s",
+        "vs_baseline": round(single["qps"] / REFERENCE_REST_QPS, 4),
+        "clients": clients,
+        "p50_ms": round(single["p50_ms"], 2),
+        "p99_ms": round(single["p99_ms"], 2),
+        "ensemble4_qps": round(ens4["qps"], 1),
+        "ensemble4_p50_ms": round(ens4["p50_ms"], 2),
+        "relay_floor_ms": round(relay_floor, 2),
+        "device": str(jax.devices()[0]),
+        "duration_s": duration,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

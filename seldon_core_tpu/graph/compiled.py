@@ -157,6 +157,10 @@ class CompiledGraph:
         def run_fb(states, X, routing, reward, truth):
             return feedback_fn(states, X, routing, reward, truth)
 
+        #: pure (states, X) -> (Y, states', routing, tags); re-jittable by
+        #: callers that want custom shardings/donation
+        self.predict_fn = run
+        self.feedback_fn = run_fb
         self._jit_predict = jax.jit(run)
         self._jit_feedback = jax.jit(run_fb)
 

@@ -80,6 +80,10 @@ class Unit:
     #: True if every implemented method is jax-traceable (pure); the compiled
     #: executor refuses impure units, the host interpreter accepts both.
     pure: bool = True
+    #: True when the predict/transform path returns state updates that depend
+    #: on the rows seen (streaming statistics).  Engines must not pad batches
+    #: through such units (padding rows would enter the statistics).
+    updates_state_on_predict: bool = False
     #: optional output feature names (the wrappers' class_names)
     class_names: Optional[list] = None
     #: static meta tags merged into every response this unit touches

@@ -28,6 +28,8 @@ _EPS = 1e-6
 
 @register_unit("MahalanobisOutlier")
 class MahalanobisOutlier(Unit):
+    updates_state_on_predict = True  # running mean/cov count every row seen
+
     def __init__(self, n_features: int, n_components: int = 3, max_n: int = -1):
         self.p = int(n_features)
         self.k = min(int(n_components), self.p)

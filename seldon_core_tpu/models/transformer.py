@@ -1,0 +1,204 @@
+"""Decoder-only transformer LM — the long-context / distributed flagship.
+
+The reference serves no sequence models (SURVEY.md §5: long-context absent,
+pre-LLM era); this family exists so the graph IR's nodes can span a TPU mesh
+slice, which the task's north star requires.  Parallelism is GSPMD-first
+(the scaling-book recipe): parameters carry ``NamedSharding``s —
+
+    wqkv [D, 3D]   P(None, 'tp')     heads sharded over tp
+    wo   [D, D]    P('tp', None)     row-sharded; XLA inserts the psum
+    w1   [D, F]    P(None, 'tp')
+    w2   [F, D]    P('tp', None)
+    embed [V, D]   replicated (small vocabs); norms replicated
+
+activations shard as tokens ``[B, S] : P('dp', 'sp')``, and attention runs
+as a ``shard_map`` ring over the ``sp`` axis (parallel/ring_attention.py),
+rotating K/V blocks over ICI with online-softmax accumulation.  Everything
+else — gradient all-reduce over dp, activation collectives for tp — is
+inserted by XLA from the shardings.
+
+``train_step`` is a pure (params, opt_state, batch) -> (params, opt_state,
+loss) function; jit it over the mesh for the full dp/tp/sp-parallel training
+step (used by ``__graft_entry__.dryrun_multichip``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.parallel.ring_attention import ring_attention
+
+__all__ = ["LMConfig", "lm_init", "lm_apply", "lm_loss", "lm_train_step",
+           "param_shardings", "TransformerLM"]
+
+
+@dataclass(frozen=True)
+class LMConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+def _rmsnorm(x, w, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * w
+
+
+def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
+    keys = jax.random.split(rng, cfg.n_layers * 4 + 1)
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+        ).astype(dt)
+
+    params: Dict[str, Any] = {
+        "embed": dense(keys[0], (cfg.vocab, cfg.d_model), cfg.d_model),
+    }
+    for i in range(cfg.n_layers):
+        k = keys[1 + 4 * i : 1 + 4 * (i + 1)]
+        params[f"l{i}"] = {
+            "ln1": jnp.ones((cfg.d_model,), dt),
+            "wqkv": dense(k[0], (cfg.d_model, 3 * cfg.d_model), cfg.d_model),
+            "wo": dense(k[1], (cfg.d_model, cfg.d_model), cfg.d_model),
+            "ln2": jnp.ones((cfg.d_model,), dt),
+            "w1": dense(k[2], (cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w2": dense(k[3], (cfg.d_ff, cfg.d_model), cfg.d_ff),
+        }
+    params["ln_f"] = jnp.ones((cfg.d_model,), dt)
+    return params
+
+
+def param_shardings(mesh: Mesh, params) -> Any:
+    """NamedShardings for the tp layout above (replicated where not listed)."""
+
+    def spec_for(path: str):
+        if path.endswith("wqkv") or path.endswith("w1"):
+            return P(None, "tp") if "tp" in mesh.axis_names else P()
+        if path.endswith("wo") or path.endswith("w2"):
+            return P("tp", None) if "tp" in mesh.axis_names else P()
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    shardings = [
+        NamedSharding(mesh, spec_for(jax.tree_util.keystr(path)))
+        for path, _ in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def _attention(q, k, v, mesh: Optional[Mesh], causal: bool):
+    """[B, H, S, hd] -> [B, H, S, hd]; ring over sp when the mesh shards S."""
+    if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        specs = P(
+            "dp" if "dp" in mesh.axis_names else None,
+            "tp" if "tp" in mesh.axis_names else None,
+            "sp",
+            None,
+        )
+
+        ring = partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(specs, specs, specs),
+            out_specs=specs,
+        )(lambda q_, k_, v_: ring_attention(q_, k_, v_, "sp", causal=causal))
+        return ring(q, k, v)
+    # single-block fallback: plain causal attention
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[2])[:, None]
+        kpos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qpos >= kpos, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def lm_apply(
+    params, tokens, cfg: LMConfig, mesh: Optional[Mesh] = None, causal: bool = True
+):
+    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    x = params["embed"][tokens]  # [B,S,D]
+    B, S, D = x.shape
+    hd = cfg.d_model // cfg.n_heads
+    for i in range(cfg.n_layers):
+        lp = params[f"l{i}"]
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = h @ lp["wqkv"]  # [B,S,3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+
+        a = _attention(heads(q), heads(k), heads(v), mesh, causal)
+        a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
+        x = x + a @ lp["wo"]
+        h = _rmsnorm(x, lp["ln2"])
+        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def lm_loss(params, batch, cfg: LMConfig, mesh: Optional[Mesh] = None):
+    """Next-token cross-entropy; batch = {tokens: [B, S+1]}."""
+    tokens = batch["tokens"]
+    logits = lm_apply(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def lm_train_step(params, opt_state, batch, optimizer, cfg: LMConfig,
+                  mesh: Optional[Mesh] = None):
+    loss, grads = jax.value_and_grad(lm_loss)(params, batch, cfg, mesh)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates)
+    return params, opt_state, loss
+
+
+@register_unit("TransformerLM")
+class TransformerLM(Unit):
+    """Serving unit: next-token logits for a token batch.  For multi-chip
+    serving construct with a mesh; params shard per ``param_shardings``."""
+
+    def __init__(
+        self,
+        vocab: int = 256,
+        d_model: int = 128,
+        n_heads: int = 4,
+        n_layers: int = 2,
+        d_ff: int = 512,
+        seed: int = 0,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.cfg = LMConfig(
+            vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
+            n_layers=int(n_layers), d_ff=int(d_ff),
+        )
+        self.seed = int(seed)
+        self.mesh = mesh
+
+    def init_state(self, rng):
+        if rng is None:
+            rng = jax.random.key(self.seed)
+        rng = jax.random.fold_in(rng, self.seed)
+        params = lm_init(rng, self.cfg)
+        if self.mesh is not None:
+            params = jax.device_put(params, param_shardings(self.mesh, params))
+        return params
+
+    def predict(self, state, X):
+        tokens = X.astype(jnp.int32)
+        return lm_apply(state, tokens, self.cfg, self.mesh)

@@ -1,0 +1,15 @@
+"""Parallelism layer: device-mesh management, ensemble sharding over ICI,
+ring attention for sequence/context parallelism, multi-host helpers.
+
+The reference's parallelism is service-level (k8s replicas, engine @Async
+fan-out — SURVEY.md §2.7); here the same concepts map onto a TPU mesh:
+data parallelism = batch axis sharding, ensemble/branch parallelism =
+member axis + psum over ICI, model parallelism = tp sharding of weight
+matrices, sequence parallelism = ring attention over the sp axis."""
+
+from seldon_core_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    local_device_count,
+)
+from seldon_core_tpu.parallel.ensemble import SharedEnsembleUnit  # noqa: F401

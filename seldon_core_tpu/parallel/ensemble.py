@@ -1,0 +1,132 @@
+"""Ensemble sharding over ICI — the COMBINER fan-out as a mesh program.
+
+The reference engine implements an ensemble by broadcasting the request to N
+child microservices over HTTP/gRPC and averaging the JSON responses
+(engine PredictiveUnitBean.java:96-118 + AverageCombinerUnit.java:30-95).
+On a TPU slice the same graph is: member parameters stacked on a leading
+``ens`` axis and sharded one-member-per-chip; every chip runs its member on
+the (replicated or dp-sharded) batch in parallel; the average is a single
+``psum`` riding the ICI links.  Wall-clock is one member's forward + one
+all-reduce — the linear-QPS-scaling north star (BASELINE.md).
+
+``SharedEnsembleUnit`` wraps any parameterised member unit (e.g.
+``MnistClassifier``) and presents the whole ensemble as ONE graph unit, so a
+4-model AVERAGE_COMBINER graph can be expressed either as the explicit
+4-child graph (compiled to 4 sequential member calls XLA may fuse) or as
+this sharded unit (4 members truly concurrent across chips)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.graph.spec import GraphSpecError
+from seldon_core_tpu.parallel.mesh import build_mesh
+
+__all__ = ["SharedEnsembleUnit", "stack_member_states", "ensemble_mean_fn"]
+
+
+def stack_member_states(member_states):
+    """Stack per-member state pytrees along a new leading ``ens`` axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *member_states)
+
+
+def ensemble_mean_fn(
+    member_apply: Callable, mesh: Mesh, n_members: int, axis: str = "ens"
+):
+    """Build fn(stacked_states, X) -> mean prediction, sharded over ``axis``.
+
+    ``member_apply(state, X) -> Y`` is one member's forward.  Inside
+    ``shard_map`` each chip holds its slice of the stacked member states,
+    runs them (vmap over the local slice, so members-per-chip > 1 works),
+    and the ensemble mean reduces with ONE psum over ICI."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+    )
+    def fn(stacked_states, X):
+        # local slice: [members_per_chip, ...]
+        local = jax.vmap(member_apply, in_axes=(0, None))(stacked_states, X)
+        return jax.lax.psum(jnp.sum(local, axis=0), axis) / n_members
+
+    return fn
+
+
+@register_unit("SharedEnsembleUnit")
+class SharedEnsembleUnit(Unit):
+    """An N-member ensemble as a single MODEL unit, members sharded over the
+    mesh's ``ens`` axis.
+
+    Parameters (graph spec):
+      member      — registered unit name / module:Class of the member model
+      n_members   — ensemble size
+      mesh_axis   — mesh axis to shard members over (default "ens")
+    plus any member parameters prefixed ``member_`` (e.g. ``member_hidden``).
+    """
+
+    def __init__(
+        self,
+        member: str = "MnistClassifier",
+        n_members: int = 4,
+        mesh_axis: str = "ens",
+        mesh: Optional[Mesh] = None,
+        **member_kwargs,
+    ):
+        from seldon_core_tpu.graph.units import resolve_unit_class
+
+        self.n = int(n_members)
+        self.axis = mesh_axis
+        member_cls = resolve_unit_class(member)
+        # graph parameters may prefix member kwargs (member_hidden=...) or not
+        self.member_kwargs = {
+            k.removeprefix("member_"): v for k, v in member_kwargs.items()
+        }
+        base_seed = int(self.member_kwargs.pop("seed", 0))
+        self.members = [
+            member_cls(**{**self.member_kwargs, "seed": base_seed + i})
+            for i in range(self.n)
+        ]
+        self.class_names = self.members[0].class_names
+        self.mesh = mesh if mesh is not None else build_mesh({mesh_axis: -1})
+        if self.n % self.mesh.shape[self.axis] != 0:
+            raise GraphSpecError(
+                f"ensemble of {self.n} members not divisible over mesh axis "
+                f"{self.axis!r} of size {self.mesh.shape[self.axis]}"
+            )
+        member_apply = type(self.members[0]).predict
+
+        def apply_one(state, X):
+            return member_apply(self.members[0], state, X)
+
+        self._fn = ensemble_mean_fn(apply_one, self.mesh, self.n, self.axis)
+
+    def init_state(self, rng):
+        import jax
+
+        if rng is None:
+            rng = jax.random.key(0)
+        keys = jax.random.split(rng, self.n)
+        stacked = stack_member_states(
+            [m.init_state(keys[i]) for i, m in enumerate(self.members)]
+        )
+        # shard member axis over ICI
+        return jax.device_put(
+            stacked,
+            jax.tree_util.tree_map(
+                lambda _: NamedSharding(
+                    self.mesh, P(self.axis)
+                ),
+                stacked,
+            ),
+        )
+
+    def predict(self, state, X):
+        return self._fn(state, X)

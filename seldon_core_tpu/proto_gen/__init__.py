@@ -1,0 +1,1 @@
+"""Generated protobuf bindings (protoc --python_out from proto/prediction.proto); regenerate via make proto."""

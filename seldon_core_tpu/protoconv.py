@@ -1,0 +1,157 @@
+"""Protobuf <-> dataclass conversion for the data plane.
+
+Only network edges touch protos; the graph runtime works on the dataclasses
+in ``seldon_core_tpu.messages`` with device-resident arrays.  Conversion
+preserves the data oneof kind exactly like the JSON codec (tensor stays
+tensor, ndarray stays ndarray — engine PredictorUtils.java:127-166)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+from google.protobuf import json_format, struct_pb2
+
+from seldon_core_tpu.messages import (
+    DefaultData,
+    Feedback,
+    Meta,
+    SeldonMessage,
+    SeldonMessageError,
+    SeldonMessageList,
+    Status,
+)
+from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+
+__all__ = [
+    "msg_to_proto",
+    "msg_from_proto",
+    "feedback_to_proto",
+    "feedback_from_proto",
+    "msg_list_to_proto",
+    "msg_list_from_proto",
+]
+
+
+def _value_to_py(v: struct_pb2.Value) -> Any:
+    return json_format.MessageToDict(v)
+
+
+def _py_to_value(x: Any) -> struct_pb2.Value:
+    v = struct_pb2.Value()
+    json_format.ParseDict(x, v)
+    return v
+
+
+def msg_to_proto(msg: SeldonMessage) -> pb.SeldonMessage:
+    out = pb.SeldonMessage()
+    if msg.status is not None:
+        out.status.code = msg.status.code
+        out.status.info = msg.status.info
+        out.status.reason = msg.status.reason
+        out.status.status = (
+            pb.Status.FAILURE if msg.status.status == "FAILURE" else pb.Status.SUCCESS
+        )
+    out.meta.puid = msg.meta.puid
+    for k, v in msg.meta.tags.items():
+        out.meta.tags[k].CopyFrom(_py_to_value(v))
+    for k, v in msg.meta.routing.items():
+        out.meta.routing[k] = int(v)
+    for k, v in msg.meta.requestPath.items():
+        out.meta.requestPath[k] = str(v)
+    if msg.data is not None:
+        out.data.names.extend(msg.data.names)
+        a = msg.data.numpy()
+        if msg.data.kind == "ndarray":
+            lv = struct_pb2.ListValue()
+            json_format.ParseDict(a.tolist(), lv)
+            out.data.ndarray.CopyFrom(lv)
+        else:
+            out.data.tensor.shape.extend(int(s) for s in a.shape)
+            out.data.tensor.values.extend(
+                np.asarray(a, dtype=np.float64).reshape(-1).tolist()
+            )
+    elif msg.bin_data is not None:
+        out.binData = msg.bin_data
+    elif msg.str_data is not None:
+        out.strData = msg.str_data
+    return out
+
+
+def msg_from_proto(p: pb.SeldonMessage, dtype=np.float64) -> SeldonMessage:
+    msg = SeldonMessage(
+        meta=Meta(
+            puid=p.meta.puid,
+            tags={k: _value_to_py(v) for k, v in p.meta.tags.items()},
+            routing=dict(p.meta.routing),
+            requestPath=dict(p.meta.requestPath),
+        )
+    )
+    if p.HasField("status"):
+        msg.status = Status(
+            code=p.status.code,
+            info=p.status.info,
+            reason=p.status.reason,
+            status="FAILURE" if p.status.status == pb.Status.FAILURE else "SUCCESS",
+        )
+    which = p.WhichOneof("data_oneof")
+    if which == "data":
+        names = list(p.data.names)
+        dwhich = p.data.WhichOneof("data_oneof")
+        if dwhich == "tensor":
+            values = np.asarray(p.data.tensor.values, dtype=dtype)
+            shape = list(p.data.tensor.shape) or [values.size]
+            try:
+                arr = values.reshape(shape)
+            except ValueError as e:
+                raise SeldonMessageError(
+                    f"tensor shape {shape} != #values {values.size}"
+                ) from e
+            msg.data = DefaultData(array=arr, names=names, kind="tensor")
+        elif dwhich == "ndarray":
+            nested = json_format.MessageToDict(p.data.ndarray)
+            try:
+                arr = np.asarray(nested, dtype=dtype)
+            except (ValueError, TypeError):
+                arr = np.asarray(nested, dtype=object)
+            msg.data = DefaultData(array=arr, names=names, kind="ndarray")
+        else:
+            raise SeldonMessageError("DefaultData missing tensor/ndarray")
+    elif which == "binData":
+        msg.bin_data = p.binData
+    elif which == "strData":
+        msg.str_data = p.strData
+    return msg
+
+
+def feedback_to_proto(fb: Feedback) -> pb.Feedback:
+    out = pb.Feedback(reward=float(fb.reward))
+    if fb.request is not None:
+        out.request.CopyFrom(msg_to_proto(fb.request))
+    if fb.response is not None:
+        out.response.CopyFrom(msg_to_proto(fb.response))
+    if fb.truth is not None:
+        out.truth.CopyFrom(msg_to_proto(fb.truth))
+    return out
+
+
+def feedback_from_proto(p: pb.Feedback, dtype=np.float64) -> Feedback:
+    return Feedback(
+        request=msg_from_proto(p.request, dtype) if p.HasField("request") else None,
+        response=msg_from_proto(p.response, dtype) if p.HasField("response") else None,
+        reward=float(p.reward),
+        truth=msg_from_proto(p.truth, dtype) if p.HasField("truth") else None,
+    )
+
+
+def msg_list_to_proto(ml: SeldonMessageList) -> pb.SeldonMessageList:
+    out = pb.SeldonMessageList()
+    for m in ml.messages:
+        out.seldonMessages.append(msg_to_proto(m))
+    return out
+
+
+def msg_list_from_proto(p: pb.SeldonMessageList, dtype=np.float64) -> SeldonMessageList:
+    return SeldonMessageList(
+        messages=[msg_from_proto(m, dtype) for m in p.seldonMessages]
+    )

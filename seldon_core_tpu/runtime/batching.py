@@ -1,0 +1,135 @@
+"""Micro-batching queue — shape-bucketed request coalescing.
+
+The reference engine serves request-at-a-time (one Spring @Async chain per
+request); on TPU the economics invert: a device dispatch has fixed overhead
+(especially host readback), while batch compute is nearly free on the MXU.
+The ``MicroBatcher`` coalesces concurrent requests that share a feature
+shape into one stacked dispatch and splits the result rows back out, so K
+concurrent clients cost ~one dispatch instead of K.
+
+Semantics note: batching is only transparent for graphs whose per-request
+decisions don't change under concatenation — MODEL / TRANSFORMER / COMBINER
+chains.  ROUTER graphs make one routing decision per *request* in the
+reference (engine PredictiveUnitBean.java:91), so the engine only enables
+auto-batching for router-free graphs (checked by ``graph_is_batchable``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from seldon_core_tpu.graph.interpreter import methods_for
+from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
+
+__all__ = ["MicroBatcher", "graph_is_batchable"]
+
+
+def graph_is_batchable(graph: PredictiveUnit) -> bool:
+    """True when no node routes (per-request decisions) — see module note."""
+    return not any(
+        UnitMethod.ROUTE in methods_for(u) and u.children for u in graph.walk()
+    )
+
+
+class MicroBatcher:
+    """Coalesce concurrent ``submit(row_batch)`` calls into stacked calls of
+    ``batch_fn`` (an ``async ([B, ...]) -> ([B, ...], aux)`` callable).
+
+    * requests are bucketed by trailing feature shape + dtype;
+    * a bucket flushes when it reaches ``max_batch`` rows or when the oldest
+      entry has waited ``max_wait_ms`` (latency bound);
+    * each caller gets back exactly its rows.
+    """
+
+    def __init__(
+        self,
+        batch_fn: Callable[[np.ndarray], Awaitable[Tuple[Any, Any]]],
+        max_batch: int = 1024,
+        max_wait_ms: float = 2.0,
+        pad_to_buckets: bool = True,
+    ):
+        self.batch_fn = batch_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        # pad stacked batches up to power-of-two sizes so jit sees a handful
+        # of shapes instead of retracing for every distinct row total; callers
+        # with state that counts rows (streaming statistics) must disable it
+        self.pad_to_buckets = pad_to_buckets
+        self._buckets: Dict[Tuple, List] = {}
+        self._flush_tasks: Dict[Tuple, asyncio.Task] = {}
+        self._inflight: set = set()  # strong refs: bare create_task is GC-able
+
+    async def submit(self, x: np.ndarray):
+        """x: [b, ...feature] rows of one request.  Returns (y_rows, aux)."""
+        x = np.asarray(x)
+        if x.ndim < 2:
+            # a 1-D payload would be bucketed as len(x) scalar rows and come
+            # back sliced by feature count — treat it as one sample instead
+            x = np.atleast_2d(x)
+        key = (x.shape[1:], str(x.dtype))
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        bucket = self._buckets.setdefault(key, [])
+        bucket.append((x, fut))
+        rows = sum(len(e[0]) for e in bucket)
+        if rows >= self.max_batch:
+            self._flush(key)
+        elif key not in self._flush_tasks:
+            self._flush_tasks[key] = asyncio.create_task(self._deadline(key))
+        return await fut
+
+    async def _deadline(self, key) -> None:
+        await asyncio.sleep(self.max_wait_s)
+        self._flush(key)
+
+    def _flush(self, key) -> None:
+        bucket = self._buckets.pop(key, [])
+        task = self._flush_tasks.pop(key, None)
+        if task is not None and not task.done():
+            task.cancel()
+        if bucket:
+            t = asyncio.get_running_loop().create_task(self._run_batch(bucket))
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+
+    async def _run_batch(self, bucket) -> None:
+        xs = [e[0] for e in bucket]
+        futs = [e[1] for e in bucket]
+        try:
+            stacked = np.concatenate(xs, axis=0)
+            total = len(stacked)
+            if self.pad_to_buckets and total > 1:
+                target = min(1 << (total - 1).bit_length(), self.max_batch)
+                if target > total:
+                    pad = np.repeat(stacked[-1:], target - total, axis=0)
+                    stacked = np.concatenate([stacked, pad], axis=0)
+            ys, aux = await self.batch_fn(stacked)
+            ys = np.asarray(ys)[:total]
+            if len(stacked) != total:  # drop padding rows from per-row aux
+                aux = _slice_aux(aux, slice(0, total), len(stacked))
+                # per-row arrays are now `total` long for the re-slice below
+            offset = 0
+            for x, fut in zip(xs, futs):
+                if not fut.cancelled():
+                    rows = slice(offset, offset + len(x))
+                    fut.set_result((ys[rows], _slice_aux(aux, rows, total)))
+                offset += len(x)
+        except Exception as e:  # propagate to every waiter
+            for fut in futs:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def _slice_aux(aux, rows: slice, total: int):
+    """Give each caller its own rows of any per-row aux arrays (leading dim
+    == stacked batch size, e.g. per-row outlier scores); everything else is
+    shared verbatim."""
+    if isinstance(aux, dict):
+        return {k: _slice_aux(v, rows, total) for k, v in aux.items()}
+    if isinstance(aux, tuple):
+        return tuple(_slice_aux(v, rows, total) for v in aux)
+    if hasattr(aux, "shape") and getattr(aux, "ndim", 0) >= 1 and aux.shape[0] == total:
+        return np.asarray(aux)[rows]
+    return aux

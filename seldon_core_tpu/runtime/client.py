@@ -25,7 +25,7 @@ from seldon_core_tpu.messages import (
     SeldonMessageList,
 )
 
-__all__ = ["RestNodeRuntime", "RemoteCallError"]
+__all__ = ["RestNodeRuntime", "GrpcNodeRuntime", "RemoteCallError", "make_node_runtime"]
 
 DEFAULT_TIMEOUT_S = 5.0  # reference TIMEOUT, InternalPredictionService.java:77
 
@@ -34,6 +34,15 @@ class RemoteCallError(RuntimeError):
     def __init__(self, node: str, path: str, detail: str):
         super().__init__(f"remote node {node!r} {path}: {detail}")
         self.node = node
+
+
+def _branch_from_msg(node_name: str, resp: SeldonMessage, where: str) -> int:
+    """Branch index extracted from the returned tensor, reference-style
+    (engine PredictiveUnitBean.java:227-237)."""
+    try:
+        return int(np.asarray(resp.array()).ravel()[0])
+    except (SeldonMessageError, IndexError, ValueError) as e:
+        raise RemoteCallError(node_name, where, f"bad branch: {e}") from e
 
 
 class RestNodeRuntime(NodeRuntime):
@@ -116,12 +125,7 @@ class RestNodeRuntime(NodeRuntime):
 
     async def route(self, msg: SeldonMessage) -> int:
         resp = await self._post("/route", msg.to_json())
-        # branch index extracted from the returned tensor, reference-style
-        # (engine PredictiveUnitBean.java:227-237)
-        try:
-            return int(np.asarray(resp.array()).ravel()[0])
-        except (SeldonMessageError, IndexError, ValueError) as e:
-            raise RemoteCallError(self.node.name, "/route", f"bad branch: {e}") from e
+        return _branch_from_msg(self.node.name, resp, "/route")
 
     async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
         payload = SeldonMessageList(messages=msgs).to_json()
@@ -129,3 +133,119 @@ class RestNodeRuntime(NodeRuntime):
 
     async def send_feedback(self, feedback: Feedback, branch: int) -> None:
         await self._post("/send-feedback", feedback.to_json())
+
+
+class GrpcNodeRuntime(NodeRuntime):
+    """gRPC microservice client for one graph node.  One persistent channel
+    per node, reused across requests — unlike the reference, which creates a
+    ManagedChannel per call (engine InternalPredictionService.java:211-214, a
+    known hot-loop inefficiency).  Method routing follows the reference's
+    type dispatch: MODEL -> Model.Predict, ROUTER -> Router.Route, ...
+    (engine InternalPredictionService.java:132-161)."""
+
+    def __init__(
+        self,
+        node: PredictiveUnit,
+        binding: ComponentBinding,
+        timeout_s: float = DEFAULT_TIMEOUT_S,
+    ):
+        import grpc
+
+        from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+        from seldon_core_tpu.runtime.grpc_server import GRPC_MAX_MESSAGE
+
+        self.node = node
+        self.binding = binding
+        self.timeout_s = timeout_s
+        self._pb = pb
+        self._channel = grpc.aio.insecure_channel(
+            f"{binding.host or 'localhost'}:{binding.port}",
+            options=[
+                ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE),
+                ("grpc.max_send_message_length", GRPC_MAX_MESSAGE),
+            ],
+        )
+
+        def unary(path, req_cls):
+            return self._channel.unary_unary(
+                path,
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+
+        self._predict = unary("/seldon.protos.Model/Predict", pb.SeldonMessage)
+        self._transform_input = unary(
+            "/seldon.protos.Transformer/TransformInput", pb.SeldonMessage
+        )
+        self._transform_output = unary(
+            "/seldon.protos.OutputTransformer/TransformOutput", pb.SeldonMessage
+        )
+        self._route = unary("/seldon.protos.Router/Route", pb.SeldonMessage)
+        self._aggregate = unary(
+            "/seldon.protos.Combiner/Aggregate", pb.SeldonMessageList
+        )
+        # feedback dispatch matches the reference exactly: typed units get
+        # Router/SendFeedback, untyped get Generic/SendFeedback (the Model
+        # service has no SendFeedback rpc in the contract) — engine
+        # InternalPredictionService.java:111-130
+        fb_service = "Generic" if node.type is None else "Router"
+        self._send_feedback = unary(
+            f"/seldon.protos.{fb_service}/SendFeedback", pb.Feedback
+        )
+
+    async def close(self) -> None:
+        await self._channel.close()
+
+    async def _call(self, stub, proto_req) -> SeldonMessage:
+        import grpc
+
+        from seldon_core_tpu import protoconv
+
+        try:
+            resp = await stub(proto_req, timeout=self.timeout_s)
+        except grpc.aio.AioRpcError as e:
+            raise RemoteCallError(
+                self.node.name, str(stub._method), f"{e.code().name}: {e.details()}"
+            ) from e
+        return protoconv.msg_from_proto(resp)
+
+    # -- NodeRuntime API ----------------------------------------------------
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        from seldon_core_tpu import protoconv
+
+        return await self._call(self._predict, protoconv.msg_to_proto(msg))
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        from seldon_core_tpu import protoconv
+
+        return await self._call(self._transform_input, protoconv.msg_to_proto(msg))
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        from seldon_core_tpu import protoconv
+
+        return await self._call(self._transform_output, protoconv.msg_to_proto(msg))
+
+    async def route(self, msg: SeldonMessage) -> int:
+        from seldon_core_tpu import protoconv
+
+        resp = await self._call(self._route, protoconv.msg_to_proto(msg))
+        return _branch_from_msg(self.node.name, resp, "Route")
+
+    async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
+        from seldon_core_tpu import protoconv
+
+        proto = protoconv.msg_list_to_proto(SeldonMessageList(messages=msgs))
+        return await self._call(self._aggregate, proto)
+
+    async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        from seldon_core_tpu import protoconv
+
+        await self._call(self._send_feedback, protoconv.feedback_to_proto(feedback))
+
+
+def make_node_runtime(node: PredictiveUnit, binding: ComponentBinding) -> NodeRuntime:
+    """Build the right remote runtime for a binding (rest/grpc)."""
+    if binding.runtime == "grpc":
+        return GrpcNodeRuntime(node, binding)
+    return RestNodeRuntime(node, binding)

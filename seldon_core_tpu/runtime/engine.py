@@ -22,8 +22,11 @@ from __future__ import annotations
 import asyncio
 from typing import Dict, Optional
 
+import numpy as np
+
 from seldon_core_tpu.graph.compiled import CompiledGraph
-from seldon_core_tpu.graph.interpreter import GraphExecutor, NodeRuntime
+from seldon_core_tpu.graph.interpreter import GraphExecutor, NodeRuntime, pythonize_tags
+from seldon_core_tpu.runtime.batching import MicroBatcher, graph_is_batchable
 from seldon_core_tpu.graph.spec import (
     GraphSpecError,
     PredictorSpec,
@@ -50,6 +53,9 @@ class EngineService:
         extra_runtimes: Optional[Dict[str, NodeRuntime]] = None,
         rng=None,
         force_host: bool = False,
+        batching: bool = True,
+        max_batch: int = 1024,
+        max_wait_ms: float = 2.0,
     ):
         self.deployment = deployment
         self.predictor: PredictorSpec = deployment.predictor(predictor_name)
@@ -73,9 +79,50 @@ class EngineService:
             except GraphSpecError:
                 pass
         if self.compiled is None:
+            # remote rest/grpc bindings get pooled clients automatically
+            runtimes = dict(extra_runtimes or {})
+            comp_map = self.predictor.component_map()
+            for node in self.predictor.graph.walk():
+                binding = comp_map.get(node.name)
+                if (
+                    node.name not in runtimes
+                    and binding is not None
+                    and binding.runtime in ("rest", "grpc")
+                ):
+                    from seldon_core_tpu.runtime.client import make_node_runtime
+
+                    runtimes[node.name] = make_node_runtime(node, binding)
             self.executor = GraphExecutor(
-                self.predictor, extra_runtimes=extra_runtimes, rng=rng
+                self.predictor, extra_runtimes=runtimes, rng=rng
             )
+        # micro-batching: coalesce concurrent requests into one device
+        # dispatch (router-free compiled graphs only — routing is a
+        # per-request decision in the reference semantics)
+        self.batcher = None
+        if self.compiled is not None and batching and graph_is_batchable(
+            self.predictor.graph
+        ):
+            # padding to power-of-two batch shapes avoids per-size retraces,
+            # but must not feed fake rows into streaming statistics
+            pad_ok = not any(
+                u.updates_state_on_predict for u in self.compiled.units.values()
+            )
+            self.batcher = MicroBatcher(
+                self._batched_predict,
+                max_batch=max_batch,
+                max_wait_ms=max_wait_ms,
+                pad_to_buckets=pad_ok,
+            )
+
+    async def _batched_predict(self, stacked):
+        async with self._device_lock:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._batched_predict_sync, stacked
+            )
+
+    def _batched_predict_sync(self, stacked):
+        y, routing, tags = self.compiled.predict_arrays(stacked)
+        return np.asarray(y), (routing, tags)
 
     # ------------------------------------------------------------------
 
@@ -84,6 +131,28 @@ class EngineService:
             msg.meta.puid = new_puid()
         with self.metrics.time_server("predictions", "POST") as code:
             try:
+                if self.batcher is not None and msg.data is not None:
+                    rows = np.atleast_2d(msg.array())
+                    y_rows, (routing, tags) = await self.batcher.submit(rows)
+                    resp = msg.with_array(
+                        y_rows,
+                        names=self.compiled._output_names(
+                            self.predictor.graph, routing
+                        ),
+                    )
+                    # fresh Meta/Status: with_array shares the request's meta
+                    # object, and the response must match the unbatched
+                    # compiled path exactly (compiled.CompiledGraph.predict)
+                    from seldon_core_tpu.messages import Meta, Status
+
+                    resp.meta = Meta(
+                        puid=msg.meta.puid,
+                        tags={**msg.meta.tags, **pythonize_tags(tags)},
+                        routing={**msg.meta.routing, **routing},
+                        requestPath=dict(msg.meta.requestPath),
+                    )
+                    resp.status = Status()
+                    return resp
                 if self.compiled is not None:
                     # device dispatch is synchronous but brief; keep the loop
                     # responsive by running it in the default executor
@@ -131,6 +200,14 @@ class EngineService:
                 return SeldonMessage.failure(str(e), code=400)
         self.metrics.record_feedback(feedback.reward)
         return ack
+
+    async def close(self) -> None:
+        """Release pooled remote-node clients (host mode)."""
+        if self.executor is not None:
+            for rt in self.executor.runtimes.values():
+                closer = getattr(rt, "close", None)
+                if closer is not None:
+                    await closer()
 
     # -- admin (engine RestClientController.java:57-99) -----------------
 

@@ -1,0 +1,190 @@
+"""gRPC servers — wire-compatible with the reference's prediction services.
+
+The environment has the grpc runtime but no protoc grpc plugin, so services
+are registered via ``grpc.method_handlers_generic_handler`` with serializers
+from the generated message classes — same wire format as stub-generated code.
+
+Engine server: ``seldon.protos.Seldon`` (Predict/SendFeedback) — the
+reference engine's SeldonGrpcServer (engine grpc/SeldonGrpcServer.java:34-62).
+Unit server: Generic/Model/Router/Transformer/OutputTransformer/Combiner —
+the reference wrappers' gRPC servicers (wrappers/python/
+model_microservice.py:92-125, router_microservice.py, ...)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from seldon_core_tpu import protoconv
+from seldon_core_tpu.graph.interpreter import InProcessNodeRuntime
+from seldon_core_tpu.graph.spec import GraphSpecError
+from seldon_core_tpu.messages import SeldonMessage, SeldonMessageError
+from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+
+__all__ = [
+    "make_engine_grpc_server",
+    "make_unit_grpc_server",
+    "serve_unit_grpc",
+    "GRPC_MAX_MESSAGE",
+]
+
+GRPC_MAX_MESSAGE = 256 * 1024 * 1024
+
+_OPTIONS = [
+    ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE),
+    ("grpc.max_send_message_length", GRPC_MAX_MESSAGE),
+]
+
+
+def _failure_proto(info: str, code: int = 400) -> pb.SeldonMessage:
+    return protoconv.msg_to_proto(SeldonMessage.failure(info, code=code))
+
+
+def _wrap(fn):
+    """Convert typed framework errors into FAILURE SeldonMessages and
+    unexpected ones into INTERNAL grpc errors."""
+
+    async def handler(request, context):
+        try:
+            return await fn(request)
+        except (SeldonMessageError, GraphSpecError) as e:
+            return _failure_proto(str(e))
+        except NotImplementedError as e:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
+
+    return handler
+
+
+def _unary(fn, req_cls, resp_cls=pb.SeldonMessage):
+    return grpc.unary_unary_rpc_method_handler(
+        _wrap(fn),
+        request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Engine (Seldon service)
+# ---------------------------------------------------------------------------
+
+
+def make_engine_grpc_server(engine, host: str, port: int) -> grpc.aio.Server:
+    async def predict(req: pb.SeldonMessage) -> pb.SeldonMessage:
+        resp = await engine.predict(protoconv.msg_from_proto(req))
+        return protoconv.msg_to_proto(resp)
+
+    async def send_feedback(req: pb.Feedback) -> pb.SeldonMessage:
+        ack = await engine.send_feedback(protoconv.feedback_from_proto(req))
+        return protoconv.msg_to_proto(ack)
+
+    server = grpc.aio.server(options=_OPTIONS)
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "seldon.protos.Seldon",
+                {
+                    "Predict": _unary(predict, pb.SeldonMessage),
+                    "SendFeedback": _unary(send_feedback, pb.Feedback),
+                },
+            ),
+        )
+    )
+    server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+# ---------------------------------------------------------------------------
+# Unit microservice (per-node services)
+# ---------------------------------------------------------------------------
+
+
+def make_unit_grpc_server(
+    runtime: InProcessNodeRuntime, host: str, port: int
+) -> grpc.aio.Server:
+    async def predict(req):
+        return protoconv.msg_to_proto(
+            await runtime.predict(protoconv.msg_from_proto(req))
+        )
+
+    async def transform_input(req):
+        return protoconv.msg_to_proto(
+            await runtime.transform_input(protoconv.msg_from_proto(req))
+        )
+
+    async def transform_output(req):
+        return protoconv.msg_to_proto(
+            await runtime.transform_output(protoconv.msg_from_proto(req))
+        )
+
+    async def route(req):
+        msg = protoconv.msg_from_proto(req)
+        branch = await runtime.route(msg)
+        # branch as 1x1 tensor, reference wrapper convention
+        # (wrappers/python/router_microservice.py:39-56)
+        return protoconv.msg_to_proto(
+            msg.with_array(np.array([[branch]], dtype=np.float64))
+        )
+
+    async def aggregate(req: pb.SeldonMessageList):
+        ml = protoconv.msg_list_from_proto(req)
+        return protoconv.msg_to_proto(await runtime.aggregate(ml.messages))
+
+    async def send_feedback(req: pb.Feedback):
+        fb = protoconv.feedback_from_proto(req)
+        routing = fb.response.meta.routing if fb.response is not None else {}
+        branch = int(routing.get(runtime.node.name, -1))
+        await runtime.send_feedback(fb, branch)
+        return protoconv.msg_to_proto(SeldonMessage())
+
+    services = {
+        "seldon.protos.Generic": {
+            "TransformInput": _unary(transform_input, pb.SeldonMessage),
+            "TransformOutput": _unary(transform_output, pb.SeldonMessage),
+            "Route": _unary(route, pb.SeldonMessage),
+            "Aggregate": _unary(aggregate, pb.SeldonMessageList),
+            "SendFeedback": _unary(send_feedback, pb.Feedback),
+        },
+        "seldon.protos.Model": {"Predict": _unary(predict, pb.SeldonMessage)},
+        "seldon.protos.Router": {
+            "Route": _unary(route, pb.SeldonMessage),
+            "SendFeedback": _unary(send_feedback, pb.Feedback),
+        },
+        "seldon.protos.Transformer": {
+            "TransformInput": _unary(transform_input, pb.SeldonMessage)
+        },
+        "seldon.protos.OutputTransformer": {
+            "TransformOutput": _unary(transform_output, pb.SeldonMessage)
+        },
+        "seldon.protos.Combiner": {
+            "Aggregate": _unary(aggregate, pb.SeldonMessageList)
+        },
+    }
+    server = grpc.aio.server(options=_OPTIONS)
+    server.add_generic_rpc_handlers(
+        tuple(
+            grpc.method_handlers_generic_handler(name, methods)
+            for name, methods in services.items()
+        )
+    )
+    server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+async def serve_unit_grpc(
+    runtime: InProcessNodeRuntime,
+    host: str,
+    port: int,
+    persistence: int = 0,
+) -> None:
+    background = []
+    if persistence:
+        from seldon_core_tpu.runtime.persistence import persist_loop, restore_runtime
+
+        restore_runtime(runtime)
+        background.append(asyncio.ensure_future(persist_loop(runtime)))
+    server = make_unit_grpc_server(runtime, host, port)
+    await server.start()
+    await server.wait_for_termination()

@@ -1,0 +1,239 @@
+"""Micro-batching tests: coalescing correctness, per-row tag slicing,
+router graphs excluded."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.batching import MicroBatcher, graph_is_batchable
+from seldon_core_tpu.runtime.engine import EngineService
+
+
+def deployment(graph, components=None):
+    return SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {"name": "p", "graph": graph, "components": components or []}
+                ],
+            }
+        }
+    )
+
+
+def test_graph_is_batchable():
+    from seldon_core_tpu.graph.spec import PredictiveUnit, UnitType
+
+    model = deployment({"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"})
+    assert graph_is_batchable(model.predictor().graph)
+    router = deployment(
+        {
+            "name": "r",
+            "implementation": "RANDOM_ABTEST",
+            "type": "ROUTER",
+            "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+            "children": [
+                {"name": "a", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+                {"name": "b", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+            ],
+        }
+    )
+    assert not graph_is_batchable(router.predictor().graph)
+    assert EngineService(router).batcher is None  # routers never auto-batch
+
+
+def test_microbatcher_coalesces_and_splits():
+    calls = []
+
+    async def batch_fn(stacked):
+        calls.append(len(stacked))
+        return stacked * 2.0, {"per_row": np.arange(len(stacked)), "shared": "x"}
+
+    async def run():
+        mb = MicroBatcher(batch_fn, max_batch=64, max_wait_ms=5.0)
+        outs = await asyncio.gather(
+            *[mb.submit(np.full((2, 3), i, np.float32)) for i in range(8)]
+        )
+        for i, (y, aux) in enumerate(outs):
+            np.testing.assert_allclose(y, np.full((2, 3), 2.0 * i))
+            assert aux["per_row"].shape == (2,)  # sliced to this caller's rows
+            np.testing.assert_array_equal(aux["per_row"], [2 * i, 2 * i + 1])
+            assert aux["shared"] == "x"
+
+    asyncio.run(run())
+    assert calls == [16]  # one coalesced dispatch for 8 concurrent callers
+
+
+def test_microbatcher_max_batch_flush():
+    calls = []
+
+    async def batch_fn(stacked):
+        calls.append(len(stacked))
+        return stacked, {}
+
+    async def run():
+        mb = MicroBatcher(batch_fn, max_batch=4, max_wait_ms=1000.0)
+        await asyncio.gather(*[mb.submit(np.ones((1, 2))) for _ in range(8)])
+
+    asyncio.run(run())
+    assert sum(calls) == 8
+    assert all(c <= 4 for c in calls)
+
+
+def test_microbatcher_error_propagates():
+    async def batch_fn(stacked):
+        raise ValueError("boom")
+
+    async def run():
+        mb = MicroBatcher(batch_fn, max_batch=4, max_wait_ms=1.0)
+        with pytest.raises(ValueError, match="boom"):
+            await mb.submit(np.ones((1, 2)))
+
+    asyncio.run(run())
+
+
+def test_microbatcher_pads_to_power_of_two():
+    sizes = []
+
+    async def batch_fn(stacked):
+        sizes.append(len(stacked))
+        return stacked, {"per_row": np.arange(len(stacked))}
+
+    async def run():
+        mb = MicroBatcher(batch_fn, max_batch=64, max_wait_ms=5.0)
+        outs = await asyncio.gather(
+            *[mb.submit(np.full((1, 3), i, np.float32)) for i in range(5)]
+        )
+        # 5 rows padded up to 8 (one jit shape, not one per row-count)
+        assert sizes == [8]
+        for i, (y, aux) in enumerate(outs):
+            np.testing.assert_allclose(y, np.full((1, 3), float(i)))
+            np.testing.assert_array_equal(aux["per_row"], [i])  # padding dropped
+
+    asyncio.run(run())
+
+
+def test_microbatcher_1d_payload_treated_as_one_sample():
+    async def batch_fn(stacked):
+        assert stacked.ndim == 2
+        return stacked * 2.0, {}
+
+    async def run():
+        mb = MicroBatcher(batch_fn, max_batch=8, max_wait_ms=1.0, pad_to_buckets=False)
+        y, _ = await mb.submit(np.array([1.0, 2.0, 3.0, 4.0]))
+        np.testing.assert_allclose(y, [[2.0, 4.0, 6.0, 8.0]])
+
+    asyncio.run(run())
+
+
+def test_engine_disables_padding_for_streaming_stats():
+    spec = deployment(
+        {
+            "name": "out",
+            "type": "TRANSFORMER",
+            "children": [{"name": "m0", "type": "MODEL"}],
+        },
+        [
+            {
+                "name": "out",
+                "runtime": "inprocess",
+                "class_path": "MahalanobisOutlier",
+                "parameters": [{"name": "n_features", "value": "784", "type": "INT"}],
+            },
+            {
+                "name": "m0",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32", "type": "INT"}],
+            },
+        ],
+    )
+    engine = EngineService(spec)
+    assert engine.batcher is not None
+    assert engine.batcher.pad_to_buckets is False  # padding would corrupt stats
+    plain = deployment(
+        {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
+    )
+    assert EngineService(plain).batcher.pad_to_buckets is True
+
+
+def test_engine_batched_results_match_unbatched():
+    spec = deployment(
+        {"name": "m0", "type": "MODEL"},
+        [
+            {
+                "name": "m0",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32", "type": "INT"}],
+            }
+        ],
+    )
+
+    async def run():
+        batched = EngineService(spec, max_wait_ms=5.0)
+        unbatched = EngineService(spec, batching=False)
+        assert batched.batcher is not None and unbatched.batcher is None
+        rng = np.random.default_rng(0)
+        reqs = [
+            SeldonMessage.from_array(rng.normal(size=(1, 784)).astype(np.float32))
+            for _ in range(6)
+        ]
+        got = await asyncio.gather(*[batched.predict(m) for m in reqs])
+        want = [await unbatched.predict(m) for m in reqs]
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g.array(), w.array(), atol=1e-5)
+            assert g.names() == w.names()
+            assert g.meta.puid  # assigned per request
+
+    asyncio.run(run())
+
+
+def test_engine_batched_outlier_tags_per_request():
+    """Per-row outlierScore tags are sliced to each caller's rows."""
+    from seldon_core_tpu.graph.units import UNIT_REGISTRY, Unit, register_unit
+
+    if "test.Scale" not in UNIT_REGISTRY:
+
+        @register_unit("test.Scale")
+        class ScaleUnit(Unit):
+            def __init__(self, factor: float = 2.0):
+                self.factor = factor
+
+            def predict(self, state, X):
+                return X * self.factor
+
+    spec = deployment(
+        {
+            "name": "out",
+            "type": "TRANSFORMER",
+            "children": [{"name": "m0", "type": "MODEL"}],
+        },
+        [
+            {
+                "name": "out",
+                "runtime": "inprocess",
+                "class_path": "MahalanobisOutlier",
+                "parameters": [{"name": "n_features", "value": "4", "type": "INT"}],
+            },
+            {"name": "m0", "runtime": "inprocess", "class_path": "test.Scale"},
+        ],
+    )
+
+    async def run():
+        engine = EngineService(spec, max_wait_ms=5.0)
+        assert engine.batcher is not None
+        reqs = [
+            SeldonMessage.from_array(np.full((2, 4), float(i), np.float32))
+            for i in range(4)
+        ]
+        resps = await asyncio.gather(*[engine.predict(m) for m in reqs])
+        for r in resps:
+            scores = np.asarray(r.meta.tags["outlierScore"])
+            assert scores.shape == (2,)  # this caller's rows only
+
+    asyncio.run(run())

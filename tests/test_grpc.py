@@ -1,0 +1,186 @@
+"""gRPC serving tests — wire-level parity with the reference's prediction
+services (engine grpc/SeldonGrpcServer.java, wrappers' gRPC servicers)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import protoconv
+from seldon_core_tpu.graph.spec import Parameter, SeldonDeploymentSpec
+from seldon_core_tpu.messages import Feedback, Meta, SeldonMessage
+from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+from seldon_core_tpu.runtime.client import GrpcNodeRuntime
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.grpc_server import (
+    make_engine_grpc_server,
+    make_unit_grpc_server,
+)
+from seldon_core_tpu.runtime.microservice import build_runtime
+from seldon_core_tpu.graph.spec import ComponentBinding, PredictiveUnit
+
+
+async def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_protoconv_roundtrip():
+    msg = SeldonMessage.from_array(
+        np.array([[1.0, 2.5]]), names=["a", "b"], kind="tensor"
+    )
+    msg.meta = Meta(puid="p1", tags={"k": "v", "n": 2.0}, routing={"r": 1})
+    back = protoconv.msg_from_proto(protoconv.msg_to_proto(msg))
+    np.testing.assert_array_equal(back.array(), msg.array())
+    assert back.meta.puid == "p1"
+    assert back.meta.tags == {"k": "v", "n": 2.0}
+    assert back.meta.routing == {"r": 1}
+    assert back.data.kind == "tensor"
+
+    nd = SeldonMessage.from_array(np.array([[1, 2], [3, 4]]), kind="ndarray")
+    back = protoconv.msg_from_proto(protoconv.msg_to_proto(nd))
+    assert back.data.kind == "ndarray"
+    np.testing.assert_array_equal(back.array(), [[1, 2], [3, 4]])
+
+    fb = Feedback(request=msg, reward=0.5)
+    back_fb = protoconv.feedback_from_proto(protoconv.feedback_to_proto(fb))
+    assert back_fb.reward == 0.5
+    np.testing.assert_array_equal(back_fb.request.array(), msg.array())
+
+    sd = SeldonMessage(str_data="hello")
+    assert protoconv.msg_from_proto(protoconv.msg_to_proto(sd)).str_data == "hello"
+    bd = SeldonMessage(bin_data=b"\x01\x02")
+    assert protoconv.msg_from_proto(protoconv.msg_to_proto(bd)).bin_data == b"\x01\x02"
+
+
+def test_engine_grpc_end_to_end():
+    """Seldon.Predict + SendFeedback against a compiled bandit graph."""
+
+    async def run():
+        spec = SeldonDeploymentSpec.from_json_dict(
+            {
+                "spec": {
+                    "name": "d",
+                    "predictors": [
+                        {
+                            "name": "p",
+                            "components": [
+                                {
+                                    "name": "eg",
+                                    "runtime": "inprocess",
+                                    "class_path": "EpsilonGreedyRouter",
+                                    "parameters": [
+                                        {"name": "n_branches", "value": "2", "type": "INT"}
+                                    ],
+                                },
+                                {
+                                    "name": "m0",
+                                    "runtime": "inprocess",
+                                    "class_path": "MnistClassifier",
+                                    "parameters": [
+                                        {"name": "hidden", "value": "32", "type": "INT"}
+                                    ],
+                                },
+                                {
+                                    "name": "m1",
+                                    "runtime": "inprocess",
+                                    "class_path": "MnistClassifier",
+                                    "parameters": [
+                                        {"name": "hidden", "value": "32", "type": "INT"},
+                                        {"name": "seed", "value": "1", "type": "INT"},
+                                    ],
+                                },
+                            ],
+                            "graph": {
+                                "name": "eg",
+                                "type": "ROUTER",
+                                "children": [
+                                    {"name": "m0", "type": "MODEL"},
+                                    {"name": "m1", "type": "MODEL"},
+                                ],
+                            },
+                        }
+                    ],
+                }
+            }
+        )
+        engine = EngineService(spec)
+        port = await _free_port()
+        server = make_engine_grpc_server(engine, "127.0.0.1", port)
+        await server.start()
+        try:
+            import grpc
+
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                predict = ch.unary_unary(
+                    "/seldon.protos.Seldon/Predict",
+                    request_serializer=pb.SeldonMessage.SerializeToString,
+                    response_deserializer=pb.SeldonMessage.FromString,
+                )
+                feedback = ch.unary_unary(
+                    "/seldon.protos.Seldon/SendFeedback",
+                    request_serializer=pb.Feedback.SerializeToString,
+                    response_deserializer=pb.SeldonMessage.FromString,
+                )
+                req = pb.SeldonMessage()
+                req.data.tensor.shape.extend([1, 784])
+                req.data.tensor.values.extend([0.0] * 784)
+                resp = await predict(req)
+                assert resp.meta.puid
+                assert "eg" in resp.meta.routing
+                probs = np.asarray(resp.data.tensor.values).reshape(
+                    list(resp.data.tensor.shape)
+                )
+                assert probs.shape == (1, 10)
+                np.testing.assert_allclose(probs.sum(), 1.0, atol=1e-3)
+
+                fb = pb.Feedback(reward=1.0)
+                fb.response.meta.routing["eg"] = 1
+                fb.request.CopyFrom(req)
+                ack = await feedback(fb)
+                assert not ack.HasField("status") or ack.status.status == pb.Status.SUCCESS
+                tries = np.asarray(engine.compiled.states["eg"]["tries"])
+                np.testing.assert_allclose(tries, [0.0, 1.0])
+        finally:
+            await server.stop(0)
+
+    asyncio.run(run())
+
+
+def test_unit_grpc_server_and_client_runtime():
+    """GrpcNodeRuntime (persistent channel) against the unit gRPC server —
+    the engine->model gRPC hop, channels reused unlike the reference."""
+
+    async def run():
+        runtime = build_runtime(
+            "MnistClassifier", "MODEL", [Parameter("hidden", "32", "INT")],
+            unit_name="m",
+        )
+        port = await _free_port()
+        server = make_unit_grpc_server(runtime, "127.0.0.1", port)
+        await server.start()
+        node = PredictiveUnit(name="m")
+        binding = ComponentBinding(name="m", runtime="grpc", host="127.0.0.1", port=port)
+        client = GrpcNodeRuntime(node, binding)
+        try:
+            msg = SeldonMessage.from_array(np.zeros((2, 784)), names=[])
+            resp = await client.predict(msg)
+            assert np.asarray(resp.array()).shape == (2, 10)
+            assert resp.names() == [f"class:{i}" for i in range(10)]
+
+            # unimplemented method on this unit -> grpc UNIMPLEMENTED surfaced
+            # as a typed client error, not a crash
+            from seldon_core_tpu.runtime.client import RemoteCallError
+
+            with pytest.raises(RemoteCallError, match="UNIMPLEMENTED"):
+                await client.transform_output(msg)
+        finally:
+            await client.close()
+            await server.stop(0)
+
+    asyncio.run(run())

@@ -1,0 +1,155 @@
+"""Parallel-layer tests on the 8-device virtual CPU mesh: ensemble psum
+combiner, ring attention vs dense reference, dp/tp/sp-sharded LM training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.models.mnist import MnistClassifier
+from seldon_core_tpu.models.transformer import (
+    LMConfig,
+    TransformerLM,
+    lm_apply,
+    lm_init,
+    lm_loss,
+    lm_train_step,
+    param_shardings,
+)
+from seldon_core_tpu.parallel.ensemble import (
+    SharedEnsembleUnit,
+    ensemble_mean_fn,
+    stack_member_states,
+)
+from seldon_core_tpu.parallel.mesh import MeshSpec, build_mesh, shard_batch
+from seldon_core_tpu.parallel.ring_attention import ring_attention_sharded
+
+
+def test_mesh_spec_resolution(devices8):
+    assert MeshSpec({"dp": -1}).resolve(8) == {"dp": 8}
+    assert MeshSpec({"dp": 2, "ens": -1}).resolve(8) == {"dp": 2, "ens": 4}
+    with pytest.raises(ValueError, match="divisible"):
+        MeshSpec({"dp": 3, "ens": -1}).resolve(8)
+    with pytest.raises(ValueError, match="needs"):
+        MeshSpec({"dp": 16}).resolve(8)
+    mesh = build_mesh({"dp": 2, "ens": 4})
+    assert mesh.shape == {"dp": 2, "ens": 4}
+
+
+def test_ensemble_matches_sequential_mean(devices8):
+    """Sharded ensemble (psum over ICI) == sequential per-member mean."""
+    mesh = build_mesh({"ens": 8})
+    members = [MnistClassifier(hidden=32, seed=i) for i in range(8)]
+    states = [members[i].init_state(jax.random.key(100 + i)) for i in range(8)]
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 784)), jnp.float32)
+
+    expected = jnp.mean(
+        jnp.stack([m.predict(s, x) for m, s in zip(members, states)]), axis=0
+    )
+
+    stacked = stack_member_states(states)
+    stacked = jax.device_put(
+        stacked,
+        jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P("ens")), stacked),
+    )
+    fn = jax.jit(ensemble_mean_fn(
+        lambda s, xx: members[0].predict(s, xx), mesh, 8, "ens"
+    ))
+    got = fn(stacked, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-6)
+
+
+def test_shared_ensemble_unit(devices8):
+    unit = SharedEnsembleUnit(member="MnistClassifier", n_members=8,
+                              member_hidden=32)
+    state = unit.init_state(jax.random.key(0))
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(2, 784)), jnp.float32
+    )
+    y = np.asarray(jax.jit(unit.predict)(state, x))
+    assert y.shape == (2, 10)
+    np.testing.assert_allclose(y.sum(axis=1), 1.0, atol=1e-2)
+    # members actually differ (per-member seeds)
+    first_member_state = jax.tree_util.tree_map(lambda a: a[0], state)
+    single = np.asarray(unit.members[0].predict(first_member_state, x))
+    assert np.abs(single - y).max() > 1e-5
+
+
+def test_ring_attention_matches_dense(devices8):
+    """Ring attention over sp == plain causal attention, causal and full."""
+    mesh = build_mesh({"sp": 8})
+    B, H, S, D = 2, 2, 64, 16
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32) for _ in range(3)
+    )
+
+    for causal in (True, False):
+        ring = jax.jit(ring_attention_sharded(mesh, "sp", causal=causal))
+        got = np.asarray(ring(q, k, v))
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = jnp.where(mask, s, -1e30)
+        expected = np.asarray(jnp.einsum(
+            "bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v
+        ))
+        np.testing.assert_allclose(got, expected, atol=2e-5, err_msg=f"causal={causal}")
+
+
+def test_lm_train_step_sharded_dp_tp_sp(devices8):
+    """Full training step jitted over a dp=2 x tp=2 x sp=2 mesh: params
+    tp-sharded, batch dp-sharded, sequence sp-sharded (ring attention)."""
+    import optax
+
+    mesh = build_mesh({"dp": 2, "tp": 2, "sp": 2})
+    cfg = LMConfig(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                   dtype=jnp.float32)
+    params = lm_init(jax.random.key(0), cfg)
+    params = jax.device_put(params, param_shardings(mesh, params))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(4, 33)), jnp.int32)
+    batch = {"tokens": jax.device_put(
+        tokens, NamedSharding(mesh, P("dp", None)))}
+
+    step = jax.jit(
+        lambda p, o, b: lm_train_step(p, o, b, opt, cfg, mesh)
+    )
+    l0 = None
+    for i in range(10):
+        params, opt_state, loss = step(params, opt_state, batch)
+        if i == 0:
+            l0 = float(loss)
+    assert np.isfinite(float(loss))
+    assert float(loss) < l0  # memorising a fixed batch
+
+    # sharded == unsharded single-device apply
+    logits_sharded = lm_apply(params, tokens[:, :-1], cfg, mesh)
+    params_local = jax.device_get(params)
+    logits_local = lm_apply(
+        jax.tree_util.tree_map(jnp.asarray, params_local), tokens[:, :-1], cfg, None
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_sharded), np.asarray(logits_local), atol=3e-4
+    )
+
+
+def test_transformer_unit_serves(devices8):
+    unit = TransformerLM(vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64)
+    state = unit.init_state(jax.random.key(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = np.asarray(unit.predict(state, tokens))
+    assert logits.shape == (2, 16, 64)
+    assert np.isfinite(logits).all()
+
+
+def test_shard_batch_helper(devices8):
+    mesh = build_mesh({"dp": 4, "ens": 2})
+    x = np.ones((8, 3), np.float32)
+    sharded = shard_batch(mesh, x, "dp")
+    assert sharded.sharding.spec == P("dp", None)
