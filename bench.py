@@ -121,8 +121,10 @@ async def _client_load(engine, payload: str, n_clients: int, duration_s: float):
             latencies.append(time.perf_counter() - t0)
             completed += 1
 
+    t_start = time.perf_counter()
     await asyncio.gather(*[client() for _ in range(n_clients)])
-    return completed, np.asarray(latencies)
+    wall = time.perf_counter() - t_start  # includes requests draining past stop
+    return completed, np.asarray(latencies), wall
 
 
 async def _bench_engine(spec, payload, n_clients, duration_s, **engine_kwargs):
@@ -131,8 +133,7 @@ async def _bench_engine(spec, payload, n_clients, duration_s, **engine_kwargs):
     engine = EngineService(spec, **engine_kwargs)
     # warm-up (compile + relay)
     await _client_load(engine, payload, min(8, n_clients), 2.0)
-    completed, lat = await _client_load(engine, payload, n_clients, duration_s)
-    wall = duration_s
+    completed, lat, wall = await _client_load(engine, payload, n_clients, duration_s)
     return {
         "qps": completed / wall,
         "p50_ms": float(np.percentile(lat, 50) * 1e3) if len(lat) else float("nan"),

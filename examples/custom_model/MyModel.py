@@ -1,0 +1,33 @@
+"""Template user model — copy this next to your weights and point a graph
+node's binding at ``examples.custom_model.MyModel:MyModel``.
+
+Two styles are accepted by the wrapper runtime
+(seldon_core_tpu/runtime/microservice.py):
+
+1. This reference-compatible style (plain object, numpy in/out), served in
+   host mode — exactly what the reference's wrappers expect
+   (wrappers/python/Test.py, wrappers/s2i/python MyModel.py).
+2. A ``seldon_core_tpu.graph.units.Unit`` subclass with jax-traceable
+   methods over an explicit state pytree — these compile INTO the graph's
+   XLA program (see seldon_core_tpu/models/mnist.py for the pattern) and
+   are the TPU-native fast path.
+"""
+
+import numpy as np
+
+
+class MyModel:
+    # optional: names for the output columns
+    class_names = ["proba"]
+
+    def __init__(self, scale: float = 1.0):
+        # load weights / warm state here; typed parameters from the graph
+        # spec arrive as constructor kwargs
+        self.scale = scale
+
+    def predict(self, X, feature_names):
+        """X: [batch, n_features] numpy array."""
+        return np.mean(X, axis=1, keepdims=True) * self.scale
+
+    def send_feedback(self, X, feature_names, reward, truth):
+        """Optional online-learning hook."""

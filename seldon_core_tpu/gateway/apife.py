@@ -1,0 +1,294 @@
+"""API gateway — the reference's ``api-frontend`` ("apife") re-designed.
+
+Responsibilities mirrored from §2.3 of the survey:
+  * OAuth2-style client-credentials auth: each deployment registers an
+    (oauth_key, oauth_secret) pair (seldon_deployment.proto:39-40); POST
+    /oauth/token with HTTP basic auth issues a bearer token; the principal
+    (client id == deployment) selects the target graph
+    (api-frontend RestClientController.java:126-177,
+    InMemoryClientDetailsService.java).  Tokens live in an in-memory store
+    with expiry (the reference used Redis).
+  * Prediction routing: principal -> deployment -> predictor.  With several
+    predictors the gateway splits traffic by replica weight — the TPU-native
+    form of the reference's canary pattern (2 predictors, replica-weighted
+    k8s service routing, docs/crd/readme.md).
+  * Request/response firehose publish, fire-and-forget (gateway/firehose.py).
+  * Ingress metrics (seldon_api_ingress_server_requests_*).
+
+Targets are in-process ``EngineService``s (the common case: gateway and
+engines share the host) or remote engine base URLs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import secrets
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from seldon_core_tpu.gateway.firehose import Firehose
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import Feedback, SeldonMessage, SeldonMessageError
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+__all__ = ["ApiGateway", "DeploymentStore", "AuthError"]
+
+TOKEN_TTL_S = 3600.0
+
+
+class AuthError(Exception):
+    pass
+
+
+@dataclass
+class _Registration:
+    deployment_id: str
+    oauth_key: str
+    oauth_secret: str
+    engines: List  # [(predictor_name, weight, EngineService | base_url)]
+
+
+class DeploymentStore:
+    """client-id -> deployment registry + token store — the reference's
+    DeploymentStore + InMemoryClientDetailsService + Redis token store
+    (api-frontend deployments/DeploymentStore.java:33-80)."""
+
+    def __init__(self):
+        self._by_key: Dict[str, _Registration] = {}
+        self._tokens: Dict[str, Tuple[str, float]] = {}  # token -> (key, expiry)
+
+    def register(
+        self,
+        spec: SeldonDeploymentSpec,
+        engines: Dict[str, object],
+    ) -> None:
+        """``engines``: predictor name -> EngineService (or URL)."""
+        weighted = []
+        for p in spec.predictors:
+            if p.name in engines:
+                weighted.append((p.name, max(int(p.replicas), 0), engines[p.name]))
+        if not weighted:
+            raise ValueError(f"no engines supplied for deployment {spec.name!r}")
+        key = spec.oauth_key or spec.name
+        self._by_key[key] = _Registration(
+            deployment_id=spec.name,
+            oauth_key=key,
+            oauth_secret=spec.oauth_secret,
+            engines=weighted,
+        )
+
+    def unregister(self, oauth_key: str) -> None:
+        self._by_key.pop(oauth_key, None)
+        self._tokens = {
+            t: (k, exp) for t, (k, exp) in self._tokens.items() if k != oauth_key
+        }
+
+    # -- auth ---------------------------------------------------------------
+
+    def issue_token(self, oauth_key: str, oauth_secret: str) -> str:
+        reg = self._by_key.get(oauth_key)
+        if reg is None or (reg.oauth_secret and reg.oauth_secret != oauth_secret):
+            raise AuthError("invalid client credentials")
+        token = secrets.token_urlsafe(24)
+        self._tokens[token] = (oauth_key, time.time() + TOKEN_TTL_S)
+        return token
+
+    def principal_for_token(self, token: str) -> _Registration:
+        entry = self._tokens.get(token)
+        if entry is None:
+            raise AuthError("invalid token")
+        key, expiry = entry
+        if time.time() > expiry:
+            self._tokens.pop(token, None)
+            raise AuthError("token expired")
+        reg = self._by_key.get(key)
+        if reg is None:
+            raise AuthError("client no longer registered")
+        return reg
+
+    def deployments(self) -> List[str]:
+        return [r.deployment_id for r in self._by_key.values()]
+
+
+class ApiGateway:
+    def __init__(
+        self,
+        store: Optional[DeploymentStore] = None,
+        firehose: Optional[Firehose] = None,
+        require_auth: bool = True,
+        seed: int = 0,
+    ):
+        self.store = store or DeploymentStore()
+        self.firehose = firehose
+        self.require_auth = require_auth
+        self.metrics = MetricsRegistry(deployment_name="gateway")
+        self._rng = np.random.default_rng(seed)
+
+    # -- principal resolution ----------------------------------------------
+
+    def _resolve(self, token: Optional[str]) -> _Registration:
+        if token:
+            return self.store.principal_for_token(token)
+        if self.require_auth:
+            raise AuthError("missing bearer token")
+        regs = list(self.store._by_key.values())
+        if len(regs) != 1:
+            raise AuthError("auth disabled but no unique deployment registered")
+        return regs[0]
+
+    def _pick_engine(self, reg: _Registration, predictor: Optional[str] = None):
+        """Replica-weighted predictor choice (canary traffic split)."""
+        if predictor is not None:
+            for name, _, engine in reg.engines:
+                if name == predictor:
+                    return name, engine
+        names = [e[0] for e in reg.engines]
+        weights = np.asarray([e[1] for e in reg.engines], dtype=np.float64)
+        if weights.sum() <= 0:
+            weights = np.ones_like(weights)
+        idx = int(self._rng.choice(len(names), p=weights / weights.sum()))
+        return reg.engines[idx][0], reg.engines[idx][2]
+
+    # -- data plane ---------------------------------------------------------
+
+    async def predict(
+        self, msg: SeldonMessage, token: Optional[str] = None
+    ) -> SeldonMessage:
+        reg = self._resolve(token)
+        with self.metrics.time_ingress("predictions", "POST") as code:
+            predictor_name, engine = self._pick_engine(reg)
+            resp = await self._dispatch_predict(engine, msg)
+            # record which predictor served (canary observability; feedback
+            # routes back to the same predictor)
+            resp.meta.requestPath.setdefault("predictor", predictor_name)
+            if resp.status is not None and resp.status.status == "FAILURE":
+                code["code"] = str(resp.status.code or 500)
+        if self.firehose is not None:
+            self.firehose.publish(reg.deployment_id, msg, resp)
+        return resp
+
+    async def send_feedback(
+        self, feedback: Feedback, token: Optional[str] = None
+    ) -> SeldonMessage:
+        reg = self._resolve(token)
+        with self.metrics.time_ingress("feedback", "POST"):
+            predictor = None
+            if feedback.response is not None:
+                predictor = feedback.response.meta.requestPath.get("predictor")
+            _, engine = self._pick_engine(reg, predictor)
+            return await self._dispatch_feedback(engine, feedback)
+
+    async def _dispatch_predict(self, engine, msg: SeldonMessage) -> SeldonMessage:
+        if hasattr(engine, "predict"):  # in-process EngineService
+            return await engine.predict(msg)
+        return await self._http_post(str(engine) + "/api/v0.1/predictions", msg.to_json())
+
+    async def _dispatch_feedback(self, engine, fb: Feedback) -> SeldonMessage:
+        if hasattr(engine, "send_feedback"):
+            return await engine.send_feedback(fb)
+        return await self._http_post(str(engine) + "/api/v0.1/feedback", fb.to_json())
+
+    async def _http_post(self, url: str, payload: str) -> SeldonMessage:
+        import aiohttp
+
+        # pooled client, 3 retries — apife's HttpRetryHandler.java:34-45
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=20)
+        ) as session:
+            last = "unreachable"
+            for _ in range(3):
+                try:
+                    async with session.post(url, data=payload) as r:
+                        return SeldonMessage.from_json(await r.text())
+                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    last = str(e)
+                    await asyncio.sleep(0.05)
+            return SeldonMessage.failure(f"engine unreachable: {last}", code=503)
+
+
+# ---------------------------------------------------------------------------
+# HTTP app
+# ---------------------------------------------------------------------------
+
+
+def make_gateway_app(gateway: ApiGateway):
+    """aiohttp app: /oauth/token, /api/v0.1/predictions, /api/v0.1/feedback,
+    /ping, /prometheus — the apife REST surface."""
+    from aiohttp import web
+
+    from seldon_core_tpu.runtime.rest import _error_response, _msg_response, _payload_text
+    from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
+
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+
+    def _bearer(request) -> Optional[str]:
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Bearer "):
+            return auth[len("Bearer ") :]
+        return None
+
+    async def token(request):
+        auth = request.headers.get("Authorization", "")
+        key = secret = None
+        if auth.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(auth[len("Basic ") :]).decode()
+                key, _, secret = decoded.partition(":")
+            except Exception:
+                pass
+        if key is None:
+            form = await request.post()
+            key = form.get("client_id")
+            secret = form.get("client_secret", "")
+        try:
+            tok = gateway.store.issue_token(key or "", secret or "")
+        except AuthError as e:
+            return web.json_response({"error": str(e)}, status=401)
+        return web.json_response(
+            {"access_token": tok, "token_type": "bearer", "expires_in": int(TOKEN_TTL_S)}
+        )
+
+    async def predictions(request):
+        try:
+            msg = SeldonMessage.from_json(await _payload_text(request))
+        except SeldonMessageError as e:
+            return _error_response(str(e))
+        try:
+            resp = await gateway.predict(msg, _bearer(request))
+        except AuthError as e:
+            return _error_response(str(e), code=401)
+        status = 200 if resp.status is None or resp.status.status == "SUCCESS" else (
+            resp.status.code or 500
+        )
+        return _msg_response(resp, status=status)
+
+    async def feedback(request):
+        try:
+            fb = Feedback.from_json(await _payload_text(request))
+        except SeldonMessageError as e:
+            return _error_response(str(e))
+        try:
+            ack = await gateway.send_feedback(fb, _bearer(request))
+        except AuthError as e:
+            return _error_response(str(e), code=401)
+        return _msg_response(ack)
+
+    async def ping(_):
+        return web.Response(text="pong")
+
+    async def prometheus(_):
+        return web.Response(
+            body=gateway.metrics.exposition(),
+            headers={"Content-Type": CONTENT_TYPE_LATEST},
+        )
+
+    app.router.add_post("/oauth/token", token)
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_get("/ping", ping)
+    app.router.add_get("/prometheus", prometheus)
+    return app

@@ -1,0 +1,80 @@
+"""Request/response firehose — the Kafka publish path of the reference
+gateway (api-frontend kafka/KafkaRequestResponseProducer.java:30-62: topic =
+deployment id, key = puid, fire-and-forget with MAX_BLOCK_MS=20 so logging
+can never stall serving).
+
+Here the sink is pluggable: an append-only JSONL file per deployment by
+default (one line per RequestResponse, key fields first so consumers can
+stream-grep), or any callable sink.  Writes happen on a background task fed
+by a bounded queue; when the queue is full events are DROPPED, never
+blocking the serving path — the same trade the reference makes."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from seldon_core_tpu.messages import SeldonMessage
+
+__all__ = ["Firehose"]
+
+
+class Firehose:
+    def __init__(
+        self,
+        base_dir: Optional[str] = None,
+        sink: Optional[Callable[[str, dict], None]] = None,
+        max_queue: int = 4096,
+    ):
+        self.base_dir = base_dir or os.environ.get(
+            "SELDON_TPU_FIREHOSE_DIR", os.path.expanduser("~/.seldon_tpu_firehose")
+        )
+        self.sink = sink
+        self.dropped = 0
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            await self._queue.join()
+            self._task.cancel()
+            self._task = None
+
+    def publish(
+        self, deployment: str, request: SeldonMessage, response: SeldonMessage
+    ) -> None:
+        """Fire-and-forget; drops when the queue is full (never blocks)."""
+        event = {
+            "puid": response.meta.puid or request.meta.puid,
+            "deployment": deployment,
+            "ts": time.time(),
+            "request": request.to_json_dict(),
+            "response": response.to_json_dict(),
+        }
+        try:
+            self._queue.put_nowait(event)
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    async def _drain(self) -> None:
+        while True:
+            event = await self._queue.get()
+            try:
+                if self.sink is not None:
+                    self.sink(event["deployment"], event)
+                else:
+                    os.makedirs(self.base_dir, exist_ok=True)
+                    path = os.path.join(self.base_dir, f"{event['deployment']}.jsonl")
+                    with open(path, "a") as f:
+                        f.write(json.dumps(event, separators=(",", ":")) + "\n")
+            except Exception:
+                self.dropped += 1
+            finally:
+                self._queue.task_done()

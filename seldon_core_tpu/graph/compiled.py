@@ -336,7 +336,11 @@ class CompiledGraph:
     # -- SeldonMessage API (drop-in for GraphExecutor at the edge) ----------
 
     def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        y, routing, tags = self.predict_arrays(jnp.asarray(msg.array()))
+        # 1-D wire payloads mean a single sample; units assume a leading
+        # batch axis (same normalisation as the micro-batched engine path)
+        y, routing, tags = self.predict_arrays(
+            jnp.atleast_2d(jnp.asarray(msg.array()))
+        )
         leaf_names = self._output_names(self.predictor.graph, routing)
         resp = msg.with_array(y, names=leaf_names)
         resp.meta = Meta(

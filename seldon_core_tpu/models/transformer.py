@@ -83,18 +83,17 @@ def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
 def param_shardings(mesh: Mesh, params) -> Any:
     """NamedShardings for the tp layout above (replicated where not listed)."""
 
-    def spec_for(path: str):
-        if path.endswith("wqkv") or path.endswith("w1"):
+    def spec_for(path) -> P:
+        # path is a tuple of DictKey objects; the leaf name is the last key
+        leaf = getattr(path[-1], "key", str(path[-1]))
+        if leaf in ("wqkv", "w1"):
             return P(None, "tp") if "tp" in mesh.axis_names else P()
-        if path.endswith("wo") or path.endswith("w2"):
+        if leaf in ("wo", "w2"):
             return P("tp", None) if "tp" in mesh.axis_names else P()
         return P()
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    shardings = [
-        NamedSharding(mesh, spec_for(jax.tree_util.keystr(path)))
-        for path, _ in flat
-    ]
+    shardings = [NamedSharding(mesh, spec_for(path)) for path, _ in flat]
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 

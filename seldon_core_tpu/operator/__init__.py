@@ -1,0 +1,4 @@
+"""Deployment operator: materializes SeldonDeployment specs into running
+engines/units, watches a spec directory, tracks status."""
+
+from seldon_core_tpu.operator.materializer import Materializer  # noqa: F401

@@ -1,0 +1,218 @@
+"""Deployment materializer — the reference operator without Kubernetes.
+
+The reference's cluster-manager watches the SeldonDeployment CRD, rewrites
+the resource (defaulting), validates it, and materializes k8s Deployments +
+Services with an injected engine container; a second watcher feeds pod
+availability back into the CR status (SURVEY.md §2.4, §3.1).
+
+Here the same control loop materializes a deployment spec into this host's
+runtime:
+
+  * ``apply``   defaulting + validation, then per predictor: spawn unit
+    microservice subprocesses for remote (rest/grpc) bindings with the
+    reference env contract injected (PREDICTIVE_UNIT_SERVICE_PORT,
+    PREDICTIVE_UNIT_PARAMETERS, ids — graph/defaulting.py), build an
+    ``EngineService`` (the engine "container", config via the same
+    ``ENGINE_PREDICTOR`` b64 contract when subprocessed), and register the
+    deployment with the gateway's DeploymentStore.
+  * ``delete``  stop processes, unregister (the reference's ownerReference GC).
+  * ``watch_dir``  poll a directory of ``*.json`` specs every interval;
+    ADDED/MODIFIED (mtime dedup, like resourceVersion) -> apply, file gone ->
+    delete (SeldonDeploymentWatcher.java:89-171's 5 s scheduled loop).
+  * ``status``  per-predictor {replicas, replicasAvailable} where available =
+    live engine + live unit subprocesses
+    (SeldonDeploymentStatusUpdateImpl.java:49-104).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from seldon_core_tpu.gateway.apife import DeploymentStore
+from seldon_core_tpu.graph.defaulting import default_and_validate
+from seldon_core_tpu.graph.spec import (
+    GraphSpecError,
+    SeldonDeploymentSpec,
+)
+
+__all__ = ["Materializer", "MaterializedDeployment"]
+
+
+@dataclass
+class _UnitProc:
+    name: str
+    popen: subprocess.Popen
+    port: int
+
+
+@dataclass
+class MaterializedDeployment:
+    spec: SeldonDeploymentSpec
+    engines: Dict[str, object] = field(default_factory=dict)  # predictor -> engine
+    unit_procs: List[_UnitProc] = field(default_factory=list)
+    applied_at: float = 0.0
+
+
+class Materializer:
+    def __init__(
+        self,
+        store: Optional[DeploymentStore] = None,
+        spawn_units: bool = True,
+        python: str = sys.executable,
+    ):
+        self.store = store or DeploymentStore()
+        self.spawn_units = spawn_units
+        self.python = python
+        self.deployments: Dict[str, MaterializedDeployment] = {}
+
+    # ------------------------------------------------------------------
+
+    def apply(self, spec: SeldonDeploymentSpec) -> MaterializedDeployment:
+        """Defaulting -> validation -> materialize -> register."""
+        default_and_validate(spec)
+        existing = self.deployments.get(spec.name)
+        if existing is not None:
+            self._teardown(existing)
+
+        md = MaterializedDeployment(spec=spec, applied_at=time.time())
+        try:
+            for predictor in spec.predictors:
+                # 1. unit subprocesses for remote bindings (the reference's
+                #    per-componentSpec Deployments)
+                for binding in predictor.components:
+                    if binding.runtime in ("rest", "grpc") and self.spawn_units:
+                        md.unit_procs.append(
+                            self._spawn_unit(binding, predictor.name, spec.name)
+                        )
+                # 2. the engine for this predictor (reference: injected
+                #    engine container per predictor)
+                from seldon_core_tpu.runtime.engine import EngineService
+
+                md.engines[predictor.name] = EngineService(spec, predictor.name)
+        except Exception:
+            self._teardown(md)
+            raise
+        self.deployments[spec.name] = md
+        self.store.register(spec, md.engines)
+        return md
+
+    def delete(self, name: str) -> None:
+        md = self.deployments.pop(name, None)
+        if md is None:
+            return
+        self._teardown(md)
+        self.store.unregister(md.spec.oauth_key or md.spec.name)
+
+    def _teardown(self, md: MaterializedDeployment) -> None:
+        for proc in md.unit_procs:
+            if proc.popen.poll() is None:
+                proc.popen.terminate()
+                try:
+                    proc.popen.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.popen.kill()
+        md.unit_procs.clear()
+
+    def _spawn_unit(self, binding, predictor_id: str, deployment_id: str) -> _UnitProc:
+        """Launch ``microservice.py``-equivalent with the reference env
+        contract (SeldonDeploymentOperatorImpl.updateContainer:195-292)."""
+        if not binding.class_path:
+            raise GraphSpecError(
+                f"remote binding {binding.name!r} needs class_path to run "
+                f"locally (no container images here)"
+            )
+        env = dict(os.environ)
+        env.update(binding.env)
+        env["PREDICTIVE_UNIT_SERVICE_PORT"] = str(binding.port)
+        env["PREDICTIVE_UNIT_ID"] = binding.name
+        env["PREDICTOR_ID"] = predictor_id
+        env["SELDON_DEPLOYMENT_ID"] = deployment_id
+        api = "GRPC" if binding.runtime == "grpc" else "REST"
+        popen = subprocess.Popen(
+            [
+                self.python,
+                "-m",
+                "seldon_core_tpu.runtime.microservice",
+                binding.class_path,
+                api,
+                "--port",
+                str(binding.port),
+            ],
+            env=env,
+        )
+        return _UnitProc(name=binding.name, popen=popen, port=binding.port)
+
+    # ------------------------------------------------------------------
+
+    def status(self, name: str) -> dict:
+        """Per-predictor availability — the reference CR status block
+        (seldon_deployment.proto PredictorStatus)."""
+        md = self.deployments.get(name)
+        if md is None:
+            return {"state": "absent"}
+        predictors = []
+        units_alive = all(p.popen.poll() is None for p in md.unit_procs)
+        for predictor in md.spec.predictors:
+            available = 1 if (predictor.name in md.engines and units_alive) else 0
+            predictors.append(
+                {
+                    "name": predictor.name,
+                    "replicas": predictor.replicas,
+                    "replicasAvailable": available * predictor.replicas,
+                }
+            )
+        return {"state": "Available" if units_alive else "Degraded",
+                "predictorStatus": predictors}
+
+    # ------------------------------------------------------------------
+
+    async def watch_dir(self, path: str, interval_s: float = 5.0, once: bool = False):
+        """Reference watch loop: 5 s schedule, mtime dedup (resourceVersion
+        bookkeeping, SeldonDeploymentWatcher.java:89-171); a file removed
+        from the directory deletes its deployment (ownerReference GC)."""
+        seen_mtime: Dict[str, float] = {}
+        file_to_name: Dict[str, str] = {}
+        while True:
+            files: Dict[str, float] = {}
+            if os.path.isdir(path):
+                for fn in sorted(os.listdir(path)):
+                    if fn.endswith(".json"):
+                        full = os.path.join(path, fn)
+                        try:
+                            files[full] = os.path.getmtime(full)
+                        except OSError:
+                            continue
+            # ADDED / MODIFIED
+            for full, mtime in files.items():
+                if seen_mtime.get(full) == mtime:
+                    continue
+                seen_mtime[full] = mtime  # never retry an unchanged bad file
+                try:
+                    with open(full) as f:
+                        spec = SeldonDeploymentSpec.from_json(f.read())
+                    self.apply(spec)
+                    file_to_name[full] = spec.name
+                except (GraphSpecError, json.JSONDecodeError, OSError) as e:
+                    import logging
+
+                    logging.getLogger(__name__).error("apply %s failed: %s", full, e)
+            # DELETED
+            for full in [f for f in seen_mtime if f not in files]:
+                del seen_mtime[full]
+                name = file_to_name.pop(full, None)
+                if name is not None:
+                    self.delete(name)
+            if once:
+                return
+            await asyncio.sleep(interval_s)
+
+    def shutdown(self) -> None:
+        for name in list(self.deployments):
+            self.delete(name)

@@ -100,16 +100,8 @@ class MicroBatcher:
         try:
             stacked = np.concatenate(xs, axis=0)
             total = len(stacked)
-            if self.pad_to_buckets and total > 1:
-                target = min(1 << (total - 1).bit_length(), self.max_batch)
-                if target > total:
-                    pad = np.repeat(stacked[-1:], target - total, axis=0)
-                    stacked = np.concatenate([stacked, pad], axis=0)
-            ys, aux = await self.batch_fn(stacked)
+            ys, aux = await self._dispatch_chunked(stacked)
             ys = np.asarray(ys)[:total]
-            if len(stacked) != total:  # drop padding rows from per-row aux
-                aux = _slice_aux(aux, slice(0, total), len(stacked))
-                # per-row arrays are now `total` long for the re-slice below
             offset = 0
             for x, fut in zip(xs, futs):
                 if not fut.cancelled():
@@ -120,6 +112,43 @@ class MicroBatcher:
             for fut in futs:
                 if not fut.done():
                     fut.set_exception(e)
+
+    async def _dispatch_chunked(self, stacked: np.ndarray):
+        """Dispatch in <= max_batch chunks (oversized single requests and
+        bursty buckets must not produce unbounded compiled shapes), padding
+        each chunk up to a power of two when allowed."""
+        total = len(stacked)
+        ys_parts = []
+        aux = None
+        for start in range(0, total, self.max_batch):
+            chunk = stacked[start : start + self.max_batch]
+            n = len(chunk)
+            if self.pad_to_buckets and n > 1:
+                target = min(1 << (n - 1).bit_length(), self.max_batch)
+                if target > n:
+                    pad = np.repeat(chunk[-1:], target - n, axis=0)
+                    chunk = np.concatenate([chunk, pad], axis=0)
+            ys, chunk_aux = await self.batch_fn(chunk)
+            ys_parts.append(np.asarray(ys)[:n])
+            # per-row aux re-based to the unpadded chunk, then accumulated
+            chunk_aux = _slice_aux(chunk_aux, slice(0, n), len(chunk))
+            aux = chunk_aux if aux is None else _concat_aux(aux, chunk_aux)
+        return np.concatenate(ys_parts, axis=0), aux
+
+
+def _concat_aux(a, b):
+    """Merge chunked aux: per-row arrays concatenate, everything else keeps
+    the latest value (routing/tags of the final chunk — shared metadata)."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        return {k: _concat_aux(a.get(k), b.get(k)) for k in {**a, **b}}
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return tuple(_concat_aux(x, y) for x, y in zip(a, b))
+    if (
+        hasattr(a, "shape") and hasattr(b, "shape")
+        and getattr(a, "ndim", 0) >= 1 and getattr(b, "ndim", 0) >= 1
+    ):
+        return np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
+    return b if b is not None else a
 
 
 def _slice_aux(aux, rows: slice, total: int):

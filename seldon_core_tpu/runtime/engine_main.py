@@ -1,0 +1,110 @@
+"""Engine process entrypoint — the reference's engine pod boot re-designed.
+
+Config resolution order mirrors ``EnginePredictor.init()`` (engine
+EnginePredictor.java:56-150):
+
+  1. ``ENGINE_PREDICTOR``          base64(JSON PredictorSpec)
+  2. ``ENGINE_SELDON_DEPLOYMENT``  base64(JSON SeldonDeployment) [+ name]
+  3. ``./deploymentdef.json``      file fallback
+  4. default SIMPLE_MODEL stub graph (the reference's in-engine test stub)
+
+Ports: ``ENGINE_SERVER_PORT`` (default 8000) REST,
+``ENGINE_SERVER_GRPC_PORT`` (default 5001) gRPC — the ports the reference
+operator wires into every engine container
+(cluster-manager SeldonDeploymentOperatorImpl.java:98-144).
+
+    python -m seldon_core_tpu.runtime.engine_main [--file deployment.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import os
+from typing import Optional
+
+from seldon_core_tpu.graph.defaulting import default_and_validate
+from seldon_core_tpu.graph.spec import (
+    PredictorSpec,
+    SeldonDeploymentSpec,
+)
+
+__all__ = ["load_deployment_from_env", "main"]
+
+DEFAULT_GRAPH = {
+    "spec": {
+        "name": "default",
+        "predictors": [
+            {
+                "name": "default",
+                "graph": {
+                    "name": "simple-model",
+                    "implementation": "SIMPLE_MODEL",
+                    "type": "MODEL",
+                },
+            }
+        ],
+    }
+}
+
+
+def load_deployment_from_env(
+    file_path: Optional[str] = None,
+) -> SeldonDeploymentSpec:
+    raw = os.environ.get("ENGINE_PREDICTOR")
+    if raw:
+        predictor = json.loads(base64.b64decode(raw))
+        spec = SeldonDeploymentSpec(
+            name=os.environ.get("SELDON_DEPLOYMENT_ID", "engine"),
+            predictors=[PredictorSpec.from_json_dict(predictor)],
+        )
+        return default_and_validate(spec)
+    raw = os.environ.get("ENGINE_SELDON_DEPLOYMENT")
+    if raw:
+        spec = SeldonDeploymentSpec.from_json(base64.b64decode(raw))
+        return default_and_validate(spec)
+    path = file_path or "./deploymentdef.json"
+    if os.path.exists(path):
+        with open(path) as f:
+            return default_and_validate(SeldonDeploymentSpec.from_json(f.read()))
+    return default_and_validate(SeldonDeploymentSpec.from_json_dict(DEFAULT_GRAPH))
+
+
+async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
+                host="0.0.0.0", rest_port=None, grpc_port=None) -> None:
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.runtime.grpc_server import make_engine_grpc_server
+    from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
+
+    rest_port = rest_port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
+    grpc_port = grpc_port or int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "5001"))
+    engine = EngineService(deployment, predictor_name)
+    await serve_app(make_engine_app(engine), host, rest_port)
+    grpc_server = make_engine_grpc_server(engine, host, grpc_port)
+    await grpc_server.start()
+    print(
+        f"engine up: predictor={engine.predictor.name} mode={engine.mode} "
+        f"rest=:{rest_port} grpc=:{grpc_port}",
+        flush=True,
+    )
+    await asyncio.Event().wait()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="seldon_core_tpu engine")
+    parser.add_argument("--file", default=None, help="deployment JSON path")
+    parser.add_argument("--predictor", default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--rest-port", type=int, default=None)
+    parser.add_argument("--grpc-port", type=int, default=None)
+    args = parser.parse_args(argv)
+    deployment = load_deployment_from_env(args.file)
+    asyncio.run(
+        serve(deployment, args.predictor, args.host, args.rest_port, args.grpc_port)
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,7 @@ from seldon_core_tpu.proto_gen import prediction_pb2 as pb
 __all__ = [
     "make_engine_grpc_server",
     "make_unit_grpc_server",
+    "make_gateway_grpc_server",
     "serve_unit_grpc",
     "GRPC_MAX_MESSAGE",
 ]
@@ -167,6 +168,68 @@ def make_unit_grpc_server(
         tuple(
             grpc.method_handlers_generic_handler(name, methods)
             for name, methods in services.items()
+        )
+    )
+    server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+def _token_from_metadata(context) -> Optional[str]:
+    for k, v in context.invocation_metadata() or ():
+        if k == "oauth_token":
+            return v
+    return None
+
+
+def _gateway_unary(fn, req_cls):
+    """Like ``_unary`` but the handler also maps AuthError to UNAUTHENTICATED
+    and receives the call context (for the oauth_token metadata)."""
+    from seldon_core_tpu.gateway.apife import AuthError
+
+    async def handler(request, context):
+        try:
+            return await fn(request, context)
+        except AuthError as e:
+            await context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
+        except (SeldonMessageError, GraphSpecError) as e:
+            return _failure_proto(str(e))
+        except NotImplementedError as e:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
+
+    return grpc.unary_unary_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=pb.SeldonMessage.SerializeToString,
+    )
+
+
+def make_gateway_grpc_server(gateway, host: str, port: int) -> grpc.aio.Server:
+    """Gateway ``Seldon`` service: the bearer token travels as ``oauth_token``
+    request metadata, like the reference's HeaderServerInterceptor
+    (api-frontend grpc/HeaderServerInterceptor.java:42)."""
+
+    async def predict(request, context):
+        resp = await gateway.predict(
+            protoconv.msg_from_proto(request), _token_from_metadata(context)
+        )
+        return protoconv.msg_to_proto(resp)
+
+    async def send_feedback(request, context):
+        ack = await gateway.send_feedback(
+            protoconv.feedback_from_proto(request), _token_from_metadata(context)
+        )
+        return protoconv.msg_to_proto(ack)
+
+    server = grpc.aio.server(options=_OPTIONS)
+    server.add_generic_rpc_handlers(
+        (
+            grpc.method_handlers_generic_handler(
+                "seldon.protos.Seldon",
+                {
+                    "Predict": _gateway_unary(predict, pb.SeldonMessage),
+                    "SendFeedback": _gateway_unary(send_feedback, pb.Feedback),
+                },
+            ),
         )
     )
     server.add_insecure_port(f"{host}:{port}")

@@ -1,0 +1,9 @@
+"""Testing/tooling layer: contract-based request generation + fuzz tester,
+async load rig (the reference's wrappers/testing/tester.py, util/api_tester,
+util/loadtester)."""
+
+from seldon_core_tpu.testing.contract import (  # noqa: F401
+    Contract,
+    generate_batch,
+    validate_response,
+)

@@ -1,0 +1,142 @@
+"""Async load rig — the locust-equivalent (util/loadtester/scripts/
+predict_rest_locust.py, helm-charts/seldon-core-loadtesting).
+
+K closed-loop clients fire contract-generated requests at a REST or gRPC
+endpoint for a fixed duration; reports qps + latency percentiles as one JSON
+line (the shape ``docs/benchmarking.md`` tabulates)::
+
+    python -m seldon_core_tpu.testing.loadtest contract.json 127.0.0.1 8000 \
+        --clients 64 --duration 10 [--api grpc] [--batch-size 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Optional
+
+import numpy as np
+
+from seldon_core_tpu.testing.contract import Contract, generate_batch
+
+__all__ = ["run_load", "main"]
+
+
+async def run_load(
+    contract: Contract,
+    host: str,
+    port: int,
+    api: str = "rest",
+    clients: int = 16,
+    duration_s: float = 10.0,
+    batch_size: int = 1,
+    oauth_key: Optional[str] = None,
+    oauth_secret: Optional[str] = None,
+) -> dict:
+    payload_msg = generate_batch(contract, batch_size, seed=0)
+    stop_at = time.perf_counter() + duration_s
+    latencies: list = []
+    failures = 0
+
+    token = None
+    if oauth_key:
+        from seldon_core_tpu.testing.api_tester import _rest_token
+
+        token = await _rest_token(host, port, oauth_key, oauth_secret or "")
+
+    if api == "grpc":
+        import grpc
+
+        from seldon_core_tpu import protoconv
+        from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+
+        channel = grpc.aio.insecure_channel(f"{host}:{port}")
+        stub = channel.unary_unary(
+            "/seldon.protos.Seldon/Predict",
+            request_serializer=pb.SeldonMessage.SerializeToString,
+            response_deserializer=pb.SeldonMessage.FromString,
+        )
+        proto_req = protoconv.msg_to_proto(payload_msg)
+        metadata = (("oauth_token", token),) if token else None
+
+        async def one_request():
+            await stub(proto_req, metadata=metadata, timeout=30)
+
+    else:
+        import aiohttp
+
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        session = aiohttp.ClientSession(headers=headers)
+        payload = payload_msg.to_json()
+        url = f"http://{host}:{port}/api/v0.1/predictions"
+
+        async def one_request():
+            async with session.post(url, data=payload) as r:
+                await r.read()
+                if r.status != 200:
+                    raise RuntimeError(f"HTTP {r.status}")
+
+    async def client():
+        nonlocal failures
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                await one_request()
+                latencies.append(time.perf_counter() - t0)
+            except Exception:
+                failures += 1
+
+    try:
+        await asyncio.gather(*[client() for _ in range(clients)])
+    finally:
+        if api == "grpc":
+            await channel.close()
+        else:
+            await session.close()
+
+    lat = np.asarray(latencies)
+    pct = (
+        {
+            f"p{p}_ms": round(float(np.percentile(lat, p)) * 1e3, 2)
+            for p in (50, 75, 90, 95, 99)
+        }
+        if len(lat)
+        else {}
+    )
+    return {
+        "requests": len(latencies),
+        "failures": failures,
+        "qps": round(len(latencies) / duration_s, 1),
+        "clients": clients,
+        "duration_s": duration_s,
+        **pct,
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="async load tester")
+    parser.add_argument("contract")
+    parser.add_argument("host")
+    parser.add_argument("port", type=int)
+    parser.add_argument("--api", choices=["rest", "grpc"], default="rest")
+    parser.add_argument("--clients", type=int, default=16)
+    parser.add_argument("--duration", type=float, default=10.0)
+    parser.add_argument("--batch-size", type=int, default=1)
+    parser.add_argument("--oauth-key", default=None)
+    parser.add_argument("--oauth-secret", default=None)
+    args = parser.parse_args(argv)
+    result = asyncio.run(
+        run_load(
+            Contract.from_file(args.contract), args.host, args.port,
+            api=args.api, clients=args.clients, duration_s=args.duration,
+            batch_size=args.batch_size, oauth_key=args.oauth_key,
+            oauth_secret=args.oauth_secret,
+        )
+    )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

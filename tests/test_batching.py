@@ -2,6 +2,7 @@
 router graphs excluded."""
 
 import asyncio
+import json
 
 import numpy as np
 import pytest
@@ -159,6 +160,58 @@ def test_engine_disables_padding_for_streaming_stats():
         {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
     )
     assert EngineService(plain).batcher.pad_to_buckets is True
+
+
+def test_microbatcher_splits_oversized_requests():
+    """Dispatch sizes stay bounded by max_batch even for one huge request."""
+    sizes = []
+
+    async def batch_fn(stacked):
+        sizes.append(len(stacked))
+        return stacked * 2.0, {"per_row": np.arange(len(stacked))}
+
+    async def run():
+        mb = MicroBatcher(batch_fn, max_batch=16, max_wait_ms=1.0)
+        big = np.arange(50, dtype=np.float32).reshape(50, 1)
+        y, aux = await mb.submit(big)
+        np.testing.assert_allclose(y, big * 2.0)
+        assert aux["per_row"].shape == (50,)
+        assert max(sizes) <= 16 and sum(s for s in sizes) >= 50
+
+    asyncio.run(run())
+
+
+def test_engine_1d_payload_consistent_batched_and_not():
+    """A 1-D wire payload is one sample in BOTH engine paths (review
+    finding: unbatched path crashed where batched path succeeded)."""
+    spec = deployment(
+        {"name": "m0", "type": "MODEL"},
+        [
+            {
+                "name": "m0",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32", "type": "INT"}],
+            }
+        ],
+    )
+
+    async def run():
+        msg_1d = SeldonMessage.from_json(
+            json.dumps({"data": {"ndarray": [0.0] * 784}})
+        )
+        import copy
+
+        batched = await EngineService(spec, max_wait_ms=2.0).predict(copy.deepcopy(msg_1d))
+        unbatched = await EngineService(spec, batching=False).predict(copy.deepcopy(msg_1d))
+        assert np.asarray(batched.array()).shape == (1, 10)
+        np.testing.assert_allclose(
+            batched.array(), unbatched.array(), atol=1e-5
+        )
+        assert (batched.status is None or batched.status.status == "SUCCESS")
+        assert (unbatched.status is None or unbatched.status.status == "SUCCESS")
+
+    asyncio.run(run())
 
 
 def test_engine_batched_results_match_unbatched():

@@ -1,0 +1,131 @@
+"""Contract generation/validation tests + end-to-end api-tester against a
+live engine (the reference's tester.py / api-tester.py behavior)."""
+
+import asyncio
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.testing.contract import (
+    Contract,
+    ContractError,
+    generate_batch,
+    validate_response,
+)
+
+EXAMPLES = pathlib.Path(__file__).parent.parent / "examples"
+
+
+def test_generate_batch_continuous_repeat():
+    contract = Contract.from_file(str(EXAMPLES / "mnist_contract.json"))
+    msg = generate_batch(contract, 8, seed=0)
+    arr = msg.array()
+    assert arr.shape == (8, 784)
+    assert arr.min() >= 0 and arr.max() <= 1
+    assert len(msg.names()) == 784
+    # deterministic for a fixed seed
+    again = generate_batch(contract, 8, seed=0)
+    np.testing.assert_array_equal(arr, again.array())
+
+
+def test_generate_batch_named_columns():
+    contract = Contract.from_file(str(EXAMPLES / "iris_contract.json"))
+    msg = generate_batch(contract, 4, seed=1)
+    assert msg.array().shape == (4, 4)
+    assert msg.names() == ["sepal_length", "sepal_width", "petal_length", "petal_width"]
+
+
+def test_generate_batch_categorical_and_int():
+    contract = Contract.from_json(
+        json.dumps(
+            {
+                "features": [
+                    {"name": "n", "dtype": "INT", "ftype": "continuous",
+                     "range": [0, 9]},
+                    {"name": "color", "ftype": "categorical",
+                     "values": ["red", "green"]},
+                ]
+            }
+        )
+    )
+    msg = generate_batch(contract, 16, seed=2)
+    arr = msg.array()
+    assert arr.shape == (16, 2)
+    assert msg.data.kind == "ndarray"  # mixed types -> ndarray wire form
+    ints = arr[:, 0].astype(float)
+    assert np.all(ints == np.floor(ints))
+    assert set(arr[:, 1]) <= {"red", "green"}
+
+
+def test_contract_errors():
+    with pytest.raises(ContractError):
+        Contract.from_json("{}")
+    with pytest.raises(ContractError):
+        Contract.from_json("not json")
+    with pytest.raises(ContractError):
+        generate_batch(
+            Contract(features=[{"name": "x", "ftype": "categorical"}]), 1
+        )
+    with pytest.raises(ContractError):
+        generate_batch(Contract(features=[{"ftype": "continuous"}]), 1)
+
+
+def test_validate_response():
+    contract = Contract.from_file(str(EXAMPLES / "mnist_contract.json"))
+    good = SeldonMessage.from_array(np.full((2, 10), 0.1))
+    assert validate_response(contract, good) == []
+    wrong_width = SeldonMessage.from_array(np.full((2, 3), 0.1))
+    assert any("width" in p for p in validate_response(contract, wrong_width))
+    out_of_range = SeldonMessage.from_array(np.full((2, 10), 7.0))
+    assert any("above range" in p for p in validate_response(contract, out_of_range))
+    failure = SeldonMessage.failure("boom")
+    assert validate_response(contract, failure) == ["FAILURE status: boom"]
+
+
+def test_api_tester_against_live_engine():
+    """Full api-tester flow against an engine serving the MNIST example."""
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
+    from seldon_core_tpu.testing.api_tester import run_test
+
+    async def run():
+        spec = SeldonDeploymentSpec.from_json(
+            (EXAMPLES / "mnist_deployment.json").read_text()
+        )
+        engine = EngineService(spec)
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            contract = Contract.from_file(str(EXAMPLES / "mnist_contract.json"))
+            result = await run_test(contract, "127.0.0.1", port, n=4, seed=0)
+            assert result["ok"], result
+            assert result["rows"] == 4
+            # feedback endpoint returns cleanly too
+            result_fb = await run_test(
+                contract, "127.0.0.1", port, endpoint="send-feedback", n=2
+            )
+            assert result_fb["ok"], result_fb
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_example_deployments_parse_and_validate():
+    """Every shipped example spec passes defaulting + validation."""
+    from seldon_core_tpu.graph.defaulting import default_and_validate
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+    for f in EXAMPLES.glob("*_deployment.json"):
+        spec = SeldonDeploymentSpec.from_json(f.read_text())
+        default_and_validate(spec)
+        assert spec.predictors, f.name

@@ -1,0 +1,252 @@
+"""Gateway + materializer tests: oauth flow, canary traffic split, firehose,
+watch-dir control loop — apife + cluster-manager behavior without k8s."""
+
+import asyncio
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.gateway.apife import (
+    ApiGateway,
+    AuthError,
+    DeploymentStore,
+    make_gateway_app,
+)
+from seldon_core_tpu.gateway.firehose import Firehose
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+from seldon_core_tpu.operator.materializer import Materializer
+from seldon_core_tpu.runtime.engine import EngineService
+
+
+def two_predictor_spec(name="canary-dep", main_replicas=3, canary_replicas=1):
+    """Main + canary predictors — the reference's canary pattern."""
+
+    def predictor(pname, seed, replicas):
+        return {
+            "name": pname,
+            "replicas": replicas,
+            "components": [
+                {
+                    "name": "m",
+                    "runtime": "inprocess",
+                    "class_path": "MnistClassifier",
+                    "parameters": [
+                        {"name": "hidden", "value": "32", "type": "INT"},
+                        {"name": "seed", "value": str(seed), "type": "INT"},
+                    ],
+                }
+            ],
+            "graph": {"name": "m", "type": "MODEL"},
+        }
+
+    return SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": name,
+                "oauth_key": "key1",
+                "oauth_secret": "secret1",
+                "predictors": [
+                    predictor("main", 0, main_replicas),
+                    predictor("canary", 1, canary_replicas),
+                ],
+            }
+        }
+    )
+
+
+def test_oauth_token_flow():
+    spec = two_predictor_spec()
+    store = DeploymentStore()
+    engines = {p.name: EngineService(spec, p.name) for p in spec.predictors}
+    store.register(spec, engines)
+
+    with pytest.raises(AuthError):
+        store.issue_token("key1", "wrong")
+    with pytest.raises(AuthError):
+        store.principal_for_token("garbage")
+    token = store.issue_token("key1", "secret1")
+    reg = store.principal_for_token(token)
+    assert reg.deployment_id == "canary-dep"
+    store.unregister("key1")
+    with pytest.raises(AuthError):
+        store.principal_for_token(token)
+
+
+def test_gateway_canary_split_and_firehose(tmp_path):
+    async def run():
+        spec = two_predictor_spec(main_replicas=3, canary_replicas=1)
+        store = DeploymentStore()
+        engines = {p.name: EngineService(spec, p.name) for p in spec.predictors}
+        store.register(spec, engines)
+        fh = Firehose(base_dir=str(tmp_path))
+        gw = ApiGateway(store=store, firehose=fh, seed=7)
+        fh.start()
+        token = store.issue_token("key1", "secret1")
+
+        served = []
+        for _ in range(40):
+            msg = SeldonMessage.from_array(np.zeros((1, 784), np.float32))
+            resp = await gw.predict(msg, token)
+            assert resp.status is None or resp.status.status == "SUCCESS"
+            served.append(resp.meta.requestPath["predictor"])
+        counts = {p: served.count(p) for p in set(served)}
+        # 3:1 replica weighting: main should dominate but canary gets traffic
+        assert counts.get("main", 0) > counts.get("canary", 0) > 0
+
+        # wrong/missing token rejected
+        with pytest.raises(AuthError):
+            await gw.predict(SeldonMessage.from_array(np.zeros((1, 784))), None)
+
+        await fh.stop()
+        lines = (tmp_path / "canary-dep.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 40
+        event = json.loads(lines[0])
+        assert event["deployment"] == "canary-dep"
+        assert len(event["puid"]) == 26
+        assert "request" in event and "response" in event
+
+    asyncio.run(run())
+
+
+def test_gateway_feedback_routes_to_serving_predictor():
+    async def run():
+        spec = two_predictor_spec()
+        store = DeploymentStore()
+        engines = {p.name: EngineService(spec, p.name) for p in spec.predictors}
+        store.register(spec, engines)
+        gw = ApiGateway(store=store, seed=0)
+        token = store.issue_token("key1", "secret1")
+        msg = SeldonMessage.from_array(np.zeros((1, 784), np.float32))
+        resp = await gw.predict(msg, token)
+        fb = Feedback(request=msg, response=resp, reward=1.0)
+        ack = await gw.send_feedback(fb, token)
+        assert ack.status is None or ack.status.status == "SUCCESS"
+
+    asyncio.run(run())
+
+
+def test_gateway_http_surface():
+    async def run():
+        import aiohttp
+
+        from seldon_core_tpu.runtime.rest import serve_app
+
+        spec = two_predictor_spec()
+        store = DeploymentStore()
+        engines = {p.name: EngineService(spec, p.name) for p in spec.predictors}
+        store.register(spec, engines)
+        gw = ApiGateway(store=store)
+
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        runner = await serve_app(make_gateway_app(gw), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as session:
+                # token via basic auth
+                async with session.post(
+                    f"http://127.0.0.1:{port}/oauth/token",
+                    auth=aiohttp.BasicAuth("key1", "secret1"),
+                ) as r:
+                    assert r.status == 200
+                    token = (await r.json())["access_token"]
+                # bad credentials -> 401
+                async with session.post(
+                    f"http://127.0.0.1:{port}/oauth/token",
+                    auth=aiohttp.BasicAuth("key1", "nope"),
+                ) as r:
+                    assert r.status == 401
+                # authorized predict
+                async with session.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    headers={"Authorization": f"Bearer {token}"},
+                    json={"data": {"ndarray": np.zeros((1, 784)).tolist()}},
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                    assert d["meta"]["requestPath"]["predictor"] in ("main", "canary")
+                # unauthorized predict -> 401
+                async with session.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1]]}},
+                ) as r:
+                    assert r.status == 401
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# materializer
+# ---------------------------------------------------------------------------
+
+
+def test_materializer_apply_status_delete():
+    mat = Materializer(spawn_units=False)
+    spec = two_predictor_spec(name="dep-a")
+    md = mat.apply(spec)
+    assert set(md.engines) == {"main", "canary"}
+    st = mat.status("dep-a")
+    assert st["state"] == "Available"
+    assert st["predictorStatus"][0] == {
+        "name": "main", "replicas": 3, "replicasAvailable": 3}
+    # gateway store was wired
+    token = mat.store.issue_token("key1", "secret1")
+    assert mat.store.principal_for_token(token).deployment_id == "dep-a"
+    mat.delete("dep-a")
+    assert mat.status("dep-a") == {"state": "absent"}
+    with pytest.raises(AuthError):
+        mat.store.principal_for_token(token)
+
+
+def test_materializer_rejects_invalid_spec():
+    from seldon_core_tpu.graph.spec import GraphSpecError
+
+    mat = Materializer(spawn_units=False)
+    bad = SeldonDeploymentSpec.from_json(
+        (pathlib.Path(__file__).parent / "resources" / "model_invalid_graph.json").read_text()
+    )
+    with pytest.raises(GraphSpecError):
+        mat.apply(bad)
+    assert bad.name not in mat.deployments
+
+
+def test_materializer_watch_dir(tmp_path):
+    async def run():
+        mat = Materializer(spawn_units=False)
+        spec_file = tmp_path / "dep.json"
+        spec_file.write_text(json.dumps(two_predictor_spec(name="dep-w").to_json_dict()))
+
+        t = asyncio.create_task(mat.watch_dir(str(tmp_path), interval_s=0.05))
+        await asyncio.sleep(0.3)
+        assert "dep-w" in mat.deployments  # ADDED
+
+        # unchanged file across many ticks -> no re-apply (mtime dedup)
+        applied_at = mat.deployments["dep-w"].applied_at
+        await asyncio.sleep(0.3)
+        assert mat.deployments["dep-w"].applied_at == applied_at
+
+        # modified file -> re-apply
+        spec_file.write_text(
+            json.dumps(two_predictor_spec(name="dep-w", main_replicas=5).to_json_dict())
+        )
+        import os
+
+        os.utime(spec_file, (applied_at + 10, applied_at + 10))
+        await asyncio.sleep(0.3)
+        assert mat.deployments["dep-w"].spec.predictor("main").replicas == 5
+
+        # file removed -> deployment deleted (ownerReference GC)
+        spec_file.unlink()
+        await asyncio.sleep(0.3)
+        assert "dep-w" not in mat.deployments
+        t.cancel()
+
+    asyncio.run(run())
