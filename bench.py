@@ -131,8 +131,9 @@ async def _bench_engine(spec, payload, n_clients, duration_s, **engine_kwargs):
     from seldon_core_tpu.runtime.engine import EngineService
 
     engine = EngineService(spec, **engine_kwargs)
-    # warm-up (compile + relay)
-    await _client_load(engine, payload, min(8, n_clients), 2.0)
+    # warm-up at FULL concurrency so every batch-bucket shape the measured
+    # load produces is already compiled (mid-run XLA retrace skews p99)
+    await _client_load(engine, payload, n_clients, 3.0)
     completed, lat, wall = await _client_load(engine, payload, n_clients, duration_s)
     return {
         "qps": completed / wall,

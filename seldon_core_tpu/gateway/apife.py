@@ -93,6 +93,13 @@ class DeploymentStore:
         reg = self._by_key.get(oauth_key)
         if reg is None or (reg.oauth_secret and reg.oauth_secret != oauth_secret):
             raise AuthError("invalid client credentials")
+        if len(self._tokens) > 4096:
+            # clients that fetch a fresh token per session would otherwise
+            # grow the store without bound (expiry eviction is lazy)
+            now = time.time()
+            self._tokens = {
+                t: (k, exp) for t, (k, exp) in self._tokens.items() if exp > now
+            }
         token = secrets.token_urlsafe(24)
         self._tokens[token] = (oauth_key, time.time() + TOKEN_TTL_S)
         return token
@@ -127,6 +134,7 @@ class ApiGateway:
         self.require_auth = require_auth
         self.metrics = MetricsRegistry(deployment_name="gateway")
         self._rng = np.random.default_rng(seed)
+        self._session = None  # lazy shared aiohttp session (remote engines)
 
     # -- principal resolution ----------------------------------------------
 
@@ -195,19 +203,30 @@ class ApiGateway:
     async def _http_post(self, url: str, payload: str) -> SeldonMessage:
         import aiohttp
 
-        # pooled client, 3 retries — apife's HttpRetryHandler.java:34-45
-        async with aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=20)
-        ) as session:
-            last = "unreachable"
-            for _ in range(3):
-                try:
-                    async with session.post(url, data=payload) as r:
-                        return SeldonMessage.from_json(await r.text())
-                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                    last = str(e)
-                    await asyncio.sleep(0.05)
-            return SeldonMessage.failure(f"engine unreachable: {last}", code=503)
+        # one pooled session per gateway + 3 retries, mirroring apife's
+        # pooling client with HttpRetryHandler (InternalPredictionService.
+        # java:60-72, HttpRetryHandler.java:34-45).  Retries fire only on
+        # connection-establishment failures — once bytes may have reached the
+        # engine, re-POSTing could double-apply feedback training
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=20)
+            )
+        last = "unreachable"
+        for _ in range(3):
+            try:
+                async with self._session.post(url, data=payload) as r:
+                    return SeldonMessage.from_json(await r.text())
+            except aiohttp.ClientConnectorError as e:
+                last = str(e)
+                await asyncio.sleep(0.05)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                return SeldonMessage.failure(f"engine error: {e}", code=503)
+        return SeldonMessage.failure(f"engine unreachable: {last}", code=503)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
 
 
 # ---------------------------------------------------------------------------

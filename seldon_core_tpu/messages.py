@@ -60,13 +60,11 @@ _BASE32 = "abcdefghijklmnopqrstuvwxyz234567"
 
 def new_puid() -> str:
     """130-bit random id, base32 lowercase — same shape as the reference's
-    ``PuidGenerator`` (engine PredictionService.java:52-58)."""
-    n = secrets.randbits(130)
-    chars = []
-    for _ in range(26):  # 26 * 5 = 130 bits
-        chars.append(_BASE32[n & 31])
-        n >>= 5
-    return "".join(reversed(chars))
+    ``PuidGenerator`` (engine PredictionService.java:52-58).  b32encode of 17
+    random bytes (136 bits) truncated to 26 chars = 130 uniform bits."""
+    import base64
+
+    return base64.b32encode(secrets.token_bytes(17))[:26].lower().decode("ascii")
 
 
 # ---------------------------------------------------------------------------
@@ -341,7 +339,53 @@ class SeldonMessage:
         return out
 
     def to_json(self) -> str:
+        fast = self._to_json_fast()
+        if fast is not None:
+            return fast
         return json.dumps(self.to_json_dict(), separators=(",", ":"))
+
+    def _to_json_fast(self) -> Optional[str]:
+        """Native-codec serialization (native/fastcodec.cpp): the numeric
+        payload is formatted in C++ and spliced into the (tiny) envelope.
+        None => caller uses the pure-Python path; the two paths emit
+        JSON-equivalent documents."""
+        if self.data is None or self.data.array is None:
+            return None
+        a = _to_numpy(self.data.array)
+        if a.dtype == object or a.dtype.kind not in "fiub":
+            return None
+        if self.data.kind == "ndarray" and a.dtype.kind != "f":
+            # python path emits ints/bools verbatim in ndarray form; the
+            # native formatter only speaks doubles — don't change the wire
+            return None
+        if a.size < 32:
+            return None  # ctypes fixed cost loses to json.dumps on tiny arrays
+        try:
+            from seldon_core_tpu.native.fastcodec import format_data_fragment
+        except ImportError:  # pragma: no cover
+            return None
+        af = np.ascontiguousarray(a, dtype=np.float64)
+        if not np.isfinite(af).all():
+            return None  # python path emits NaN/Infinity literals
+        frag = format_data_fragment(af, self.data.kind)
+        if frag is None:
+            return None
+        out: dict = {"meta": self.meta.to_json_dict()}
+        if self.status is not None:
+            out["status"] = self.status.to_json_dict()
+        data_obj: dict = {}
+        if self.data.names:
+            data_obj["names"] = list(self.data.names)
+        data_obj["__payload__"] = 0
+        out["data"] = data_obj
+        s = json.dumps(out, separators=(",", ":"))
+        # splice at the LAST occurrence: ours is inside "data", which is the
+        # final member of `out`; an adversarial meta tag *key* named
+        # __payload__ serializes earlier (string values can't match at all —
+        # their quotes get escaped)
+        marker = '"__payload__":0'
+        idx = s.rfind(marker)
+        return s[:idx] + frag.decode("ascii") + s[idx + len(marker):]
 
     @staticmethod
     def from_json_dict(d: Mapping[str, Any], dtype=np.float64) -> "SeldonMessage":
@@ -377,11 +421,40 @@ class SeldonMessage:
 
     @staticmethod
     def from_json(s: Union[str, bytes], dtype=np.float64) -> "SeldonMessage":
+        fast = SeldonMessage._from_json_fast(s, dtype)
+        if fast is not None:
+            return fast
         try:
             d = json.loads(s)
         except json.JSONDecodeError as e:
             raise SeldonMessageError(f"invalid JSON: {e}") from e
         return SeldonMessage.from_json_dict(d, dtype=dtype)
+
+    @staticmethod
+    def _from_json_fast(s: Union[str, bytes], dtype) -> Optional["SeldonMessage"]:
+        """Native-codec parse (native/fastcodec.cpp).  The C++ side hands back
+        the message envelope (numeric payload removed) plus the payload as a
+        contiguous buffer; anything it declines — including invalid JSON —
+        returns None so the pure-Python parser owns error behaviour."""
+        try:
+            from seldon_core_tpu.native.fastcodec import parse_message_fast
+        except ImportError:  # pragma: no cover
+            return None
+        fast = parse_message_fast(s)
+        if fast is None:
+            return None
+        envelope, kind, arr = fast
+        data_env = envelope.pop("data", None)
+        msg = SeldonMessage.from_json_dict(envelope, dtype=dtype)
+        if kind is not None:
+            if np.dtype(dtype) != arr.dtype:
+                arr = arr.astype(dtype)
+            names = list((data_env or {}).get("names", []) or [])
+            msg.data = DefaultData(array=arr, names=names, kind=kind)
+        elif data_env is not None:
+            # a data object with no payload member fails like the python path
+            raise SeldonMessageError("data must contain 'tensor' or 'ndarray'")
+        return msg
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,14 @@
+"""Native (C++) runtime components — wire codec and support libraries.
+
+The compute path of this framework is JAX/XLA on TPU; the host runtime
+around it uses compiled C++ where the hot loops are host-bound, loaded via
+ctypes (no pybind11 in this environment).  Every native component has a
+pure-Python fallback so the framework degrades gracefully on machines
+without a toolchain.
+"""
+
+from seldon_core_tpu.native.fastcodec import (  # noqa: F401
+    native_available,
+    parse_message_fast,
+    format_data_fragment,
+)

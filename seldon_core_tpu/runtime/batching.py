@@ -59,6 +59,7 @@ class MicroBatcher:
         # with state that counts rows (streaming statistics) must disable it
         self.pad_to_buckets = pad_to_buckets
         self._buckets: Dict[Tuple, List] = {}
+        self._bucket_rows: Dict[Tuple, int] = {}
         self._flush_tasks: Dict[Tuple, asyncio.Task] = {}
         self._inflight: set = set()  # strong refs: bare create_task is GC-able
 
@@ -73,7 +74,8 @@ class MicroBatcher:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         bucket = self._buckets.setdefault(key, [])
         bucket.append((x, fut))
-        rows = sum(len(e[0]) for e in bucket)
+        rows = self._bucket_rows.get(key, 0) + len(x)
+        self._bucket_rows[key] = rows
         if rows >= self.max_batch:
             self._flush(key)
         elif key not in self._flush_tasks:
@@ -86,6 +88,7 @@ class MicroBatcher:
 
     def _flush(self, key) -> None:
         bucket = self._buckets.pop(key, [])
+        self._bucket_rows.pop(key, None)
         task = self._flush_tasks.pop(key, None)
         if task is not None and not task.done():
             task.cancel()

@@ -56,6 +56,7 @@ class EngineService:
         batching: bool = True,
         max_batch: int = 1024,
         max_wait_ms: float = 2.0,
+        pipeline_depth: int = 4,
     ):
         self.deployment = deployment
         self.predictor: PredictorSpec = deployment.predictor(predictor_name)
@@ -67,8 +68,12 @@ class EngineService:
         self.paused = False
         # compiled-mode state advances via read-modify-write of
         # CompiledGraph.states; serialize device dispatches so concurrent
-        # requests can't double-spend a PRNG key or drop a bandit update
+        # requests can't double-spend a PRNG key or drop a bandit update.
+        # Stateless graphs get a semaphore instead (set below): device
+        # dispatch has a fixed sync cost, and the runtime overlaps several
+        # in-flight batches to hide it (throughput ~= depth x single-stream)
         self._device_lock = asyncio.Lock()
+        self._dispatch_sem: Optional[asyncio.Semaphore] = None
         self.mode = "host"
         self.compiled: Optional[CompiledGraph] = None
         self.executor: Optional[GraphExecutor] = None
@@ -113,9 +118,22 @@ class EngineService:
                 max_wait_ms=max_wait_ms,
                 pad_to_buckets=pad_ok,
             )
+            if pad_ok and pipeline_depth > 1 and not self.compiled.states:
+                # truly stateless graph (no unit declares ANY state): device
+                # dispatches are order-independent, so pipeline them to hide
+                # dispatch RTT.  Graphs with feedback-trained state keep the
+                # exclusive lock — a pipelined predict's state write-back
+                # could otherwise clobber a concurrent feedback update
+                self._dispatch_sem = asyncio.Semaphore(pipeline_depth)
+            # batchable graphs have no routers, so the executed path — and
+            # therefore the output names — never varies per request
+            self._static_names = self.compiled._output_names(
+                self.predictor.graph, {}
+            )
 
     async def _batched_predict(self, stacked):
-        async with self._device_lock:
+        gate = self._dispatch_sem or self._device_lock
+        async with gate:
             return await asyncio.get_running_loop().run_in_executor(
                 None, self._batched_predict_sync, stacked
             )
@@ -131,15 +149,18 @@ class EngineService:
             msg.meta.puid = new_puid()
         with self.metrics.time_server("predictions", "POST") as code:
             try:
+                if self.compiled is not None and msg.data is not None:
+                    # device graphs need numeric payloads; a ragged/string
+                    # ndarray parses to an object array and must fail as a
+                    # 400 FAILURE message, not an opaque dispatch error
+                    if msg.array().dtype == object:
+                        raise SeldonMessageError(
+                            "data payload is not a numeric rectangular tensor"
+                        )
                 if self.batcher is not None and msg.data is not None:
                     rows = np.atleast_2d(msg.array())
                     y_rows, (routing, tags) = await self.batcher.submit(rows)
-                    resp = msg.with_array(
-                        y_rows,
-                        names=self.compiled._output_names(
-                            self.predictor.graph, routing
-                        ),
-                    )
+                    resp = msg.with_array(y_rows, names=self._static_names)
                     # fresh Meta/Status: with_array shares the request's meta
                     # object, and the response must match the unbatched
                     # compiled path exactly (compiled.CompiledGraph.predict)

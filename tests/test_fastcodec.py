@@ -1,0 +1,167 @@
+"""Native wire codec (native/fastcodec.cpp) — equivalence with the pure-Python
+codec is the contract: every message must parse/serialize to the same result
+through either path (the reference pins the same property on its vendored
+JsonFormat fork via round-trip tests, engine/src/test/.../pb/TestJsonParse.java).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.native.fastcodec import (
+    format_data_fragment,
+    native_available,
+    parse_message_fast,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native codec unavailable (no toolchain)"
+)
+
+
+def pyparse(s):
+    return SeldonMessage.from_json_dict(json.loads(s))
+
+
+CASES = [
+    '{"data":{"ndarray":[[1.0,2.5],[3.0,-4.25]]}}',
+    '{"data":{"names":["a","b"],"tensor":{"shape":[2,2],"values":[1,2,3,4.5e-3]}}}',
+    '{"meta":{"puid":"x","tags":{"k":"v","n":1.5},"routing":{"r":0}},"data":{"ndarray":[1,2,3]}}',
+    '{"strData":"hello"}',
+    '{"binData":"aGVsbG8="}',
+    '{"data":{"ndarray":[[1,2],[3]]}}',  # ragged -> python fallback object array
+    '{"data":{"ndarray":[1,[2]]}}',  # mixed scalar/array level -> fallback
+    '{"data":{"ndarray":[[1],[[2]]]}}',  # depth mismatch across branches
+    '{"data":{"ndarray":[NaN,1]}}',  # python json accepts NaN literals
+    '{"data":{"ndarray":[]}}',
+    '{"data":{"ndarray":[[]]}}',
+    '{"data":{"tensor":{"shape":[0],"values":[]}}}',
+    '{"status":{"code":500,"status":"FAILURE","info":"boom"},"meta":{"puid":"p"}}',
+    '{"data":null,"strData":"s"}',
+    '{  "data" : { "ndarray" : [ 1 , 2 ] } }',
+    '{"data":{"ndarray":[1e308,-1e-308,0.1,123456789012345678901234567890.5]}}',
+    '{"meta":{"tags":{"weird":{"nested":[1,"two"]}}},"data":{"ndarray":[7]}}',
+    '{"meta":{"tags":{"trick":"\\"__payload__\\":0"}},"data":{"ndarray":[1,2]}}',
+]
+
+
+@pytest.mark.parametrize("s", CASES)
+def test_parse_matches_python_path(s):
+    a = SeldonMessage.from_json(s)
+    b = pyparse(s)
+    assert a.data_kind == b.data_kind
+    if a.data is not None:
+        na, nb = a.data.numpy(), b.data.numpy()
+        assert a.data.kind == b.data.kind
+        assert a.data.names == b.data.names
+        assert na.shape == nb.shape
+        if na.dtype != object:
+            np.testing.assert_array_equal(
+                na.astype(np.float64), nb.astype(np.float64)
+            )
+    assert a.meta.__dict__ == b.meta.__dict__
+    assert (a.status is None) == (b.status is None)
+    if a.status is not None:
+        assert a.status.__dict__ == b.status.__dict__
+
+
+@pytest.mark.parametrize("s", CASES)
+def test_serialize_reparses_identically(s):
+    m = SeldonMessage.from_json(s)
+    back = SeldonMessage.from_json(m.to_json())
+    assert back.data_kind == m.data_kind
+    if m.data is not None and m.data.numpy().dtype != object:
+        np.testing.assert_array_equal(
+            back.array().astype(np.float64), m.array().astype(np.float64)
+        )
+    assert back.meta.__dict__ == m.meta.__dict__
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["{", '{"data":{"ndarray":[1,}}', "null", "[1,2]", "",
+     '{"data":{"tensor":{"shape":[3],"values":[1,2]}}}'],
+)
+def test_invalid_inputs_still_raise(bad):
+    with pytest.raises(Exception):
+        SeldonMessage.from_json(bad)
+
+
+def test_fuzz_roundtrip_exact():
+    rng = np.random.default_rng(0)
+    for trial in range(100):
+        ndim = int(rng.integers(1, 4))
+        shape = tuple(int(x) for x in rng.integers(1, 6, ndim))
+        scale = 10.0 ** rng.integers(-200, 200)
+        arr = rng.standard_normal(shape) * scale
+        kind = ["tensor", "ndarray"][trial % 2]
+        m = SeldonMessage.from_array(arr, kind=kind)
+        s = m.to_json()
+        np.testing.assert_array_equal(SeldonMessage.from_json(s).array(), arr)
+        np.testing.assert_array_equal(pyparse(s).array(), arr)
+        # python-serialized text through the native parser
+        s2 = json.dumps(m.to_json_dict(), separators=(",", ":"))
+        np.testing.assert_array_equal(SeldonMessage.from_json(s2).array(), arr)
+
+
+def test_float32_tails_roundtrip():
+    arr = np.float32(np.random.default_rng(3).standard_normal((8, 16))).astype(
+        np.float64
+    )
+    m = SeldonMessage.from_array(arr)
+    np.testing.assert_array_equal(SeldonMessage.from_json(m.to_json()).array(), arr)
+
+
+def test_fragment_formatter_direct():
+    a = np.arange(6, dtype=np.float64).reshape(2, 3)
+    frag = format_data_fragment(a, "ndarray")
+    assert frag is not None
+    assert json.loads("{%s}" % frag.decode()) == {"ndarray": a.tolist()}
+    frag = format_data_fragment(a, "tensor")
+    d = json.loads("{%s}" % frag.decode())
+    assert d["tensor"]["shape"] == [2, 3]
+    assert d["tensor"]["values"] == a.reshape(-1).tolist()
+
+
+def test_parser_declines_exotics():
+    assert parse_message_fast('{"data":{"ndarray":[[1,2],[3]]}}') is None
+    assert parse_message_fast('{"data":{"ndarray":["a"]}}') is None
+    assert parse_message_fast("not json") is None
+
+
+@pytest.mark.parametrize(
+    "bad_number", ["+1", ".5", "1.", "01", "0 1", "1e", "--1"]
+)
+def test_strict_number_grammar_matches_json_loads(bad_number):
+    s = '{"data":{"ndarray":[%s]}}' % bad_number
+    # the native parser must never accept what json.loads rejects
+    assert parse_message_fast(s) is None
+    with pytest.raises(Exception):
+        SeldonMessage.from_json(s)
+
+
+def test_escaped_keys_fall_back_to_python():
+    # n == 'n': valid JSON whose payload key is escaped — python path
+    # must own it (native re-emits keys raw and would corrupt/misparse)
+    s = '{"data":{"\\u006edarray":[1.0,2.0]}}'
+    assert parse_message_fast(s) is None
+    m = SeldonMessage.from_json(s)
+    np.testing.assert_array_equal(m.array(), [1.0, 2.0])
+
+
+def test_int_bool_ndarray_wire_form_preserved():
+    for arr in (np.arange(64), np.ones(64, dtype=bool)):
+        m = SeldonMessage.from_array(arr, kind="ndarray")
+        assert json.loads(m.to_json())["data"]["ndarray"] == arr.tolist()
+
+
+def test_payload_placeholder_key_in_tags():
+    m = SeldonMessage.from_array(np.arange(64, dtype=np.float64))
+    m.meta.tags = {"__payload__": 0}
+    d = json.loads(m.to_json())
+    assert d["meta"]["tags"] == {"__payload__": 0}
+    np.testing.assert_array_equal(
+        np.asarray(d["data"]["tensor"]["values"]), np.arange(64.0)
+    )
