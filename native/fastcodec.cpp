@@ -377,6 +377,9 @@ static int parse_message(const char* buf, size_t len, Parse& out) {
     if (key_escaped) return SM_UNSUPPORTED;  // keys are re-emitted raw
     if (!s.lit(':')) return SM_INVALID;
     if (key == "data") {
+      // any duplicate top-level "data" (object-then-null, double object)
+      // would need json.loads last-wins semantics — decline to Python
+      if (has_data) return SM_UNSUPPORTED;
       if (s.peek() != '{') {
         // "data": null — treat as absent, like protobuf JsonFormat
         const char* vstart = s.p;
@@ -384,7 +387,6 @@ static int parse_message(const char* buf, size_t len, Parse& out) {
         std::string v(vstart, s.p - vstart);
         if (v != "null") return SM_UNSUPPORTED;
       } else {
-        if (has_data) return SM_UNSUPPORTED;
         has_data = true;
         int rc = parse_data(s, out, data_env);
         if (rc != SM_OK) return rc;
@@ -419,6 +421,11 @@ static int parse_message(const char* buf, size_t len, Parse& out) {
 // ---------------------------------------------------------------------------
 
 static int format_double(double v, char* buf /* >= 32 bytes */) {
+  if (v == 0.0 && 1.0 / v < 0) {
+    // python json.dumps(-0.0) keeps the sign; the integral path would drop it
+    memcpy(buf, "-0.0", 4);
+    return 4;
+  }
   if (v == (double)(long long)v && v > -1e15 && v < 1e15) {
     // integral fast path, python-json style "N.0"
     long long i = (long long)v;
@@ -507,6 +514,21 @@ const long long* sm_shape(Parse* p, int* ndim) {
 
 void sm_free(Parse* p) { delete p; }
 
+// Empty-array nesting: mirror numpy .tolist() — full nesting down to the
+// first zero-length dim, which renders as [] (e.g. (2,0) -> [[],[]],
+// (0,5) -> [], (2,3,0) -> [[[],[],[]],[[],[],[]]]).
+static void emit_empty_ndarray(std::string& out, const long long* shape,
+                               int first_zero_dim, int d) {
+  out += '[';
+  if (d < first_zero_dim) {
+    for (long long i = 0; i < shape[d]; ++i) {
+      if (i) out += ',';
+      emit_empty_ndarray(out, shape, first_zero_dim, d + 1);
+    }
+  }
+  out += ']';
+}
+
 // Format a payload fragment from a flat double buffer:
 //   kind==KIND_TENSOR  -> "tensor":{"shape":[..],"values":[..]}
 //   kind==KIND_NDARRAY -> "ndarray":[[..],..] nested per shape
@@ -541,10 +563,10 @@ char* sm_format(const double* vals, const long long* shape, int ndim,
     long long acc = 1;
     for (int d = ndim - 1; d >= 0; --d) { acc *= shape[d]; period[d] = acc; }
     if (total == 0) {
-      // degenerate: emit the shape's nesting with empty innermost arrays
       out += "\"ndarray\":";
-      for (int d = 0; d < ndim; ++d) out += '[';
-      for (int d = 0; d < ndim; ++d) out += ']';
+      int z = 0;
+      while (z < ndim && shape[z] != 0) ++z;
+      emit_empty_ndarray(out, shape, z, 0);
     } else {
       out += "\"ndarray\":";
       for (long long i = 0; i < total; ++i) {

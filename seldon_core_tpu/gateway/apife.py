@@ -310,4 +310,9 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_post("/api/v0.1/feedback", feedback)
     app.router.add_get("/ping", ping)
     app.router.add_get("/prometheus", prometheus)
+
+    async def _cleanup(_app):
+        await gateway.close()  # pooled upstream session/connector
+
+    app.on_cleanup.append(_cleanup)
     return app

@@ -295,9 +295,17 @@ class CompiledGraph:
     # execution
     # ------------------------------------------------------------------
 
-    def predict_arrays(self, X) -> Tuple[Any, Dict[str, int], Dict[str, Any]]:
+    def predict_arrays(
+        self, X, update_states: bool = True
+    ) -> Tuple[Any, Dict[str, int], Dict[str, Any]]:
         """Run the compiled graph; returns (Y, routing, tags) and advances the
-        held unit states."""
+        held unit states.
+
+        ``update_states=False`` skips the state write-back: when no unit
+        updates state on predict the returned states equal the inputs, and
+        skipping the read-modify-write lets the engine pipeline several
+        in-flight dispatches without a stale write-back clobbering a
+        concurrent feedback update."""
         y, new_states, routing, tags = self._jit_predict(self.states, jnp.asarray(X))
         routing_py = {
             k: int(v) for k, v in routing.items() if int(v) != NOT_ROUTED
@@ -313,7 +321,8 @@ class CompiledGraph:
                     f"{self._router_children[r]} children (broadcast routing is "
                     f"host-mode only)"
                 )
-        self.states = new_states
+        if update_states:
+            self.states = new_states
         return y, routing_py, tags
 
     def feedback_arrays(

@@ -56,15 +56,17 @@ class SeldonMessageError(ValueError):
 # ---------------------------------------------------------------------------
 
 _BASE32 = "abcdefghijklmnopqrstuvwxyz234567"
+# byte -> base32 char of its low 5 bits (uniform: 256 = 8 * 32)
+_B32_TABLE = bytes(ord(_BASE32[b & 31]) for b in range(256))
 
 
 def new_puid() -> str:
     """130-bit random id, base32 lowercase — same shape as the reference's
-    ``PuidGenerator`` (engine PredictionService.java:52-58).  b32encode of 17
-    random bytes (136 bits) truncated to 26 chars = 130 uniform bits."""
-    import base64
-
-    return base64.b32encode(secrets.token_bytes(17))[:26].lower().decode("ascii")
+    ``PuidGenerator`` (engine PredictionService.java:52-58): 26 chars of
+    [a-z2-7] = 130 uniform bits.  Implemented as bytes.translate over the
+    low 5 bits of 26 random bytes (b32encode costs ~8us/call — too hot for
+    the per-request path)."""
+    return secrets.token_bytes(26).translate(_B32_TABLE).decode("ascii")
 
 
 # ---------------------------------------------------------------------------

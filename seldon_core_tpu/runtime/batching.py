@@ -1,4 +1,4 @@
-"""Micro-batching queue — shape-bucketed request coalescing.
+"""Micro-batching queue — shape-bucketed, slot-driven request coalescing.
 
 The reference engine serves request-at-a-time (one Spring @Async chain per
 request); on TPU the economics invert: a device dispatch has fixed overhead
@@ -6,6 +6,13 @@ request); on TPU the economics invert: a device dispatch has fixed overhead
 The ``MicroBatcher`` coalesces concurrent requests that share a feature
 shape into one stacked dispatch and splits the result rows back out, so K
 concurrent clients cost ~one dispatch instead of K.
+
+Dispatch is *slot-driven* (continuous batching): up to ``max_inflight``
+stacked dispatches ride the device at once, and a bucket flushes the moment
+a slot frees up rather than on a fixed timer.  While every slot is busy the
+bucket keeps accumulating, so batch size adapts to load automatically —
+light load dispatches immediately (latency-bound), heavy load dispatches
+big stacks (throughput-bound) with no tuning knob coupling the two.
 
 Semantics note: batching is only transparent for graphs whose per-request
 decisions don't change under concatenation — MODEL / TRANSFORMER / COMBINER
@@ -17,7 +24,8 @@ auto-batching for router-free graphs (checked by ``graph_is_batchable``).
 from __future__ import annotations
 
 import asyncio
-from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Any, Awaitable, Callable, Deque, Dict, Tuple
 
 import numpy as np
 
@@ -39,8 +47,12 @@ class MicroBatcher:
     ``batch_fn`` (an ``async ([B, ...]) -> ([B, ...], aux)`` callable).
 
     * requests are bucketed by trailing feature shape + dtype;
-    * a bucket flushes when it reaches ``max_batch`` rows or when the oldest
-      entry has waited ``max_wait_ms`` (latency bound);
+    * up to ``max_inflight`` stacked dispatches run concurrently; a bucket
+      flushes whenever rows are waiting and a slot is free, so batch size
+      grows under load instead of queueing small fixed-interval flushes;
+    * a freshly-runnable flush waits ``coalesce_ms`` (bounded by
+      ``max_wait_ms``) so a burst of same-tick submitters lands in one
+      stack;
     * each caller gets back exactly its rows.
     """
 
@@ -50,17 +62,20 @@ class MicroBatcher:
         max_batch: int = 1024,
         max_wait_ms: float = 2.0,
         pad_to_buckets: bool = True,
+        max_inflight: int = 1,
+        coalesce_ms: float = 0.5,
     ):
         self.batch_fn = batch_fn
         self.max_batch = int(max_batch)
-        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.coalesce_s = min(float(coalesce_ms), float(max_wait_ms)) / 1e3
         # pad stacked batches up to power-of-two sizes so jit sees a handful
         # of shapes instead of retracing for every distinct row total; callers
         # with state that counts rows (streaming statistics) must disable it
         self.pad_to_buckets = pad_to_buckets
-        self._buckets: Dict[Tuple, List] = {}
-        self._bucket_rows: Dict[Tuple, int] = {}
-        self._flush_tasks: Dict[Tuple, asyncio.Task] = {}
+        self.max_inflight = int(max_inflight)
+        self._sem = asyncio.Semaphore(self.max_inflight)
+        self._buckets: Dict[Tuple, Deque] = {}
+        self._pumps: Dict[Tuple, asyncio.Task] = {}
         self._inflight: set = set()  # strong refs: bare create_task is GC-able
 
     async def submit(self, x: np.ndarray):
@@ -70,32 +85,41 @@ class MicroBatcher:
             # a 1-D payload would be bucketed as len(x) scalar rows and come
             # back sliced by feature count — treat it as one sample instead
             x = np.atleast_2d(x)
-        key = (x.shape[1:], str(x.dtype))
+        key = (x.shape[1:], x.dtype)  # np.dtype hashes fine; str() is ~5us
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        bucket = self._buckets.setdefault(key, [])
-        bucket.append((x, fut))
-        rows = self._bucket_rows.get(key, 0) + len(x)
-        self._bucket_rows[key] = rows
-        if rows >= self.max_batch:
-            self._flush(key)
-        elif key not in self._flush_tasks:
-            self._flush_tasks[key] = asyncio.create_task(self._deadline(key))
+        self._buckets.setdefault(key, deque()).append((x, fut))
+        if key not in self._pumps:
+            self._pumps[key] = asyncio.create_task(self._pump(key))
         return await fut
 
-    async def _deadline(self, key) -> None:
-        await asyncio.sleep(self.max_wait_s)
-        self._flush(key)
-
-    def _flush(self, key) -> None:
-        bucket = self._buckets.pop(key, [])
-        self._bucket_rows.pop(key, None)
-        task = self._flush_tasks.pop(key, None)
-        if task is not None and not task.done():
-            task.cancel()
-        if bucket:
-            t = asyncio.get_running_loop().create_task(self._run_batch(bucket))
-            self._inflight.add(t)
-            t.add_done_callback(self._inflight.discard)
+    async def _pump(self, key) -> None:
+        """One pump per shape bucket: take a dispatch slot, give same-burst
+        submitters a beat to land, stack what's waiting, dispatch, repeat.
+        The pump exits when its bucket drains (a later submit restarts it)."""
+        try:
+            while self._buckets.get(key):
+                await self._sem.acquire()
+                if self.coalesce_s > 0:
+                    await asyncio.sleep(self.coalesce_s)
+                bucket = self._buckets.get(key)
+                take, rows = [], 0
+                while bucket and rows < self.max_batch:
+                    entry = bucket.popleft()
+                    take.append(entry)
+                    rows += len(entry[0])
+                if bucket is not None and not bucket:
+                    del self._buckets[key]
+                if not take:
+                    self._sem.release()
+                    continue
+                t = asyncio.get_running_loop().create_task(self._run_batch(take))
+                self._inflight.add(t)
+                t.add_done_callback(self._inflight.discard)
+                t.add_done_callback(lambda _t: self._sem.release())
+        finally:
+            # reached only with the bucket empty and no awaits since that
+            # check, so a concurrent submit can't be orphaned
+            self._pumps.pop(key, None)
 
     async def _run_batch(self, bucket) -> None:
         xs = [e[0] for e in bucket]
@@ -105,11 +129,15 @@ class MicroBatcher:
             total = len(stacked)
             ys, aux = await self._dispatch_chunked(stacked)
             ys = np.asarray(ys)[:total]
+            # one walk decides whether aux carries per-row arrays at all;
+            # the common ({}, {}) routing/tags case then skips N tree walks
+            per_row = _aux_has_per_row(aux, total)
             offset = 0
             for x, fut in zip(xs, futs):
                 if not fut.cancelled():
                     rows = slice(offset, offset + len(x))
-                    fut.set_result((ys[rows], _slice_aux(aux, rows, total)))
+                    sliced = _slice_aux(aux, rows, total) if per_row else aux
+                    fut.set_result((ys[rows], sliced))
                 offset += len(x)
         except Exception as e:  # propagate to every waiter
             for fut in futs:
@@ -117,9 +145,9 @@ class MicroBatcher:
                     fut.set_exception(e)
 
     async def _dispatch_chunked(self, stacked: np.ndarray):
-        """Dispatch in <= max_batch chunks (oversized single requests and
-        bursty buckets must not produce unbounded compiled shapes), padding
-        each chunk up to a power of two when allowed."""
+        """Dispatch in <= max_batch chunks (oversized single requests must
+        not produce unbounded compiled shapes), padding each chunk up to a
+        power of two when allowed."""
         total = len(stacked)
         ys_parts = []
         aux = None
@@ -152,6 +180,20 @@ def _concat_aux(a, b):
     ):
         return np.concatenate([np.asarray(a), np.asarray(b)], axis=0)
     return b if b is not None else a
+
+
+def _aux_has_per_row(aux, total: int) -> bool:
+    """True when the aux tree contains any array whose leading dim matches
+    the stacked batch (i.e. per-row data that must be sliced per caller)."""
+    if isinstance(aux, dict):
+        return any(_aux_has_per_row(v, total) for v in aux.values())
+    if isinstance(aux, tuple):
+        return any(_aux_has_per_row(v, total) for v in aux)
+    return (
+        hasattr(aux, "shape")
+        and getattr(aux, "ndim", 0) >= 1
+        and aux.shape[0] == total
+    )
 
 
 def _slice_aux(aux, rows: slice, total: int):

@@ -43,6 +43,27 @@ from seldon_core_tpu.utils.metrics import MetricsRegistry
 __all__ = ["EngineService"]
 
 
+def _meta_shape_ok(meta_in: dict) -> bool:
+    """Fast-path precondition: the request meta must be representable by
+    Meta.from_json_dict without coercion errors, otherwise we fall back so
+    the object path returns its 400 'malformed meta' (parity with the
+    non-native codepath)."""
+    if not isinstance(meta_in.get("puid", ""), str):
+        return False
+    tags = meta_in.get("tags", {}) or {}
+    routing = meta_in.get("routing", {}) or {}
+    request_path = meta_in.get("requestPath", {}) or {}
+    if not (
+        isinstance(tags, dict)
+        and isinstance(routing, dict)
+        and isinstance(request_path, dict)
+    ):
+        return False
+    # the object path coerces routing values via int(v); only plain ints
+    # echo back unchanged, so anything else takes the object path
+    return all(type(v) is int for v in routing.values())
+
+
 class EngineService:
     """One engine per predictor; thread-safe for a single asyncio loop."""
 
@@ -56,7 +77,7 @@ class EngineService:
         batching: bool = True,
         max_batch: int = 1024,
         max_wait_ms: float = 2.0,
-        pipeline_depth: int = 4,
+        pipeline_depth: int = 8,
     ):
         self.deployment = deployment
         self.predictor: PredictorSpec = deployment.predictor(predictor_name)
@@ -73,7 +94,7 @@ class EngineService:
         # dispatch has a fixed sync cost, and the runtime overlaps several
         # in-flight batches to hide it (throughput ~= depth x single-stream)
         self._device_lock = asyncio.Lock()
-        self._dispatch_sem: Optional[asyncio.Semaphore] = None
+        self._pipelined = False
         self.mode = "host"
         self.compiled: Optional[CompiledGraph] = None
         self.executor: Optional[GraphExecutor] = None
@@ -112,37 +133,152 @@ class EngineService:
             pad_ok = not any(
                 u.updates_state_on_predict for u in self.compiled.units.values()
             )
+            # when no unit updates state on predict (pad_ok), dispatches are
+            # order-independent reads — the batcher pipelines several
+            # in-flight stacks to hide dispatch RTT, and predict_arrays skips
+            # its state write-back so a stale write can't clobber a
+            # concurrent feedback update (weights-only state is read-only at
+            # predict time).  Streaming-stats graphs keep max_inflight=1 +
+            # the exclusive device lock
+            self._pipelined = pad_ok and pipeline_depth > 1
             self.batcher = MicroBatcher(
                 self._batched_predict,
                 max_batch=max_batch,
                 max_wait_ms=max_wait_ms,
                 pad_to_buckets=pad_ok,
+                max_inflight=pipeline_depth if self._pipelined else 1,
             )
-            if pad_ok and pipeline_depth > 1 and not self.compiled.states:
-                # truly stateless graph (no unit declares ANY state): device
-                # dispatches are order-independent, so pipeline them to hide
-                # dispatch RTT.  Graphs with feedback-trained state keep the
-                # exclusive lock — a pipelined predict's state write-back
-                # could otherwise clobber a concurrent feedback update
-                self._dispatch_sem = asyncio.Semaphore(pipeline_depth)
             # batchable graphs have no routers, so the executed path — and
             # therefore the output names — never varies per request
             self._static_names = self.compiled._output_names(
                 self.predictor.graph, {}
             )
+            # precomputed fragments for the wire-to-wire fast path
+            import json as _json
+
+            self._names_fragment = (
+                '"names":%s,' % _json.dumps(list(self._static_names))
+                if self._static_names
+                else ""
+            )
+            # build/load the native codec NOW (engine startup) — a first-call
+            # build inside a request coroutine would block the event loop for
+            # the duration of the g++ run
+            from seldon_core_tpu.native.fastcodec import native_available
+
+            native_available()
 
     async def _batched_predict(self, stacked):
-        gate = self._dispatch_sem or self._device_lock
-        async with gate:
+        if self._pipelined:
+            # concurrency is bounded by the batcher's in-flight slots
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._batched_predict_sync, stacked
+            )
+        async with self._device_lock:
             return await asyncio.get_running_loop().run_in_executor(
                 None, self._batched_predict_sync, stacked
             )
 
     def _batched_predict_sync(self, stacked):
-        y, routing, tags = self.compiled.predict_arrays(stacked)
+        y, routing, tags = self.compiled.predict_arrays(
+            stacked, update_states=not self._pipelined
+        )
         return np.asarray(y), (routing, tags)
 
     # ------------------------------------------------------------------
+
+    async def predict_json(self, raw) -> "tuple[str, int]":
+        """Wire-to-wire predict: JSON in, ``(JSON out, http_status)``.
+
+        The REST hot path.  For batchable compiled graphs with a numeric
+        payload the native codec parses straight to an array and the
+        response document is composed from precomputed fragments — no
+        SeldonMessage object churn (~3x the per-request Python of
+        from_json -> predict -> to_json).  Everything else falls back to
+        the object path with identical semantics."""
+        fast = None
+        if self.batcher is not None:
+            from seldon_core_tpu.native.fastcodec import (
+                format_data_fragment,
+                parse_message_fast,
+            )
+
+            fast = parse_message_fast(raw)
+        if fast is not None:
+            envelope, kind, arr = fast
+            meta_in = envelope.get("meta") or {}
+            if (
+                kind is not None
+                and isinstance(meta_in, dict)
+                and _meta_shape_ok(meta_in)
+                and "binData" not in envelope
+                and "strData" not in envelope
+            ):
+                with self.metrics.time_server("predictions", "POST") as code:
+                    puid = meta_in.get("puid") or new_puid()
+                    rows = arr if arr.ndim >= 2 else arr.reshape(1, -1)
+                    try:
+                        y_rows, (routing, tags) = await self.batcher.submit(rows)
+                    except (SeldonMessageError, GraphSpecError) as e:
+                        code["code"] = "400"
+                        return (
+                            SeldonMessage.failure(str(e), code=400).to_json(),
+                            400,
+                        )
+                    meta_out = dict(meta_in)
+                    meta_out["puid"] = puid
+                    if tags or routing:
+                        if tags:
+                            meta_out["tags"] = {
+                                **(meta_in.get("tags") or {}),
+                                **pythonize_tags(tags),
+                            }
+                        if routing:
+                            meta_out["routing"] = {
+                                **(meta_in.get("routing") or {}),
+                                **routing,
+                            }
+                    frag = format_data_fragment(
+                        np.ascontiguousarray(y_rows, dtype=np.float64), kind
+                    )
+                    if frag is not None:
+                        import json as _json
+
+                        return (
+                            '{"meta":%s,"status":{"code":200,"status":"SUCCESS"},'
+                            '"data":{%s%s}}'
+                            % (
+                                _json.dumps(meta_out, separators=(",", ":")),
+                                self._names_fragment,
+                                frag.decode("ascii"),
+                            ),
+                            200,
+                        )
+                    # native formatter declined (NaN/Inf in the result) —
+                    # serialize the SAME result through the object codec; a
+                    # re-dispatch would double-update streaming-stats state
+                    from seldon_core_tpu.messages import (
+                        DefaultData,
+                        Meta,
+                        Status,
+                    )
+
+                    resp = SeldonMessage(
+                        meta=Meta.from_json_dict(meta_out),
+                        status=Status(),
+                        data=DefaultData(
+                            array=y_rows,
+                            names=list(self._static_names),
+                            kind=kind,
+                        ),
+                    )
+                    return resp.to_json(), 200
+            # fall through to object path
+
+        msg = SeldonMessage.from_json(raw)
+        resp = await self.predict(msg)
+        ok = resp.status is None or resp.status.status == "SUCCESS"
+        return resp.to_json(), 200 if ok else (resp.status.code or 400)
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         if not msg.meta.puid:

@@ -72,12 +72,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
 
     async def predictions(request: web.Request) -> web.Response:
         try:
-            msg = SeldonMessage.from_json(await _payload_text(request))
+            text, status = await engine.predict_json(await _payload_text(request))
         except SeldonMessageError as e:
             return _error_response(str(e))
-        resp = await engine.predict(msg)
-        status = 200 if resp.status is None or resp.status.status == "SUCCESS" else resp.status.code
-        return _msg_response(resp, status=status or 200)
+        return web.Response(
+            text=text, status=status or 200, content_type="application/json"
+        )
 
     async def feedback(request: web.Request) -> web.Response:
         try:

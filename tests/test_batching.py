@@ -290,3 +290,85 @@ def test_engine_batched_outlier_tags_per_request():
             assert scores.shape == (2,)  # this caller's rows only
 
     asyncio.run(run())
+
+
+def test_predict_json_matches_object_path():
+    """Wire-to-wire fast path emits a document equivalent to
+    from_json -> predict -> to_json (field-for-field after parsing)."""
+    spec = deployment(
+        {"name": "m0", "type": "MODEL"},
+        [
+            {
+                "name": "m0",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32", "type": "INT"}],
+            }
+        ],
+    )
+
+    async def run():
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 784)).astype(np.float64)
+        for wire in (
+            json.dumps({"data": {"ndarray": x.tolist()}}),
+            json.dumps(
+                {
+                    "meta": {"puid": "fixedpuid", "tags": {"k": "v"}},
+                    "data": {"tensor": {"shape": [2, 784], "values": x.reshape(-1).tolist()}},
+                }
+            ),
+        ):
+            fast_engine = EngineService(spec, max_wait_ms=2.0)
+            slow_engine = EngineService(spec, batching=False)
+            text, status = await fast_engine.predict_json(wire)
+            assert status == 200
+            got = json.loads(text)
+            want = json.loads(
+                (await slow_engine.predict(SeldonMessage.from_json(wire))).to_json()
+            )
+            assert got["data"].keys() == want["data"].keys()
+            assert got["data"].get("names") == want["data"].get("names")
+            np.testing.assert_allclose(
+                np.asarray(got["data"].get("ndarray", got["data"].get("tensor", {}).get("values"))),
+                np.asarray(want["data"].get("ndarray", want["data"].get("tensor", {}).get("values"))),
+                atol=1e-4,
+            )
+            if "tensor" in got["data"]:
+                assert got["data"]["tensor"]["shape"] == want["data"]["tensor"]["shape"]
+            assert got["status"]["status"] == "SUCCESS"
+            assert got["meta"].get("tags") == want["meta"].get("tags")
+            assert got["meta"]["puid"]
+            if "fixedpuid" in wire:
+                assert got["meta"]["puid"] == "fixedpuid"
+
+    asyncio.run(run())
+
+
+def test_predict_json_failure_and_fallbacks():
+    spec = deployment(
+        {"name": "m0", "type": "MODEL"},
+        [
+            {
+                "name": "m0",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32", "type": "INT"}],
+            }
+        ],
+    )
+
+    async def run():
+        engine = EngineService(spec, max_wait_ms=2.0)
+        # ragged payload -> 400 FAILURE document either path
+        text, status = await engine.predict_json(
+            json.dumps({"data": {"ndarray": [[1.0, 2.0], [3.0]]}})
+        )
+        assert status == 400
+        assert json.loads(text)["status"]["status"] == "FAILURE"
+        # strData (non-numeric) falls back to the object path cleanly
+        text, status = await engine.predict_json(json.dumps({"strData": "hi"}))
+        doc = json.loads(text)
+        assert "meta" in doc
+
+    asyncio.run(run())

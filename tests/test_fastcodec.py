@@ -165,3 +165,41 @@ def test_payload_placeholder_key_in_tags():
     np.testing.assert_array_equal(
         np.asarray(d["data"]["tensor"]["values"]), np.arange(64.0)
     )
+
+
+def test_format_negative_zero_keeps_sign():
+    from seldon_core_tpu.native.fastcodec import format_data_fragment, native_available
+
+    if not native_available():
+        pytest.skip("native codec unavailable")
+    frag = format_data_fragment(np.array([[-0.0] * 4]), "ndarray")
+    assert frag is not None and b"-0.0" in frag
+
+
+def test_format_empty_array_nesting_matches_numpy():
+    from seldon_core_tpu.native.fastcodec import format_data_fragment, native_available
+
+    if not native_available():
+        pytest.skip("native codec unavailable")
+    for shape in ((2, 0), (0, 5), (2, 3, 0), (1, 0, 4)):
+        frag = format_data_fragment(np.empty(shape), "ndarray")
+        want = json.dumps(np.empty(shape).tolist(), separators=(",", ":"))
+        assert frag == ('"ndarray":%s' % want).encode(), (shape, frag)
+
+
+def test_parse_duplicate_data_key_defers_to_python():
+    """json.loads is last-wins for duplicate keys; the native parser declines
+    any document where that matters so both paths agree."""
+    from seldon_core_tpu.native.fastcodec import parse_message_fast, native_available
+
+    if not native_available():
+        pytest.skip("native codec unavailable")
+    assert parse_message_fast('{"data":{"ndarray":[1,2]},"data":null}') is None
+    r = parse_message_fast('{"data":null,"data":{"ndarray":[1.0,2.0]}}')
+    assert r is not None and r[2].tolist() == [1.0, 2.0]
+    from seldon_core_tpu.messages import SeldonMessage
+
+    # object path agrees on both documents
+    assert SeldonMessage.from_json('{"data":{"ndarray":[1,2]},"data":null}').data is None
+    m = SeldonMessage.from_json('{"data":null,"data":{"ndarray":[1.0,2.0]}}')
+    assert m.array().tolist() == [1.0, 2.0]
