@@ -263,8 +263,12 @@ class GraphExecutor:
         predictor: PredictorSpec,
         extra_runtimes: Optional[Dict[str, NodeRuntime]] = None,
         rng=None,
+        tracer=None,
     ):
+        from seldon_core_tpu.utils.tracing import TRACER
+
         self.predictor = predictor
+        self.tracer = tracer if tracer is not None else TRACER
         self.runtimes: Dict[str, NodeRuntime] = {}
         comp_map = predictor.component_map()
         rngs = unit_rngs([u.name for u in predictor.graph.walk()], rng)
@@ -312,20 +316,27 @@ class GraphExecutor:
     ) -> SeldonMessage:
         methods = methods_for(node)
         rt = self.runtimes[node.name]
+        tracer = self.tracer
+        puid = msg.meta.puid
 
         # 1. transform input (MODEL dispatches its predict here, mirroring
         #    InternalPredictionService.transformInput's type switch,
         #    engine InternalPredictionService.java:132-161)
         if UnitMethod.TRANSFORM_INPUT in methods:
             if effective_type(node) is UnitType.MODEL:
-                msg = await rt.predict(msg)
+                with tracer.span(puid, node.name, method="predict"):
+                    msg = await rt.predict(msg)
             else:
-                msg = await rt.transform_input(msg)
+                with tracer.span(puid, node.name, method="transform_input"):
+                    msg = await rt.transform_input(msg)
 
         # 2. route + children (engine PredictiveUnitBean.java:91-112)
         if node.children:
             if UnitMethod.ROUTE in methods:
-                branch = await rt.route(msg)
+                with tracer.span(puid, node.name, method="route") as sp:
+                    branch = await rt.route(msg)
+                    if isinstance(sp, dict):
+                        sp["branch"] = branch
                 if branch >= len(node.children) or branch < -1:
                     # routing sanity check (PredictiveUnitBean.java:244-250);
                     # -1 means broadcast, other negatives are bugs (python
@@ -348,7 +359,8 @@ class GraphExecutor:
                 merged_meta = msg.meta
                 for cm in child_msgs:
                     merged_meta = merged_meta.merged_with(cm.meta)
-                out = await rt.aggregate(list(child_msgs))
+                with tracer.span(puid, node.name, method="aggregate"):
+                    out = await rt.aggregate(list(child_msgs))
                 out.meta = merged_meta.merged_with(out.meta)
             else:
                 if len(child_msgs) != 1:
@@ -363,7 +375,8 @@ class GraphExecutor:
 
         # 4. transform output (engine PredictiveUnitBean.java:115-124)
         if UnitMethod.TRANSFORM_OUTPUT in methods:
-            out = await rt.transform_output(out)
+            with tracer.span(puid, node.name, method="transform_output"):
+                out = await rt.transform_output(out)
         return out
 
     # -- feedback path ------------------------------------------------------

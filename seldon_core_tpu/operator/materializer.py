@@ -50,6 +50,11 @@ class _UnitProc:
     name: str
     popen: subprocess.Popen
     port: int
+    binding: object = None
+    predictor_id: str = ""
+    deployment_id: str = ""
+    restarts: int = 0
+    last_restart: float = 0.0
 
 
 @dataclass
@@ -147,7 +152,39 @@ class Materializer:
             ],
             env=env,
         )
-        return _UnitProc(name=binding.name, popen=popen, port=binding.port)
+        return _UnitProc(
+            name=binding.name,
+            popen=popen,
+            port=binding.port,
+            binding=binding,
+            predictor_id=predictor_id,
+            deployment_id=deployment_id,
+        )
+
+    # ------------------------------------------------------------------
+
+    def supervise(self) -> int:
+        """Restart dead unit subprocesses with exponential backoff — the
+        reference delegates this to the kubelet (k8s Deployment restart
+        policy, SURVEY.md §2.7 elasticity row); a local materializer must
+        supervise its own children.  Returns the number of restarts made."""
+        restarted = 0
+        now = time.time()
+        for md in self.deployments.values():
+            for proc in md.unit_procs:
+                if proc.popen.poll() is None or proc.binding is None:
+                    continue
+                backoff = min(2.0 ** min(proc.restarts, 5), 30.0)
+                if now - proc.last_restart < backoff:
+                    continue
+                fresh = self._spawn_unit(
+                    proc.binding, proc.predictor_id, proc.deployment_id
+                )
+                proc.popen = fresh.popen
+                proc.restarts += 1
+                proc.last_restart = now
+                restarted += 1
+        return restarted
 
     # ------------------------------------------------------------------
 
@@ -168,8 +205,11 @@ class Materializer:
                     "replicasAvailable": available * predictor.replicas,
                 }
             )
-        return {"state": "Available" if units_alive else "Degraded",
-                "predictorStatus": predictors}
+        return {
+            "state": "Available" if units_alive else "Degraded",
+            "predictorStatus": predictors,
+            "unitRestarts": sum(p.restarts for p in md.unit_procs),
+        }
 
     # ------------------------------------------------------------------
 
@@ -180,6 +220,7 @@ class Materializer:
         seen_mtime: Dict[str, float] = {}
         file_to_name: Dict[str, str] = {}
         while True:
+            self.supervise()  # restart any dead unit subprocess (backoff)
             files: Dict[str, float] = {}
             if os.path.isdir(path):
                 for fn in sorted(os.listdir(path)):
@@ -209,6 +250,19 @@ class Materializer:
                 name = file_to_name.pop(full, None)
                 if name is not None:
                     self.delete(name)
+                    try:
+                        os.remove(full + ".status")
+                    except OSError:
+                        pass
+            # status write-back: the reference patches the CR status
+            # (SeldonDeploymentStatusUpdateImpl.java:49-104); a sibling
+            # ``<spec>.json.status`` file is this materializer's CR
+            for full, name in file_to_name.items():
+                try:
+                    with open(full + ".status", "w") as f:
+                        json.dump(self.status(name), f)
+                except OSError:
+                    pass
             if once:
                 return
             await asyncio.sleep(interval_s)
@@ -216,3 +270,28 @@ class Materializer:
     def shutdown(self) -> None:
         for name in list(self.deployments):
             self.delete(name)
+
+
+def main(argv=None) -> None:
+    """``python -m seldon_core_tpu.operator.materializer <spec-dir>`` — run
+    the watch/supervise/status loop over a directory of deployment specs
+    (the reference's cluster-manager as a local process)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    parser.add_argument("spec_dir")
+    parser.add_argument("--interval", type=float, default=5.0)
+    parser.add_argument("--no-spawn", action="store_true",
+                        help="do not launch unit subprocesses (engines only)")
+    args = parser.parse_args(argv)
+    m = Materializer(spawn_units=not args.no_spawn)
+    try:
+        asyncio.run(m.watch_dir(args.spec_dir, interval_s=args.interval))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        m.shutdown()
+
+
+if __name__ == "__main__":
+    main()

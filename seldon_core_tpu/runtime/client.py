@@ -86,7 +86,18 @@ class RestNodeRuntime(NodeRuntime):
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
-    async def _post(self, path: str, payload: str) -> SeldonMessage:
+    async def _post(
+        self, path: str, payload: str, puid: str = ""
+    ) -> SeldonMessage:
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        with TRACER.span(
+            puid, self.node.name, kind="client", method=path.strip("/"),
+            transport="rest",
+        ):
+            return await self._post_traced(path, payload)
+
+    async def _post_traced(self, path: str, payload: str) -> SeldonMessage:
         import aiohttp
 
         session = await self._get_session()
@@ -115,24 +126,28 @@ class RestNodeRuntime(NodeRuntime):
     # -- NodeRuntime API ----------------------------------------------------
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._post("/predict", msg.to_json())
+        return await self._post("/predict", msg.to_json(), msg.meta.puid)
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._post("/transform-input", msg.to_json())
+        return await self._post("/transform-input", msg.to_json(), msg.meta.puid)
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._post("/transform-output", msg.to_json())
+        return await self._post("/transform-output", msg.to_json(), msg.meta.puid)
 
     async def route(self, msg: SeldonMessage) -> int:
-        resp = await self._post("/route", msg.to_json())
+        resp = await self._post("/route", msg.to_json(), msg.meta.puid)
         return _branch_from_msg(self.node.name, resp, "/route")
 
     async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
         payload = SeldonMessageList(messages=msgs).to_json()
-        return await self._post("/aggregate", payload)
+        puid = msgs[0].meta.puid if msgs else ""
+        return await self._post("/aggregate", payload, puid)
 
     async def send_feedback(self, feedback: Feedback, branch: int) -> None:
-        await self._post("/send-feedback", feedback.to_json())
+        puid = (
+            feedback.response.meta.puid if feedback.response is not None else ""
+        )
+        await self._post("/send-feedback", feedback.to_json(), puid)
 
 
 class GrpcNodeRuntime(NodeRuntime):

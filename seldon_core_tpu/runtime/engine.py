@@ -79,7 +79,10 @@ class EngineService:
         max_wait_ms: float = 2.0,
         pipeline_depth: int = 8,
     ):
+        from seldon_core_tpu.utils.tracing import TRACER
+
         self.deployment = deployment
+        self.tracer = TRACER
         self.predictor: PredictorSpec = deployment.predictor(predictor_name)
         self.metrics = MetricsRegistry(
             deployment_name=deployment.name,
@@ -180,9 +183,12 @@ class EngineService:
             )
 
     def _batched_predict_sync(self, stacked):
-        y, routing, tags = self.compiled.predict_arrays(
-            stacked, update_states=not self._pipelined
-        )
+        with self.tracer.span(
+            "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
+        ):
+            y, routing, tags = self.compiled.predict_arrays(
+                stacked, update_states=not self._pipelined
+            )
         return np.asarray(y), (routing, tags)
 
     # ------------------------------------------------------------------
@@ -214,8 +220,13 @@ class EngineService:
                 and "binData" not in envelope
                 and "strData" not in envelope
             ):
-                with self.metrics.time_server("predictions", "POST") as code:
-                    puid = meta_in.get("puid") or new_puid()
+                puid = meta_in.get("puid") or new_puid()
+                with self.metrics.time_server(
+                    "predictions", "POST"
+                ) as code, self.tracer.span(
+                    puid, "request", kind="request", method="predict",
+                    mode=self.mode,
+                ):
                     rows = arr if arr.ndim >= 2 else arr.reshape(1, -1)
                     try:
                         y_rows, (routing, tags) = await self.batcher.submit(rows)
@@ -283,7 +294,10 @@ class EngineService:
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         if not msg.meta.puid:
             msg.meta.puid = new_puid()
-        with self.metrics.time_server("predictions", "POST") as code:
+        with self.metrics.time_server("predictions", "POST") as code, self.tracer.span(
+            msg.meta.puid, "request", kind="request", method="predict",
+            mode=self.mode,
+        ):
             try:
                 if self.compiled is not None and msg.data is not None:
                     # device graphs need numeric payloads; a ragged/string
@@ -326,7 +340,12 @@ class EngineService:
             return resp
 
     async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
-        with self.metrics.time_server("feedback", "POST") as code:
+        fb_puid = (
+            feedback.response.meta.puid if feedback.response is not None else ""
+        )
+        with self.metrics.time_server("feedback", "POST") as code, self.tracer.span(
+            fb_puid, "request", kind="request", method="feedback",
+        ):
             try:
                 if self.compiled is not None:
                     routing = (

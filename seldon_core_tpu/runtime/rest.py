@@ -111,6 +111,28 @@ def make_engine_app(engine: EngineService) -> web.Application:
             headers={"Content-Type": CONTENT_TYPE_LATEST},
         )
 
+    async def trace(request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        puid = request.query.get("puid", "")
+        limit = int(request.query.get("limit", "100"))
+        spans = TRACER.trace(puid) if puid else TRACER.recent(limit)
+        return web.json_response(
+            {"enabled": TRACER.enabled, "spans": [s.to_json_dict() for s in spans]}
+        )
+
+    async def trace_enable(_):
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        TRACER.enable()
+        return web.Response(text="tracing enabled")
+
+    async def trace_disable(_):
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        TRACER.disable()
+        return web.Response(text="tracing disabled")
+
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
     app.router.add_get("/ping", ping)
@@ -118,6 +140,9 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/pause", pause)
     app.router.add_get("/unpause", unpause)
     app.router.add_get("/prometheus", prometheus)
+    app.router.add_get("/trace", trace)
+    app.router.add_get("/trace/enable", trace_enable)
+    app.router.add_get("/trace/disable", trace_disable)
     return app
 
 

@@ -1,0 +1,139 @@
+"""Request tracing — puid-correlated spans + TPU device profiling.
+
+The reference has no distributed tracing: it logs per-hop call durations
+(engine InternalPredictionService.java:267-268) and threads ``puid``
+through every hop and the Kafka firehose as the correlation id
+(engine PredictionService.java:52-58).  This module makes that design
+first-class:
+
+  * ``Tracer`` records bounded in-memory spans — one per node call in host
+    mode, one per device dispatch in compiled mode, one per request at the
+    engine edge — each tagged with the request ``puid`` so a trace can be
+    reassembled across the graph (and across processes, since the puid rides
+    the wire in ``meta``).
+  * The engine exposes ``GET /trace?puid=`` and enable/disable admin
+    endpoints (runtime/rest.py).
+  * ``device_profile`` wraps ``jax.profiler`` tracing for XLA/TPU-level
+    timelines (the compiled graph is ONE XLA program, so intra-graph timing
+    lives in the device profile, not host spans — that's the TPU-native
+    analogue of the reference's per-microservice-hop latencies).
+
+Tracing is off by default (`SELDON_TPU_TRACE=1` or ``TRACER.enable()``);
+disabled spans cost one attribute load and return a shared null context.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager, nullcontext
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "TRACER", "device_profile"]
+
+
+@dataclass
+class Span:
+    puid: str
+    name: str  # node name, or "request" / "dispatch"
+    kind: str  # "request" | "node" | "dispatch" | "client"
+    method: str  # predict / route / aggregate / ...
+    start_s: float  # epoch seconds
+    duration_ms: float
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json_dict(self) -> dict:
+        out = {
+            "puid": self.puid,
+            "name": self.name,
+            "kind": self.kind,
+            "method": self.method,
+            "start_s": round(self.start_s, 6),
+            "duration_ms": round(self.duration_ms, 3),
+        }
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+
+class Tracer:
+    """Bounded ring of recent spans, queryable by puid.  Thread-safe: spans
+    arrive from the event loop and from device-dispatch executor threads."""
+
+    def __init__(self, capacity: int = 8192, enabled: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get("SELDON_TPU_TRACE", "") not in ("", "0")
+        self.enabled = bool(enabled)
+        self._spans: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._null = nullcontext()
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def span(self, puid: str, name: str, kind: str = "node",
+             method: str = "", **attrs):
+        if not self.enabled:
+            return self._null
+        return self._record(puid, name, kind, method, attrs)
+
+    @contextmanager
+    def _record(self, puid, name, kind, method, attrs):
+        t0 = time.perf_counter()
+        start = time.time()
+        try:
+            yield attrs  # callers may add attrs while the span is open
+        finally:
+            self.add(
+                Span(
+                    puid=puid,
+                    name=name,
+                    kind=kind,
+                    method=method,
+                    start_s=start,
+                    duration_ms=(time.perf_counter() - t0) * 1e3,
+                    attrs=attrs,
+                )
+            )
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def trace(self, puid: str) -> List[Span]:
+        """All recorded spans of one request, in start order."""
+        with self._lock:
+            found = [s for s in self._spans if s.puid == puid]
+        return sorted(found, key=lambda s: s.start_s)
+
+    def recent(self, n: int = 100) -> List[Span]:
+        with self._lock:
+            return list(self._spans)[-int(n):]
+
+
+TRACER = Tracer()
+
+
+@contextmanager
+def device_profile(logdir: str):
+    """Capture a jax.profiler trace (XLA op timeline, TPU utilisation) for
+    the enclosed block; view with TensorBoard/xprof.  This is the
+    device-level complement to host spans: inside one compiled graph the
+    per-op timing only exists here."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -250,3 +250,70 @@ def test_materializer_watch_dir(tmp_path):
         t.cancel()
 
     asyncio.run(run())
+
+
+def test_materializer_supervise_restarts_dead_units(tmp_path):
+    """The reference leans on kubelet restart policy; the local materializer
+    must supervise its own unit subprocesses (SURVEY.md 2.7 elasticity)."""
+    import subprocess, sys, time as _time
+    from seldon_core_tpu.operator.materializer import Materializer, _UnitProc
+    from seldon_core_tpu.graph.spec import ComponentBinding
+
+    m = Materializer(spawn_units=False)
+    spec = SeldonDeploymentSpec.from_json_dict(
+        {"spec": {"name": "sup", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m0", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+        }]}}
+    )
+    md = m.apply(spec)
+    # attach a fake unit process that dies immediately
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    binding = ComponentBinding(name="u0", runtime="rest", class_path="MnistClassifier", port=0)
+    proc = _UnitProc(name="u0", popen=dead, port=0, binding=binding,
+                     predictor_id="p", deployment_id="sup")
+    # patch _spawn_unit so no real server starts
+    spawned = []
+    def fake_spawn(b, pid, did):
+        live = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+        spawned.append(live)
+        return _UnitProc(name=b.name, popen=live, port=0, binding=b,
+                         predictor_id=pid, deployment_id=did)
+    m._spawn_unit = fake_spawn
+    md.unit_procs.append(proc)
+    try:
+        assert m.status("sup")["state"] == "Degraded"
+        assert m.supervise() == 1
+        assert proc.restarts == 1
+        assert proc.popen.poll() is None  # replaced by a live process
+        assert m.status("sup")["state"] == "Available"
+        assert m.status("sup")["unitRestarts"] == 1
+        # backoff: immediate second death doesn't restart instantly
+        proc.popen.terminate(); proc.popen.wait()
+        assert m.supervise() == 0
+    finally:
+        for p in spawned:
+            p.terminate()
+        m.shutdown()
+
+
+def test_watch_dir_writes_status_files(tmp_path):
+    from seldon_core_tpu.operator.materializer import Materializer
+
+    m = Materializer(spawn_units=False)
+    spec = {
+        "spec": {"name": "st", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m0", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+        }]}
+    }
+    f = tmp_path / "st.json"
+    f.write_text(json.dumps(spec))
+    asyncio.run(m.watch_dir(str(tmp_path), once=True))
+    try:
+        status = json.loads((tmp_path / "st.json.status").read_text())
+        assert status["state"] == "Available"
+        assert status["predictorStatus"][0]["name"] == "p"
+    finally:
+        m.shutdown()

@@ -1,0 +1,174 @@
+"""Tracing subsystem: puid-correlated spans across the graph, admin API.
+
+The reference's only per-request observability is hop-latency logs keyed by
+puid (engine InternalPredictionService.java:267-268, PredictionService.java:
+52-58); here the same correlation id drives a real span store.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.utils.tracing import TRACER, Span, Tracer
+
+
+def deployment(graph, components=None):
+    return SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": "d",
+                "predictors": [
+                    {"name": "p", "graph": graph, "components": components or []}
+                ],
+            }
+        }
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    TRACER.clear()
+    TRACER.disable()
+    yield
+    TRACER.clear()
+    TRACER.disable()
+
+
+def test_tracer_disabled_is_nullcontext():
+    t = Tracer(enabled=False)
+    with t.span("p1", "n1") as sp:
+        assert sp is None  # shared null context, no span recorded
+    assert t.recent() == []
+
+
+def test_tracer_records_and_queries_by_puid():
+    t = Tracer(enabled=True)
+    with t.span("p1", "node_a", method="predict") as sp:
+        sp["rows"] = 4
+    with t.span("p2", "node_b", method="route"):
+        pass
+    with t.span("p1", "node_c", method="aggregate"):
+        pass
+    spans = t.trace("p1")
+    assert [s.name for s in spans] == ["node_a", "node_c"]
+    assert spans[0].attrs == {"rows": 4}
+    assert spans[0].duration_ms >= 0
+    assert len(t.recent()) == 3
+    d = spans[0].to_json_dict()
+    json.dumps(d)  # JSON-safe
+
+
+def test_tracer_capacity_bounded():
+    t = Tracer(capacity=10, enabled=True)
+    for i in range(50):
+        t.add(Span(puid=f"p{i}", name="n", kind="node", method="m",
+                   start_s=float(i), duration_ms=1.0))
+    assert len(t.recent(1000)) == 10
+
+
+def test_host_graph_records_node_spans():
+    """Host-mode execution: one span per node method, all sharing the puid."""
+    spec = deployment(
+        {
+            "name": "r",
+            "implementation": "RANDOM_ABTEST",
+            "type": "ROUTER",
+            "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+            "children": [
+                {"name": "a", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+                {"name": "b", "implementation": "SIMPLE_MODEL", "type": "MODEL"},
+            ],
+        }
+    )
+
+    async def run():
+        TRACER.enable()
+        engine = EngineService(spec, force_host=True)
+        msg = SeldonMessage.from_array(np.ones((1, 3), np.float32))
+        resp = await engine.predict(msg)
+        spans = TRACER.trace(resp.meta.puid)
+        kinds = {(s.name, s.method) for s in spans}
+        assert ("request", "predict") in kinds
+        assert ("r", "route") in kinds
+        # exactly one branch served
+        assert (("a", "predict") in kinds) != (("b", "predict") in kinds)
+        route_span = next(s for s in spans if s.method == "route")
+        assert route_span.attrs.get("branch") in (0, 1)
+
+    asyncio.run(run())
+
+
+def test_compiled_engine_records_request_and_dispatch_spans():
+    spec = deployment(
+        {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
+    )
+
+    async def run():
+        TRACER.enable()
+        engine = EngineService(spec)
+        assert engine.mode == "compiled"
+        msg = SeldonMessage.from_array(np.ones((2, 3), np.float32))
+        resp = await engine.predict(msg)
+        spans = TRACER.trace(resp.meta.puid)
+        assert any(s.kind == "request" for s in spans)
+        # batched dispatch spans are per-stack (no puid)
+        dispatches = [s for s in TRACER.recent(100) if s.kind == "dispatch"]
+        assert dispatches and dispatches[0].attrs.get("rows") >= 1
+
+    asyncio.run(run())
+
+
+def test_feedback_span_uses_response_puid():
+    spec = deployment(
+        {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
+    )
+
+    async def run():
+        TRACER.enable()
+        engine = EngineService(spec)
+        fb = Feedback(
+            response=SeldonMessage.from_json(
+                json.dumps({"meta": {"puid": "fbpuid"}})
+            ),
+            reward=1.0,
+        )
+        await engine.send_feedback(fb)
+        spans = TRACER.trace("fbpuid")
+        assert any(s.method == "feedback" for s in spans)
+
+    asyncio.run(run())
+
+
+def test_rest_trace_endpoints():
+    from seldon_core_tpu.runtime.rest import make_engine_app
+
+    spec = deployment(
+        {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
+    )
+
+    async def run():
+        from aiohttp.test_utils import TestClient, TestServer
+
+        engine = EngineService(spec)
+        app = make_engine_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/trace/enable")
+            assert r.status == 200
+            body = json.dumps({"meta": {"puid": "restpuid"},
+                               "data": {"ndarray": [[1.0, 2.0, 3.0]]}})
+            r = await client.post("/api/v0.1/predictions", data=body,
+                                  headers={"Content-Type": "application/json"})
+            assert r.status == 200
+            r = await client.get("/trace", params={"puid": "restpuid"})
+            doc = await r.json()
+            assert doc["enabled"] is True
+            assert any(s["puid"] == "restpuid" for s in doc["spans"])
+            r = await client.get("/trace/disable")
+            assert r.status == 200
+
+    asyncio.run(run())
