@@ -109,11 +109,22 @@ class MnistClassifier(Unit):
         depth: int = 2,
         seed: int = 0,
         dtype: str = "bfloat16",
+        use_pallas: str = "auto",
     ):
         self.hidden = int(hidden)
         self.depth = int(depth)
         self.seed = int(seed)
         self.dtype = jnp.dtype(dtype)
+        # kernel-path decision is made HERE (static under jit): "auto" probes
+        # the backend once; "never" forces the XLA path; "interpret" runs the
+        # kernel in interpreter mode (CPU tests of the kernel itself)
+        self.use_pallas = str(use_pallas)
+        if self.use_pallas == "auto":
+            from seldon_core_tpu.ops.fused_mlp import pallas_supported
+
+            self._pallas = pallas_supported()
+        else:
+            self._pallas = self.use_pallas == "interpret"
 
     def init_state(self, rng):
         if rng is None:
@@ -125,6 +136,15 @@ class MnistClassifier(Unit):
 
     def predict(self, state, X):
         X = X.reshape(X.shape[0], -1)
+        if self._pallas:
+            from seldon_core_tpu.ops.fused_mlp import fused_mlp_softmax
+
+            try:
+                return fused_mlp_softmax(
+                    state, X, interpret=self.use_pallas == "interpret"
+                )
+            except ValueError:
+                pass  # shape/VMEM constraints — XLA path below
         return jax.nn.softmax(mlp_apply(state, X), axis=-1)
 
 

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the serving hot path."""
+
+from seldon_core_tpu.ops.fused_mlp import (  # noqa: F401
+    fused_mlp_softmax,
+    pallas_supported,
+)

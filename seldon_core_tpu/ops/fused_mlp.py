@@ -1,0 +1,150 @@
+"""Fused MLP-forward Pallas kernel — the serving flagship's hot op.
+
+The reference's "model math" is a TF session per microservice
+(examples/models/deep_mnist/DeepMnist.py:1-17); the TPU-native equivalent
+keeps the whole forward in one kernel:
+
+    probs = softmax(relu(x @ w0 + b0) ... @ wL + bL)
+
+Under plain XLA each layer's activation round-trips HBM between fused
+regions; this kernel tiles the batch, keeps every weight and intermediate in
+VMEM, and runs matmul -> bias -> relu -> ... -> softmax per batch tile with
+zero HBM traffic for intermediates.  Weights are bf16 (MXU-native), the
+final logits and softmax accumulate in f32.
+
+Weight VMEM budget: all layers must fit (~16 MB/core); serving MLPs
+(784x512x512x10 bf16 ~= 1.3 MB) are far under it.  ``fused_mlp_softmax``
+checks the budget and shape constraints and raises ``ValueError`` when the
+kernel doesn't apply — callers fall back to the XLA path
+(models/mnist.py mlp_apply).
+
+``pallas_supported()`` probes the runtime once (compiles a trivial kernel);
+serving code uses it to pick the kernel path at unit-construction time, so
+the decision is static under jit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_mlp_softmax", "pallas_supported"]
+
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024  # leave headroom under ~16 MB/core
+
+
+def _layer_params(params: Dict[str, Any]):
+    n_layers = len(params) // 2
+    return [(params[f"w{i}"], params[f"b{i}"]) for i in range(n_layers)]
+
+
+def _mlp_kernel(*refs, n_layers: int):
+    """refs = (x_ref, w0, b0, w1, b1, ..., out_ref).  One batch tile: all
+    layers + softmax computed entirely in VMEM."""
+    x_ref = refs[0]
+    out_ref = refs[-1]
+    h = x_ref[:]
+    for i in range(n_layers):
+        w_ref, b_ref = refs[1 + 2 * i], refs[2 + 2 * i]
+        w = w_ref[:]
+        h = jnp.dot(
+            h.astype(w.dtype), w, preferred_element_type=jnp.float32
+        ) + b_ref[:].astype(jnp.float32)
+        if i < n_layers - 1:
+            h = jnp.maximum(h, 0.0)
+    # softmax in f32 (numerically-stable shift)
+    h = h - jnp.max(h, axis=-1, keepdims=True)
+    e = jnp.exp(h)
+    out_ref[:] = e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def fused_mlp_softmax(
+    params: Dict[str, Any],
+    x: jax.Array,
+    *,
+    block_b: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """softmax(mlp(x)) fused in one Pallas kernel.
+
+    params: flat dict {w0,b0,...,wL,bL} (models/mnist.py mlp_init layout);
+    x: [B, in_dim] float array.  Returns [B, out_dim] float32 probabilities.
+    Raises ValueError when the kernel's constraints don't hold (caller falls
+    back to XLA)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    layers = _layer_params(params)
+    if not layers:
+        raise ValueError("empty params")
+    if x.ndim != 2:
+        raise ValueError(f"x must be [B, D], got {x.shape}")
+    in_dim = layers[0][0].shape[0]
+    out_dim = layers[-1][0].shape[1]
+    if x.shape[1] != in_dim:
+        raise ValueError(f"x dim {x.shape[1]} != w0 in_dim {in_dim}")
+    weight_bytes = sum(w.size * w.dtype.itemsize + b.size * b.dtype.itemsize
+                       for w, b in layers)
+    # x tile + widest activation tile, f32
+    act_bytes = 4 * block_b * (in_dim + max(w.shape[1] for w, _ in layers))
+    if weight_bytes + act_bytes > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"fused MLP needs ~{(weight_bytes + act_bytes) >> 20} MiB VMEM "
+            f"(budget {_VMEM_BUDGET_BYTES >> 20} MiB)"
+        )
+
+    B = x.shape[0]
+    block_b = min(block_b, max(B, 1))
+    grid = (pl.cdiv(B, block_b),)
+
+    # x is tiled over the batch grid; weights/biases are whole-array blocks
+    # (the same VMEM-resident block every step — Mosaic hoists the copies)
+    in_specs = [
+        pl.BlockSpec((block_b, in_dim), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM)
+    ]
+    flat_inputs = [x]
+    for w, b in layers:
+        in_specs.append(pl.BlockSpec(w.shape, lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        # biases as [1, D] — TPU VMEM wants >=2D tiles
+        in_specs.append(pl.BlockSpec((1, b.shape[0]), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        flat_inputs += [w, b.reshape(1, -1)]
+
+    fn = pl.pallas_call(
+        functools.partial(_mlp_kernel, n_layers=len(layers)),
+        out_shape=jax.ShapeDtypeStruct((B, out_dim), jnp.float32),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_b, out_dim), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+    return fn(*flat_inputs)
+
+
+@functools.cache
+def pallas_supported() -> bool:
+    """True when the default backend compiles+runs a trivial Pallas TPU
+    kernel.  Cached: probe once per process."""
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def k(i_ref, o_ref):
+            o_ref[:] = i_ref[:] * 2.0
+
+        x = jnp.ones((8, 128), jnp.float32)
+        y = pl.pallas_call(
+            k,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(x)
+        return bool(abs(float(y[0, 0]) - 2.0) < 1e-6)
+    except Exception:  # noqa: BLE001 - any lowering/runtime failure => no
+        return False

@@ -1,0 +1,66 @@
+"""Pallas fused-MLP kernel vs the XLA reference path (interpret mode on the
+CPU test platform; the real lowering runs on TPU where supported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.models.mnist import MnistClassifier, mlp_apply, mlp_init
+from seldon_core_tpu.ops.fused_mlp import fused_mlp_softmax
+
+
+@pytest.mark.parametrize("batch,hidden,depth", [(8, 64, 2), (5, 32, 1), (17, 48, 3)])
+def test_fused_mlp_matches_xla(batch, hidden, depth):
+    rng = jax.random.key(0)
+    params = mlp_init(rng, hidden=hidden, depth=depth, in_dim=24, out_dim=10,
+                      dtype=jnp.float32)
+    x = jax.random.normal(jax.random.key(1), (batch, 24), jnp.float32)
+    got = fused_mlp_softmax(params, x, block_b=8, interpret=True)
+    want = jax.nn.softmax(mlp_apply(params, x), axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got).sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_fused_mlp_bf16_weights():
+    params = mlp_init(jax.random.key(0), hidden=64, depth=2, in_dim=16,
+                      out_dim=10, dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.key(1), (4, 16), jnp.float32)
+    got = fused_mlp_softmax(params, x, block_b=4, interpret=True)
+    want = jax.nn.softmax(mlp_apply(params, x), axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2)
+
+
+def test_fused_mlp_rejects_oversized_and_bad_shapes():
+    params = mlp_init(jax.random.key(0), hidden=8, depth=1, in_dim=4,
+                      out_dim=2, dtype=jnp.float32)
+    with pytest.raises(ValueError, match=r"x must be \[B, D\]"):
+        fused_mlp_softmax(params, jnp.ones((4,)), interpret=True)
+    with pytest.raises(ValueError, match="in_dim"):
+        fused_mlp_softmax(params, jnp.ones((2, 5)), interpret=True)
+    big = mlp_init(jax.random.key(0), hidden=4096, depth=2, in_dim=4096,
+                   out_dim=10, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="VMEM"):
+        fused_mlp_softmax(big, jnp.ones((2, 4096)), interpret=True)
+
+
+def test_mnist_unit_pallas_interpret_matches_xla():
+    """The serving unit produces identical probabilities on either path."""
+    xla_unit = MnistClassifier(hidden=32, use_pallas="never")
+    pl_unit = MnistClassifier(hidden=32, use_pallas="interpret")
+    state = xla_unit.init_state(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(2), (6, 784), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(pl_unit.predict(state, x)),
+        np.asarray(xla_unit.predict(state, x)),
+        atol=2e-2,  # bf16 weights
+    )
+
+
+def test_mnist_unit_auto_falls_back_on_cpu():
+    """On the CPU test platform the probe must return False and the unit
+    must serve via XLA (never crash)."""
+    unit = MnistClassifier(hidden=32)
+    state = unit.init_state(jax.random.key(0))
+    y = unit.predict(state, jnp.zeros((2, 784), jnp.float32))
+    assert np.asarray(y).shape == (2, 10)
