@@ -32,10 +32,19 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+    stack_stage_params,
+    stage_param_shardings,
+)
 from seldon_core_tpu.parallel.ring_attention import ring_attention
 
 __all__ = ["LMConfig", "lm_init", "lm_apply", "lm_loss", "lm_train_step",
-           "param_shardings", "TransformerLM"]
+           "param_shardings", "TransformerLM",
+           "lm_pipeline_params", "lm_pipeline_apply", "lm_pipeline_loss",
+           "lm_pipeline_train_step"]
 
 
 @dataclass(frozen=True)
@@ -124,47 +133,129 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
 
 
+def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool):
+    """One decoder block: attn + MLP with residuals.  x [B,S,D] -> [B,S,D]."""
+    B, S, D = x.shape
+    hd = cfg.d_model // cfg.n_heads
+    h = _rmsnorm(x, lp["ln1"])
+    qkv = h @ lp["wqkv"]  # [B,S,3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+
+    a = _attention(heads(q), heads(k), heads(v), mesh, causal)
+    a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + a @ lp["wo"]
+    h = _rmsnorm(x, lp["ln2"])
+    return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+
+
 def lm_apply(
     params, tokens, cfg: LMConfig, mesh: Optional[Mesh] = None, causal: bool = True
 ):
     """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
     x = params["embed"][tokens]  # [B,S,D]
-    B, S, D = x.shape
-    hd = cfg.d_model // cfg.n_heads
     for i in range(cfg.n_layers):
-        lp = params[f"l{i}"]
-        h = _rmsnorm(x, lp["ln1"])
-        qkv = h @ lp["wqkv"]  # [B,S,3D]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
-
-        a = _attention(heads(q), heads(k), heads(v), mesh, causal)
-        a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
-        x = x + a @ lp["wo"]
-        h = _rmsnorm(x, lp["ln2"])
-        x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+        x = _block(params[f"l{i}"], x, cfg, mesh, causal)
     x = _rmsnorm(x, params["ln_f"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
 
-def lm_loss(params, batch, cfg: LMConfig, mesh: Optional[Mesh] = None):
-    """Next-token cross-entropy; batch = {tokens: [B, S+1]}."""
+def lm_loss(params, batch, cfg: LMConfig, mesh: Optional[Mesh] = None,
+            apply_fn=None):
+    """Next-token cross-entropy; batch = {tokens: [B, S+1]}.
+
+    ``apply_fn(params, tokens) -> logits`` overrides the forward (used by the
+    pipelined variant); defaults to ``lm_apply``."""
     tokens = batch["tokens"]
-    logits = lm_apply(params, tokens[:, :-1], cfg, mesh)
+    if apply_fn is None:
+        apply_fn = lambda p, t: lm_apply(p, t, cfg, mesh)  # noqa: E731
+    logits = apply_fn(params, tokens[:, :-1])
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return jnp.mean(nll)
 
 
-def lm_train_step(params, opt_state, batch, optimizer, cfg: LMConfig,
-                  mesh: Optional[Mesh] = None):
-    loss, grads = jax.value_and_grad(lm_loss)(params, batch, cfg, mesh)
+def _grad_update(loss_fn, params, opt_state, batch, optimizer):
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = jax.tree_util.tree_map(lambda p, u: p + u.astype(p.dtype), params, updates)
     return params, opt_state, loss
+
+
+def lm_train_step(params, opt_state, batch, optimizer, cfg: LMConfig,
+                  mesh: Optional[Mesh] = None):
+    return _grad_update(lambda p, b: lm_loss(p, b, cfg, mesh), params,
+                        opt_state, batch, optimizer)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel variant: the layer stack splits into pp stages, one stage
+# per chip; microbatched GPipe schedule over ICI (parallel/pipeline.py).
+# Embed/unembed stay outside the pipeline (replicated, batch over dp).
+# ---------------------------------------------------------------------------
+
+
+def lm_pipeline_params(params, cfg: LMConfig, n_stages: int, mesh: Mesh):
+    """Re-layout lm_init params for a pp-stage pipeline.
+
+    Returns {embed, ln_f, stages} where ``stages`` leaves are stacked
+    [n_stages, layers_per_stage, ...] and sharded P('pp', ...).
+    """
+    if cfg.n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
+        )
+    lps = cfg.n_layers // n_stages
+    per_stage = []
+    for s in range(n_stages):
+        layers = [params[f"l{s * lps + j}"] for j in range(lps)]
+        per_stage.append(
+            jax.tree_util.tree_map(lambda *ls: jnp.stack(ls, 0), *layers)
+        )
+    stages = stack_stage_params(per_stage)
+    stages = jax.device_put(stages, stage_param_shardings(mesh, stages))
+    return {"embed": params["embed"], "ln_f": params["ln_f"], "stages": stages}
+
+
+def lm_pipeline_apply(pp_params, tokens, cfg: LMConfig, mesh: Mesh,
+                      n_micro: int = 4, causal: bool = True):
+    """Pipelined forward: tokens [B, S] -> logits [B, S, V]."""
+
+    def stage_fn(stage_params, x):
+        # stage_params leaves: [layers_per_stage, ...]; scan the sub-stack
+        def body(h, lp):
+            return _block(lp, h, cfg, mesh=None, causal=causal), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    x = pp_params["embed"][tokens]  # [B,S,D]
+    xm = split_microbatches(x, n_micro)
+    ym = pipeline_apply(stage_fn, pp_params["stages"], xm, mesh=mesh)
+    x = merge_microbatches(ym)
+    x = _rmsnorm(x, pp_params["ln_f"])
+    return (x @ pp_params["embed"].T).astype(jnp.float32)
+
+
+def lm_pipeline_loss(pp_params, batch, cfg: LMConfig, mesh: Mesh,
+                     n_micro: int = 4):
+    return lm_loss(
+        pp_params, batch, cfg, mesh,
+        apply_fn=lambda p, t: lm_pipeline_apply(p, t, cfg, mesh, n_micro),
+    )
+
+
+def lm_pipeline_train_step(pp_params, opt_state, batch, optimizer,
+                           cfg: LMConfig, mesh: Mesh, n_micro: int = 4):
+    """Full pipeline-parallel train step — backward replays the GPipe
+    schedule in reverse through the scan+ppermute graph."""
+    return _grad_update(
+        lambda p, b: lm_pipeline_loss(p, b, cfg, mesh, n_micro),
+        pp_params, opt_state, batch, optimizer,
+    )
 
 
 @register_unit("TransformerLM")

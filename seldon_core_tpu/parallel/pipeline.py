@@ -1,0 +1,142 @@
+"""Pipeline parallelism — GPipe-style microbatched stage pipeline over a
+``pp`` mesh axis.
+
+The reference has no model parallelism at all (SURVEY.md §2.7: each model
+fits in one container); here a graph node too large for one chip splits its
+layer stack into ``pp`` stages, one stage resident per chip, and activations
+flow stage-to-stage over ICI via ``lax.ppermute`` (neighbour hops on the
+ring).  The batch is cut into microbatches; at steady state every stage is
+busy and the pipeline bubble is the usual ``(n_stages-1)/(n_micro+n_stages-1)``
+fraction.  The schedule is written as a single ``lax.scan`` under
+``shard_map``, so ``jax.grad`` differentiates straight through it — the
+backward pass replays the schedule in reverse (ppermute's transpose is the
+reverse permutation), giving pipeline-parallel backprop for free.
+
+Composes with data parallelism: run on a ``dp × pp`` mesh and the microbatch
+batch dim shards over ``dp`` while stages shard over ``pp``.
+
+Layout contract:
+  * stage parameters are stacked along a leading stage axis and sharded
+    ``P('pp', ...)`` — each chip holds exactly its stage's weights;
+  * the input is pre-split into ``[n_micro, mb, ...]`` microbatches;
+  * ``stage_fn(stage_params, x) -> y`` applies one stage (same activation
+    shape in and out, the pipeline invariant).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "stack_stage_params",
+    "stage_param_shardings",
+    "pipeline_apply",
+    "split_microbatches",
+    "merge_microbatches",
+]
+
+
+def stack_stage_params(per_stage_params) -> Any:
+    """Stack a list of per-stage param pytrees along a new leading stage axis.
+
+    The result should be device_put with ``stage_param_shardings`` so chip i
+    of the pp axis holds stage i's slice.
+    """
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params
+    )
+
+
+def stage_param_shardings(mesh: Mesh, stacked_params, axis: str = "pp") -> Any:
+    """P('pp', None, ...) on every leaf of a stacked stage-param tree."""
+    def spec(leaf):
+        return NamedSharding(mesh, P(axis, *([None] * (jnp.ndim(leaf) - 1))))
+    return jax.tree_util.tree_map(spec, stacked_params)
+
+
+def split_microbatches(x, n_micro: int):
+    """[B, ...] -> [n_micro, B // n_micro, ...] (leading-dim split)."""
+    if x.shape[0] % n_micro != 0:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible into {n_micro} microbatches"
+        )
+    return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+
+def merge_microbatches(y):
+    """Inverse of split_microbatches."""
+    return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params,
+    x_micro,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    batch_axis: str | None = "dp",
+):
+    """Run the microbatched pipeline; returns outputs shaped like ``x_micro``.
+
+    ``x_micro``: [n_micro, mb, ...] activations entering stage 0.
+    ``stacked_params``: per-stage params stacked on a leading stage axis
+    (sharded ``P('pp', ...)``).  Differentiable (grad flows through the
+    scan + ppermute schedule).
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = x_micro.shape[0]
+    if n_stages == 1:
+        # degenerate pipeline: single stage, no rotation
+        sq = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+        return jax.vmap(lambda mb: stage_fn(sq, mb))(x_micro)
+
+    dp_in_mesh = batch_axis is not None and batch_axis in mesh.axis_names
+    bspec = batch_axis if dp_in_mesh else None
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def run(params_local, x_local):
+        # per-device view: params_local leaves have leading stage dim 1;
+        # x_local is [n_micro, mb_local, ...]
+        params_loc = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage_idx = lax.axis_index(axis)
+        act_shape = x_local.shape[1:]
+
+        def step(carry, t):
+            # carry: activation handed to this stage by its predecessor
+            inp_idx = jnp.clip(t, 0, n_micro - 1)
+            fresh = lax.dynamic_index_in_dim(x_local, inp_idx, 0,
+                                             keepdims=False)
+            stage_in = jnp.where(stage_idx == 0, fresh, carry)
+            y = stage_fn(params_loc, stage_in)
+            shifted = lax.ppermute(y, axis, perm)
+            # only the last stage's finished microbatches are real output
+            emit = jnp.where(stage_idx == n_stages - 1, y, jnp.zeros_like(y))
+            return shifted, emit
+
+        init = jnp.zeros(act_shape, x_local.dtype)
+        _, emits = lax.scan(step, init, jnp.arange(n_micro + n_stages - 1))
+        # microbatch j finishes at t = j + n_stages - 1 on the last stage
+        outs = lax.dynamic_slice_in_dim(emits, n_stages - 1, n_micro, 0)
+        # replicate across pp (zeros everywhere but the last stage -> psum
+        # is a broadcast from the last stage)
+        return lax.psum(outs, axis)
+
+    in_param_spec = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (jnp.ndim(p) - 1))), stacked_params
+    )
+    x_spec = P(None, bspec, *([None] * (x_micro.ndim - 2)))
+    mapped = jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(in_param_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    return mapped(stacked_params, x_micro)
