@@ -13,3 +13,10 @@ from seldon_core_tpu.parallel.mesh import (  # noqa: F401
     local_device_count,
 )
 from seldon_core_tpu.parallel.ensemble import SharedEnsembleUnit  # noqa: F401
+from seldon_core_tpu.parallel.moe import (  # noqa: F401
+    MoEConfig,
+    moe_apply,
+    moe_init,
+    moe_param_shardings,
+)
+from seldon_core_tpu.parallel.pipeline import pipeline_apply  # noqa: F401

@@ -1,0 +1,157 @@
+"""Mixture-of-experts layer with expert parallelism over an ``ep`` mesh axis.
+
+The reference's only "expert" notion is the COMBINER ensemble (every member
+sees every request — engine PredictiveUnitBean.java:96-118); MoE is its
+sparse TPU-native sibling: a learned router sends each token to its top-k
+experts, experts live one shard per chip along ``ep``, and the token
+shuffle to/from expert shards is an all-to-all that XLA inserts from the
+sharding annotations (GSPMD — no hand-written collectives).
+
+Everything is static-shaped for the MXU: routing uses the classic
+dispatch/combine one-hot tensors (Switch-Transformer style) with a fixed
+per-expert capacity ``C = ceil(k * T * capacity_factor / E)``; tokens past
+capacity overflow and pass through on the residual path.  The heavy math is
+two batched einsums over ``[E, C, D]`` blocks, sharded ``P('ep', ...)`` so
+each chip multiplies only its experts' blocks.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MoEConfig", "moe_init", "moe_apply", "moe_param_shardings"]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_ff: int = 128
+    n_experts: int = 8
+    k: int = 2                    # top-k routing (1 = Switch)
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+
+def moe_init(rng, cfg: MoEConfig) -> Dict[str, Any]:
+    kg, k1, k2 = jax.random.split(rng, 3)
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)
+        ).astype(dt)
+
+    return {
+        # router in f32: small, and routing decisions are precision-sensitive
+        "wg": jax.random.normal(kg, (cfg.d_model, cfg.n_experts), jnp.float32)
+        * (cfg.d_model ** -0.5),
+        "w1": dense(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff), cfg.d_model),
+        "w2": dense(k2, (cfg.n_experts, cfg.d_ff, cfg.d_model), cfg.d_ff),
+    }
+
+
+def moe_param_shardings(mesh: Mesh, params, axis: str = "ep") -> Any:
+    """Experts shard over ``ep``; router weights replicate."""
+    def spec(path, leaf):
+        name = getattr(path[-1], "key", str(path[-1]))
+        if name in ("w1", "w2") and axis in mesh.axis_names:
+            return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
+        return NamedSharding(mesh, P())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec(p, l) for p, l in flat]
+    )
+
+
+def _capacity(cfg: MoEConfig, n_tokens: int) -> int:
+    return max(1, math.ceil(cfg.k * n_tokens * cfg.capacity_factor
+                            / cfg.n_experts))
+
+
+def _route(gates, cfg: MoEConfig, capacity: int):
+    """Top-k dispatch/combine tensors from gate probabilities.
+
+    gates [T, E] -> dispatch [T, E, C] in {0,1}, combine [T, E, C] f32.
+    Earlier tokens win capacity slots (deterministic, like the reference's
+    deterministic seeded router RandomABTestUnit.java:27-58 is replayable).
+    """
+    T, E = gates.shape
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    taken = jnp.zeros((T, E), jnp.float32)   # choices already made
+    used = jnp.zeros((E,), jnp.float32)      # slots consumed per expert
+
+    for _ in range(cfg.k):
+        masked = jnp.where(taken > 0, -jnp.inf, gates)
+        idx = jnp.argmax(masked, axis=1)                      # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [T,E]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot     # queue pos
+        pos = pos + used[None, :] * onehot                    # offset by prior k
+        keep = onehot * (pos < capacity)
+        slot = jax.nn.one_hot(pos.sum(1).astype(jnp.int32), capacity,
+                              dtype=jnp.float32)              # [T,C]
+        disp = keep[:, :, None] * slot[:, None, :]            # [T,E,C]
+        gate_val = (gates * onehot).sum(1, keepdims=True)     # chosen prob
+        dispatch = dispatch + disp
+        combine = combine + disp * gate_val[:, :, None]
+        taken = taken + onehot
+        used = used + keep.sum(0)
+
+    # renormalise combine weights over the k chosen experts per token
+    denom = combine.sum(axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine
+
+
+def moe_apply(
+    params,
+    x,
+    cfg: MoEConfig,
+    mesh: Optional[Mesh] = None,
+    axis: str = "ep",
+) -> Tuple[Any, Any]:
+    """x [..., D] -> (y [..., D], aux) with residual pass-through overflow.
+
+    aux = {"lb_loss": switch-style load-balance loss, "overflow": fraction
+    of token-choices dropped for capacity}.  Under a mesh the [E, C, D]
+    expert blocks are sharding-constrained to ``P('ep', ...)``; XLA lowers
+    the dispatch/combine einsums to all-to-alls over ICI.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    xt = x.reshape(-1, D)                                     # [T,D]
+    T = xt.shape[0]
+    capacity = _capacity(cfg, T)
+
+    logits = xt.astype(jnp.float32) @ params["wg"]            # [T,E]
+    gates = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine = _route(gates, cfg, capacity)
+
+    xin = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)  # [E,C,D]
+    if mesh is not None and axis in mesh.axis_names:
+        constraint = NamedSharding(mesh, P(axis, None, None))
+        xin = jax.lax.with_sharding_constraint(xin, constraint)
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xin, params["w1"]))
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"])         # [E,C,D]
+    if mesh is not None and axis in mesh.axis_names:
+        out = jax.lax.with_sharding_constraint(out, constraint)
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), out)
+
+    # residual pass-through for overflowed tokens (their combine mass is 0)
+    got = dispatch.sum(axis=(1, 2))                           # choices served
+    y = jnp.where((got > 0)[:, None], y, xt)
+
+    # switch-style load-balance loss: E * sum_e f_e * p_e
+    density = jax.nn.one_hot(
+        jnp.argmax(gates, axis=1), cfg.n_experts, dtype=jnp.float32
+    ).mean(0)
+    lb_loss = cfg.n_experts * jnp.sum(density * gates.mean(0))
+    overflow = 1.0 - got.sum() / (cfg.k * T)
+    return y.reshape(orig_shape), {"lb_loss": lb_loss, "overflow": overflow}

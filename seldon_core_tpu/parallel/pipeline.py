@@ -92,6 +92,13 @@ def pipeline_apply(
     """
     n_stages = mesh.shape[axis]
     n_micro = x_micro.shape[0]
+    stacked_dim = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if stacked_dim != n_stages:
+        # without this, each chip would hold >1 stage slice and p[0] below
+        # would silently drop all but the first
+        raise ValueError(
+            f"stacked stage dim {stacked_dim} != mesh {axis!r} size {n_stages}"
+        )
     if n_stages == 1:
         # degenerate pipeline: single stage, no rotation
         sq = jax.tree_util.tree_map(lambda p: p[0], stacked_params)

@@ -117,6 +117,16 @@ def test_pipelined_train_step_matches_dense_loss(devices8):
     assert float(loss2) < float(loss1)
 
 
+def test_stage_count_mesh_mismatch_rejected(devices8):
+    """4 stacked stages on a pp=2 mesh must fail loudly, not drop stages."""
+    mesh = build_mesh({"pp": 2}, devices=devices8[:2])
+    params = lm_init(jax.random.key(5), CFG)
+    with pytest.raises(ValueError, match="stacked stage dim"):
+        pp_params = lm_pipeline_params(params, CFG, 4, mesh)
+        lm_pipeline_apply(pp_params, _tokens(np.random.default_rng(4), 4, 8),
+                          CFG, mesh, n_micro=2)
+
+
 def test_single_stage_degenerate():
     params = lm_init(jax.random.key(4), CFG)
     mesh = build_mesh({"pp": 1}, devices=jax.devices()[:1])
