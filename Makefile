@@ -1,5 +1,5 @@
 proto:
-	protoc -I proto --python_out=seldon_core_tpu/proto_gen proto/prediction.proto
+	protoc -I proto --python_out=seldon_core_tpu/proto_gen proto/prediction.proto proto/seldon_deployment.proto
 
 test:
 	python -m pytest tests/ -q

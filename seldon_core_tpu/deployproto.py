@@ -1,0 +1,175 @@
+"""Protobuf <-> dataclass conversion for the deployment resource.
+
+The JSON form (graph/spec.py) is canonical; this gives gRPC control-plane
+clients a typed contract (proto/seldon_deployment.proto, mirroring the
+reference CRD schema reference proto/seldon_deployment.proto:10-125 with
+TPU-native ComponentBindings in place of embedded k8s PodTemplateSpecs)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from seldon_core_tpu.graph.spec import (
+    ComponentBinding,
+    Endpoint,
+    EndpointType,
+    Parameter,
+    PredictiveUnit,
+    PredictorSpec,
+    SeldonDeploymentSpec,
+    UnitImplementation,
+    UnitMethod,
+    UnitType,
+)
+from seldon_core_tpu.proto_gen import seldon_deployment_pb2 as pb
+
+__all__ = ["deployment_to_proto", "deployment_from_proto"]
+
+_RUNTIME_TO_PB = {"inprocess": pb.ComponentBinding.INPROCESS,
+                  "rest": pb.ComponentBinding.REST,
+                  "grpc": pb.ComponentBinding.GRPC}
+_RUNTIME_FROM_PB = {v: k for k, v in _RUNTIME_TO_PB.items()}
+
+
+def _param_to_proto(p: Parameter) -> pb.Parameter:
+    return pb.Parameter(name=p.name, value=str(p.value),
+                        type=pb.Parameter.ParmType.Value(p.type))
+
+
+def _param_from_proto(p: pb.Parameter) -> Parameter:
+    return Parameter(name=p.name, value=p.value,
+                     type=pb.Parameter.ParmType.Name(p.type))
+
+
+def _unit_to_proto(u: PredictiveUnit) -> pb.PredictiveUnit:
+    out = pb.PredictiveUnit(name=u.name)
+    for c in u.children:
+        out.children.append(_unit_to_proto(c))
+    if u.type is not None:
+        out.type = pb.PredictiveUnit.PredictiveUnitType.Value(u.type.value)
+    out.implementation = pb.PredictiveUnit.PredictiveUnitImplementation.Value(
+        u.implementation.value
+    )
+    for m in u.methods or []:
+        out.methods.append(pb.PredictiveUnit.PredictiveUnitMethod.Value(m.value))
+    if u.endpoint is not None:
+        out.endpoint.service_host = u.endpoint.service_host
+        out.endpoint.service_port = u.endpoint.service_port
+        out.endpoint.type = pb.Endpoint.EndpointType.Value(u.endpoint.type.value)
+    for p in u.parameters:
+        out.parameters.append(_param_to_proto(p))
+    return out
+
+
+def _unit_from_proto(u: pb.PredictiveUnit) -> PredictiveUnit:
+    # proto3 scalar defaults are indistinguishable from unset; treat type 0
+    # (UNKNOWN_TYPE) as "not given" the way the JSON codec omits the key
+    unit_type = None
+    if u.type != pb.PredictiveUnit.UNKNOWN_TYPE:
+        unit_type = UnitType(pb.PredictiveUnit.PredictiveUnitType.Name(u.type))
+    methods: List[UnitMethod] | None = None
+    if u.methods:
+        methods = [
+            UnitMethod(pb.PredictiveUnit.PredictiveUnitMethod.Name(m))
+            for m in u.methods
+        ]
+    endpoint = None
+    if u.HasField("endpoint"):
+        endpoint = Endpoint(
+            service_host=u.endpoint.service_host,
+            service_port=u.endpoint.service_port,
+            type=EndpointType(pb.Endpoint.EndpointType.Name(u.endpoint.type)),
+        )
+    return PredictiveUnit(
+        name=u.name,
+        children=[_unit_from_proto(c) for c in u.children],
+        type=unit_type,
+        implementation=UnitImplementation(
+            pb.PredictiveUnit.PredictiveUnitImplementation.Name(u.implementation)
+        ),
+        methods=methods,
+        endpoint=endpoint,
+        parameters=[_param_from_proto(p) for p in u.parameters],
+    )
+
+
+def _binding_to_proto(c: ComponentBinding) -> pb.ComponentBinding:
+    out = pb.ComponentBinding(
+        name=c.name,
+        runtime=_RUNTIME_TO_PB[c.runtime],
+        class_path=c.class_path,
+        image=c.image,
+        device=c.device,
+        host=c.host,
+        port=c.port,
+    )
+    for k, v in (c.mesh_axes or {}).items():
+        out.mesh_axes[k] = int(v)
+    for p in c.parameters:
+        out.parameters.append(_param_to_proto(p))
+    for k, v in c.env.items():
+        out.env[k] = str(v)
+    return out
+
+
+def _binding_from_proto(c: pb.ComponentBinding) -> ComponentBinding:
+    return ComponentBinding(
+        name=c.name,
+        runtime=_RUNTIME_FROM_PB[c.runtime],
+        class_path=c.class_path,
+        image=c.image,
+        device=c.device or "tpu",
+        mesh_axes=dict(c.mesh_axes) if c.mesh_axes else None,
+        parameters=[_param_from_proto(p) for p in c.parameters],
+        env=dict(c.env),
+        host=c.host,
+        port=c.port,
+    )
+
+
+def deployment_to_proto(spec: SeldonDeploymentSpec) -> pb.SeldonDeployment:
+    out = pb.SeldonDeployment(api_version=spec.api_version,
+                              kind="SeldonDeployment")
+    out.metadata.name = spec.metadata_name or spec.name
+    for k, v in spec.labels.items():
+        out.metadata.labels[k] = str(v)
+    out.spec.name = spec.name
+    out.spec.oauth_key = spec.oauth_key
+    out.spec.oauth_secret = spec.oauth_secret
+    for k, v in spec.annotations.items():
+        out.spec.annotations[k] = str(v)
+    for p in spec.predictors:
+        pp = out.spec.predictors.add()
+        pp.name = p.name
+        pp.graph.CopyFrom(_unit_to_proto(p.graph))
+        pp.replicas = p.replicas
+        for c in p.components:
+            pp.components.append(_binding_to_proto(c))
+        for k, v in p.annotations.items():
+            pp.annotations[k] = str(v)
+        for k, v in p.labels.items():
+            pp.labels[k] = str(v)
+    return out
+
+
+def deployment_from_proto(d: pb.SeldonDeployment) -> SeldonDeploymentSpec:
+    return SeldonDeploymentSpec(
+        name=d.spec.name or d.metadata.name,
+        metadata_name=d.metadata.name,
+        predictors=[
+            PredictorSpec(
+                name=p.name,
+                graph=_unit_from_proto(p.graph),
+                components=[_binding_from_proto(c) for c in p.components],
+                replicas=p.replicas or 1,
+                annotations=dict(p.annotations),
+                labels=dict(p.labels),
+            )
+            for p in d.spec.predictors
+        ],
+        annotations=dict(d.spec.annotations),
+        oauth_key=d.spec.oauth_key,
+        oauth_secret=d.spec.oauth_secret,
+        labels=dict(d.metadata.labels),
+        api_version=d.api_version or "machinelearning.seldon.io/v1alpha2",
+    )

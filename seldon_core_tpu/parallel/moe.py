@@ -83,6 +83,10 @@ def _route(gates, cfg: MoEConfig, capacity: int):
     deterministic seeded router RandomABTestUnit.java:27-58 is replayable).
     """
     T, E = gates.shape
+    if cfg.k > E:
+        # argmax over an all -inf row would silently re-pick expert 0 and
+        # double-consume its capacity slots
+        raise ValueError(f"k={cfg.k} > n_experts={E}")
     dispatch = jnp.zeros((T, E, capacity), jnp.float32)
     combine = jnp.zeros((T, E, capacity), jnp.float32)
     taken = jnp.zeros((T, E), jnp.float32)   # choices already made
@@ -104,9 +108,13 @@ def _route(gates, cfg: MoEConfig, capacity: int):
         taken = taken + onehot
         used = used + keep.sum(0)
 
-    # renormalise combine weights over the k chosen experts per token
-    denom = combine.sum(axis=(1, 2), keepdims=True)
-    combine = combine / jnp.maximum(denom, 1e-9)
+    if cfg.k > 1:
+        # renormalise combine weights over the k chosen experts per token;
+        # for k=1 keep the raw gate scale on the output — dividing by the
+        # gate's own value would cancel it and zero the router gradient
+        # (Switch-style routing learns through that scale)
+        denom = combine.sum(axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
     return dispatch, combine
 
 

@@ -89,3 +89,27 @@ def test_gradients_reach_experts_and_router():
     assert float(jnp.abs(g["w1"]).sum()) > 0
     assert float(jnp.abs(g["w2"]).sum()) > 0
     assert float(jnp.abs(g["wg"]).sum()) > 0  # via combine weights + lb loss
+
+
+def test_switch_k1_router_gradient_flows_through_task_loss():
+    """k=1 must keep the gate scale on the output (no renorm) so the router
+    learns from the task loss, not just the aux loss."""
+    cfg = _cfg(k=1)
+    params = moe_init(jax.random.key(5), cfg)
+    x = jnp.asarray(np.random.default_rng(5).normal(size=(16, 16)),
+                    jnp.float32)
+
+    def task_loss(p):
+        y, _ = moe_apply(p, x, cfg)
+        return jnp.sum(y * y)  # no lb term: gradient must come via combine
+
+    g = jax.grad(task_loss)(params)
+    assert float(jnp.abs(g["wg"]).sum()) > 1e-3
+
+
+def test_k_greater_than_experts_rejected():
+    cfg = _cfg(n_experts=2, k=3)
+    params = moe_init(jax.random.key(6), cfg)
+    x = jnp.zeros((4, 16), jnp.float32)
+    with pytest.raises(ValueError, match="n_experts"):
+        moe_apply(params, x, cfg)

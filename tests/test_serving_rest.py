@@ -226,3 +226,47 @@ def test_reference_style_user_object():
             await runner.cleanup()
 
     asyncio.run(run())
+
+
+def test_combiner_microservice():
+    """A user-object COMBINER served over the internal API — the reference
+    accepted --service-type COMBINER but shipped no combiner microservice
+    (SURVEY.md §2.6 gap); here it is first-class.  /aggregate takes a
+    SeldonMessageList and returns one message."""
+
+    class WeightedCombiner:
+        def __init__(self, w0=0.75):
+            self.w0 = float(w0)
+
+        def aggregate(self, Xs, names_list):
+            return self.w0 * Xs[0] + (1.0 - self.w0) * Xs[1]
+
+    import seldon_core_tpu.graph.units as units_mod
+
+    units_mod.UNIT_REGISTRY["test.WeightedCombiner"] = WeightedCombiner
+
+    async def run():
+        params = [Parameter("w0", "0.75", "FLOAT")]
+        runtime = build_runtime(
+            "test.WeightedCombiner", "COMBINER", params, unit_name="comb"
+        )
+        port = await _free_port()
+        runner = await serve_app(make_unit_app(runtime), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                payload = {
+                    "seldonMessages": [
+                        {"data": {"ndarray": [[4.0, 8.0]]}},
+                        {"data": {"ndarray": [[0.0, 0.0]]}},
+                    ]
+                }
+                async with s.post(
+                    f"http://127.0.0.1:{port}/aggregate", json=payload
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                assert d["data"]["ndarray"] == [[3.0, 6.0]]
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
