@@ -84,6 +84,11 @@ class Unit:
     #: on the rows seen (streaming statistics).  Engines must not pad batches
     #: through such units (padding rows would enter the statistics).
     updates_state_on_predict: bool = False
+    #: True when outputs couple rows across the batch (cross-row reductions,
+    #: e.g. batch-global min/max normalisation).  Engines must not coalesce
+    #: concurrent requests through such units — one caller's rows would
+    #: change another caller's answer.
+    batch_coupled: bool = False
     #: optional output feature names (the wrappers' class_names)
     class_names: Optional[list] = None
     #: static meta tags merged into every response this unit touches

@@ -6,3 +6,9 @@ from seldon_core_tpu.models.mnist import MnistClassifier, MnistCNN  # noqa: F401
 from seldon_core_tpu.models.iris import IrisClassifier  # noqa: F401
 from seldon_core_tpu.models.mab import EpsilonGreedyRouter  # noqa: F401
 from seldon_core_tpu.models.outlier import MahalanobisOutlier  # noqa: F401
+from seldon_core_tpu.models.tabular import (  # noqa: F401
+    MeanClassifier,
+    MeanTransformer,
+    ObliviousTreeEnsemble,
+    SigmoidPredictor,
+)

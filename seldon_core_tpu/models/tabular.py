@@ -1,0 +1,225 @@
+"""Tabular model families — parity with the reference's small-model zoo.
+
+Covers the reference examples beyond iris/MNIST:
+  * ``MeanClassifier``     — sigmoid of (row mean − threshold)
+                             (reference examples/models/mean_classifier/
+                             MeanClassifier.py:7-27, sans the model.npy file:
+                             the threshold is a constructor parameter).
+  * ``SigmoidPredictor``   — 2-layer MLP trained at construction on the
+                             synthetic sigmoid(x0*x1) task (reference
+                             examples/models/sigmoid_predictor/
+                             SigmoidPredictor.py:8-21 trains an sklearn
+                             MLPClassifier the same way).
+  * ``MeanTransformer``    — min-max normalisation input TRANSFORMER
+                             (reference examples/transformers/
+                             mean_transformer/MeanTransformer.py:3-12).
+  * ``ObliviousTreeEnsemble`` — gradient-boosted oblivious trees, the
+                             TPU-native stand-in for the reference's H2O GBM
+                             example (examples/models/h2o_example): level-wise
+                             shared splits mean a tree evaluates as d feature
+                             comparisons + a bit-packed leaf lookup, which is
+                             a one-hot matmul on the MXU — no per-node
+                             branching, fully jit-traceable.
+
+All are pure ``Unit``s: state is a parameter pytree, methods are traceable,
+so any of them can compile into the graph's single XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.graph.units import Unit, register_unit
+
+__all__ = [
+    "MeanClassifier",
+    "SigmoidPredictor",
+    "MeanTransformer",
+    "ObliviousTreeEnsemble",
+]
+
+
+@register_unit("MeanClassifier")
+class MeanClassifier(Unit):
+    """P(positive) = sigmoid(mean(x) - threshold)."""
+
+    class_names = ["proba"]
+
+    def __init__(self, threshold: float = 0.0, intValue: int = 0):
+        # the reference's intValue shifts the trained threshold; keep both
+        self.threshold = float(threshold) + int(intValue)
+
+    def init_state(self, rng):
+        return {"threshold": jnp.asarray(self.threshold, jnp.float32)}
+
+    def predict(self, state, X):
+        m = jnp.mean(X.astype(jnp.float32), axis=1, keepdims=True)
+        return jax.nn.sigmoid(m - state["threshold"])
+
+
+@register_unit("SigmoidPredictor")
+class SigmoidPredictor(Unit):
+    """Binary classifier on the synthetic y = [sigmoid(x0*x1) >= 0.5] task,
+    trained with a few hundred full-batch gradient steps at init."""
+
+    class_names = ["p0", "p1"]
+
+    def __init__(self, n_features: int = 10, hidden: int = 32,
+                 train_samples: int = 2048, train_steps: int = 300,
+                 seed: int = 0):
+        self.n_features = int(n_features)
+        self.hidden = int(hidden)
+        self.train_samples = int(train_samples)
+        self.train_steps = int(train_steps)
+        self.seed = int(seed)
+
+    def init_state(self, rng):
+        if rng is None:
+            rng = jax.random.key(self.seed)
+        kx, k1, k2 = jax.random.split(jax.random.fold_in(rng, self.seed), 3)
+        X = jax.random.normal(kx, (self.train_samples, self.n_features))
+        y = (jax.nn.sigmoid(X[:, 0] * X[:, 1]) >= 0.5).astype(jnp.int32)
+        params = {
+            "w1": jax.random.normal(k1, (self.n_features, self.hidden))
+            * (self.n_features ** -0.5),
+            "b1": jnp.zeros((self.hidden,)),
+            "w2": jax.random.normal(k2, (self.hidden, 2)) * (self.hidden ** -0.5),
+            "b2": jnp.zeros((2,)),
+        }
+
+        def loss(p):
+            logits = jnp.tanh(X @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+        def step(p, _):
+            g = jax.grad(loss)(p)
+            return jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g), None
+
+        params, _ = jax.lax.scan(step, params, None, length=self.train_steps)
+        return params
+
+    def predict(self, state, X):
+        h = jnp.tanh(X.astype(jnp.float32) @ state["w1"] + state["b1"])
+        return jax.nn.softmax(h @ state["w2"] + state["b2"], axis=-1)
+
+
+@register_unit("MeanTransformer")
+class MeanTransformer(Unit):
+    """Min-max normalise the whole batch to [0, 1]; constant batch -> zeros
+    (reference MeanTransformer.py:8-12 semantics exactly).
+
+    The min/max reduction couples rows, so a request must see only its own
+    rows: ``batch_coupled`` opts graphs containing this unit out of
+    cross-request coalescing (in the reference each HTTP request was
+    normalised by itself, one call per request)."""
+
+    batch_coupled = True
+
+    def transform_input(self, state, X):
+        X = X.astype(jnp.float32)
+        lo, hi = jnp.min(X), jnp.max(X)
+        rng = hi - lo
+        safe = jnp.where(rng == 0.0, 1.0, rng)
+        return jnp.where(rng == 0.0, jnp.zeros_like(X), (X - lo) / safe)
+
+
+@register_unit("ObliviousTreeEnsemble")
+class ObliviousTreeEnsemble(Unit):
+    """Boosted oblivious trees fitted at init on a synthetic regression task
+    (or supplied data): every level of a tree shares one (feature, threshold)
+    split, so a depth-d tree maps a row to one of 2^d leaves by d vectorised
+    comparisons; leaf values are gathered with a one-hot matmul (MXU).
+
+    Fitting is greedy CatBoost-style: per boosting round, pick each level's
+    split by scoring a quantile grid of candidate thresholds on the current
+    residuals, then set leaf values to the mean residual per leaf.
+    """
+
+    class_names = ["prediction"]
+
+    def __init__(self, n_features: int = 8, n_trees: int = 16, depth: int = 3,
+                 learning_rate: float = 0.3, train_samples: int = 1024,
+                 seed: int = 0):
+        self.n_features = int(n_features)
+        self.n_trees = int(n_trees)
+        self.depth = int(depth)
+        self.lr = float(learning_rate)
+        self.train_samples = int(train_samples)
+        self.seed = int(seed)
+
+    # -- fitting (host-side numpy; runs once at construction) ---------------
+
+    def _synthetic(self, rng):
+        X = rng.normal(size=(self.train_samples, self.n_features))
+        y = (
+            np.sin(X[:, 0]) + 0.5 * X[:, 1] * (X[:, 2] > 0)
+            + 0.25 * rng.normal(size=self.train_samples)
+        )
+        return X, y
+
+    def fit_arrays(self, X, y):
+        """Greedy fit; returns (feat [T,d], thresh [T,d], leaves [T,2^d], base)."""
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64)
+        base = float(y.mean())
+        resid = y - base
+        feats = np.zeros((self.n_trees, self.depth), np.int32)
+        thrs = np.zeros((self.n_trees, self.depth), np.float64)
+        leaves = np.zeros((self.n_trees, 2 ** self.depth), np.float64)
+        qgrid = np.linspace(0.1, 0.9, 9)
+        # candidate thresholds depend only on X — one vectorised pass
+        cand_thrs = np.quantile(X, qgrid, axis=0)  # [Q, F]
+        for t in range(self.n_trees):
+            codes = np.zeros(len(X), np.int64)
+            for lvl in range(self.depth):
+                best = (None, None, np.inf)
+                for f in range(self.n_features):
+                    for qi in range(len(qgrid)):
+                        thr = cand_thrs[qi, f]
+                        cand = codes * 2 + (X[:, f] > thr)
+                        # SSE after assigning mean residual per candidate leaf
+                        sums = np.bincount(cand, weights=resid,
+                                           minlength=2 ** (lvl + 1))
+                        cnts = np.bincount(cand, minlength=2 ** (lvl + 1))
+                        means = sums / np.maximum(cnts, 1)
+                        sse = np.sum((resid - means[cand]) ** 2)
+                        if sse < best[2]:
+                            best = (f, thr, sse)
+                feats[t, lvl], thrs[t, lvl] = best[0], best[1]
+                codes = codes * 2 + (X[:, feats[t, lvl]] > thrs[t, lvl])
+            sums = np.bincount(codes, weights=resid, minlength=2 ** self.depth)
+            cnts = np.bincount(codes, minlength=2 ** self.depth)
+            leaf_vals = self.lr * sums / np.maximum(cnts, 1)
+            leaves[t] = leaf_vals
+            resid = resid - leaf_vals[codes]
+        return feats, thrs, leaves, base
+
+    def init_state(self, rng):
+        nprng = np.random.default_rng(self.seed)
+        X, y = self._synthetic(nprng)
+        feats, thrs, leaves, base = self.fit_arrays(X, y)
+        return {
+            "feat": jnp.asarray(feats, jnp.int32),         # [T, d]
+            "thresh": jnp.asarray(thrs, jnp.float32),      # [T, d]
+            "leaves": jnp.asarray(leaves, jnp.float32),    # [T, 2^d]
+            "base": jnp.asarray(base, jnp.float32),
+        }
+
+    # -- inference (pure, jit-traceable, MXU-friendly) ----------------------
+
+    def predict(self, state, X):
+        X = X.astype(jnp.float32)                           # [B, F]
+        gathered = X[:, state["feat"].reshape(-1)]          # [B, T*d]
+        B = X.shape[0]
+        T, d = state["feat"].shape
+        bits = (
+            gathered.reshape(B, T, d) > state["thresh"][None, :, :]
+        ).astype(jnp.int32)                                 # [B, T, d]
+        weights = 2 ** jnp.arange(d - 1, -1, -1, dtype=jnp.int32)
+        codes = jnp.sum(bits * weights[None, None, :], axis=-1)  # [B, T]
+        onehot = jax.nn.one_hot(codes, 2 ** d, dtype=jnp.float32)  # [B,T,2^d]
+        per_tree = jnp.einsum("btl,tl->bt", onehot, state["leaves"])
+        return (state["base"] + per_tree.sum(axis=1))[:, None]

@@ -128,8 +128,13 @@ class EngineService:
         # dispatch (router-free compiled graphs only — routing is a
         # per-request decision in the reference semantics)
         self.batcher = None
-        if self.compiled is not None and batching and graph_is_batchable(
-            self.predictor.graph
+        if (
+            self.compiled is not None
+            and batching
+            and graph_is_batchable(self.predictor.graph)
+            # cross-row-coupled units (batch-global reductions) would let one
+            # caller's rows change another caller's answer if coalesced
+            and not any(u.batch_coupled for u in self.compiled.units.values())
         ):
             # padding to power-of-two batch shapes avoids per-size retraces,
             # but must not feed fake rows into streaming statistics
