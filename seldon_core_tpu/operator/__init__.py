@@ -1,4 +1,10 @@
 """Deployment operator: materializes SeldonDeployment specs into running
-engines/units, watches a spec directory, tracks status."""
+engines/units, watches a spec directory, tracks status; renders k8s
+manifests (helm-equivalent) and packages model images (s2i-equivalent)."""
 
 from seldon_core_tpu.operator.materializer import Materializer  # noqa: F401
+from seldon_core_tpu.operator.manifests import (  # noqa: F401
+    generate_manifests,
+    to_yaml_stream,
+)
+from seldon_core_tpu.operator.packaging import ImageSpec, package_model  # noqa: F401

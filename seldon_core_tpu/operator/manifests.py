@@ -1,0 +1,346 @@
+"""Kubernetes manifest generation — the operator's resource-creation pass,
+emitted as data instead of API calls.
+
+Mirrors the reference operator's ``createResources`` (cluster-manager
+SeldonDeploymentOperatorImpl.java:520-666) and the helm/ksonnet packaging
+(helm-charts/, seldon-core/ core.libsonnet:35-141): per predictor an engine
+Deployment (graph shipped as ``ENGINE_PREDICTOR`` base64 JSON env —
+SeldonDeploymentOperatorImpl.java:105 — prometheus scrape annotations,
+``/ready`` readiness probe, pre-stop ``/pause`` drain, rolling update
+maxUnavailable 10%), one Deployment + ClusterIP Service per remote component
+binding (TCP readiness probe on the assigned port, ``seldon-app-<name>``
+selector labels), and one per-deployment Service fronting the engine with
+Ambassador-style route annotations.
+
+TPU-native additions: engine pods for predictors with ``device: tpu``
+inprocess bindings request ``google.com/tpu`` resources and carry a
+``tpu-topology`` node-selector derived from the binding's ``mesh_axes``
+(the graph compiles INTO the engine, so the engine pod — not the model
+pods — owns the chips; remote bindings keep the reference's CPU layout).
+
+Everything returns plain dicts; ``to_yaml_stream`` renders the multi-doc
+YAML that ``kubectl apply -f -`` consumes.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Dict, List
+
+from seldon_core_tpu.graph.defaulting import default_and_validate
+from seldon_core_tpu.graph.spec import PredictorSpec, SeldonDeploymentSpec
+
+__all__ = ["generate_manifests", "engine_deployment", "to_yaml_stream"]
+
+ENGINE_IMAGE = "seldon-core-tpu/engine:latest"
+ENGINE_REST_PORT = 8000   # cluster-manager application.properties:5
+ENGINE_GRPC_PORT = 5001   # cluster-manager application.properties:6
+ENGINE_METRICS_PATH = "/prometheus"
+
+
+def _labels(spec: SeldonDeploymentSpec, predictor: PredictorSpec,
+            component: str = "") -> Dict[str, str]:
+    lab = {
+        "app": "seldon",
+        "seldon-deployment-id": spec.name,
+        "seldon-predictor": predictor.name,
+    }
+    if component:
+        # the reference labels model pods seldon-app-<container> so the
+        # per-container Service can select them
+        # (SeldonDeploymentOperatorImpl.java:254-258)
+        lab[f"seldon-app-{component}"] = "true"
+    else:
+        lab["seldon-type"] = "engine"
+    return lab
+
+
+def _tpu_request(predictor: PredictorSpec) -> Dict[str, str]:
+    """Chips the engine pod needs: max mesh size over inprocess tpu bindings."""
+    chips = 0
+    for b in predictor.components:
+        if b.runtime == "inprocess" and b.device == "tpu":
+            n = 1
+            for v in (b.mesh_axes or {}).values():
+                n *= int(v)
+            chips = max(chips, n)
+    return {"google.com/tpu": str(chips)} if chips else {}
+
+
+def _topology(chips: int) -> str:
+    """GKE tpu-topology label value for a chip count (v5e slice shapes)."""
+    return {1: "1x1", 2: "1x2", 4: "2x2", 8: "2x4", 16: "4x4",
+            32: "4x8"}.get(chips, f"1x{chips}")
+
+
+def engine_deployment(spec: SeldonDeploymentSpec,
+                      predictor: PredictorSpec) -> dict:
+    pred_b64 = base64.b64encode(
+        json.dumps(predictor.to_json_dict(), separators=(",", ":")).encode()
+    ).decode()
+    labels = _labels(spec, predictor)
+    resources: dict = {"requests": {"cpu": "0.1"}}
+    tpu = _tpu_request(predictor)
+    if tpu:
+        resources["limits"] = dict(tpu)
+        resources["requests"].update(tpu)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{spec.name}-{predictor.name}-engine",
+            "labels": labels,
+            "annotations": dict(spec.annotations),
+        },
+        "spec": {
+            "replicas": predictor.replicas,
+            "selector": {"matchLabels": labels},
+            # reference rolling policy (SeldonDeploymentOperatorImpl.java:564)
+            "strategy": {
+                "type": "RollingUpdate",
+                "rollingUpdate": {"maxUnavailable": "10%"},
+            },
+            "template": {
+                "metadata": {
+                    "labels": labels,
+                    "annotations": {
+                        # scrape annotations the reference injects
+                        # (SeldonDeploymentOperatorImpl.java:542-544)
+                        "prometheus.io/scrape": "true",
+                        "prometheus.io/path": ENGINE_METRICS_PATH,
+                        "prometheus.io/port": str(ENGINE_REST_PORT),
+                    },
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "seldon-engine",
+                            "image": ENGINE_IMAGE,
+                            "env": [
+                                {"name": "ENGINE_PREDICTOR", "value": pred_b64},
+                                {"name": "SELDON_DEPLOYMENT_ID",
+                                 "value": spec.name},
+                                {"name": "ENGINE_SERVER_PORT",
+                                 "value": str(ENGINE_REST_PORT)},
+                                {"name": "ENGINE_SERVER_GRPC_PORT",
+                                 "value": str(ENGINE_GRPC_PORT)},
+                            ],
+                            "ports": [
+                                {"containerPort": ENGINE_REST_PORT,
+                                 "name": "rest"},
+                                {"containerPort": ENGINE_GRPC_PORT,
+                                 "name": "grpc"},
+                            ],
+                            "readinessProbe": {
+                                "httpGet": {"path": "/ready",
+                                            "port": ENGINE_REST_PORT},
+                                "initialDelaySeconds": 5,
+                                "periodSeconds": 5,
+                            },
+                            "lifecycle": {
+                                # pre-stop drain: flip readiness then sleep
+                                # (SeldonDeploymentOperatorImpl.java:130-134)
+                                "preStop": {
+                                    "exec": {
+                                        "command": [
+                                            "/bin/sh", "-c",
+                                            f"curl -s localhost:"
+                                            f"{ENGINE_REST_PORT}/pause "
+                                            f"&& sleep 5",
+                                        ]
+                                    }
+                                }
+                            },
+                            "resources": resources,
+                        }
+                    ],
+                    **(
+                        {"nodeSelector": {"cloud.google.com/gke-tpu-topology":
+                                          _topology(int(tpu["google.com/tpu"]))}}
+                        if tpu
+                        else {}
+                    ),
+                },
+            },
+        },
+    }
+
+
+def component_deployment(spec: SeldonDeploymentSpec, predictor: PredictorSpec,
+                         binding) -> dict:
+    labels = _labels(spec, predictor, binding.name)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": f"{spec.name}-{predictor.name}-{binding.name}",
+            "labels": labels,
+        },
+        "spec": {
+            "replicas": predictor.replicas,
+            "selector": {"matchLabels": labels},
+            "strategy": {
+                "type": "RollingUpdate",
+                "rollingUpdate": {"maxUnavailable": "10%"},
+            },
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": binding.name,
+                            "image": binding.image
+                            or "seldon-core-tpu/microservice:latest",
+                            "env": [
+                                {"name": k, "value": str(v)}
+                                for k, v in sorted(binding.env.items())
+                            ],
+                            "ports": [
+                                {"containerPort": binding.port,
+                                 "name": "http"
+                                 if binding.runtime == "rest" else "grpc"}
+                            ],
+                            # TCP probe on the assigned unit port
+                            # (SeldonDeploymentOperatorImpl.java:210-250)
+                            "readinessProbe": {
+                                "tcpSocket": {"port": binding.port},
+                                "initialDelaySeconds": 10,
+                                "periodSeconds": 5,
+                            },
+                            "livenessProbe": {
+                                "tcpSocket": {"port": binding.port},
+                                "initialDelaySeconds": 60,
+                                "periodSeconds": 5,
+                            },
+                            "lifecycle": {
+                                "preStop": {
+                                    "exec": {"command": ["/bin/sh", "-c",
+                                                         "sleep 10"]}
+                                }
+                            },
+                        }
+                    ]
+                },
+            },
+        },
+    }
+
+
+def component_service(spec: SeldonDeploymentSpec, predictor: PredictorSpec,
+                      binding) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{spec.name}-{predictor.name}-{binding.name}",
+            "labels": {"seldon-deployment-id": spec.name},
+        },
+        "spec": {
+            "type": "ClusterIP",
+            # scope by deployment AND predictor: a bare seldon-app-<name>
+            # selector would grab same-named components of other deployments
+            "selector": {
+                "seldon-deployment-id": spec.name,
+                "seldon-predictor": predictor.name,
+                f"seldon-app-{binding.name}": "true",
+            },
+            "ports": [
+                {
+                    "port": binding.port,
+                    "targetPort": binding.port,
+                    "protocol": "TCP",
+                    "name": "http" if binding.runtime == "rest" else "grpc",
+                }
+            ],
+        },
+    }
+
+
+def deployment_service(spec: SeldonDeploymentSpec) -> dict:
+    """Per-deployment Service fronting the engines, with Ambassador-style
+    route annotations (SeldonDeploymentOperatorImpl.java:465-484)."""
+    import yaml  # deferred: pyyaml only needed when rendering manifests
+
+    ambassador = {
+        "apiVersion": "ambassador/v0",
+        "kind": "Mapping",
+        "name": f"seldon_{spec.name}_mapping",
+        "prefix": f"/seldon/{spec.name}/",
+        "service": f"{spec.name}:{ENGINE_REST_PORT}",
+    }
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": spec.name,
+            "labels": {"seldon-deployment-id": spec.name},
+            "annotations": {
+                "getambassador.io/config": yaml.safe_dump(ambassador,
+                                                          sort_keys=False)
+            },
+        },
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"seldon-deployment-id": spec.name,
+                         "seldon-type": "engine"},
+            "ports": [
+                {"port": ENGINE_REST_PORT, "targetPort": ENGINE_REST_PORT,
+                 "name": "rest"},
+                {"port": ENGINE_GRPC_PORT, "targetPort": ENGINE_GRPC_PORT,
+                 "name": "grpc"},
+            ],
+        },
+    }
+
+
+def generate_manifests(spec: SeldonDeploymentSpec,
+                       run_defaulting: bool = True) -> List[dict]:
+    """All resources for a deployment, reference createResources order:
+    engine Deployments, component Deployments/Services, deployment Service."""
+    if run_defaulting:
+        default_and_validate(spec)
+    out: List[dict] = []
+    for predictor in spec.predictors:
+        out.append(engine_deployment(spec, predictor))
+        for binding in predictor.components:
+            if binding.runtime in ("rest", "grpc"):
+                out.append(component_deployment(spec, predictor, binding))
+                out.append(component_service(spec, predictor, binding))
+    out.append(deployment_service(spec))
+    return out
+
+
+def to_yaml_stream(manifests: List[dict]) -> str:
+    """Multi-document YAML for ``kubectl apply -f -``."""
+    import yaml  # deferred: pyyaml only needed when rendering manifests
+
+    class NoAliasDumper(yaml.SafeDumper):
+        # kubectl chokes on nothing, but humans choke on &id001 anchors
+        # that appear when the same labels dict is referenced twice
+        def ignore_aliases(self, data):
+            return True
+
+    return "---\n".join(
+        yaml.dump(m, Dumper=NoAliasDumper, sort_keys=False)
+        for m in manifests
+    )
+
+
+def main(argv=None) -> None:
+    """CLI: render a deployment spec to k8s YAML (the helm-template
+    equivalent): ``python -m seldon_core_tpu.operator.manifests spec.json``.
+    """
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(description="render deployment manifests")
+    parser.add_argument("spec", help="SeldonDeployment JSON file")
+    args = parser.parse_args(argv)
+    with open(args.spec) as f:
+        spec = SeldonDeploymentSpec.from_json(f.read())
+    sys.stdout.write(to_yaml_stream(generate_manifests(spec)))
+
+
+if __name__ == "__main__":
+    main()

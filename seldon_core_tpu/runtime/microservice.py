@@ -162,6 +162,21 @@ def main(argv: Optional[List[str]] = None) -> None:
         args.interface_name, args.service_type, params
     )
 
+    if os.environ.get("MICROSERVICE_SMOKE_EXIT"):
+        # image-build smoke contract: construct the runtime (user class
+        # import + init), check the serving stack imports, and exit 0
+        # without binding the port — lets packaged images self-test the way
+        # the reference's s2i test/run scripts do
+        if args.api == "GRPC":
+            try:
+                from seldon_core_tpu.runtime.grpc_server import (  # noqa: F401
+                    serve_unit_grpc,
+                )
+            except ImportError as e:
+                raise SystemExit(f"GRPC serving unavailable: {e}") from e
+        print(f"smoke ok: {args.interface_name} as {args.service_type}")
+        return
+
     if args.api == "GRPC":
         try:
             from seldon_core_tpu.runtime.grpc_server import serve_unit_grpc

@@ -1,0 +1,191 @@
+"""Manifest generation (helm/ksonnet-equivalent) and model packaging
+(s2i-equivalent): golden assertions mirroring the reference operator tests
+(cluster-manager SeldonDeploymentDefaultingTest.java:30-69)."""
+
+import base64
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.operator.manifests import (
+    ENGINE_GRPC_PORT,
+    ENGINE_REST_PORT,
+    generate_manifests,
+    to_yaml_stream,
+)
+from seldon_core_tpu.operator.packaging import ImageSpec, package_model
+
+EXAMPLES = pathlib.Path(__file__).resolve().parent.parent / "examples"
+
+
+def _mixed_spec():
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": "mixed-dep",
+            "annotations": {"project_name": "demo"},
+            "predictors": [{
+                "name": "main",
+                "replicas": 2,
+                "graph": {
+                    "name": "tf", "type": "TRANSFORMER",
+                    "children": [{"name": "m", "type": "MODEL"}],
+                },
+                "components": [
+                    {"name": "tf", "runtime": "rest", "image": "user/tf:1"},
+                    {"name": "m", "runtime": "inprocess",
+                     "class_path": "MnistClassifier",
+                     "device": "tpu", "mesh_axes": {"tp": 2, "sp": 2}},
+                ],
+            }],
+        }
+    })
+
+
+def test_engine_deployment_contract():
+    spec = _mixed_spec()
+    manifests = generate_manifests(spec)
+    engines = [m for m in manifests if m["kind"] == "Deployment"
+               and m["metadata"]["labels"].get("seldon-type") == "engine"]
+    assert len(engines) == 1
+    eng = engines[0]
+    assert eng["spec"]["replicas"] == 2
+    assert eng["spec"]["strategy"]["rollingUpdate"]["maxUnavailable"] == "10%"
+    tmpl = eng["spec"]["template"]
+    assert tmpl["metadata"]["annotations"]["prometheus.io/scrape"] == "true"
+    c = tmpl["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    # graph ships as base64 JSON, reference ENGINE_PREDICTOR contract
+    pred = json.loads(base64.b64decode(env["ENGINE_PREDICTOR"]))
+    assert pred["name"] == "main" and pred["graph"]["name"] == "tf"
+    assert c["readinessProbe"]["httpGet"]["path"] == "/ready"
+    assert "pause" in c["lifecycle"]["preStop"]["exec"]["command"][-1]
+    # tpu inprocess binding with tp*sp=4 mesh -> engine pod owns 4 chips
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    # and schedules onto the matching slice topology
+    node_sel = tmpl["spec"]["nodeSelector"]
+    assert node_sel == {"cloud.google.com/gke-tpu-topology": "2x2"}
+
+
+def test_component_resources_and_services():
+    spec = _mixed_spec()
+    manifests = generate_manifests(spec)
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in manifests]
+    # remote binding 'tf' gets Deployment + Service; inprocess 'm' gets none
+    assert ("Deployment", "mixed-dep-main-tf") in kinds
+    assert ("Service", "mixed-dep-main-tf") in kinds
+    assert not any("main-m" in name for _, name in kinds)
+    comp = next(m for m in manifests
+                if m["metadata"]["name"] == "mixed-dep-main-tf"
+                and m["kind"] == "Deployment")
+    c = comp["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    # defaulting injected the standard unit env and the assigned port
+    assert env["PREDICTIVE_UNIT_ID"] == "tf"
+    port = int(env["PREDICTIVE_UNIT_SERVICE_PORT"])
+    assert port >= 9000
+    assert c["readinessProbe"]["tcpSocket"]["port"] == port
+    svc = next(m for m in manifests
+               if m["metadata"]["name"] == "mixed-dep-main-tf"
+               and m["kind"] == "Service")
+    assert svc["spec"]["selector"] == {
+        "seldon-deployment-id": "mixed-dep",
+        "seldon-predictor": "main",
+        "seldon-app-tf": "true",
+    }
+    assert svc["spec"]["ports"][0]["port"] == port
+
+
+def test_deployment_service_and_yaml_stream():
+    spec = _mixed_spec()
+    manifests = generate_manifests(spec)
+    front = next(m for m in manifests if m["kind"] == "Service"
+                 and m["metadata"]["name"] == "mixed-dep")
+    assert front["spec"]["ports"][0]["port"] == ENGINE_REST_PORT
+    assert front["spec"]["ports"][1]["port"] == ENGINE_GRPC_PORT
+    amb = yaml.safe_load(front["metadata"]["annotations"]["getambassador.io/config"])
+    assert amb["prefix"] == "/seldon/mixed-dep/"
+    # multi-doc stream parses back to the same resources
+    docs = list(yaml.safe_load_all(to_yaml_stream(manifests)))
+    assert len(docs) == len(manifests)
+    assert docs[0]["kind"] == "Deployment"
+
+
+def test_manifests_for_every_example():
+    for path in sorted(EXAMPLES.glob("*_deployment.json")):
+        spec = SeldonDeploymentSpec.from_json(path.read_text())
+        manifests = generate_manifests(spec)
+        assert manifests, path.name
+        names = [m["metadata"]["name"] for m in manifests]
+        assert len(names) == len(set(names)), f"duplicate names in {path.name}"
+        # every predictor has an engine deployment
+        assert sum(
+            1 for m in manifests
+            if m["kind"] == "Deployment"
+            and m["metadata"]["labels"].get("seldon-type") == "engine"
+        ) == len(spec.predictors)
+
+
+def test_package_model_writes_contract(tmp_path):
+    model_dir = tmp_path / "mymodel"
+    model_dir.mkdir()
+    (model_dir / "MyModel.py").write_text(
+        "class MyModel:\n"
+        "    def predict(self, X, names):\n"
+        "        return X\n"
+    )
+    spec = ImageSpec(model_name="MyModel:MyModel", api_type="REST",
+                     service_type="MODEL", persistence=0)
+    written = package_model(str(model_dir), spec)
+    assert set(written) == {"Dockerfile", "run.sh", ".s2i/environment"}
+    df = (model_dir / "Dockerfile").read_text()
+    assert "ENV MODEL_NAME=MyModel:MyModel" in df
+    assert "EXPOSE 5000" in df
+    env = (model_dir / ".s2i" / "environment").read_text()
+    assert "SERVICE_TYPE=MODEL" in env
+    run = (model_dir / "run.sh").read_text()
+    assert "seldon_core_tpu.runtime.microservice" in run
+
+
+def test_package_model_validates():
+    with pytest.raises(ValueError, match="api_type"):
+        ImageSpec(model_name="M", api_type="SOAP").validate()
+    with pytest.raises(ValueError, match="service_type"):
+        ImageSpec(model_name="M", service_type="NOPE").validate()
+
+
+def test_packaged_run_contract_boots(tmp_path):
+    """The generated run.sh env contract actually starts the wrapper CLI
+    (reference wrappers/s2i test/run scripts boot the template app)."""
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    (model_dir / "EchoModel.py").write_text(
+        "import numpy as np\n"
+        "class EchoModel:\n"
+        "    def predict(self, X, names):\n"
+        "        return np.asarray(X)\n"
+    )
+    package_model(str(model_dir), ImageSpec(model_name="EchoModel:EchoModel"))
+    import os
+
+    env = dict(os.environ)
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    env.update({
+        "MODEL_NAME": "EchoModel:EchoModel",
+        "API_TYPE": "REST",
+        "SERVICE_TYPE": "MODEL",
+        "PERSISTENCE": "0",
+        "PYTHONPATH": repo + os.pathsep + str(model_dir),
+        "PREDICTIVE_UNIT_SERVICE_PORT": "0",  # bind an ephemeral port
+        "MICROSERVICE_SMOKE_EXIT": "1",       # build runtime, then exit
+    })
+    out = subprocess.run(
+        ["/bin/sh", str(model_dir / "run.sh")],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=str(model_dir),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
