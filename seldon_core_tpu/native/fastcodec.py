@@ -194,10 +194,13 @@ def parse_message_fast(
         if r is None:
             return None
         env_bytes, kind_code, arr = r
-        try:
-            envelope = json.loads(env_bytes)
-        except json.JSONDecodeError:
-            return None  # envelope should always be valid; be safe
+        if env_bytes == b"{}" or not env_bytes:
+            envelope = {}  # bare-data message: skip the ~11us loads
+        else:
+            try:
+                envelope = json.loads(env_bytes)
+            except json.JSONDecodeError:
+                return None  # envelope should always be valid; be safe
         if kind_code == KIND_NONE:
             return envelope, None, None
         return envelope, ("tensor" if kind_code == KIND_TENSOR else "ndarray"), arr

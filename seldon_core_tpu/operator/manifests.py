@@ -302,6 +302,14 @@ def generate_manifests(spec: SeldonDeploymentSpec,
         default_and_validate(spec)
     out: List[dict] = []
     for predictor in spec.predictors:
+        for binding in predictor.components:
+            if binding.name == "engine" and binding.runtime in ("rest", "grpc"):
+                # its Deployment name would collide with (and on kubectl
+                # apply, overwrite) the predictor's engine Deployment
+                raise ValueError(
+                    f"component name 'engine' is reserved "
+                    f"(predictor {predictor.name!r})"
+                )
         out.append(engine_deployment(spec, predictor))
         for binding in predictor.components:
             if binding.runtime in ("rest", "grpc"):

@@ -14,6 +14,7 @@ no s2i binary needed.
 from __future__ import annotations
 
 import os
+import shutil
 import stat
 from dataclasses import dataclass
 from typing import Optional
@@ -82,6 +83,10 @@ def package_model(model_dir: str, spec: ImageSpec,
     spec.validate()
     out_dir = out_dir or model_dir
     os.makedirs(out_dir, exist_ok=True)
+    if os.path.realpath(out_dir) != os.path.realpath(model_dir):
+        # out_dir becomes the docker build context ("COPY . /microservice"),
+        # so the model sources must be staged into it
+        shutil.copytree(model_dir, out_dir, dirs_exist_ok=True)
     os.makedirs(os.path.join(out_dir, ".s2i"), exist_ok=True)
     fields = dict(
         base_image=spec.base_image,

@@ -260,11 +260,21 @@ class EngineService:
                     if frag is not None:
                         import json as _json
 
+                        if len(meta_out) == 1 and "puid" not in meta_in:
+                            # only OUR generated puid (base32 [a-z2-7], never
+                            # needs escaping) — skip the ~20us dumps call.  A
+                            # client-supplied puid goes through dumps: it can
+                            # contain quotes/backslashes
+                            meta_json = '{"puid":"%s"}' % puid
+                        else:
+                            meta_json = _json.dumps(
+                                meta_out, separators=(",", ":")
+                            )
                         return (
                             '{"meta":%s,"status":{"code":200,"status":"SUCCESS"},'
                             '"data":{%s%s}}'
                             % (
-                                _json.dumps(meta_out, separators=(",", ":")),
+                                meta_json,
                                 self._names_fragment,
                                 frag.decode("ascii"),
                             ),

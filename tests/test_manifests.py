@@ -151,6 +151,31 @@ def test_package_model_writes_contract(tmp_path):
     assert "seldon_core_tpu.runtime.microservice" in run
 
 
+def test_engine_component_name_reserved():
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {"name": "engine", "type": "MODEL"},
+            "components": [{"name": "engine", "runtime": "rest",
+                            "image": "x:1"}],
+        }]}
+    })
+    with pytest.raises(ValueError, match="reserved"):
+        generate_manifests(spec)
+
+
+def test_package_model_stages_sources_into_out_dir(tmp_path):
+    model_dir = tmp_path / "src"
+    model_dir.mkdir()
+    (model_dir / "M.py").write_text("class M: pass\n")
+    out = tmp_path / "build"
+    package_model(str(model_dir), ImageSpec(model_name="M:M"),
+                  out_dir=str(out))
+    # the build context must contain the model sources, not just Dockerfile
+    assert (out / "M.py").exists()
+    assert (out / "Dockerfile").exists()
+
+
 def test_package_model_validates():
     with pytest.raises(ValueError, match="api_type"):
         ImageSpec(model_name="M", api_type="SOAP").validate()

@@ -147,3 +147,25 @@ def test_jax_array_payload(devices8):
     assert msg.data.shape == (2, 3)
     d = json.loads(msg.to_json())
     assert d["data"]["tensor"]["shape"] == [2, 3]
+
+
+def test_puid_fork_safety():
+    """Forked children must not replay the parent's buffered id sequence."""
+    import multiprocessing as mp
+
+    from seldon_core_tpu.messages import new_puid
+
+    new_puid()  # fill the parent's buffer
+    parent_next = None
+    ctx = mp.get_context("fork")
+
+    def child(q):
+        q.put(new_puid())
+
+    q = ctx.Queue()
+    p = ctx.Process(target=child, args=(q,))
+    p.start()
+    child_id = q.get(timeout=30)
+    p.join(30)
+    parent_next = new_puid()
+    assert child_id != parent_next

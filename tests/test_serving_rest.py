@@ -270,3 +270,37 @@ def test_combiner_microservice():
             await runner.cleanup()
 
     asyncio.run(run())
+
+
+def test_client_puid_with_quotes_is_escaped():
+    """A client-supplied puid goes through real JSON encoding on the fast
+    path — quotes must not break (or inject into) the response document."""
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+    engine = EngineService(spec)
+    evil = 'x","tags":{"injected":true},"z":"'
+    payload = json.dumps({
+        "meta": {"puid": evil},
+        "data": {"ndarray": np.zeros((1, 784)).tolist()},
+    })
+
+    async def run():
+        text, status = await engine.predict_json(payload)
+        assert status == 200
+        d = json.loads(text)  # must parse — no raw interpolation
+        assert d["meta"]["puid"] == evil
+        assert "injected" not in (d["meta"].get("tags") or {})
+
+    asyncio.run(run())
