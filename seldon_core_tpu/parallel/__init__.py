@@ -20,3 +20,4 @@ from seldon_core_tpu.parallel.moe import (  # noqa: F401
     moe_param_shardings,
 )
 from seldon_core_tpu.parallel.pipeline import pipeline_apply  # noqa: F401
+from seldon_core_tpu.parallel import multihost  # noqa: F401

@@ -1,0 +1,167 @@
+"""Multi-host runtime — the distributed communication backend.
+
+The reference's cross-process transport is HTTP/gRPC between pods
+(SURVEY.md §2.7: no NCCL/MPI — engine fans out over the network per node).
+Here the split is by physical link, the way TPU pods are built:
+
+  * **within a slice (ICI)**: a graph node's mesh spans the slice; all
+    communication is XLA collectives (psum / all-gather / ppermute /
+    all-to-all) compiled into the program — nothing to configure.
+  * **across hosts of one slice**: JAX's multi-controller runtime — every
+    host runs the same program under ``jit``; arrays are globally sharded.
+    ``initialize()`` below wires the coordination service.
+  * **across slices / unrelated pods (DCN)**: hybrid meshes put the
+    slow axis outermost (``dp`` over DCN, ``tp``/``sp``/``ep`` over ICI),
+    so collectives that ride DCN are the cheap once-per-step gradient/
+    ensemble reductions; OR the hop stays at the service level — a graph
+    edge to a remote engine over gRPC (runtime/client.py), exactly the
+    reference's semantics.
+
+``initialize`` reads the standard env contract so the same container image
+works single-host (no-op) and multi-host (coordinator address injected by
+the operator/manifests layer, like the reference's env-injection chain).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "initialize",
+    "is_distributed",
+    "process_info",
+    "global_mesh",
+    "host_local_to_global",
+    "global_to_host_local",
+    "barrier",
+]
+
+ENV_COORDINATOR = "SELDON_COORDINATOR_ADDRESS"   # host:port of process 0
+ENV_NUM_PROCESSES = "SELDON_NUM_PROCESSES"
+ENV_PROCESS_ID = "SELDON_PROCESS_ID"
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the JAX multi-controller runtime.  Arguments fall back to the
+    ``SELDON_*`` env contract; values absent from both are passed through as
+    None so JAX's own cluster auto-detection (GKE/TPU metadata) applies.
+    With no coordinator configured anywhere this is a no-op (single-host
+    mode) and returns False.
+
+    MUST run before anything touches a JAX backend (including
+    ``is_distributed``/``process_info`` below, ``jax.devices()``, or any
+    jit) — call it first thing in the engine process."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(ENV_COORDINATOR)
+    if not coordinator_address:
+        return False
+    if num_processes is None and ENV_NUM_PROCESSES in os.environ:
+        num_processes = int(os.environ[ENV_NUM_PROCESSES])
+    if process_id is None and ENV_PROCESS_ID in os.environ:
+        process_id = int(os.environ[ENV_PROCESS_ID])
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        raise RuntimeError(
+            "multihost.initialize() must run before the first JAX backend "
+            "use (jax.devices(), jit, process_info(), ...)"
+        ) from e
+    _initialized = True
+    return True
+
+
+def is_distributed() -> bool:
+    """NB: touches the backend — only call after initialize()."""
+    return jax.process_count() > 1
+
+
+def process_info() -> Dict[str, int]:
+    """NB: touches the backend — only call after initialize()."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def global_mesh(
+    axes: Dict[str, int],
+    dcn_axes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Mesh over ALL processes' devices.
+
+    ``axes`` are the fast (ICI) axes; ``dcn_axes`` (e.g. ``{"dp": n_slices}``)
+    are placed outermost so their collectives ride DCN.  Single-host with no
+    dcn_axes degrades to a plain mesh — same code runs everywhere.
+    """
+    from jax.experimental import mesh_utils
+
+    from seldon_core_tpu.parallel.mesh import build_mesh
+
+    if not dcn_axes:
+        return build_mesh(dict(axes))
+    names = tuple(dcn_axes) + tuple(axes)
+    ici_shape = tuple(axes[n] for n in axes)
+    dcn_shape = tuple(dcn_axes[n] for n in dcn_axes)
+    devs = jax.devices()
+    if not hasattr(devs[0], "slice_index"):
+        # no slice topology info (CPU platform / single host): the "DCN"
+        # axes are virtual — fold them into a plain mesh so the same
+        # program shape runs in tests and single-slice deployments.  Only
+        # this specific condition degrades; real topology mismatches below
+        # must fail loudly, not silently span tp/sp over DCN links.
+        combined = {**dict(dcn_axes), **dict(axes)}
+        return build_mesh(combined)
+    # create_hybrid_device_mesh multiplies shapes elementwise, so pad both
+    # to full rank: result shape = dcn_shape + ici_shape
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        (1,) * len(dcn_shape) + ici_shape,
+        dcn_shape + (1,) * len(ici_shape),
+        devices=devs,
+        process_is_granule=False,
+    )
+    return Mesh(dev_array, names)
+
+
+def host_local_to_global(mesh: Mesh, spec, local_array):
+    """Per-host shard -> globally sharded jax.Array (multi-host data
+    loading: each host feeds its local batch rows)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.host_local_array_to_global_array(
+        local_array, mesh, spec
+    )
+
+
+def global_to_host_local(mesh: Mesh, spec, global_array):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.global_array_to_host_local_array(
+        global_array, mesh, spec
+    )
+
+
+def barrier(name: str = "seldon_barrier") -> None:
+    """Block until every process arrives (pre-serve warmup sync; the
+    reference's readiness-gate equivalent for the multi-controller world)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,59 @@
+"""Multi-host helpers, exercised in single-process mode (the 8-virtual-
+device platform stands in for one host's chips; true multi-process needs a
+real coordinator, which the env contract wires in production)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from seldon_core_tpu.parallel import multihost as mh
+
+
+def test_initialize_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv(mh.ENV_COORDINATOR, raising=False)
+    assert mh.initialize() is False  # single-host: nothing to join
+    assert mh.is_distributed() is False
+
+
+def test_process_info_shape(devices8):
+    info = mh.process_info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_device_count"] >= 8
+
+
+def test_global_mesh_plain(devices8):
+    mesh = mh.global_mesh({"dp": 2, "tp": 4})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    # a psum over the mesh executes
+    y = jax.jit(
+        lambda x: x * 1.0,
+        out_shardings=NamedSharding(mesh, P("dp", "tp")),
+    )(jnp.ones((4, 8)))
+    assert float(np.asarray(y).sum()) == 32.0
+
+
+def test_global_mesh_hybrid_single_host(devices8):
+    """With one 'slice' per process, hybrid construction still works on a
+    single host: dcn axis of size 1 outermost."""
+    mesh = mh.global_mesh({"tp": 4}, dcn_axes={"dp": 2})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.shape == {"dp": 2, "tp": 4}
+
+
+def test_global_mesh_too_big_rejected(devices8):
+    with pytest.raises(ValueError, match="devices"):
+        mh.global_mesh({"dp": 1024})
+
+
+def test_host_local_roundtrip(devices8):
+    mesh = mh.global_mesh({"dp": 8})
+    x = np.arange(16.0).reshape(16, 1)
+    g = mh.host_local_to_global(mesh, P("dp", None), x)
+    assert g.shape == (16, 1)  # single process: local == global
+    back = mh.global_to_host_local(mesh, P("dp", None), g)
+    np.testing.assert_array_equal(np.asarray(back), x)
+    mh.barrier("test")  # no-op single process
