@@ -106,8 +106,22 @@ def param_shardings(mesh: Mesh, params) -> Any:
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
-def _attention(q, k, v, mesh: Optional[Mesh], causal: bool):
-    """[B, H, S, hd] -> [B, H, S, hd]; ring over sp when the mesh shards S."""
+def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
+               use_flash: bool = False):
+    """[B, H, S, hd] -> [B, H, S, hd]; ring over sp when the mesh shards S.
+
+    ``use_flash`` opts the single-chip path into the Pallas flash kernel
+    (serving only — it has no VJP); constraint violations fall back to the
+    plain XLA path silently."""
+    if use_flash and (mesh is None or mesh.size == 1):
+        # single-chip only: pallas_call is not auto-partitionable under
+        # GSPMD, so any multi-device mesh (tp/dp/sp) keeps the XLA path
+        from seldon_core_tpu.ops.flash_attention import flash_attention
+
+        try:
+            return flash_attention(q, k, v, causal=causal)
+        except ValueError:
+            pass  # shape constraints unmet -> XLA path below
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         specs = P(
             "dp" if "dp" in mesh.axis_names else None,
@@ -133,7 +147,8 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool):
     return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
 
 
-def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool):
+def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
+           use_flash: bool = False):
     """One decoder block: attn + MLP with residuals.  x [B,S,D] -> [B,S,D]."""
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
@@ -144,7 +159,7 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool):
     def heads(t):
         return t.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
 
-    a = _attention(heads(q), heads(k), heads(v), mesh, causal)
+    a = _attention(heads(q), heads(k), heads(v), mesh, causal, use_flash)
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + a @ lp["wo"]
     h = _rmsnorm(x, lp["ln2"])
@@ -152,12 +167,14 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool):
 
 
 def lm_apply(
-    params, tokens, cfg: LMConfig, mesh: Optional[Mesh] = None, causal: bool = True
+    params, tokens, cfg: LMConfig, mesh: Optional[Mesh] = None,
+    causal: bool = True, use_flash: bool = False
 ):
-    """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+    """tokens [B, S] int32 -> logits [B, S, V] (f32).  ``use_flash`` is
+    serving-only (the flash kernel has no VJP — keep it False under grad)."""
     x = params["embed"][tokens]  # [B,S,D]
     for i in range(cfg.n_layers):
-        x = _block(params[f"l{i}"], x, cfg, mesh, causal)
+        x = _block(params[f"l{i}"], x, cfg, mesh, causal, use_flash)
     x = _rmsnorm(x, params["ln_f"])
     return (x @ params["embed"].T).astype(jnp.float32)
 
@@ -290,5 +307,10 @@ class TransformerLM(Unit):
         return params
 
     def predict(self, state, X):
+        from seldon_core_tpu.ops.fused_mlp import pallas_supported
+
         tokens = X.astype(jnp.int32)
-        return lm_apply(state, tokens, self.cfg, self.mesh)
+        return lm_apply(
+            state, tokens, self.cfg, self.mesh,
+            use_flash=pallas_supported(),
+        )

@@ -25,10 +25,9 @@ the operator/manifests layer, like the reference's env-injection chain).
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional
 
 import jax
-import numpy as np
 from jax.sharding import Mesh
 
 __all__ = [
@@ -54,10 +53,10 @@ def initialize(
     process_id: Optional[int] = None,
 ) -> bool:
     """Join the JAX multi-controller runtime.  Arguments fall back to the
-    ``SELDON_*`` env contract; values absent from both are passed through as
-    None so JAX's own cluster auto-detection (GKE/TPU metadata) applies.
-    With no coordinator configured anywhere this is a no-op (single-host
-    mode) and returns False.
+    ``SELDON_*`` env contract.  A coordinator address is REQUIRED to join:
+    without one this is a no-op (single-host mode) returning False —
+    JAX's cluster auto-detection applies only to ``num_processes`` /
+    ``process_id`` (passed through as None when absent).
 
     MUST run before anything touches a JAX backend (including
     ``is_distributed``/``process_info`` below, ``jax.devices()``, or any
@@ -118,6 +117,12 @@ def global_mesh(
 
     if not dcn_axes:
         return build_mesh(dict(axes))
+    overlap = set(dcn_axes) & set(axes)
+    if overlap:
+        raise ValueError(
+            f"axis names {sorted(overlap)} appear in both dcn_axes and axes; "
+            f"an axis lives on exactly one link layer"
+        )
     names = tuple(dcn_axes) + tuple(axes)
     ici_shape = tuple(axes[n] for n in axes)
     dcn_shape = tuple(dcn_axes[n] for n in dcn_axes)

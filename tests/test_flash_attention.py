@@ -1,0 +1,59 @@
+"""Flash-attention kernel numerics vs plain XLA attention (interpret mode on
+the CPU test platform; the TPU path compiles the same kernel)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.ops.flash_attention import flash_attention
+
+
+def _xla_attention(q, k, v, causal):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[2])[:, None]
+        kpos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qpos >= kpos, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def _rand(shape, seed):
+    return jnp.asarray(
+        np.random.default_rng(seed).normal(size=shape), jnp.float32
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_xla(causal):
+    B, H, S, D = 2, 2, 256, 64
+    q, k, v = (_rand((B, H, S, D), i) for i in range(3))
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _xla_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_multiblock_online_softmax():
+    """S = 3 blocks forces cross-block max/normaliser carries."""
+    B, H, S, D = 1, 1, 384, 32
+    q, k, v = (_rand((B, H, S, D), 10 + i) for i in range(3))
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _xla_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_constraint_errors():
+    q = jnp.zeros((1, 1, 100, 64))  # S not multiple of 128
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, q, q)
+    q = jnp.zeros((1, 1, 128, 512))  # head dim too large
+    with pytest.raises(ValueError, match="head dim"):
+        flash_attention(q, q, q)
+    with pytest.raises(ValueError, match="shapes differ"):
+        flash_attention(
+            jnp.zeros((1, 1, 128, 64)), jnp.zeros((1, 1, 128, 32)),
+            jnp.zeros((1, 1, 128, 64)),
+        )

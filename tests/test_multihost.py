@@ -44,6 +44,11 @@ def test_global_mesh_hybrid_single_host(devices8):
     assert mesh.shape == {"dp": 2, "tp": 4}
 
 
+def test_global_mesh_overlapping_axes_rejected(devices8):
+    with pytest.raises(ValueError, match="exactly one link layer"):
+        mh.global_mesh({"dp": 2, "tp": 2}, dcn_axes={"dp": 2})
+
+
 def test_global_mesh_too_big_rejected(devices8):
     with pytest.raises(ValueError, match="devices"):
         mh.global_mesh({"dp": 1024})
