@@ -4,3 +4,4 @@ from seldon_core_tpu.ops.fused_mlp import (  # noqa: F401
     fused_mlp_softmax,
     pallas_supported,
 )
+from seldon_core_tpu.ops.flash_attention import flash_attention  # noqa: F401
