@@ -73,9 +73,18 @@ def _unary(fn, req_cls, resp_cls=pb.SeldonMessage):
 
 
 def make_engine_grpc_server(engine, host: str, port: int) -> grpc.aio.Server:
-    async def predict(req: pb.SeldonMessage) -> pb.SeldonMessage:
-        resp = await engine.predict(protoconv.msg_from_proto(req))
-        return protoconv.msg_to_proto(resp)
+    async def predict_wire(wire: bytes, context) -> bytes:
+        # raw-bytes handler: tensor requests are scanned at the wire level
+        # (packed doubles -> frombuffer) and answered as composed bytes —
+        # no protobuf object materialises on the hot path.  Error mapping
+        # mirrors _wrap: typed errors -> FAILURE message, unimplemented ->
+        # UNIMPLEMENTED, anything else propagates as INTERNAL
+        try:
+            return await engine.predict_proto_wire(wire)
+        except (SeldonMessageError, GraphSpecError) as e:
+            return _failure_proto(str(e)).SerializeToString()
+        except NotImplementedError as e:
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
 
     async def send_feedback(req: pb.Feedback) -> pb.SeldonMessage:
         ack = await engine.send_feedback(protoconv.feedback_from_proto(req))
@@ -87,7 +96,10 @@ def make_engine_grpc_server(engine, host: str, port: int) -> grpc.aio.Server:
             grpc.method_handlers_generic_handler(
                 "seldon.protos.Seldon",
                 {
-                    "Predict": _unary(predict, pb.SeldonMessage),
+                    # deserializer/serializer omitted: grpc passes bytes
+                    "Predict": grpc.unary_unary_rpc_method_handler(
+                        predict_wire
+                    ),
                     "SendFeedback": _unary(send_feedback, pb.Feedback),
                 },
             ),
