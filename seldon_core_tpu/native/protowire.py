@@ -112,6 +112,10 @@ def _scan_tensor(buf: bytes):
             else:
                 return None
         elif field == 2 and wt == 2:  # values: packed doubles
+            if values is not None:
+                # split packed field: protobuf merge semantics concatenate —
+                # decline and let the full parser handle it
+                return None
             n, pos = _read_len(buf, pos)
             if n % 8:
                 return None
@@ -135,10 +139,14 @@ def parse_tensor_request(wire: bytes):
         end = len(wire)
         puid = ""
         tensor = None
+        seen_meta = False
         while pos < end:
             key, pos = _read_varint(wire, pos)
             field, wt = key >> 3, key & 7
             if field == 2 and wt == 2:  # meta
+                if seen_meta:
+                    return None  # repeated field -> protobuf merges; decline
+                seen_meta = True
                 n, pos = _read_len(wire, pos)
                 meta_puid = _scan_meta(wire[pos : pos + n])
                 if meta_puid is None:
@@ -146,6 +154,8 @@ def parse_tensor_request(wire: bytes):
                 puid = meta_puid
                 pos += n
             elif field == 3 and wt == 2:  # data
+                if tensor is not None:
+                    return None  # repeated data -> merge semantics; decline
                 n, pos = _read_len(wire, pos)
                 sub = wire[pos : pos + n]
                 pos += n
@@ -154,6 +164,8 @@ def parse_tensor_request(wire: bytes):
                     skey, spos = _read_varint(sub, spos)
                     sfield, swt = skey >> 3, skey & 7
                     if sfield == 2 and swt == 2:  # tensor
+                        if tensor is not None:
+                            return None  # repeated tensor: merge; decline
                         sn, spos = _read_len(sub, spos)
                         tensor = _scan_tensor(sub[spos : spos + sn])
                         if tensor is None:

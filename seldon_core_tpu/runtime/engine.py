@@ -34,6 +34,7 @@ from seldon_core_tpu.graph.spec import (
 )
 from seldon_core_tpu.messages import (
     Feedback,
+    Meta,
     SeldonMessage,
     SeldonMessageError,
     new_puid,
@@ -169,9 +170,16 @@ class EngineService:
                 if self._static_names
                 else ""
             )
-            from seldon_core_tpu.native.protowire import names_fragment
+            from seldon_core_tpu.native.protowire import (
+                build_tensor_response,
+                names_fragment,
+                parse_tensor_request,
+            )
 
             self._proto_names_frag = names_fragment(self._static_names or [])
+            # bound once: these sit on the per-request proto hot path
+            self._parse_tensor_request = parse_tensor_request
+            self._build_tensor_response = build_tensor_response
             # build/load the native codec NOW (engine startup) — a first-call
             # build inside a request coroutine would block the event loop for
             # the duration of the g++ run
@@ -194,9 +202,17 @@ class EngineService:
         with self.tracer.span(
             "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
         ):
-            y, routing, tags = self.compiled.predict_arrays(
-                stacked, update_states=not self._pipelined
-            )
+            try:
+                y, routing, tags = self.compiled.predict_arrays(
+                    stacked, update_states=not self._pipelined
+                )
+            except (TypeError, ValueError) as e:
+                # shape/dtype mismatches surface from XLA tracing as raw
+                # TypeErrors; at the serving edge they are client errors
+                # (wrong feature width), so convert to the typed 400
+                raise SeldonMessageError(
+                    f"graph rejected input of shape {stacked.shape}: {e}"
+                ) from e
         return np.asarray(y), (routing, tags)
 
     # ------------------------------------------------------------------
@@ -317,12 +333,7 @@ class EngineService:
         as bytes; anything unusual falls back to real protobuf parsing via
         ``predict_proto``."""
         if self.batcher is not None:
-            from seldon_core_tpu.native.protowire import (
-                build_tensor_response,
-                parse_tensor_request,
-            )
-
-            parsed = parse_tensor_request(wire)
+            parsed = self._parse_tensor_request(wire)
             if parsed is not None:
                 puid, rows = parsed
                 puid = puid or new_puid()
@@ -338,11 +349,14 @@ class EngineService:
                         code["code"] = "400"
                         from seldon_core_tpu.protoconv import msg_to_proto
 
+                        # echo the request puid, like the object path does
                         return msg_to_proto(
-                            SeldonMessage.failure(str(e), code=400)
+                            SeldonMessage.failure(
+                                str(e), code=400, meta=Meta(puid=puid)
+                            )
                         ).SerializeToString()
                     if not routing and not tags:
-                        return build_tensor_response(
+                        return self._build_tensor_response(
                             puid, y, self._proto_names_frag
                         )
                     # routing/tags present (rare on batchable graphs):
@@ -362,11 +376,7 @@ class EngineService:
         skip the SeldonMessage object layer entirely: packed values ->
         batched dispatch -> packed response.  Everything else goes through
         the object path with identical semantics."""
-        from seldon_core_tpu.proto_gen import prediction_pb2 as pb
-        from seldon_core_tpu.protoconv import (
-            msg_from_proto,
-            msg_to_proto,
-        )
+        from seldon_core_tpu.protoconv import msg_from_proto, msg_to_proto
 
         fast = (
             self.batcher is not None
@@ -395,9 +405,11 @@ class EngineService:
                         y, (routing, tags) = await self.batcher.submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = "400"
-                        from seldon_core_tpu.messages import SeldonMessage as _SM
-
-                        return msg_to_proto(_SM.failure(str(e), code=400))
+                        return msg_to_proto(
+                            SeldonMessage.failure(
+                                str(e), code=400, meta=Meta(puid=puid)
+                            )
+                        )
                     return self._compose_proto_response(puid, y, routing, tags)
         resp_msg = await self.predict(msg_from_proto(req))
         return msg_to_proto(resp_msg)

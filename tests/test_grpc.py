@@ -184,3 +184,52 @@ def test_unit_grpc_server_and_client_runtime():
             await server.stop(0)
 
     asyncio.run(run())
+
+
+def test_engine_grpc_wire_fast_lane_over_socket():
+    """Tensor request through a REAL grpc channel must hit the wire-level
+    fast lane (batchable MODEL graph) and round-trip correctly."""
+
+    async def run():
+        spec = SeldonDeploymentSpec.from_json_dict({
+            "spec": {"name": "d", "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "type": "MODEL"},
+                "components": [{
+                    "name": "m", "runtime": "inprocess",
+                    "class_path": "MnistClassifier",
+                    "parameters": [{"name": "hidden", "value": "32",
+                                    "type": "INT"}],
+                }],
+            }]}
+        })
+        engine = EngineService(spec)
+        assert engine.batcher is not None  # fast lane armed
+        port = await _free_port()
+        server = make_engine_grpc_server(engine, "127.0.0.1", port)
+        await server.start()
+        try:
+            import grpc
+
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                predict = ch.unary_unary(
+                    "/seldon.protos.Seldon/Predict",
+                    request_serializer=pb.SeldonMessage.SerializeToString,
+                    response_deserializer=pb.SeldonMessage.FromString,
+                )
+                req = pb.SeldonMessage()
+                req.meta.puid = "wirepuid"
+                req.data.tensor.shape.extend([2, 784])
+                req.data.tensor.values.extend([0.0] * (2 * 784))
+                resp = await predict(req)
+                assert resp.meta.puid == "wirepuid"
+                assert resp.status.code == 200
+                assert list(resp.data.tensor.shape) == [2, 10]
+                probs = np.asarray(resp.data.tensor.values).reshape(2, 10)
+                np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-3)
+                assert list(resp.data.names) == [f"class:{i}"
+                                                 for i in range(10)]
+        finally:
+            await server.stop(0)
+
+    asyncio.run(run())

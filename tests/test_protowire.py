@@ -131,3 +131,44 @@ def test_truncated_messages_decline():
         pb.SeldonMessage.FromString(truncated)
     # chopped packed values
     assert parse_tensor_request(base[:-4]) is None
+
+
+def test_repeated_fields_decline():
+    """Split packed values / repeated data submessages follow protobuf
+    merge semantics — the fast lane must decline them, not last-win."""
+    single = _tensor_req([4], [1.0, 2.0, 3.0, 4.0])
+    # two concatenated SeldonMessages with data fields = repeated `data`
+    double_data = (single.SerializeToString()
+                   + _tensor_req([4], [9.0, 9.0, 9.0, 9.0]).SerializeToString())
+    assert parse_tensor_request(double_data) is None
+    # protobuf merges them; our decline means the full parser handles it
+    merged = pb.SeldonMessage.FromString(double_data)
+    assert len(merged.data.tensor.values) == 8
+
+
+def test_fast_lane_failure_echoes_puid():
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "16",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+    engine = EngineService(spec)
+    bad = _tensor_req([1, 3], [1.0, 2.0, 3.0], puid="mypuid")  # wrong width
+
+    async def run():
+        wire = await engine.predict_proto_wire(bad.SerializeToString())
+        resp = pb.SeldonMessage.FromString(wire)
+        assert resp.status.status == pb.Status.FAILURE
+        assert resp.meta.puid == "mypuid"
+
+    asyncio.run(run())

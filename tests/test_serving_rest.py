@@ -304,3 +304,35 @@ def test_client_puid_with_quotes_is_escaped():
         assert "injected" not in (d["meta"].get("tags") or {})
 
     asyncio.run(run())
+
+
+def test_wrong_feature_width_is_400_not_crash():
+    """A client sending the wrong feature width must get a 400 FAILURE,
+    not an unhandled XLA shape error (SeldonMessageError is the only typed
+    edge error; anything else at the surface is a bug)."""
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "16",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+    engine = EngineService(spec)
+
+    async def run():
+        text, status = await engine.predict_json(
+            '{"data":{"ndarray":[[1.0,2.0,3.0]]}}'
+        )
+        assert status == 400
+        d = json.loads(text)
+        assert d["status"]["status"] == "FAILURE"
+        assert "shape" in d["status"]["info"]
+
+    asyncio.run(run())
