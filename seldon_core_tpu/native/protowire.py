@@ -111,10 +111,11 @@ def _scan_tensor(buf: bytes):
                 shape = shape + (d,)
             else:
                 return None
-        elif field == 2 and wt == 2:  # values: packed doubles
-            if values is not None:
-                # split packed field: protobuf merge semantics concatenate —
-                # decline and let the full parser handle it
+        elif field == 2:  # values
+            if wt != 2 or values is not None:
+                # unpacked (wt 1) elements or a split packed field: protobuf
+                # merge semantics concatenate — decline so the full parser
+                # (and its shape validation) handles the message
                 return None
             n, pos = _read_len(buf, pos)
             if n % 8:

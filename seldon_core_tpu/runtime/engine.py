@@ -99,6 +99,10 @@ class EngineService:
         # in-flight batches to hide it (throughput ~= depth x single-stream)
         self._device_lock = asyncio.Lock()
         self._pipelined = False
+        # feature widths that have served successfully: a dispatch failure
+        # on a known-good width is a server bug (500), on a novel width a
+        # client shape error (400)
+        self._known_good_widths: set = set()
         self.mode = "host"
         self.compiled: Optional[CompiledGraph] = None
         self.executor: Optional[GraphExecutor] = None
@@ -202,17 +206,22 @@ class EngineService:
         with self.tracer.span(
             "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
         ):
+            width = stacked.shape[1:]
             try:
                 y, routing, tags = self.compiled.predict_arrays(
                     stacked, update_states=not self._pipelined
                 )
             except (TypeError, ValueError) as e:
-                # shape/dtype mismatches surface from XLA tracing as raw
-                # TypeErrors; at the serving edge they are client errors
-                # (wrong feature width), so convert to the typed 400
+                if width in self._known_good_widths:
+                    # this feature width has served before: the failure is a
+                    # server-side defect, not bad client input — surface it
+                    raise
+                # never-seen width failing at trace time = wrong feature
+                # width from the client: typed 400
                 raise SeldonMessageError(
                     f"graph rejected input of shape {stacked.shape}: {e}"
                 ) from e
+            self._known_good_widths.add(width)
         return np.asarray(y), (routing, tags)
 
     # ------------------------------------------------------------------

@@ -233,3 +233,62 @@ def test_engine_grpc_wire_fast_lane_over_socket():
             await server.stop(0)
 
     asyncio.run(run())
+
+
+def test_gateway_grpc_oauth_over_socket():
+    """Gateway Seldon service over a real channel: oauth_token metadata
+    selects the principal (HeaderServerInterceptor.java:42 semantics);
+    missing/garbage tokens fail with an auth FAILURE."""
+    from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+    from seldon_core_tpu.runtime.grpc_server import make_gateway_grpc_server
+
+    async def run():
+        spec = SeldonDeploymentSpec.from_json_dict({
+            "spec": {
+                "name": "gdep", "oauth_key": "k", "oauth_secret": "s",
+                "predictors": [{
+                    "name": "p",
+                    "graph": {"name": "m", "type": "MODEL"},
+                    "components": [{
+                        "name": "m", "runtime": "inprocess",
+                        "class_path": "MnistClassifier",
+                        "parameters": [{"name": "hidden", "value": "16",
+                                        "type": "INT"}],
+                    }],
+                }],
+            }
+        })
+        store = DeploymentStore()
+        engines = {p.name: EngineService(spec, p.name)
+                   for p in spec.predictors}
+        store.register(spec, engines)
+        gw = ApiGateway(store=store)
+        token = store.issue_token("k", "s")
+
+        port = await _free_port()
+        server = make_gateway_grpc_server(gw, "127.0.0.1", port)
+        await server.start()
+        try:
+            import grpc
+
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                predict = ch.unary_unary(
+                    "/seldon.protos.Seldon/Predict",
+                    request_serializer=pb.SeldonMessage.SerializeToString,
+                    response_deserializer=pb.SeldonMessage.FromString,
+                )
+                req = pb.SeldonMessage()
+                req.data.tensor.shape.extend([1, 784])
+                req.data.tensor.values.extend([0.0] * 784)
+
+                resp = await predict(req, metadata=(("oauth_token", token),))
+                assert resp.status.status == pb.Status.SUCCESS
+                assert list(resp.data.tensor.shape) == [1, 10]
+
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await predict(req, metadata=(("oauth_token", "junk"),))
+                assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        finally:
+            await server.stop(0)
+
+    asyncio.run(run())

@@ -172,3 +172,20 @@ def test_fast_lane_failure_echoes_puid():
         assert resp.meta.puid == "mypuid"
 
     asyncio.run(run())
+
+
+def test_unpacked_values_decline():
+    """Mixed packed + unpacked (wire type 1) values elements merge under
+    protobuf; the fast lane must decline, not drop the unpacked element."""
+    base = _tensor_req([2], [1.0, 2.0]).SerializeToString()
+    import struct as _struct
+
+    # append data{tensor{values: one unpacked double}}: field2/wt1 inside
+    # tensor, inside data
+    unpacked_val = bytes([(2 << 3) | 1]) + _struct.pack("<d", 9.0)
+    tensor = bytes([(2 << 3) | 2, len(unpacked_val)]) + unpacked_val
+    data = bytes([(3 << 3) | 2, len(tensor)]) + tensor
+    wire = base + data
+    merged = pb.SeldonMessage.FromString(wire)
+    assert len(merged.data.tensor.values) == 3  # protobuf merges to 3
+    assert parse_tensor_request(wire) is None   # we decline
