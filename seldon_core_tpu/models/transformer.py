@@ -289,10 +289,12 @@ class TransformerLM(Unit):
         d_ff: int = 512,
         seed: int = 0,
         mesh: Optional[Mesh] = None,
+        dtype: str = "bfloat16",
     ):
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff),
+            dtype=jnp.dtype(dtype).type,
         )
         self.seed = int(seed)
         self.mesh = mesh

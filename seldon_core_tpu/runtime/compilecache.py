@@ -1,0 +1,39 @@
+"""Persistent XLA compilation cache — shared boot helper for every serving
+entrypoint (engine and unit microservice): restarts and rolling updates
+reuse compiled executables instead of paying the 20-40 s first-compile
+inside the readiness-probe window."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["enable_compile_cache"]
+
+
+def enable_compile_cache() -> bool:
+    """Point JAX at a persistent on-disk cache.  Opt out with
+    SELDON_COMPILE_CACHE=0; dir overridable via SELDON_COMPILE_CACHE_DIR.
+    Returns True when active; failures log a warning and serve uncached
+    (readiness timing then assumes full compiles)."""
+    if os.environ.get("SELDON_COMPILE_CACHE", "1") == "0":
+        return False
+    cache_dir = os.environ.get(
+        "SELDON_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "seldon_core_tpu_xla"),
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        return True
+    except (ImportError, OSError, ValueError, AttributeError) as e:
+        # AttributeError: jax raises it for unrecognized config options
+        logging.getLogger(__name__).warning(
+            "compile cache disabled (%s: %s) — every restart pays full "
+            "XLA compiles; check SELDON_COMPILE_CACHE_DIR writability",
+            type(e).__name__, e,
+        )
+        return False

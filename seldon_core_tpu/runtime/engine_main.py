@@ -92,27 +92,6 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
     await asyncio.Event().wait()
 
 
-def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache: restarts and rolling updates skip
-    the 20-40 s first-compile (the k8s readiness probe budget assumes it).
-    Opt-out with SELDON_COMPILE_CACHE=0; cache dir overridable."""
-    if os.environ.get("SELDON_COMPILE_CACHE", "1") == "0":
-        return
-    cache_dir = os.environ.get(
-        "SELDON_COMPILE_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "seldon_core_tpu_xla"),
-    )
-    try:
-        import jax
-
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except (ImportError, OSError, ValueError, AttributeError):
-        # AttributeError: jax raises it for unrecognized config options
-        pass  # caching is an optimisation; serve without it
-
-
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="seldon_core_tpu engine")
     parser.add_argument("--file", default=None, help="deployment JSON path")
@@ -121,7 +100,9 @@ def main(argv=None) -> None:
     parser.add_argument("--rest-port", type=int, default=None)
     parser.add_argument("--grpc-port", type=int, default=None)
     args = parser.parse_args(argv)
-    _enable_compile_cache()
+    from seldon_core_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()
     deployment = load_deployment_from_env(args.file)
     asyncio.run(
         serve(deployment, args.predictor, args.host, args.rest_port, args.grpc_port)

@@ -152,6 +152,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     )
     args = parser.parse_args(argv)
 
+    from seldon_core_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()
+
     params = (
         [Parameter.from_json_dict(p) for p in json.loads(args.parameters)]
         if args.parameters
