@@ -129,3 +129,38 @@ def test_example_deployments_parse_and_validate():
         spec = SeldonDeploymentSpec.from_json(f.read_text())
         default_and_validate(spec)
         assert spec.predictors, f.name
+
+
+def test_every_example_contract_conforms():
+    """Contract fuzz -> predict -> validate for every contract that has a
+    matching example deployment (the reference's api-tester loop,
+    util/api_tester/api-tester.py:24-120)."""
+    import asyncio
+    import pathlib
+
+    import numpy as np
+
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.testing.contract import (
+        Contract,
+        generate_batch,
+        validate_response,
+    )
+
+    examples = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    pairs = []
+    for cpath in sorted(examples.glob("*_contract.json")):
+        dpath = examples / cpath.name.replace("_contract", "_deployment")
+        if dpath.exists():
+            pairs.append((cpath, dpath))
+    assert len(pairs) >= 4, [p[0].name for p in pairs]
+    for cpath, dpath in pairs:
+        contract = Contract.from_file(str(cpath))
+        spec = SeldonDeploymentSpec.from_json(dpath.read_text())
+        engine = EngineService(spec)
+        msg = generate_batch(contract, 4, seed=0)
+        resp = asyncio.run(engine.predict(msg))
+        errs = validate_response(contract, resp)
+        assert not errs, (cpath.name, errs)
+        assert np.asarray(resp.data.array).shape[0] == 4
