@@ -135,23 +135,12 @@ def test_every_example_contract_conforms():
     """Contract fuzz -> predict -> validate for every contract that has a
     matching example deployment (the reference's api-tester loop,
     util/api_tester/api-tester.py:24-120)."""
-    import asyncio
-    import pathlib
-
-    import numpy as np
-
     from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
     from seldon_core_tpu.runtime.engine import EngineService
-    from seldon_core_tpu.testing.contract import (
-        Contract,
-        generate_batch,
-        validate_response,
-    )
 
-    examples = pathlib.Path(__file__).resolve().parent.parent / "examples"
     pairs = []
-    for cpath in sorted(examples.glob("*_contract.json")):
-        dpath = examples / cpath.name.replace("_contract", "_deployment")
+    for cpath in sorted(EXAMPLES.glob("*_contract.json")):
+        dpath = EXAMPLES / cpath.name.replace("_contract", "_deployment")
         if dpath.exists():
             pairs.append((cpath, dpath))
     assert len(pairs) >= 4, [p[0].name for p in pairs]
