@@ -12,3 +12,4 @@ from seldon_core_tpu.models.tabular import (  # noqa: F401
     ObliviousTreeEnsemble,
     SigmoidPredictor,
 )
+from seldon_core_tpu.models.generate import TransformerGenerator  # noqa: F401

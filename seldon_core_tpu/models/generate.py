@@ -1,0 +1,211 @@
+"""Autoregressive decoding with a KV cache — LLM-style serving through the
+same graph engine.
+
+The reference predates sequence models entirely (SURVEY.md §5); this module
+makes generation a first-class graph workload: ``TransformerGenerator`` is
+a MODEL unit whose ``predict`` maps prompt token rows to generated token
+rows, so a deployment JSON serves text continuation over the identical
+REST/gRPC data plane as every other model.
+
+TPU-shaped decoding:
+  * the whole decode loop is ONE ``lax.scan`` inside jit — no Python
+    per-token dispatch, no host round-trips between steps;
+  * K/V caches are preallocated ``[B, H, max_len, hd]`` buffers updated
+    with ``dynamic_update_slice`` (static shapes, no retraces);
+  * the prompt is consumed in one batched prefill (full-sequence
+    ``lm_apply``-style pass that also fills the cache), then single-token
+    steps attend over the cache with a position mask;
+  * greedy (temperature=0) or sampled decoding via ``jax.random`` keys
+    threaded through the scan carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.models.transformer import (
+    LMConfig,
+    _rmsnorm,
+    lm_init,
+)
+
+__all__ = ["init_cache", "prefill", "decode_step", "generate",
+           "TransformerGenerator"]
+
+
+def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    hd = cfg.d_model // cfg.n_heads
+    return {
+        f"l{i}": {
+            "k": jnp.zeros((batch, cfg.n_heads, max_len, hd), cfg.dtype),
+            "v": jnp.zeros((batch, cfg.n_heads, max_len, hd), cfg.dtype),
+        }
+        for i in range(cfg.n_layers)
+    }
+
+
+def _heads(t, B, S, H, hd):
+    return t.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+
+
+def _attend_cached(q, cache_k, cache_v, n_valid):
+    """q [B,H,1,hd] against the cache; positions >= n_valid (scalar) masked."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   cache_k.astype(jnp.float32)) * scale
+    valid = jnp.arange(cache_k.shape[2]) < n_valid  # [max_len]
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cache_v.dtype), cache_v)
+
+
+def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig):
+    """One decoder block writing K/V into the cache at ``start`` and
+    attending over cache[:n_valid].  x [B,S,D]; returns (x', cache_layer').
+    S > 1 means prefill from position 0; S == 1 is a cached decode step."""
+    B, S, D = x.shape
+    hd = cfg.d_model // cfg.n_heads
+    h = _rmsnorm(x, lp["ln1"])
+    qkv = h @ lp["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q, k, v = (_heads(t, B, S, cfg.n_heads, hd) for t in (q, k, v))
+    cache_k = jax.lax.dynamic_update_slice(
+        cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, 0, start, 0)
+    )
+    cache_v = jax.lax.dynamic_update_slice(
+        cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, 0, start, 0)
+    )
+    if S > 1:
+        # prefill: plain causal attention over the fresh k/v only — the
+        # cache tail past S is all-masked zeros, no need to attend over it
+        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        pos = jnp.arange(S)
+        mask = pos[:, None] >= pos[None, :]
+        s = jnp.where(mask[None, None, :, :], s, -1e30)
+        a = jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            jax.nn.softmax(s, axis=-1).astype(v.dtype), v,
+        )
+    else:
+        a = _attend_cached(q, cache_k, cache_v, n_valid)
+    a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + a @ lp["wo"]
+    h = _rmsnorm(x, lp["ln2"])
+    x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+    return x, {"k": cache_k, "v": cache_v}
+
+
+def prefill(params, tokens, cache, cfg: LMConfig):
+    """Consume the prompt in one pass, filling the cache.
+
+    tokens [B, S_prompt] -> (last-position logits [B, V], cache')."""
+    B, S = tokens.shape
+    x = params["embed"][tokens]
+    for i in range(cfg.n_layers):
+        x, cache[f"l{i}"] = _block_cached(
+            params[f"l{i}"], x, cache[f"l{i}"], 0, S, cfg
+        )
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x[:, -1, :] @ params["embed"].T).astype(jnp.float32)
+    return logits, cache
+
+
+def decode_step(params, token, cache, pos, cfg: LMConfig):
+    """One cached step.  token [B] int32, pos scalar -> (logits [B,V],
+    cache')."""
+    x = params["embed"][token][:, None, :]  # [B,1,D]
+    for i in range(cfg.n_layers):
+        x, cache[f"l{i}"] = _block_cached(
+            params[f"l{i}"], x, cache[f"l{i}"], pos, pos + 1, cfg
+        )
+    x = _rmsnorm(x, params["ln_f"])
+    return (x[:, 0, :] @ params["embed"].T).astype(jnp.float32), cache
+
+
+def generate(
+    params,
+    prompt,
+    cfg: LMConfig,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """prompt [B, S] int32 -> generated [B, max_new_tokens] int32.
+
+    Greedy when temperature == 0 (a static python branch), else sampled.
+    The decode loop is a single lax.scan; jit the whole function."""
+    B, S = prompt.shape
+    cache = init_cache(cfg, B, S + max_new_tokens)
+    logits, cache = prefill(params, prompt, cache, cfg)
+    if rng is None:
+        rng = jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    key0, rng = jax.random.split(rng)
+    first = pick(logits, key0).astype(jnp.int32)
+
+    def step(carry, _):
+        token, cache, pos, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(params, token, cache, pos, cfg)
+        nxt = pick(logits, sub).astype(jnp.int32)
+        return (nxt, cache, pos + 1, key), nxt
+
+    # first token came from prefill; the scan emits the remaining N-1 (no
+    # wasted final forward whose logits would be discarded)
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first, cache, jnp.int32(S), rng), None,
+        length=max_new_tokens - 1,
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, max_new]
+
+
+@register_unit("TransformerGenerator")
+class TransformerGenerator(Unit):
+    """Serving unit: prompt token rows in, generated token rows out, over
+    the standard data plane.  Generation length and temperature are graph
+    parameters, so a deployment JSON fully describes the decode behavior."""
+
+    pure = True
+    class_names = None
+
+    def __init__(self, vocab: int = 256, d_model: int = 128, n_heads: int = 4,
+                 n_layers: int = 2, d_ff: int = 512, seed: int = 0,
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 dtype: str = "bfloat16"):
+        self.cfg = LMConfig(
+            vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
+            n_layers=int(n_layers), d_ff=int(d_ff),
+            dtype=jnp.dtype(dtype).type,
+        )
+        self.seed = int(seed)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        # sampled decoding draws per-row noise from one key, so a row's
+        # tokens depend on its position in the stacked batch — coalescing
+        # other callers' rows would change this caller's sample
+        self.batch_coupled = self.temperature > 0.0
+
+    def init_state(self, rng):
+        if rng is None:
+            rng = jax.random.key(self.seed)
+        return lm_init(jax.random.fold_in(rng, self.seed), self.cfg)
+
+    def predict(self, state, X):
+        prompt = X.astype(jnp.int32)
+        return generate(
+            state, prompt, self.cfg,
+            max_new_tokens=self.max_new_tokens,
+            temperature=self.temperature,
+            rng=jax.random.key(self.seed),
+        ).astype(jnp.float32)

@@ -8,7 +8,7 @@ tests pin that concurrent traffic cannot lose updates or corrupt state.
   * predict vs feedback: pipelined predict dispatches skip their state
     write-back, so a slow in-flight predict must not clobber a feedback
     update that raced past it.
-  * drain: /pause flips readiness while in-flight requests complete.
+  * drain: /pause flips readiness; serving continues through the drain.
 """
 
 import asyncio
@@ -79,9 +79,11 @@ def test_concurrent_feedback_no_lost_updates():
 
 
 def test_predict_feedback_interleaving_keeps_state():
-    """Pipelined predicts racing with feedback must not clobber bandit
-    state (predict_arrays skips its state write-back when pipelined)."""
+    """Predicts racing with feedback on a ROUTER graph (serialized under
+    the device lock — router graphs never batch) must not lose or corrupt
+    bandit updates."""
     engine = EngineService(_bandit_spec())
+    assert engine.batcher is None  # ROUTE => not batchable, lock discipline
     payload = json.dumps({"data": {"ndarray": [[0.0] * 784]}})
     N = 30
 
@@ -102,10 +104,50 @@ def test_predict_feedback_interleaving_keeps_state():
     assert tries.sum() == N, f"lost feedback updates: {tries}"
 
 
-def test_pause_drains_inflight():
-    """Pre-stop drain: requests genuinely in flight when /pause lands must
-    complete with 200 while /ready flips to 503 (the k8s pre-stop contract:
-    curl /pause && sleep — SeldonDeploymentOperatorImpl.java:130-134)."""
+def test_pipelined_predicts_do_not_write_back_state():
+    """On a batchable graph with pipelining, overlapped predict dispatches
+    must NOT write their (stale) state back — a concurrent feedback-style
+    state swap mid-flight has to survive (engine.py pad_ok/pipelined
+    discipline)."""
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "16",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+    engine = EngineService(spec, pipeline_depth=4)
+    assert engine.batcher is not None and engine._pipelined
+    payload = json.dumps({"data": {"ndarray": [[0.0] * 784]}})
+
+    async def run():
+        tasks = [asyncio.create_task(engine.predict_json(payload))
+                 for _ in range(16)]
+        await asyncio.sleep(0)  # let dispatches start
+        # a feedback-style state replacement racing the in-flight predicts
+        swapped = dict(engine.compiled.states)
+        swapped["__fb_marker__"] = 123
+        engine.compiled.states = swapped
+        results = await asyncio.gather(*tasks)
+        assert all(status == 200 for _, status in results)
+
+    asyncio.run(run())
+    # in-flight predicts completed AFTER the swap; had any written back its
+    # captured states, the marker would be gone
+    assert engine.compiled.states.get("__fb_marker__") == 123
+
+
+def test_pause_flips_readiness_and_keeps_serving():
+    """Pre-stop contract (curl /pause && sleep —
+    SeldonDeploymentOperatorImpl.java:130-134): /pause flips /ready to 503
+    so the load balancer stops routing here, while the engine KEEPS serving
+    whatever still arrives during the drain window (pausing rejects
+    nothing — that is the whole point of the drain)."""
     import aiohttp
     from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
 
@@ -132,7 +174,12 @@ def test_pause_drains_inflight():
                 responses = await asyncio.gather(*tasks)
                 assert all(r.status == 200 for r in responses), [
                     r.status for r in responses
-                ]  # in-flight work drained, not dropped
+                ]  # pausing rejects nothing; traffic drains via the LB
+                # and requests arriving WHILE paused still serve
+                async with s.post(
+                    f"{base}/api/v0.1/predictions", data=payload
+                ) as r2:
+                    assert r2.status == 200
                 for r in responses:
                     r.release()
         finally:
