@@ -261,6 +261,34 @@ def main() -> None:
         return single, high, ens4, hi_clients, grpc_r
 
     single, high, ens4, hi_clients, grpc_r = asyncio.run(run_all())
+
+    # LLM-style generation throughput (no reference counterpart: the
+    # reference predates sequence models).  One KV-cache decode of B x N
+    # tokens is a single device dispatch.  NB this is a RAW device-dispatch
+    # figure (jit call + one readback per rep), not the served wire path —
+    # it isolates the decode-loop cost from codec/batching overhead.
+    def _gen_tokens_per_s():
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.generate import generate
+        from seldon_core_tpu.models.transformer import LMConfig, lm_init
+
+        gcfg = LMConfig(vocab=256, d_model=256, n_heads=8,
+                        n_layers=2 if args.smoke else 4, d_ff=1024)
+        gparams = lm_init(jax.random.key(0), gcfg)
+        B, new = (4, 16) if args.smoke else (8, 64)
+        prompt = jnp.zeros((B, 64), jnp.int32)
+        f = jax.jit(lambda p, t: generate(p, t, gcfg, max_new_tokens=new))
+        np.asarray(f(gparams, prompt))  # compile + warm
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(f(gparams, prompt))  # asarray forces each dispatch
+        dt = (time.perf_counter() - t0) / reps
+        return B * new / dt
+
+    gen_tps = _gen_tokens_per_s()
     best, best_clients = (
         (high, hi_clients) if high["qps"] >= single["qps"] else (single, clients)
     )
@@ -282,6 +310,7 @@ def main() -> None:
         "ensemble4_p50_ms": round(ens4["p50_ms"], 2),
         "grpc_path_qps": round(grpc_r["qps"], 1),
         "grpc_vs_baseline": round(grpc_r["qps"] / REFERENCE_GRPC_QPS, 4),
+        "gen_tokens_per_s": round(gen_tps, 1),
         "relay_floor_ms": round(relay_floor, 2),
         "device": str(jax.devices()[0]),
         "duration_s": duration,

@@ -26,9 +26,10 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
 from seldon_core_tpu.models.transformer import (
     LMConfig,
+    _attention,
     _rmsnorm,
     lm_init,
 )
@@ -63,7 +64,8 @@ def _attend_cached(q, cache_k, cache_v, n_valid):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cache_v.dtype), cache_v)
 
 
-def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig):
+def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
+                  use_flash: bool = False):
     """One decoder block writing K/V into the cache at ``start`` and
     attending over cache[:n_valid].  x [B,S,D]; returns (x', cache_layer').
     S > 1 means prefill from position 0; S == 1 is a cached decode step."""
@@ -80,18 +82,11 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig):
         cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, 0, start, 0)
     )
     if S > 1:
-        # prefill: plain causal attention over the fresh k/v only — the
-        # cache tail past S is all-masked zeros, no need to attend over it
-        scale = 1.0 / jnp.sqrt(jnp.float32(hd))
-        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
-        pos = jnp.arange(S)
-        mask = pos[:, None] >= pos[None, :]
-        s = jnp.where(mask[None, None, :, :], s, -1e30)
-        a = jnp.einsum(
-            "bhqk,bhkd->bhqd",
-            jax.nn.softmax(s, axis=-1).astype(v.dtype), v,
-        )
+        # prefill: causal attention over the fresh k/v only — the cache
+        # tail past S is all-masked zeros, no need to attend over it.
+        # Reuses the LM's _attention (flash kernel when available, same
+        # fallback numerics as lm_apply) so the two paths cannot drift.
+        a = _attention(q, k, v, None, causal=True, use_flash=use_flash)
     else:
         a = _attend_cached(q, cache_k, cache_v, n_valid)
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
@@ -101,7 +96,7 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig):
     return x, {"k": cache_k, "v": cache_v}
 
 
-def prefill(params, tokens, cache, cfg: LMConfig):
+def prefill(params, tokens, cache, cfg: LMConfig, use_flash: bool = False):
     """Consume the prompt in one pass, filling the cache.
 
     tokens [B, S_prompt] -> (last-position logits [B, V], cache')."""
@@ -109,7 +104,7 @@ def prefill(params, tokens, cache, cfg: LMConfig):
     x = params["embed"][tokens]
     for i in range(cfg.n_layers):
         x, cache[f"l{i}"] = _block_cached(
-            params[f"l{i}"], x, cache[f"l{i}"], 0, S, cfg
+            params[f"l{i}"], x, cache[f"l{i}"], 0, S, cfg, use_flash
         )
     x = _rmsnorm(x, params["ln_f"])
     logits = (x[:, -1, :] @ params["embed"].T).astype(jnp.float32)
@@ -135,6 +130,7 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    use_flash: bool = False,
 ) -> jax.Array:
     """prompt [B, S] int32 -> generated [B, max_new_tokens] int32.
 
@@ -142,7 +138,7 @@ def generate(
     The decode loop is a single lax.scan; jit the whole function."""
     B, S = prompt.shape
     cache = init_cache(cfg, B, S + max_new_tokens)
-    logits, cache = prefill(params, prompt, cache, cfg)
+    logits, cache = prefill(params, prompt, cache, cfg, use_flash)
     if rng is None:
         rng = jax.random.key(0)
 
@@ -174,7 +170,17 @@ def generate(
 class TransformerGenerator(Unit):
     """Serving unit: prompt token rows in, generated token rows out, over
     the standard data plane.  Generation length and temperature are graph
-    parameters, so a deployment JSON fully describes the decode behavior."""
+    parameters, so a deployment JSON fully describes the decode behavior.
+
+    Input contract: prompt values are truncated to int32 and CLAMPED to
+    [0, vocab) — jit-compiled programs cannot reject data-dependent values
+    per-request, so out-of-range ids degrade deterministically instead of
+    hitting XLA's unspecified out-of-bounds gather.
+
+    Sampling: temperature>0 threads a request counter through unit state,
+    so repeated identical prompts draw fresh continuations (a fixed key
+    would make sampling a worse greedy); the counter update rides the
+    normal state write-back."""
 
     pure = True
     class_names = None
@@ -193,19 +199,32 @@ class TransformerGenerator(Unit):
         self.temperature = float(temperature)
         # sampled decoding draws per-row noise from one key, so a row's
         # tokens depend on its position in the stacked batch — coalescing
-        # other callers' rows would change this caller's sample
+        # other callers' rows would change this caller's sample; the
+        # request counter in state additionally varies the key per request
         self.batch_coupled = self.temperature > 0.0
+        self.updates_state_on_predict = self.temperature > 0.0
 
     def init_state(self, rng):
         if rng is None:
             rng = jax.random.key(self.seed)
-        return lm_init(jax.random.fold_in(rng, self.seed), self.cfg)
+        params = lm_init(jax.random.fold_in(rng, self.seed), self.cfg)
+        return {"params": params, "requests": jnp.zeros((), jnp.int32)}
 
     def predict(self, state, X):
-        prompt = X.astype(jnp.int32)
-        return generate(
-            state, prompt, self.cfg,
+        from seldon_core_tpu.ops.fused_mlp import pallas_supported
+
+        prompt = jnp.clip(X.astype(jnp.int32), 0, self.cfg.vocab - 1)
+        key = jax.random.fold_in(jax.random.key(self.seed),
+                                 state["requests"])
+        y = generate(
+            state["params"], prompt, self.cfg,
             max_new_tokens=self.max_new_tokens,
             temperature=self.temperature,
-            rng=jax.random.key(self.seed),
+            rng=key,
+            use_flash=pallas_supported(),
         ).astype(jnp.float32)
+        if self.temperature > 0.0:
+            new_state = {"params": state["params"],
+                         "requests": state["requests"] + 1}
+            return y, UnitAux(state=new_state)
+        return y

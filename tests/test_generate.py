@@ -102,3 +102,31 @@ def test_sampled_generator_declares_batch_coupling():
     sampled = TransformerGenerator(temperature=1.0)
     assert greedy.batch_coupled is False
     assert sampled.batch_coupled is True
+
+
+def test_sampled_unit_varies_across_requests():
+    """temperature>0 must not replay the same continuation for repeated
+    identical prompts: the request counter in state varies the key."""
+    u = TransformerGenerator(vocab=48, d_model=32, n_heads=4, n_layers=1,
+                             d_ff=64, max_new_tokens=8, temperature=1.0,
+                             dtype="float32")
+    st = u.init_state(jax.random.key(0))
+    X = jnp.zeros((2, 4), jnp.float32)
+    from seldon_core_tpu.graph.units import normalize_output
+
+    y1, st1, _ = normalize_output(u.predict(st, X), st)
+    y2, st2, _ = normalize_output(u.predict(st1, X), st1)
+    assert int(st2["requests"]) == 2
+    assert (np.asarray(y1) != np.asarray(y2)).any()
+
+
+def test_out_of_range_prompt_tokens_clamped():
+    u = TransformerGenerator(vocab=48, d_model=32, n_heads=4, n_layers=1,
+                             d_ff=64, max_new_tokens=4, dtype="float32")
+    st = u.init_state(jax.random.key(0))
+    wild = jnp.asarray([[-5.0, 3.2, 999.0, 47.0]], jnp.float32)
+    tame = jnp.asarray([[0.0, 3.0, 47.0, 47.0]], jnp.float32)
+    y_wild = np.asarray(u.predict(st, wild))
+    y_tame = np.asarray(u.predict(st, tame))
+    np.testing.assert_array_equal(y_wild, y_tame)  # clamp contract
+    assert ((0 <= y_wild) & (y_wild < 48)).all()

@@ -111,6 +111,7 @@ _EXAMPLE_FEATURES = {
     "canary_deployment.json": 784,
     "mean_transformer_deployment.json": 6,
     "gbm_deployment.json": 8,
+    "generator_deployment.json": 5,  # 5-token prompts -> generated tokens
 }
 
 
