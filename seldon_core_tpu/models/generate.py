@@ -213,7 +213,9 @@ class TransformerGenerator(Unit):
     def predict(self, state, X):
         from seldon_core_tpu.ops.fused_mlp import pallas_supported
 
-        prompt = jnp.clip(X.astype(jnp.int32), 0, self.cfg.vocab - 1)
+        # clip in float space FIRST: float->int32 of out-of-range values is
+        # implementation-defined in XLA (wrap vs saturate varies by backend)
+        prompt = jnp.clip(X, 0, self.cfg.vocab - 1).astype(jnp.int32)
         key = jax.random.fold_in(jax.random.key(self.seed),
                                  state["requests"])
         y = generate(
