@@ -30,6 +30,7 @@ from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
 from seldon_core_tpu.models.transformer import (
     LMConfig,
     _attention,
+    _ffn,
     _rmsnorm,
     lm_init,
 )
@@ -92,7 +93,8 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + a @ lp["wo"]
     h = _rmsnorm(x, lp["ln2"])
-    x = x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+    y, _lb = _ffn(lp, h, cfg, mesh=None)  # dense or MoE FFN
+    x = x + y
     return x, {"k": cache_k, "v": cache_v}
 
 
@@ -188,11 +190,14 @@ class TransformerGenerator(Unit):
     def __init__(self, vocab: int = 256, d_model: int = 128, n_heads: int = 4,
                  n_layers: int = 2, d_ff: int = 512, seed: int = 0,
                  max_new_tokens: int = 32, temperature: float = 0.0,
-                 dtype: str = "bfloat16"):
+                 dtype: str = "bfloat16", moe_every: int = 0,
+                 n_experts: int = 8, moe_k: int = 2):
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff),
             dtype=jnp.dtype(dtype).type,
+            moe_every=int(moe_every), n_experts=int(n_experts),
+            moe_k=int(moe_k),
         )
         self.seed = int(seed)
         self.max_new_tokens = int(max_new_tokens)
@@ -213,9 +218,13 @@ class TransformerGenerator(Unit):
     def predict(self, state, X):
         from seldon_core_tpu.ops.fused_mlp import pallas_supported
 
-        # clip in float space FIRST: float->int32 of out-of-range values is
-        # implementation-defined in XLA (wrap vs saturate varies by backend)
-        prompt = jnp.clip(X, 0, self.cfg.vocab - 1).astype(jnp.int32)
+        # nan_to_num then clip in float space BEFORE the cast: float->int32
+        # of NaN or out-of-range values is implementation-defined in XLA
+        # (wrap vs saturate varies by backend); after this chain the cast
+        # input is always a finite value in [0, vocab)
+        prompt = jnp.clip(
+            jnp.nan_to_num(X), 0, self.cfg.vocab - 1
+        ).astype(jnp.int32)
         key = jax.random.fold_in(jax.random.key(self.seed),
                                  state["requests"])
         y = generate(

@@ -55,6 +55,15 @@ class LMConfig:
     n_layers: int = 2
     d_ff: int = 512
     dtype: Any = jnp.bfloat16
+    # MoE: every ``moe_every``-th block (1-indexed) swaps its dense FFN for
+    # a mixture of ``n_experts`` experts, top-``moe_k`` routed, sharded over
+    # the mesh's ``ep`` axis (parallel/moe.py).  0 = dense everywhere.
+    moe_every: int = 0
+    n_experts: int = 8
+    moe_k: int = 2
+
+    def is_moe_layer(self, i: int) -> bool:
+        return self.moe_every > 0 and (i + 1) % self.moe_every == 0
 
 
 def _rmsnorm(x, w, eps=1e-6):
@@ -77,14 +86,24 @@ def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
     }
     for i in range(cfg.n_layers):
         k = keys[1 + 4 * i : 1 + 4 * (i + 1)]
-        params[f"l{i}"] = {
+        lp = {
             "ln1": jnp.ones((cfg.d_model,), dt),
             "wqkv": dense(k[0], (cfg.d_model, 3 * cfg.d_model), cfg.d_model),
             "wo": dense(k[1], (cfg.d_model, cfg.d_model), cfg.d_model),
             "ln2": jnp.ones((cfg.d_model,), dt),
-            "w1": dense(k[2], (cfg.d_model, cfg.d_ff), cfg.d_model),
-            "w2": dense(k[3], (cfg.d_ff, cfg.d_model), cfg.d_ff),
         }
+        if cfg.is_moe_layer(i):
+            from seldon_core_tpu.parallel.moe import MoEConfig, moe_init
+
+            lp["moe"] = moe_init(
+                k[2],
+                MoEConfig(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                          n_experts=cfg.n_experts, k=cfg.moe_k, dtype=dt),
+            )
+        else:
+            lp["w1"] = dense(k[2], (cfg.d_model, cfg.d_ff), cfg.d_model)
+            lp["w2"] = dense(k[3], (cfg.d_ff, cfg.d_model), cfg.d_ff)
+        params[f"l{i}"] = lp
     params["ln_f"] = jnp.ones((cfg.d_model,), dt)
     return params
 
@@ -92,17 +111,25 @@ def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
 def param_shardings(mesh: Mesh, params) -> Any:
     """NamedShardings for the tp layout above (replicated where not listed)."""
 
-    def spec_for(path) -> P:
+    def spec_for(path, leaf) -> P:
         # path is a tuple of DictKey objects; the leaf name is the last key
-        leaf = getattr(path[-1], "key", str(path[-1]))
-        if leaf in ("wqkv", "w1"):
+        names = [getattr(p, "key", str(p)) for p in path]
+        name = names[-1]
+        if "moe" in names:
+            # same rule as parallel/moe.py moe_param_shardings: expert
+            # stacks shard over ep (rank from the leaf), router replicated
+            if name in ("w1", "w2") and "ep" in mesh.axis_names:
+                return P("ep", *([None] * (leaf.ndim - 1)))
+            return P()
+        if name in ("wqkv", "w1"):
             return P(None, "tp") if "tp" in mesh.axis_names else P()
-        if leaf in ("wo", "w2"):
+        if name in ("wo", "w2"):
             return P("tp", None) if "tp" in mesh.axis_names else P()
         return P()
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    shardings = [NamedSharding(mesh, spec_for(path)) for path, _ in flat]
+    shardings = [NamedSharding(mesh, spec_for(path, leaf))
+                 for path, leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
@@ -149,7 +176,8 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
 
 def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
            use_flash: bool = False):
-    """One decoder block: attn + MLP with residuals.  x [B,S,D] -> [B,S,D]."""
+    """One decoder block: attn + FFN (dense or MoE) with residuals.
+    x [B,S,D] -> (x', lb_loss) where lb_loss is 0 for dense layers."""
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
     h = _rmsnorm(x, lp["ln1"])
@@ -163,36 +191,67 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + a @ lp["wo"]
     h = _rmsnorm(x, lp["ln2"])
-    return x + jax.nn.gelu(h @ lp["w1"]) @ lp["w2"]
+    y, lb = _ffn(lp, h, cfg, mesh)
+    return x + y, lb
+
+
+def _ffn(lp, h, cfg: LMConfig, mesh: Optional[Mesh]):
+    """Dense or MoE feed-forward on h [B,S,D] -> (y, lb_loss)."""
+    if "moe" in lp:
+        from seldon_core_tpu.parallel.moe import MoEConfig, moe_apply
+
+        mcfg = MoEConfig(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                         n_experts=cfg.n_experts, k=cfg.moe_k,
+                         dtype=cfg.dtype)
+        y, aux = moe_apply(lp["moe"], h, mcfg, mesh=mesh)
+        return y, aux["lb_loss"]
+    return jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], jnp.float32(0.0)
 
 
 def lm_apply(
     params, tokens, cfg: LMConfig, mesh: Optional[Mesh] = None,
-    causal: bool = True, use_flash: bool = False
+    causal: bool = True, use_flash: bool = False, return_lb: bool = False
 ):
     """tokens [B, S] int32 -> logits [B, S, V] (f32).  ``use_flash`` is
-    serving-only (the flash kernel has no VJP — keep it False under grad)."""
+    serving-only (the flash kernel has no VJP — keep it False under grad).
+    ``return_lb`` additionally returns the summed MoE load-balance loss."""
     x = params["embed"][tokens]  # [B,S,D]
+    lb_total = jnp.float32(0.0)
     for i in range(cfg.n_layers):
-        x = _block(params[f"l{i}"], x, cfg, mesh, causal, use_flash)
+        x, lb = _block(params[f"l{i}"], x, cfg, mesh, causal, use_flash)
+        lb_total = lb_total + lb
     x = _rmsnorm(x, params["ln_f"])
-    return (x @ params["embed"].T).astype(jnp.float32)
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return (logits, lb_total) if return_lb else logits
+
+
+LB_LOSS_COEF = 0.01  # Switch-style aux-loss weight
 
 
 def lm_loss(params, batch, cfg: LMConfig, mesh: Optional[Mesh] = None,
             apply_fn=None):
-    """Next-token cross-entropy; batch = {tokens: [B, S+1]}.
+    """Next-token cross-entropy (+ weighted MoE load-balance loss when the
+    config has MoE layers); batch = {tokens: [B, S+1]}.
 
     ``apply_fn(params, tokens) -> logits`` overrides the forward (used by the
     pipelined variant); defaults to ``lm_apply``."""
     tokens = batch["tokens"]
+    lb_total = jnp.float32(0.0)
     if apply_fn is None:
-        apply_fn = lambda p, t: lm_apply(p, t, cfg, mesh)  # noqa: E731
-    logits = apply_fn(params, tokens[:, :-1])
+        logits, lb_total = lm_apply(params, tokens[:, :-1], cfg, mesh,
+                                    return_lb=True)
+    else:
+        if cfg.moe_every:
+            # a custom forward cannot report the lb loss through this
+            # interface; training without it collapses the router
+            raise ValueError(
+                "lm_loss(apply_fn=...) does not support MoE configs"
+            )
+        logits = apply_fn(params, tokens[:, :-1])
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    return jnp.mean(nll) + LB_LOSS_COEF * lb_total
 
 
 def _grad_update(loss_fn, params, opt_state, batch, optimizer):
@@ -225,6 +284,11 @@ def lm_pipeline_params(params, cfg: LMConfig, n_stages: int, mesh: Mesh):
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pp={n_stages}"
         )
+    if cfg.moe_every:
+        # MoE layers have a different param tree than dense ones, so they
+        # cannot stack into a homogeneous per-stage scan; also their
+        # lb_loss would be silently dropped by the pipeline schedule
+        raise ValueError("pipeline parallelism does not support MoE layers")
     lps = cfg.n_layers // n_stages
     per_stage = []
     for s in range(n_stages):
@@ -244,7 +308,8 @@ def lm_pipeline_apply(pp_params, tokens, cfg: LMConfig, mesh: Mesh,
     def stage_fn(stage_params, x):
         # stage_params leaves: [layers_per_stage, ...]; scan the sub-stack
         def body(h, lp):
-            return _block(lp, h, cfg, mesh=None, causal=causal), None
+            h2, _lb = _block(lp, h, cfg, mesh=None, causal=causal)
+            return h2, None
 
         out, _ = jax.lax.scan(body, x, stage_params)
         return out
@@ -290,11 +355,16 @@ class TransformerLM(Unit):
         seed: int = 0,
         mesh: Optional[Mesh] = None,
         dtype: str = "bfloat16",
+        moe_every: int = 0,
+        n_experts: int = 8,
+        moe_k: int = 2,
     ):
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff),
             dtype=jnp.dtype(dtype).type,
+            moe_every=int(moe_every), n_experts=int(n_experts),
+            moe_k=int(moe_k),
         )
         self.seed = int(seed)
         self.mesh = mesh
