@@ -203,10 +203,14 @@ class TransformerGenerator(Unit):
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         # sampled decoding draws per-row noise from one key, so a row's
-        # tokens depend on its position in the stacked batch — coalescing
-        # other callers' rows would change this caller's sample; the
-        # request counter in state additionally varies the key per request
-        self.batch_coupled = self.temperature > 0.0
+        # tokens depend on its position in the stacked batch; MoE capacity
+        # routing likewise couples rows (shared capacity over the flattened
+        # token stream) — either way, coalescing other callers' rows would
+        # change this caller's answer.  The request counter in state
+        # additionally varies the sampling key per request.
+        self.batch_coupled = (
+            self.temperature > 0.0 or self.cfg.moe_every > 0
+        )
         self.updates_state_on_predict = self.temperature > 0.0
 
     def init_state(self, rng):

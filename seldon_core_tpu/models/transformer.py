@@ -116,11 +116,9 @@ def param_shardings(mesh: Mesh, params) -> Any:
         names = [getattr(p, "key", str(p)) for p in path]
         name = names[-1]
         if "moe" in names:
-            # same rule as parallel/moe.py moe_param_shardings: expert
-            # stacks shard over ep (rank from the leaf), router replicated
-            if name in ("w1", "w2") and "ep" in mesh.axis_names:
-                return P("ep", *([None] * (leaf.ndim - 1)))
-            return P()
+            from seldon_core_tpu.parallel.moe import moe_leaf_spec
+
+            return moe_leaf_spec(name, leaf, mesh)
         if name in ("wqkv", "w1"):
             return P(None, "tp") if "tp" in mesh.axis_names else P()
         if name in ("wo", "w2"):
@@ -368,6 +366,10 @@ class TransformerLM(Unit):
         )
         self.seed = int(seed)
         self.mesh = mesh
+        # MoE capacity routing flattens the stacked batch into one token
+        # stream (shared capacity, cumsum slot order), so co-batched rows
+        # change each other's overflow — no cross-request coalescing
+        self.batch_coupled = self.cfg.moe_every > 0
 
     def init_state(self, rng):
         if rng is None:

@@ -56,13 +56,20 @@ def moe_init(rng, cfg: MoEConfig) -> Dict[str, Any]:
     }
 
 
+def moe_leaf_spec(name: str, leaf, mesh: Mesh, axis: str = "ep") -> P:
+    """PartitionSpec for one MoE param leaf: expert stacks shard over the
+    ep axis, the router replicates.  THE single source of the MoE layout —
+    used here and by the LM's param_shardings so the rules cannot drift."""
+    if name in ("w1", "w2") and axis in mesh.axis_names:
+        return P(axis, *([None] * (leaf.ndim - 1)))
+    return P()
+
+
 def moe_param_shardings(mesh: Mesh, params, axis: str = "ep") -> Any:
     """Experts shard over ``ep``; router weights replicate."""
     def spec(path, leaf):
         name = getattr(path[-1], "key", str(path[-1]))
-        if name in ("w1", "w2") and axis in mesh.axis_names:
-            return NamedSharding(mesh, P(axis, *([None] * (leaf.ndim - 1))))
-        return NamedSharding(mesh, P())
+        return NamedSharding(mesh, moe_leaf_spec(name, leaf, mesh, axis))
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     return jax.tree_util.tree_unflatten(

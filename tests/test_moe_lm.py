@@ -99,3 +99,15 @@ def test_moe_generator_unit_serves():
     y = np.asarray(u.predict(st, X))
     assert y.shape == (1, 4)
     assert ((0 <= y) & (y < 48)).all()
+
+
+def test_moe_units_declare_batch_coupling():
+    """MoE capacity routing couples co-batched rows, so MoE-configured
+    serving units must opt out of request coalescing."""
+    from seldon_core_tpu.models.generate import TransformerGenerator
+    from seldon_core_tpu.models.transformer import TransformerLM
+
+    assert TransformerLM(moe_every=2).batch_coupled is True
+    assert TransformerLM().batch_coupled is False
+    assert TransformerGenerator(moe_every=2).batch_coupled is True
+    assert TransformerGenerator().batch_coupled is False
