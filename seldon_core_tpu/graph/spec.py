@@ -45,7 +45,12 @@ __all__ = [
 
 
 class GraphSpecError(ValueError):
-    """Invalid graph/deployment spec (the reference's SeldonDeploymentException)."""
+    """Invalid graph/deployment spec (the reference's SeldonDeploymentException).
+
+    ``http_code`` lets the serving edge map it to a FAILURE status without
+    special-casing (same contract as messages.SeldonMessageError)."""
+
+    http_code = 400
 
 
 class UnitType(Enum):

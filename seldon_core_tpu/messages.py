@@ -49,7 +49,20 @@ ArrayLike = Any  # np.ndarray | jax.Array | nested lists
 
 
 class SeldonMessageError(ValueError):
-    """Malformed message payload (maps to a FAILURE Status at the edge)."""
+    """Malformed message payload (maps to a FAILURE Status at the edge).
+
+    ``http_code`` drives the FAILURE status code; subclasses override it
+    for non-client-fault failures (e.g. dispatch deadline -> 504)."""
+
+    http_code = 400
+
+
+class DispatchTimeoutError(SeldonMessageError):
+    """Device dispatch exceeded the engine deadline — the per-node budget
+    the reference enforced with 5 s gRPC deadlines
+    (engine InternalPredictionService.java:77)."""
+
+    http_code = 504
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.parallel.moe import moe_leaf_spec
 from seldon_core_tpu.parallel.pipeline import (
     merge_microbatches,
     pipeline_apply,
@@ -116,8 +117,6 @@ def param_shardings(mesh: Mesh, params) -> Any:
         names = [getattr(p, "key", str(p)) for p in path]
         name = names[-1]
         if "moe" in names:
-            from seldon_core_tpu.parallel.moe import moe_leaf_spec
-
             return moe_leaf_spec(name, leaf, mesh)
         if name in ("wqkv", "w1"):
             return P(None, "tp") if "tp" in mesh.axis_names else P()

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["MoEConfig", "moe_init", "moe_apply", "moe_param_shardings"]
+__all__ = ["MoEConfig", "moe_init", "moe_apply", "moe_param_shardings",
+           "moe_leaf_spec"]
 
 
 @dataclass(frozen=True)

@@ -33,6 +33,7 @@ from seldon_core_tpu.graph.spec import (
     SeldonDeploymentSpec,
 )
 from seldon_core_tpu.messages import (
+    DispatchTimeoutError,
     Feedback,
     Meta,
     SeldonMessage,
@@ -79,6 +80,7 @@ class EngineService:
         max_batch: int = 1024,
         max_wait_ms: float = 2.0,
         pipeline_depth: int = 8,
+        dispatch_timeout_s: float = 30.0,
     ):
         from seldon_core_tpu.utils.tracing import TRACER
 
@@ -97,6 +99,7 @@ class EngineService:
         # Stateless graphs get a semaphore instead (set below): device
         # dispatch has a fixed sync cost, and the runtime overlaps several
         # in-flight batches to hide it (throughput ~= depth x single-stream)
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
         self._device_lock = asyncio.Lock()
         self._pipelined = False
         # feature widths that have served successfully: a dispatch failure
@@ -191,6 +194,22 @@ class EngineService:
 
             native_available()
 
+
+    async def _submit(self, rows):
+        """Batched dispatch under the engine deadline — the reference's
+        per-call budget (5 s gRPC deadlines,
+        InternalPredictionService.java:77) applied to the device hop.  A
+        hung relay/device surfaces as a 504 FAILURE instead of a request
+        that never returns."""
+        try:
+            return await asyncio.wait_for(
+                self.batcher.submit(rows), self.dispatch_timeout_s
+            )
+        except asyncio.TimeoutError:
+            raise DispatchTimeoutError(
+                f"device dispatch exceeded {self.dispatch_timeout_s:.0f}s"
+            ) from None
+
     async def _batched_predict(self, stacked):
         if self._pipelined:
             # concurrency is bounded by the batcher's in-flight slots
@@ -262,12 +281,14 @@ class EngineService:
                 ):
                     rows = arr if arr.ndim >= 2 else arr.reshape(1, -1)
                     try:
-                        y_rows, (routing, tags) = await self.batcher.submit(rows)
+                        y_rows, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
-                        code["code"] = "400"
+                        code["code"] = str(e.http_code)
                         return (
-                            SeldonMessage.failure(str(e), code=400).to_json(),
-                            400,
+                            SeldonMessage.failure(
+                                str(e), code=e.http_code
+                            ).to_json(),
+                            e.http_code,
                         )
                     meta_out = dict(meta_in)
                     meta_out["puid"] = puid
@@ -353,15 +374,15 @@ class EngineService:
                     mode=self.mode,
                 ):
                     try:
-                        y, (routing, tags) = await self.batcher.submit(rows)
+                        y, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
-                        code["code"] = "400"
+                        code["code"] = str(e.http_code)
                         from seldon_core_tpu.protoconv import msg_to_proto
 
                         # echo the request puid, like the object path does
                         return msg_to_proto(
                             SeldonMessage.failure(
-                                str(e), code=400, meta=Meta(puid=puid)
+                                str(e), code=e.http_code, meta=Meta(puid=puid)
                             )
                         ).SerializeToString()
                     if not routing and not tags:
@@ -411,12 +432,12 @@ class EngineService:
                     mode=self.mode,
                 ):
                     try:
-                        y, (routing, tags) = await self.batcher.submit(rows)
+                        y, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
-                        code["code"] = "400"
+                        code["code"] = str(e.http_code)
                         return msg_to_proto(
                             SeldonMessage.failure(
-                                str(e), code=400, meta=Meta(puid=puid)
+                                str(e), code=e.http_code, meta=Meta(puid=puid)
                             )
                         )
                     return self._compose_proto_response(puid, y, routing, tags)
@@ -462,7 +483,7 @@ class EngineService:
                         )
                 if self.batcher is not None and msg.data is not None:
                     rows = np.atleast_2d(msg.array())
-                    y_rows, (routing, tags) = await self.batcher.submit(rows)
+                    y_rows, (routing, tags) = await self._submit(rows)
                     resp = msg.with_array(y_rows, names=self._static_names)
                     # fresh Meta/Status: with_array shares the request's meta
                     # object, and the response must match the unbatched
@@ -487,8 +508,10 @@ class EngineService:
                 else:
                     resp = await self.executor.predict(msg)
             except (SeldonMessageError, GraphSpecError) as e:
-                code["code"] = "400"
-                return SeldonMessage.failure(str(e), code=400, meta=msg.meta)
+                code["code"] = str(getattr(e, "http_code", 400))
+                return SeldonMessage.failure(
+                    str(e), code=getattr(e, "http_code", 400), meta=msg.meta
+                )
             resp.meta.puid = msg.meta.puid
             return resp
 

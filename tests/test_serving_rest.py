@@ -336,3 +336,40 @@ def test_wrong_feature_width_is_400_not_crash():
         assert "shape" in d["status"]["info"]
 
     asyncio.run(run())
+
+
+def test_dispatch_deadline_maps_to_504():
+    """A hung device dispatch must surface as a 504 FAILURE within the
+    engine deadline, not a request that never returns (the reference's
+    5 s per-call budget, InternalPredictionService.java:77)."""
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [{"name": "hidden", "value": "16",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+    engine = EngineService(spec, dispatch_timeout_s=0.2)
+
+    async def hung(_chunk):
+        await asyncio.sleep(60)
+
+    engine.batcher.batch_fn = hung  # simulate a wedged relay/device
+
+    async def run():
+        text, status = await engine.predict_json(
+            json.dumps({"data": {"ndarray": [[0.0] * 784]}})
+        )
+        assert status == 504
+        d = json.loads(text)
+        assert d["status"]["status"] == "FAILURE"
+        assert "exceeded" in d["status"]["info"]
+
+    asyncio.run(run())
