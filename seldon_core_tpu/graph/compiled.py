@@ -296,7 +296,7 @@ class CompiledGraph:
     # ------------------------------------------------------------------
 
     def predict_arrays(
-        self, X, update_states: bool = True
+        self, X, update_states=True
     ) -> Tuple[Any, Dict[str, int], Dict[str, Any]]:
         """Run the compiled graph; returns (Y, routing, tags) and advances the
         held unit states.
@@ -305,7 +305,10 @@ class CompiledGraph:
         updates state on predict the returned states equal the inputs, and
         skipping the read-modify-write lets the engine pipeline several
         in-flight dispatches without a stale write-back clobbering a
-        concurrent feedback update."""
+        concurrent feedback update.  A callable is evaluated AFTER the
+        device round-trip, letting the engine veto a write-back whose
+        request already timed out (the client saw a 504 — a late state
+        update would double-apply on retry)."""
         y, new_states, routing, tags = self._jit_predict(self.states, jnp.asarray(X))
         routing_py = {
             k: int(v) for k, v in routing.items() if int(v) != NOT_ROUTED
@@ -321,7 +324,7 @@ class CompiledGraph:
                     f"{self._router_children[r]} children (broadcast routing is "
                     f"host-mode only)"
                 )
-        if update_states:
+        if update_states() if callable(update_states) else update_states:
             self.states = new_states
         return y, routing_py, tags
 

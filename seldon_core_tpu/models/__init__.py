@@ -2,7 +2,11 @@
 MNIST classifiers (flagship), iris classifier, epsilon-greedy bandit router,
 Mahalanobis streaming outlier detector."""
 
-from seldon_core_tpu.models.mnist import MnistClassifier, MnistCNN  # noqa: F401
+from seldon_core_tpu.models.mnist import (  # noqa: F401
+    MnistClassifier,
+    MnistCNN,
+    QuantizedMnistClassifier,
+)
 from seldon_core_tpu.models.iris import IrisClassifier  # noqa: F401
 from seldon_core_tpu.models.mab import EpsilonGreedyRouter  # noqa: F401
 from seldon_core_tpu.models.outlier import MahalanobisOutlier  # noqa: F401

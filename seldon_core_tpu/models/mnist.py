@@ -26,7 +26,8 @@ import jax.numpy as jnp
 
 from seldon_core_tpu.graph.units import Unit, register_unit
 
-__all__ = ["MnistClassifier", "MnistCNN", "mlp_init", "mlp_apply", "train_step"]
+__all__ = ["MnistClassifier", "QuantizedMnistClassifier", "MnistCNN",
+           "mlp_init", "mlp_apply", "train_step"]
 
 NUM_CLASSES = 10
 INPUT_DIM = 784
@@ -146,6 +147,24 @@ class MnistClassifier(Unit):
             except ValueError:
                 pass  # shape/VMEM constraints — XLA path below
         return jax.nn.softmax(mlp_apply(state, X), axis=-1)
+
+
+@register_unit("QuantizedMnistClassifier")
+class QuantizedMnistClassifier(MnistClassifier):
+    """Int8 serving variant: weights quantize once at init (symmetric
+    per-channel), activations quantize per row at predict, matmuls run
+    int8 x int8 -> int32 on the MXU (ops/quant.py) — ~2x MXU rate and half
+    the weight HBM traffic vs bf16, argmax-stable for classifier heads."""
+
+    def init_state(self, rng):
+        from seldon_core_tpu.ops.quant import quantize_mlp_params
+
+        return quantize_mlp_params(super().init_state(rng))
+
+    def predict(self, state, X):
+        from seldon_core_tpu.ops.quant import QuantizedMLP
+
+        return QuantizedMLP.apply(state, X.reshape(X.shape[0], -1))
 
 
 @register_unit("MnistCNN")

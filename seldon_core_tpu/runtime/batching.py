@@ -64,8 +64,13 @@ class MicroBatcher:
         pad_to_buckets: bool = True,
         max_inflight: int = 1,
         coalesce_ms: float = 0.5,
+        dispatch_timeout_s: float = 0.0,
     ):
         self.batch_fn = batch_fn
+        # >0: abandon a dispatch after this long so its in-flight slot frees
+        # (a wedged device must not wedge the whole queue); the engine's
+        # state-write gate separately vetoes the late write-back
+        self.dispatch_timeout_s = float(dispatch_timeout_s)
         self.max_batch = int(max_batch)
         self.coalesce_s = min(float(coalesce_ms), float(max_wait_ms)) / 1e3
         # pad stacked batches up to power-of-two sizes so jit sees a handful
@@ -159,7 +164,20 @@ class MicroBatcher:
                 if target > n:
                     pad = np.repeat(chunk[-1:], target - n, axis=0)
                     chunk = np.concatenate([chunk, pad], axis=0)
-            ys, chunk_aux = await self.batch_fn(chunk)
+            if self.dispatch_timeout_s > 0:
+                try:
+                    ys, chunk_aux = await asyncio.wait_for(
+                        self.batch_fn(chunk), self.dispatch_timeout_s
+                    )
+                except asyncio.TimeoutError:
+                    from seldon_core_tpu.messages import DispatchTimeoutError
+
+                    raise DispatchTimeoutError(
+                        f"device dispatch exceeded "
+                        f"{self.dispatch_timeout_s:.1f}s"
+                    ) from None
+            else:
+                ys, chunk_aux = await self.batch_fn(chunk)
             ys_parts.append(np.asarray(ys)[:n])
             # per-row aux re-based to the unpadded chunk, then accumulated
             chunk_aux = _slice_aux(chunk_aux, slice(0, n), len(chunk))

@@ -163,6 +163,10 @@ class EngineService:
                 max_wait_ms=max_wait_ms,
                 pad_to_buckets=pad_ok,
                 max_inflight=pipeline_depth if self._pipelined else 1,
+                # backstop slightly above the per-request deadline: frees
+                # the in-flight slot of a wedged dispatch after callers
+                # have already received their 504s
+                dispatch_timeout_s=self.dispatch_timeout_s * 1.5,
             )
             # batchable graphs have no routers, so the executed path — and
             # therefore the output names — never varies per request
@@ -211,24 +215,38 @@ class EngineService:
             ) from None
 
     async def _batched_predict(self, stacked):
+        import time as _time
+
+        deadline = _time.monotonic() + self.dispatch_timeout_s
         if self._pipelined:
             # concurrency is bounded by the batcher's in-flight slots
             return await asyncio.get_running_loop().run_in_executor(
-                None, self._batched_predict_sync, stacked
+                None, self._batched_predict_sync, stacked, deadline
             )
         async with self._device_lock:
             return await asyncio.get_running_loop().run_in_executor(
-                None, self._batched_predict_sync, stacked
+                None, self._batched_predict_sync, stacked, deadline
             )
 
-    def _batched_predict_sync(self, stacked):
+    def _batched_predict_sync(self, stacked, deadline=None):
+        import time as _time
+
         with self.tracer.span(
             "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
         ):
             width = stacked.shape[1:]
+            # state write-back is vetoed AFTER the device round-trip if the
+            # request already timed out (client saw 504; a late update
+            # would double-apply on retry) — evaluated post-dispatch via
+            # the callable form of update_states
+            gate = (
+                (lambda: _time.monotonic() < deadline)
+                if (not self._pipelined and deadline is not None)
+                else (not self._pipelined)
+            )
             try:
                 y, routing, tags = self.compiled.predict_arrays(
-                    stacked, update_states=not self._pipelined
+                    stacked, update_states=gate
                 )
             except (TypeError, ValueError) as e:
                 if width in self._known_good_widths:
@@ -286,7 +304,8 @@ class EngineService:
                         code["code"] = str(e.http_code)
                         return (
                             SeldonMessage.failure(
-                                str(e), code=e.http_code
+                                str(e), code=e.http_code,
+                                meta=Meta(puid=puid),
                             ).to_json(),
                             e.http_code,
                         )
@@ -332,11 +351,7 @@ class EngineService:
                     # native formatter declined (NaN/Inf in the result) —
                     # serialize the SAME result through the object codec; a
                     # re-dispatch would double-update streaming-stats state
-                    from seldon_core_tpu.messages import (
-                        DefaultData,
-                        Meta,
-                        Status,
-                    )
+                    from seldon_core_tpu.messages import DefaultData, Status
 
                     resp = SeldonMessage(
                         meta=Meta.from_json_dict(meta_out),

@@ -1,0 +1,90 @@
+"""Int8 quantized serving: weight/activation quantization numerics, argmax
+stability vs the f32 MLP, and the quantized unit through the engine."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.models.mnist import MnistClassifier, QuantizedMnistClassifier
+from seldon_core_tpu.ops.quant import (
+    QuantizedMLP,
+    quant_matmul,
+    quantize_mlp_params,
+    quantize_weight,
+)
+
+
+def test_quantize_weight_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    w_q, s = quantize_weight(w)
+    assert w_q.dtype == jnp.int8 and s.shape == (32,)
+    deq = np.asarray(w_q, np.float32) * np.asarray(s)[None, :]
+    err = np.abs(deq - np.asarray(w)).max()
+    # per-channel symmetric: error bounded by half a quantization step
+    assert err <= float(np.asarray(s).max()) * 0.5 + 1e-6
+
+
+def test_quant_matmul_close_to_f32():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    w_q, s = quantize_weight(w)
+    got = np.asarray(quant_matmul(x, w_q, s))
+    ref = np.asarray(x @ w)
+    # relative error ~1% for int8 dynamic quantization
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 0.02
+
+
+def test_quantized_mlp_argmax_agrees_with_f32():
+    f32_unit = MnistClassifier(hidden=64, depth=2, dtype="float32",
+                               use_pallas="never")
+    q_unit = QuantizedMnistClassifier(hidden=64, depth=2, dtype="float32",
+                                      use_pallas="never")
+    f32_state = f32_unit.init_state(jax.random.key(0))
+    q_state = q_unit.init_state(jax.random.key(0))
+    X = jnp.asarray(np.random.default_rng(2).normal(size=(256, 784)),
+                    jnp.float32)
+    p_f32 = np.asarray(f32_unit.predict(f32_state, X))
+    p_q = np.asarray(q_unit.predict(q_state, X))
+    np.testing.assert_allclose(p_q.sum(axis=1), 1.0, atol=1e-5)
+    # an untrained random MLP is the WORST case for argmax stability (its
+    # logits are near-uniform, so borderline rows flip on tiny noise);
+    # probabilities must still be close and agreement high
+    assert np.abs(p_f32 - p_q).max() < 0.05
+    agree = (p_f32.argmax(1) == p_q.argmax(1)).mean()
+    assert agree >= 0.95, f"argmax agreement {agree}"
+
+
+def test_quantized_unit_serves_through_engine():
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "q", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "QuantizedMnistClassifier",
+                "parameters": [{"name": "hidden", "value": "32",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+    engine = EngineService(spec)
+    assert engine.mode == "compiled" and engine.batcher is not None
+
+    async def run():
+        text, status = await engine.predict_json(
+            json.dumps({"data": {"ndarray": np.zeros((2, 784)).tolist()}})
+        )
+        assert status == 200
+        probs = np.asarray(json.loads(text)["data"]["ndarray"])
+        assert probs.shape == (2, 10)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    asyncio.run(run())
