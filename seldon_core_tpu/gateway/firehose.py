@@ -78,3 +78,71 @@ class Firehose:
                 self.dropped += 1
             finally:
                 self._queue.task_done()
+
+
+def main(argv=None) -> None:
+    """Consumer CLI — the reference's Kafka reader example
+    (kafka/tests/src/read_predictions.py:22-30): stream a deployment's
+    request/response log, one summarised line per event.
+
+        python -m seldon_core_tpu.gateway.firehose <deployment> [--follow]
+    """
+    import argparse
+    import sys
+    import time as _time
+
+    parser = argparse.ArgumentParser(description="firehose consumer")
+    parser.add_argument("deployment", help="deployment id (topic)")
+    parser.add_argument("--dir", default=None, help="firehose base dir")
+    parser.add_argument("--follow", action="store_true", help="tail -f mode")
+    parser.add_argument("--raw", action="store_true", help="print full JSONL")
+    args = parser.parse_args(argv)
+    base = args.dir or os.environ.get(
+        "SELDON_TPU_FIREHOSE_DIR", os.path.expanduser("~/.seldon_tpu_firehose")
+    )
+    path = os.path.join(base, f"{args.deployment}.jsonl")
+    if not os.path.exists(path) and not args.follow:
+        raise SystemExit(f"no firehose log at {path}")
+
+    def emit(line: str) -> None:
+        line = line.strip()
+        if not line:
+            return
+        if args.raw:
+            sys.stdout.write(line + "\n")
+            return
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            return
+        status = ((ev.get("response") or {}).get("status") or {})
+        sys.stdout.write(
+            f"{ev.get('ts', 0):.3f} puid={ev.get('puid', '')} "
+            f"status={status.get('status', 'SUCCESS')}\n"
+        )
+
+    pos = 0
+    while True:
+        if os.path.exists(path):
+            with open(path) as f:
+                f.seek(pos)
+                while True:
+                    line_start = f.tell()
+                    line = f.readline()
+                    if not line:
+                        break
+                    if not line.endswith("\n"):
+                        # producer mid-write: hold the fragment back and
+                        # re-read the whole line once it is terminated
+                        pos = line_start
+                        break
+                    emit(line)
+                    pos = f.tell()
+        if not args.follow:
+            break
+        _time.sleep(1.0)
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -324,7 +324,15 @@ class CompiledGraph:
                     f"{self._router_children[r]} children (broadcast routing is "
                     f"host-mode only)"
                 )
-        if update_states() if callable(update_states) else update_states:
+        if callable(update_states):
+            # the gate decides based on wall time AFTER the device work
+            # finished — JAX dispatch is async, so without forcing here the
+            # gate would fire microseconds after enqueue and always pass
+            jax.block_until_ready(new_states)
+            do_update = update_states()
+        else:
+            do_update = update_states
+        if do_update:
             self.states = new_states
         return y, routing_py, tags
 

@@ -65,12 +65,17 @@ class MicroBatcher:
         max_inflight: int = 1,
         coalesce_ms: float = 0.5,
         dispatch_timeout_s: float = 0.0,
+        atomic_chunks: bool = False,
     ):
         self.batch_fn = batch_fn
         # >0: abandon a dispatch after this long so its in-flight slot frees
         # (a wedged device must not wedge the whole queue); the engine's
         # state-write gate separately vetoes the late write-back
         self.dispatch_timeout_s = float(dispatch_timeout_s)
+        # True for stateful graphs: a request split over several chunks
+        # would commit state per chunk, so a mid-request failure leaves it
+        # partially applied — reject oversized requests instead
+        self.atomic_chunks = bool(atomic_chunks)
         self.max_batch = int(max_batch)
         self.coalesce_s = min(float(coalesce_ms), float(max_wait_ms)) / 1e3
         # pad stacked batches up to power-of-two sizes so jit sees a handful
@@ -109,6 +114,11 @@ class MicroBatcher:
                 bucket = self._buckets.get(key)
                 take, rows = [], 0
                 while bucket and rows < self.max_batch:
+                    # never let a COALESCED stack exceed max_batch (only a
+                    # single oversized request may, and then it is alone in
+                    # the batch, so multi-chunk dispatch stays per-request)
+                    if take and rows + len(bucket[0][0]) > self.max_batch:
+                        break
                     entry = bucket.popleft()
                     take.append(entry)
                     rows += len(entry[0])
@@ -154,6 +164,14 @@ class MicroBatcher:
         not produce unbounded compiled shapes), padding each chunk up to a
         power of two when allowed."""
         total = len(stacked)
+        if self.atomic_chunks and total > self.max_batch:
+            from seldon_core_tpu.messages import SeldonMessageError
+
+            raise SeldonMessageError(
+                f"request of {total} rows exceeds max_batch "
+                f"({self.max_batch}) for a stateful graph — state updates "
+                f"must apply atomically per request"
+            )
         ys_parts = []
         aux = None
         for start in range(0, total, self.max_batch):

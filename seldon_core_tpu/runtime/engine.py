@@ -20,6 +20,7 @@ graceful drain (engine RestClientController.java:57-99), feedback counters
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -164,9 +165,15 @@ class EngineService:
                 pad_to_buckets=pad_ok,
                 max_inflight=pipeline_depth if self._pipelined else 1,
                 # backstop slightly above the per-request deadline: frees
-                # the in-flight slot of a wedged dispatch after callers
-                # have already received their 504s
-                dispatch_timeout_s=self.dispatch_timeout_s * 1.5,
+                # the in-flight slot of a wedged dispatch after callers got
+                # their 504s.  Stateless (pipelined) dispatches only — for
+                # stateful graphs an abandoned dispatch could still write
+                # state late, so they rely on the post-round-trip gate
+                dispatch_timeout_s=(
+                    self.dispatch_timeout_s * 1.5 if self._pipelined else 0.0
+                ),
+                # stateful graphs must apply state atomically per request
+                atomic_chunks=not pad_ok,
             )
             # batchable graphs have no routers, so the executed path — and
             # therefore the output names — never varies per request
@@ -215,9 +222,7 @@ class EngineService:
             ) from None
 
     async def _batched_predict(self, stacked):
-        import time as _time
-
-        deadline = _time.monotonic() + self.dispatch_timeout_s
+        deadline = time.monotonic() + self.dispatch_timeout_s
         if self._pipelined:
             # concurrency is bounded by the batcher's in-flight slots
             return await asyncio.get_running_loop().run_in_executor(
@@ -229,8 +234,6 @@ class EngineService:
             )
 
     def _batched_predict_sync(self, stacked, deadline=None):
-        import time as _time
-
         with self.tracer.span(
             "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
         ):
@@ -240,7 +243,7 @@ class EngineService:
             # would double-apply on retry) — evaluated post-dispatch via
             # the callable form of update_states
             gate = (
-                (lambda: _time.monotonic() < deadline)
+                (lambda: time.monotonic() < deadline)
                 if (not self._pipelined and deadline is not None)
                 else (not self._pipelined)
             )

@@ -372,3 +372,53 @@ def test_predict_json_failure_and_fallbacks():
         assert "meta" in doc
 
     asyncio.run(run())
+
+
+def test_stateful_graph_rejects_oversized_request():
+    """A request larger than max_batch on a stateful graph must fail
+    loudly: splitting it into chunks would commit state per chunk, and a
+    mid-request failure would leave it partially applied."""
+    import asyncio
+    import json
+
+    import numpy as np
+
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "d", "predictors": [{
+            "name": "p",
+            "graph": {
+                "name": "out", "type": "TRANSFORMER",
+                "children": [{"name": "m", "type": "MODEL"}],
+            },
+            "components": [
+                {"name": "out", "runtime": "inprocess",
+                 "class_path": "MahalanobisOutlier",
+                 "parameters": [{"name": "n_features", "value": "8",
+                                 "type": "INT"}]},
+                {"name": "m", "runtime": "inprocess",
+                 "class_path": "MeanClassifier"},
+            ],
+        }]}
+    })
+    engine = EngineService(spec, max_batch=16)
+    assert engine.batcher is not None
+    assert engine.batcher.atomic_chunks  # streaming stats => stateful
+
+    async def run():
+        big = np.zeros((40, 8)).tolist()
+        text, status = await engine.predict_json(
+            json.dumps({"data": {"ndarray": big}})
+        )
+        assert status == 400
+        assert "atomically" in json.loads(text)["status"]["info"]
+        # within-limit requests still serve
+        ok = np.zeros((8, 8)).tolist()
+        text, status = await engine.predict_json(
+            json.dumps({"data": {"ndarray": ok}})
+        )
+        assert status == 200
+
+    asyncio.run(run())

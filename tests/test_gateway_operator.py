@@ -317,3 +317,38 @@ def test_watch_dir_writes_status_files(tmp_path):
         assert status["predictorStatus"][0]["name"] == "p"
     finally:
         m.shutdown()
+
+
+def test_firehose_consumer_holds_back_partial_lines(tmp_path):
+    """The --follow consumer must not consume a line the producer is still
+    writing (no trailing newline yet): held back, then read whole."""
+    import io
+    import sys
+
+    from seldon_core_tpu.gateway import firehose as fh_mod
+
+    log = tmp_path / "dep.jsonl"
+    full = '{"puid":"a","ts":1.0,"response":{"status":{"status":"SUCCESS"}}}\n'
+    log.write_text(full + '{"puid":"b","ts":2.0')  # second line mid-write
+
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        fh_mod.main(["dep", "--dir", str(tmp_path)])
+    finally:
+        sys.stdout = old
+    assert "puid=a" in out.getvalue()
+    assert "puid=b" not in out.getvalue()  # fragment held back, not dropped
+
+    # once terminated, a re-read from the held position sees it whole
+    log.write_text(
+        full + '{"puid":"b","ts":2.0,"response":{"status":{"status":"SUCCESS"}}}\n'
+    )
+    out2 = io.StringIO()
+    sys.stdout = out2
+    try:
+        fh_mod.main(["dep", "--dir", str(tmp_path)])
+    finally:
+        sys.stdout = old
+    assert "puid=b" in out2.getvalue()
