@@ -22,6 +22,12 @@ from seldon_core_tpu.messages import SeldonMessage
 __all__ = ["Firehose"]
 
 
+def _default_base_dir() -> str:
+    return os.environ.get(
+        "SELDON_TPU_FIREHOSE_DIR", os.path.expanduser("~/.seldon_tpu_firehose")
+    )
+
+
 class Firehose:
     def __init__(
         self,
@@ -29,9 +35,7 @@ class Firehose:
         sink: Optional[Callable[[str, dict], None]] = None,
         max_queue: int = 4096,
     ):
-        self.base_dir = base_dir or os.environ.get(
-            "SELDON_TPU_FIREHOSE_DIR", os.path.expanduser("~/.seldon_tpu_firehose")
-        )
+        self.base_dir = base_dir or _default_base_dir()
         self.sink = sink
         self.dropped = 0
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
@@ -97,9 +101,7 @@ def main(argv=None) -> None:
     parser.add_argument("--follow", action="store_true", help="tail -f mode")
     parser.add_argument("--raw", action="store_true", help="print full JSONL")
     args = parser.parse_args(argv)
-    base = args.dir or os.environ.get(
-        "SELDON_TPU_FIREHOSE_DIR", os.path.expanduser("~/.seldon_tpu_firehose")
-    )
+    base = args.dir or _default_base_dir()
     path = os.path.join(base, f"{args.deployment}.jsonl")
     if not os.path.exists(path) and not args.follow:
         raise SystemExit(f"no firehose log at {path}")
@@ -124,6 +126,8 @@ def main(argv=None) -> None:
     pos = 0
     while True:
         if os.path.exists(path):
+            if os.path.getsize(path) < pos:
+                pos = 0  # truncated/rotated: restart from the top
             with open(path) as f:
                 f.seek(pos)
                 while True:

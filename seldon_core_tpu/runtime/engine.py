@@ -166,12 +166,11 @@ class EngineService:
                 max_inflight=pipeline_depth if self._pipelined else 1,
                 # backstop slightly above the per-request deadline: frees
                 # the in-flight slot of a wedged dispatch after callers got
-                # their 504s.  Stateless (pipelined) dispatches only — for
-                # stateful graphs an abandoned dispatch could still write
-                # state late, so they rely on the post-round-trip gate
-                dispatch_timeout_s=(
-                    self.dispatch_timeout_s * 1.5 if self._pipelined else 0.0
-                ),
+                # their 504s.  Safe for stateful graphs too: abandonment
+                # happens at 1.5x the deadline, so any late write-back is
+                # post-deadline and the completion-forcing state gate
+                # vetoes it
+                dispatch_timeout_s=self.dispatch_timeout_s * 1.5,
                 # stateful graphs must apply state atomically per request
                 atomic_chunks=not pad_ok,
             )

@@ -81,7 +81,7 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
     rest_port = rest_port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
     grpc_port = grpc_port or int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "5001"))
     engine = EngineService(deployment, predictor_name)
-    await serve_app(make_engine_app(engine), host, rest_port)
+    runner = await serve_app(make_engine_app(engine), host, rest_port)
     grpc_server = make_engine_grpc_server(engine, host, grpc_port)
     await grpc_server.start()
     print(
@@ -89,7 +89,43 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         f"rest=:{rest_port} grpc=:{grpc_port}",
         flush=True,
     )
-    await asyncio.Event().wait()
+
+    # graceful shutdown: SIGTERM/SIGINT flips readiness and drains before
+    # exit — the reference's Tomcat drain (App.java:85-95, 20 s) + pre-stop
+    # pause contract, built into the process itself
+    import signal
+
+    stop = asyncio.Event()
+    hurry = asyncio.Event()  # second signal: skip the drain
+    loop = asyncio.get_running_loop()
+
+    def _on_signal():
+        if stop.is_set():
+            hurry.set()
+        else:
+            stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, _on_signal)
+        except (NotImplementedError, RuntimeError):
+            pass  # platforms without signal support: external kill only
+    await stop.wait()
+    drain_s = float(os.environ.get("ENGINE_SHUTDOWN_DRAIN_S", "20"))
+    print(
+        f"engine draining: {drain_s:.0f}s (readiness now 503; "
+        f"signal again to skip)",
+        flush=True,
+    )
+    engine.pause()  # /ready -> 503; the LB stops routing here
+    try:
+        await asyncio.wait_for(hurry.wait(), drain_s)
+        print("drain skipped by second signal", flush=True)
+    except asyncio.TimeoutError:
+        pass  # full drain window elapsed
+    await grpc_server.stop(grace=5.0)
+    await runner.cleanup()
+    print("engine stopped", flush=True)
 
 
 def main(argv=None) -> None:
