@@ -240,6 +240,13 @@ def main() -> None:
             _deployment(g, c), payload, clients, max(duration / 2, 3.0),
             max_wait_ms=3.0, max_batch=128, pipeline_depth=8,
         )
+        # north star (BASELINE.md): ensemble QPS stays flat as members grow
+        # because the fan-out happens on-device, not over the network
+        g, c = _mnist_graph(8)
+        ens8 = await _bench_engine(
+            _deployment(g, c), payload, clients, max(duration / 2, 3.0),
+            max_wait_ms=3.0, max_batch=128, pipeline_depth=8,
+        )
         # gRPC data path (proto wire in/out through the engine handler),
         # Tensor form — packed doubles, same as the reference's locust gRPC
         # script (util/loadtester/scripts/predict_grpc_locust.py:127-131)
@@ -261,9 +268,9 @@ def main() -> None:
             )
             if grpc_r is None or gr["qps"] > grpc_r["qps"]:
                 grpc_r = gr
-        return single, high, ens4, hi_clients, grpc_r
+        return single, high, ens4, ens8, hi_clients, grpc_r
 
-    single, high, ens4, hi_clients, grpc_r = asyncio.run(run_all())
+    single, high, ens4, ens8, hi_clients, grpc_r = asyncio.run(run_all())
 
     # LLM-style generation throughput (no reference counterpart: the
     # reference predates sequence models).  One KV-cache decode of B x N
@@ -311,6 +318,8 @@ def main() -> None:
         "p99_ms": round(single["p99_ms"], 2),
         "ensemble4_qps": round(ens4["qps"], 1),
         "ensemble4_p50_ms": round(ens4["p50_ms"], 2),
+        "ensemble8_qps": round(ens8["qps"], 1),
+        "ensemble8_p50_ms": round(ens8["p50_ms"], 2),
         "grpc_path_qps": round(grpc_r["qps"], 1),
         "grpc_vs_baseline": round(grpc_r["qps"] / REFERENCE_GRPC_QPS, 4),
         "gen_tokens_per_s": round(gen_tps, 1),
