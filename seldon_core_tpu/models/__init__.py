@@ -17,3 +17,4 @@ from seldon_core_tpu.models.tabular import (  # noqa: F401
     SigmoidPredictor,
 )
 from seldon_core_tpu.models.generate import TransformerGenerator  # noqa: F401
+from seldon_core_tpu.models.speculative import speculative_generate  # noqa: F401

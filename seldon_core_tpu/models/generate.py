@@ -65,11 +65,28 @@ def _attend_cached(q, cache_k, cache_v, n_valid):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cache_v.dtype), cache_v)
 
 
+def _attend_cached_causal(q, cache_k, cache_v, start):
+    """q [B,H,S,hd] for global positions start..start+S-1 over the cache:
+    query i may see cache positions <= start + i (speculative segments)."""
+    S = q.shape[2]
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   cache_k.astype(jnp.float32)) * scale
+    qpos = start + jnp.arange(S)[:, None]
+    kpos = jnp.arange(cache_k.shape[2])[None, :]
+    mask = kpos <= qpos  # [S, max_len]
+    s = jnp.where(mask[None, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cache_v.dtype), cache_v)
+
+
 def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
-                  use_flash: bool = False):
+                  use_flash: bool = False, segment: bool = False):
     """One decoder block writing K/V into the cache at ``start`` and
     attending over cache[:n_valid].  x [B,S,D]; returns (x', cache_layer').
-    S > 1 means prefill from position 0; S == 1 is a cached decode step."""
+    S > 1 with ``segment=False`` means prefill from position 0; with
+    ``segment=True`` a mid-sequence continuation at traced offset ``start``
+    attending causally over the cache; S == 1 is a cached decode step."""
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
     h = _rmsnorm(x, lp["ln1"])
@@ -82,7 +99,11 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
     cache_v = jax.lax.dynamic_update_slice(
         cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, 0, start, 0)
     )
-    if S > 1:
+    if segment:
+        # mid-sequence continuation (speculative draft/verify): causal over
+        # the whole cache with global position offsets (any S, traced start)
+        a = _attend_cached_causal(q, cache_k, cache_v, start)
+    elif S > 1:
         # prefill: causal attention over the fresh k/v only — the cache
         # tail past S is all-masked zeros, no need to attend over it.
         # Reuses the LM's _attention (flash kernel when available, same
@@ -98,19 +119,29 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
     return x, {"k": cache_k, "v": cache_v}
 
 
+def segment_forward(params, tokens, cache, start, cfg: LMConfig,
+                    use_flash: bool = False, segment: bool = True):
+    """Forward S tokens at global positions start.. over the cache
+    (filling it); returns (logits [B, S, V] for EVERY position, cache').
+    ``segment=False`` is the prefill special case (start must be 0)."""
+    x = params["embed"][tokens]
+    for i in range(cfg.n_layers):
+        x, cache[f"l{i}"] = _block_cached(
+            params[f"l{i}"], x, cache[f"l{i}"], start, tokens.shape[1], cfg,
+            use_flash, segment,
+        )
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["embed"].T).astype(jnp.float32), cache
+
+
 def prefill(params, tokens, cache, cfg: LMConfig, use_flash: bool = False):
     """Consume the prompt in one pass, filling the cache.
 
     tokens [B, S_prompt] -> (last-position logits [B, V], cache')."""
-    B, S = tokens.shape
-    x = params["embed"][tokens]
-    for i in range(cfg.n_layers):
-        x, cache[f"l{i}"] = _block_cached(
-            params[f"l{i}"], x, cache[f"l{i}"], 0, S, cfg, use_flash
-        )
-    x = _rmsnorm(x, params["ln_f"])
-    logits = (x[:, -1, :] @ params["embed"].T).astype(jnp.float32)
-    return logits, cache
+    logits, cache = segment_forward(
+        params, tokens, cache, 0, cfg, use_flash, segment=False
+    )
+    return logits[:, -1, :], cache
 
 
 def decode_step(params, token, cache, pos, cfg: LMConfig):

@@ -1,0 +1,130 @@
+"""Speculative decoding — draft/verify generation, exact under greedy.
+
+A small draft LM proposes ``k`` tokens with its own KV cache; the target LM
+scores all ``k+1`` positions in ONE forward (one MXU pass instead of k+1
+sequential decode steps); the longest prefix where the draft matched the
+target's argmax is accepted plus one corrected token.  Greedy acceptance is
+exact in exact arithmetic: the output equals vanilla greedy decoding of the
+target token-for-token (pinned bit-exact by the f32 tests).  In low
+precision an argmax near-tie can flip between the S=1 and S=k+1 segment
+forwards (different reduction orders), so bf16 outputs may diverge at tie
+positions — same-quality tokens, not errors.  The target runs
+~(accepted+1)x fewer sequential passes; acceptance rate tracks how well
+the draft approximates the target (an unrelated random draft accepts ~0).
+
+TPU shape: the whole loop is one ``lax.while_loop`` under jit — draft scan,
+target segment-verify, acceptance, cache advance — so an entire generation
+is still a single device dispatch.  Caches are preallocated; partially
+rejected segments need no rewind because attention masks by global position
+and later segments overwrite the stale tail (``dynamic_update_slice``).
+
+Batch: size 1 (the latency-critical case speculative decoding exists for);
+larger batches raise — ragged per-row acceptance would need per-row cache
+offsets.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.generate import init_cache, segment_forward
+from seldon_core_tpu.models.transformer import LMConfig
+
+__all__ = ["speculative_generate"]
+
+
+def speculative_generate(
+    target_params,
+    draft_params,
+    prompt,
+    target_cfg: LMConfig,
+    draft_cfg: LMConfig,
+    max_new_tokens: int = 32,
+    k: int = 4,
+) -> Tuple[jax.Array, jax.Array]:
+    """prompt [1, S] int32 -> (tokens [1, max_new_tokens] int32,
+    rounds int32 — verify passes used; ~max_new/rounds tokens per target
+    pass, vs exactly 1 for vanilla decoding).
+
+    Greedy only; output is exactly vanilla greedy decoding of the target.
+    """
+    B, S = prompt.shape
+    if B != 1:
+        raise ValueError("speculative_generate supports batch size 1")
+    max_len = S + max_new_tokens + k + 2
+    t_cache = init_cache(target_cfg, B, max_len)
+    d_cache = init_cache(draft_cfg, B, max_len)
+
+    # prefill both models on the prompt; last-position argmax = first token
+    t_logits, t_cache = segment_forward(
+        target_params, prompt, t_cache, 0, target_cfg, segment=False)
+    _d_logits, d_cache = segment_forward(
+        draft_params, prompt, d_cache, 0, draft_cfg, segment=False)
+    first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [1]
+
+    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
+    out = out.at[0].set(first[0])
+
+    def cond(carry):
+        n, *_ = carry
+        return n < max_new_tokens
+
+    def body(carry):
+        n, rounds, out, t_cache, d_cache = carry
+        # positions: the last accepted token sits at global index S + n - 1
+        last = jax.lax.dynamic_index_in_dim(
+            out, n - 1, 0, keepdims=False
+        )  # newest token (scalar)
+
+        # -- draft proposes k tokens with its cache ------------------------
+        # k+1 steps: the extra step writes the KV of the LAST proposal so a
+        # fully-accepted round leaves no cache hole behind (holes would
+        # degrade every later round's acceptance); its proposal is unused
+        def draft_step(c, i):
+            tok, d_cache = c
+            logits, d_cache = segment_forward(
+                draft_params, tok[None, None], d_cache, S + n - 1 + i,
+                draft_cfg)
+            nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
+            return (nxt, d_cache), nxt
+
+        (_, d_cache), proposals = jax.lax.scan(
+            draft_step, (last, d_cache), jnp.arange(k + 1))  # [k+1]
+        draft_toks = proposals[:k]
+
+        # -- target verifies last + k draft tokens in ONE forward ----------
+        seg = jnp.concatenate([last[None], draft_toks])[None, :]  # [1, k+1]
+        t_logits, t_cache = segment_forward(
+            target_params, seg, t_cache, S + n - 1, target_cfg)
+        t_argmax = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+
+        # greedy acceptance: longest prefix where draft == target argmax
+        match = draft_toks == t_argmax[:k]
+        accepted = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((1,), bool)])
+        )  # first False; k if all matched
+        # tokens gained this round: accepted drafts + 1 corrected/extended
+        new_toks = jnp.where(
+            jnp.arange(k + 1) < accepted,
+            jnp.concatenate([draft_toks, jnp.zeros((1,), jnp.int32)]),
+            jnp.broadcast_to(
+                jax.lax.dynamic_index_in_dim(
+                    t_argmax, accepted, 0, keepdims=False
+                ),
+                (k + 1,),
+            ),
+        )  # positions > accepted are garbage; masked by the write below
+        gained = accepted + 1
+        keep = jnp.arange(k + 1) < gained
+        cur = jax.lax.dynamic_slice_in_dim(out, n, k + 1)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, jnp.where(keep, new_toks, cur), n, 0)
+        return n + gained, rounds + 1, out, t_cache, d_cache
+
+    n0 = jnp.int32(1)
+    n, rounds, out, _, _ = jax.lax.while_loop(
+        cond, body, (n0, jnp.int32(0), out, t_cache, d_cache))
+    return out[:max_new_tokens][None, :], rounds
