@@ -17,4 +17,7 @@ from seldon_core_tpu.models.tabular import (  # noqa: F401
     SigmoidPredictor,
 )
 from seldon_core_tpu.models.generate import TransformerGenerator  # noqa: F401
-from seldon_core_tpu.models.speculative import speculative_generate  # noqa: F401
+from seldon_core_tpu.models.speculative import (  # noqa: F401
+    SpeculativeGenerator,
+    speculative_generate,
+)

@@ -54,6 +54,16 @@ def _heads(t, B, S, H, hd):
     return t.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
 
 
+def sanitize_prompt(X, vocab: int):
+    """Float wire rows -> int32 token ids in [0, vocab).
+
+    nan_to_num then clip in float space BEFORE the cast: float->int32 of
+    NaN or out-of-range values is implementation-defined in XLA (wrap vs
+    saturate varies by backend); after this chain the cast input is always
+    a finite value in range."""
+    return jnp.clip(jnp.nan_to_num(X), 0, vocab - 1).astype(jnp.int32)
+
+
 def _attend_cached(q, cache_k, cache_v, n_valid):
     """q [B,H,1,hd] against the cache; positions >= n_valid (scalar) masked."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
@@ -253,13 +263,7 @@ class TransformerGenerator(Unit):
     def predict(self, state, X):
         from seldon_core_tpu.ops.fused_mlp import pallas_supported
 
-        # nan_to_num then clip in float space BEFORE the cast: float->int32
-        # of NaN or out-of-range values is implementation-defined in XLA
-        # (wrap vs saturate varies by backend); after this chain the cast
-        # input is always a finite value in [0, vocab)
-        prompt = jnp.clip(
-            jnp.nan_to_num(X), 0, self.cfg.vocab - 1
-        ).astype(jnp.int32)
+        prompt = sanitize_prompt(X, self.cfg.vocab)
         key = jax.random.fold_in(jax.random.key(self.seed),
                                  state["requests"])
         y = generate(

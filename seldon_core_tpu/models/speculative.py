@@ -30,10 +30,15 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from seldon_core_tpu.models.generate import init_cache, segment_forward
-from seldon_core_tpu.models.transformer import LMConfig
+from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.models.generate import (
+    init_cache,
+    sanitize_prompt,
+    segment_forward,
+)
+from seldon_core_tpu.models.transformer import LMConfig, lm_init
 
-__all__ = ["speculative_generate"]
+__all__ = ["speculative_generate", "SpeculativeGenerator"]
 
 
 def speculative_generate(
@@ -128,3 +133,64 @@ def speculative_generate(
     n, rounds, out, _, _ = jax.lax.while_loop(
         cond, body, (n0, jnp.int32(0), out, t_cache, d_cache))
     return out[:max_new_tokens][None, :], rounds
+
+
+@register_unit("SpeculativeGenerator")
+class SpeculativeGenerator(Unit):
+    """Serving unit: speculative draft/verify generation over the standard
+    data plane.  Target and draft dimensions are graph parameters (draft_*
+    defaults to a quarter-size model).  Requests serve one at a time
+    (batch_coupled: the algorithm is per-sequence), prompt rows handled
+    row-by-row inside predict."""
+
+    pure = True
+    batch_coupled = True  # B=1 algorithm: never coalesce callers
+
+    def __init__(self, vocab: int = 256, d_model: int = 128, n_heads: int = 4,
+                 n_layers: int = 2, d_ff: int = 512,
+                 draft_d_model: int = 0, draft_n_heads: int = 0,
+                 draft_n_layers: int = 0, draft_d_ff: int = 0,
+                 seed: int = 0, max_new_tokens: int = 32, k: int = 4,
+                 dtype: str = "float32"):
+        dt = jnp.dtype(dtype).type
+        self.target_cfg = LMConfig(
+            vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
+            n_layers=int(n_layers), d_ff=int(d_ff), dtype=dt,
+        )
+        dd = int(draft_d_model) or max(16, int(d_model) // 4)
+        dh = int(draft_n_heads) or max(2, int(n_heads) // 2)
+        while dd % dh != 0:  # derived defaults must keep hd integral
+            dh -= 1
+        self.draft_cfg = LMConfig(
+            vocab=int(vocab), d_model=dd, n_heads=dh,
+            n_layers=int(draft_n_layers) or max(1, int(n_layers) // 2),
+            d_ff=int(draft_d_ff) or max(32, int(d_ff) // 4),
+            dtype=dt,
+        )
+        self.seed = int(seed)
+        self.max_new_tokens = int(max_new_tokens)
+        self.k = int(k)
+
+    def init_state(self, rng):
+        if rng is None:
+            rng = jax.random.key(self.seed)
+        rng = jax.random.fold_in(rng, self.seed)
+        kt, kd = jax.random.split(rng)
+        return {"target": lm_init(kt, self.target_cfg),
+                "draft": lm_init(kd, self.draft_cfg)}
+
+    def predict(self, state, X):
+        prompt = sanitize_prompt(X, self.target_cfg.vocab)
+
+        def one_row(row):
+            toks, _rounds = speculative_generate(
+                state["target"], state["draft"], row[None, :],
+                self.target_cfg, self.draft_cfg,
+                max_new_tokens=self.max_new_tokens, k=self.k,
+            )
+            return toks[0]
+
+        # rows decode independently (per-sequence algorithm); vmap would
+        # vectorise the while_loop to worst-case length — map keeps each
+        # row's loop at its own length
+        return jax.lax.map(one_row, prompt).astype(jnp.float32)

@@ -66,6 +66,15 @@ class LMConfig:
     def is_moe_layer(self, i: int) -> bool:
         return self.moe_every > 0 and (i + 1) % self.moe_every == 0
 
+    def __post_init__(self):
+        if self.d_model % self.n_heads != 0:
+            # caught at config construction (graph load), not as an opaque
+            # reshape error at first-request trace time
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by "
+                f"n_heads={self.n_heads}"
+            )
+
 
 def _rmsnorm(x, w, eps=1e-6):
     x32 = x.astype(jnp.float32)

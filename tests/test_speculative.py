@@ -4,6 +4,7 @@ defining invariant) and target-pass savings."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from seldon_core_tpu.models.generate import generate
 from seldon_core_tpu.models.speculative import speculative_generate
@@ -47,8 +48,55 @@ def test_speculative_self_draft_max_acceptance():
 
 def test_speculative_rejects_batches():
     tp = lm_init(jax.random.key(3), TARGET)
-    import pytest
-
     with pytest.raises(ValueError, match="batch size 1"):
         speculative_generate(tp, tp, jnp.zeros((2, 4), jnp.int32),
                              TARGET, TARGET)
+
+
+def test_speculative_unit_serves_through_engine():
+    import asyncio
+    import json
+
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "s", "predictors": [{
+            "name": "p",
+            "graph": {"name": "g", "type": "MODEL"},
+            "components": [{
+                "name": "g", "runtime": "inprocess",
+                "class_path": "SpeculativeGenerator",
+                "parameters": [
+                    {"name": "vocab", "value": "48", "type": "INT"},
+                    {"name": "d_model", "value": "32", "type": "INT"},
+                    {"name": "n_layers", "value": "2", "type": "INT"},
+                    {"name": "d_ff", "value": "64", "type": "INT"},
+                    {"name": "max_new_tokens", "value": "8", "type": "INT"},
+                ],
+            }],
+        }]}
+    })
+    engine = EngineService(spec)
+    assert engine.batcher is None  # batch_coupled: never coalesce callers
+
+    from seldon_core_tpu.messages import SeldonMessage
+
+    msg = SeldonMessage.from_json(json.dumps(
+        {"data": {"ndarray": [[1, 2, 3, 4], [5, 6, 7, 8]]}}
+    ))
+    resp = asyncio.run(engine.predict(msg))
+    y = np.asarray(resp.data.array)
+    assert y.shape == (2, 8)
+    assert ((0 <= y) & (y < 48)).all()
+
+
+def test_config_divisibility_validated_at_load():
+    from seldon_core_tpu.models.speculative import SpeculativeGenerator
+
+    with pytest.raises(ValueError, match="divisible"):
+        LMConfig(d_model=40, n_heads=12)
+    # derived draft defaults stay valid even for awkward target shapes
+    u = SpeculativeGenerator(vocab=48, d_model=48, n_heads=12, n_layers=2,
+                             d_ff=64)
+    assert u.draft_cfg.d_model % u.draft_cfg.n_heads == 0
