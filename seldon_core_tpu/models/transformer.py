@@ -144,8 +144,8 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
     """[B, H, S, hd] -> [B, H, S, hd]; ring over sp when the mesh shards S.
 
     ``use_flash`` opts the single-chip path into the Pallas flash kernel
-    (serving only — it has no VJP); constraint violations fall back to the
-    plain XLA path silently."""
+    (differentiable — custom flash VJP); constraint violations fall back
+    to the plain XLA path silently."""
     if use_flash and (mesh is None or mesh.size == 1):
         # single-chip only: pallas_call is not auto-partitionable under
         # GSPMD, so any multi-device mesh (tp/dp/sp) keeps the XLA path
@@ -218,8 +218,8 @@ def lm_apply(
     params, tokens, cfg: LMConfig, mesh: Optional[Mesh] = None,
     causal: bool = True, use_flash: bool = False, return_lb: bool = False
 ):
-    """tokens [B, S] int32 -> logits [B, S, V] (f32).  ``use_flash`` is
-    serving-only (the flash kernel has no VJP — keep it False under grad).
+    """tokens [B, S] int32 -> logits [B, S, V] (f32).  ``use_flash`` uses
+    the Pallas flash kernel on single-chip meshes (differentiable).
     ``return_lb`` additionally returns the summed MoE load-balance loss."""
     x = params["embed"][tokens]  # [B,S,D]
     lb_total = jnp.float32(0.0)
@@ -235,17 +235,24 @@ LB_LOSS_COEF = 0.01  # Switch-style aux-loss weight
 
 
 def lm_loss(params, batch, cfg: LMConfig, mesh: Optional[Mesh] = None,
-            apply_fn=None):
+            apply_fn=None, use_flash: Optional[bool] = None):
     """Next-token cross-entropy (+ weighted MoE load-balance loss when the
     config has MoE layers); batch = {tokens: [B, S+1]}.
 
     ``apply_fn(params, tokens) -> logits`` overrides the forward (used by the
-    pipelined variant); defaults to ``lm_apply``."""
+    pipelined variant); defaults to ``lm_apply``.  ``use_flash=None`` picks
+    the Pallas flash kernel automatically on single-chip TPU (the kernel
+    carries a custom VJP, so training uses it too); shapes outside its
+    constraints fall back to XLA attention inside ``_attention``."""
     tokens = batch["tokens"]
     lb_total = jnp.float32(0.0)
+    if use_flash is None:
+        from seldon_core_tpu.ops.fused_mlp import pallas_supported
+
+        use_flash = pallas_supported()
     if apply_fn is None:
         logits, lb_total = lm_apply(params, tokens[:, :-1], cfg, mesh,
-                                    return_lb=True)
+                                    return_lb=True, use_flash=use_flash)
     else:
         if cfg.moe_every:
             # a custom forward cannot report the lb loss through this
@@ -268,9 +275,12 @@ def _grad_update(loss_fn, params, opt_state, batch, optimizer):
 
 
 def lm_train_step(params, opt_state, batch, optimizer, cfg: LMConfig,
-                  mesh: Optional[Mesh] = None):
-    return _grad_update(lambda p, b: lm_loss(p, b, cfg, mesh), params,
-                        opt_state, batch, optimizer)
+                  mesh: Optional[Mesh] = None,
+                  use_flash: Optional[bool] = None):
+    return _grad_update(
+        lambda p, b: lm_loss(p, b, cfg, mesh, use_flash=use_flash), params,
+        opt_state, batch, optimizer,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -13,10 +13,14 @@ kept lane-broadcast ([bq, bk] blocks with bq = bk = 128) to stay on the
 VPU's native tiles.  Causality is applied by global-position masking.
 
 ``flash_attention`` raises ValueError when its constraints don't hold
-(S % 128, head dim <= 256); callers fall back to the XLA path.  Serving
-integration: ``models/transformer.TransformerLM.predict`` uses it when
-``ops.fused_mlp.pallas_supported()``; the training path keeps plain XLA
-attention (this kernel defines no custom VJP).
+(S % 128, head dim <= 256); callers fall back to the XLA path.
+
+Training: the op carries a custom VJP (flash-attention backward — recompute
+p from the saved per-row log-sum-exp, never materialise [S, S] in HBM).
+dQ runs on a (heads, q-block, k-block) grid accumulating over K blocks;
+dK/dV run on a (heads, k-block, q-block) grid accumulating over Q blocks —
+two passes instead of atomics, the standard TPU formulation.  Gradients
+match the XLA attention VJP to ~1e-5 in f32 (tests/test_flash_attention.py).
 
 Measured on v5e (chained-dependency timing, bf16, causal): 8.8x faster
 than the XLA einsum+softmax attention at S=2048/H=8/D=128, 2.5x at
@@ -37,7 +41,7 @@ _BLOCK = 128
 _NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, causal: bool, scale: float, n_k: int):
     from jax.experimental import pallas as pl
 
@@ -90,22 +94,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         o_ref[0] = (
             acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+        # per-row log-sum-exp of the scaled scores, saved for the backward
+        # pass (p is recomputed there as exp(s - lse))
+        lse_ref[0] = m_ref[:, :1] + jnp.log(
+            jnp.maximum(l_ref[:, :1], 1e-30)
+        )
 
 
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    causal: bool = True,
-    interpret: bool = False,
-) -> jax.Array:
-    """[B, H, S, D] q/k/v -> [B, H, S, D] attention output.
-
-    Constraints (ValueError otherwise, caller falls back to XLA):
-    S divisible by 128, D <= 256, q/k/v same shape."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
+def _validate(q, k, v):
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
     if q.ndim != 4:
@@ -115,35 +111,245 @@ def flash_attention(
         raise ValueError(f"seq len {S} not divisible by {_BLOCK}")
     if D > 256:
         raise ValueError(f"head dim {D} > 256")
-    n_q = S // _BLOCK
+    return B, H, S, D
+
+
+def _fwd_impl(q, k, v, causal: bool, interpret: bool):
+    """Returns (out [B,H,S,D], lse [B*H,S,1] f32)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = _validate(q, k, v)
     n_k = S // _BLOCK
     scale = float(1.0 / (D ** 0.5))
 
     def merge(t):
         return t.reshape(B * H, S, D)
 
-    qf, kf, vf = merge(q), merge(k), merge(v)
-    grid = (B * H, n_q, n_k)
+    grid = (B * H, S // _BLOCK, n_k)
     blk = lambda idx: pl.BlockSpec(  # noqa: E731
         (1, _BLOCK, D), idx, memory_space=pltpu.VMEM
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, causal=causal, scale=scale, n_k=n_k
         ),
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             blk(lambda b, i, j: (b, i, 0)),   # Q: follows the q-block axis
             blk(lambda b, i, j: (b, j, 0)),   # K: follows the k-block axis
             blk(lambda b, i, j: (b, j, 0)),   # V
         ],
-        out_specs=blk(lambda b, i, j: (b, i, 0)),
+        out_specs=(
+            blk(lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, _BLOCK, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
         scratch_shapes=[
             pltpu.VMEM((_BLOCK, _BLOCK), jnp.float32),  # m (lane-broadcast)
             pltpu.VMEM((_BLOCK, _BLOCK), jnp.float32),  # l
             pltpu.VMEM((_BLOCK, D), jnp.float32),       # acc
         ],
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(B, H, S, D)
+    )(merge(q), merge(k), merge(v))
+    return out.reshape(B, H, S, D), lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+                   dq_acc, *, causal: bool, scale: float, n_k: int):
+    """grid (B*H, n_q, n_k): K innermost, dq accumulates across K blocks."""
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(jnp.logical_or(not causal, ik <= iq))
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = iq * _BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (_BLOCK, _BLOCK), 0
+            )
+            kpos = ik * _BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (_BLOCK, _BLOCK), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                      # [bq, bk]
+        dp = jax.lax.dot_general(                        # dO V^T  [bq, bk]
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum_ref[0])                      # [bq, bk] f32
+        dq_acc[:] += jax.lax.dot_general(                # dS K    [bq, D]
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(ik == n_k - 1)
+    def _done():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, causal: bool, scale: float, n_q: int):
+    """grid (B*H, n_k, n_q): Q innermost, dk/dv accumulate across Q blocks."""
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(jnp.logical_or(not causal, iq >= ik))
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            qpos = iq * _BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (_BLOCK, _BLOCK), 0
+            )
+            kpos = ik * _BLOCK + jax.lax.broadcasted_iota(
+                jnp.int32, (_BLOCK, _BLOCK), 1
+            )
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                      # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(                # P^T dO  [bk, D]
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(                        # dO V^T  [bq, bk]
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum_ref[0])
+        dk_acc[:] += jax.lax.dot_general(                # dS^T Q  [bk, D]
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(iq == n_q - 1)
+    def _done():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal: bool, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, D = q.shape
+    n = S // _BLOCK
+    scale = float(1.0 / (D ** 0.5))
+
+    def merge(t):
+        return t.reshape(B * H, S, D)
+
+    qf, kf, vf, dof = merge(q), merge(k), merge(v), merge(do)
+    # D_i = rowsum(dO * O): O(S*D) elementwise, XLA fuses it fine
+    dsum = jnp.sum(
+        dof.astype(jnp.float32) * merge(o).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )  # [B*H, S, 1]
+
+    blk = lambda idx: pl.BlockSpec(  # noqa: E731
+        (1, _BLOCK, D), idx, memory_space=pltpu.VMEM
+    )
+    row = lambda idx: pl.BlockSpec(  # noqa: E731
+        (1, _BLOCK, 1), idx, memory_space=pltpu.VMEM
+    )
+
+    qside = lambda b, i, j: (b, i, 0)  # noqa: E731
+    kside = lambda b, i, j: (b, j, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, causal=causal, scale=scale, n_k=n
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        grid=(B * H, n, n),
+        in_specs=[
+            blk(qside), blk(kside), blk(kside), blk(qside),
+            row(qside), row(qside),
+        ],
+        out_specs=blk(qside),
+        scratch_shapes=[pltpu.VMEM((_BLOCK, D), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dsum)
+
+    # swapped grid: program_id(1) walks K blocks, program_id(2) walks Q
+    qside2 = lambda b, j, i: (b, i, 0)  # noqa: E731
+    kside2 = lambda b, j, i: (b, j, 0)  # noqa: E731
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, causal=causal, scale=scale, n_q=n
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, S, D), v.dtype),
+        ),
+        grid=(B * H, n, n),
+        in_specs=[
+            blk(qside2), blk(kside2), blk(kside2), blk(qside2),
+            row(qside2), row(qside2),
+        ],
+        out_specs=(blk(kside2), blk(kside2)),
+        scratch_shapes=[
+            pltpu.VMEM((_BLOCK, D), jnp.float32),
+            pltpu.VMEM((_BLOCK, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, dsum)
+
+    unmerge = lambda t: t.reshape(B, H, S, D)  # noqa: E731
+    return unmerge(dq), unmerge(dk), unmerge(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    interpret: bool = False,
+) -> jax.Array:
+    """[B, H, S, D] q/k/v -> [B, H, S, D] attention output.
+
+    Differentiable (custom flash VJP).  Constraints (ValueError otherwise,
+    caller falls back to XLA): S divisible by 128, D <= 256, q/k/v same
+    shape."""
+    out, _ = _fwd_impl(q, k, v, causal, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    out, lse = _fwd_impl(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_impl(q, k, v, o, lse, do, causal, interpret)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -80,8 +80,25 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
 
     rest_port = rest_port or int(os.environ.get("ENGINE_SERVER_PORT", "8000"))
     grpc_port = grpc_port or int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "5001"))
-    engine = EngineService(deployment, predictor_name)
-    runner = await serve_app(make_engine_app(engine), host, rest_port)
+    # batching knobs, part of the engine env contract the operator renders
+    # (the reference's engine JVM opts role, SeldonDeploymentOperatorImpl)
+    engine = EngineService(
+        deployment,
+        predictor_name,
+        max_batch=int(os.environ.get("ENGINE_MAX_BATCH", "1024")),
+        max_wait_ms=float(os.environ.get("ENGINE_BATCH_WAIT_MS", "2.0")),
+        pipeline_depth=int(os.environ.get("ENGINE_PIPELINE_DEPTH", "8")),
+    )
+    # data plane: raw-protocol HTTP front by default (runtime/httpfast.py);
+    # ENGINE_HTTP_IMPL=aiohttp keeps the full aiohttp app on the port
+    if os.environ.get("ENGINE_HTTP_IMPL", "fast") == "fast":
+        from seldon_core_tpu.runtime.httpfast import serve_fast
+
+        fast_server = await serve_fast(engine, host, rest_port)
+        runner = None
+    else:
+        fast_server = None
+        runner = await serve_app(make_engine_app(engine), host, rest_port)
     grpc_server = make_engine_grpc_server(engine, host, grpc_port)
     await grpc_server.start()
     print(
@@ -124,7 +141,10 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
     except asyncio.TimeoutError:
         pass  # full drain window elapsed
     await grpc_server.stop(grace=5.0)
-    await runner.cleanup()
+    if runner is not None:
+        await runner.cleanup()
+    if fast_server is not None:
+        await fast_server.stop()
     print("engine stopped", flush=True)
 
 

@@ -1,0 +1,407 @@
+"""Fast HTTP/1.1 engine front — asyncio.Protocol, zero per-request framework.
+
+The aiohttp app (runtime/rest.py) stays the full-featured surface; this
+module serves the same engine routes straight off an ``asyncio.Protocol``
+for the data plane.  Rationale: on a single-core host the HTTP stack is
+the serving bottleneck — an echo benchmark on this class of machine puts
+aiohttp server+client at ~4k req/s while a raw protocol pair sustains
+~40k req/s.  The reference engine leans on Tomcat NIO + Jackson for the
+same reason (engine RestClientController.java); this is the TPU-serving
+equivalent: terminate HTTP cheaply, spend the cycles on batching and
+device dispatch.
+
+Semantics match ``rest.py:make_engine_app`` route for route:
+
+  POST /api/v0.1/predictions   JSON body or form field ``json=``
+  POST /api/v0.1/feedback
+  GET  /ping /ready /pause /unpause /prometheus
+  GET  /trace /trace/enable /trace/disable
+
+Protocol scope (documented contract, tested in tests/test_httpfast.py):
+HTTP/1.1 with keepalive and Content-Length bodies.  Pipelined requests
+are answered in order (each request's handler runs concurrently; a
+per-connection writer drains responses FIFO).  ``Transfer-Encoding:
+chunked`` is declined with 501 — every client in scope (loadtest rig,
+aiohttp, curl, the gateway's pooled client) sends Content-Length.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs
+
+from seldon_core_tpu.graph.spec import GraphSpecError
+from seldon_core_tpu.messages import (
+    Feedback,
+    SeldonMessage,
+    SeldonMessageError,
+)
+from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
+
+__all__ = ["FastHttpServer", "serve_fast"]
+
+_JSON = "application/json"
+_MAX_BODY = 256 * 1024 * 1024  # matches rest.py client_max_size
+_MAX_HEAD = 64 * 1024
+
+# handler result: (status, body bytes, content-type)
+Result = Tuple[int, bytes, str]
+Handler = Callable[[bytes, str, str], Awaitable[Result]]
+
+_STATUS_LINE = {
+    code: f"HTTP/1.1 {code} {text}\r\n".encode()
+    for code, text in {
+        200: "OK", 400: "Bad Request", 404: "Not Found",
+        405: "Method Not Allowed", 411: "Length Required",
+        413: "Payload Too Large", 500: "Internal Server Error",
+        501: "Not Implemented", 503: "Service Unavailable",
+        504: "Gateway Timeout",
+    }.items()
+}
+
+
+def _payload_text(body: bytes, ctype: str) -> str:
+    """JSON body or form-encoded ``json=`` field (rest.py:_payload_text)."""
+    if "form" in ctype:
+        form = parse_qs(body.decode("utf-8", "replace"), keep_blank_values=True)
+        if "json" in form:
+            return form["json"][0]
+    return body.decode("utf-8", "replace")
+
+
+class _EngineRoutes:
+    """The engine route table shared by every fast connection."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.post: Dict[bytes, Handler] = {
+            b"/api/v0.1/predictions": self._predictions,
+            b"/api/v0.1/feedback": self._feedback,
+        }
+        self.get: Dict[bytes, Handler] = {
+            b"/ping": self._ping,
+            b"/ready": self._ready,
+            b"/pause": self._pause,
+            b"/unpause": self._unpause,
+            b"/prometheus": self._prometheus,
+            b"/trace": self._trace,
+            b"/trace/enable": self._trace_enable,
+            b"/trace/disable": self._trace_disable,
+        }
+
+    async def _predictions(self, body, ctype, query) -> Result:
+        try:
+            text, status = await self.engine.predict_json(
+                _payload_text(body, ctype)
+            )
+        except SeldonMessageError as e:
+            return 400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
+        return status or 200, text.encode(), _JSON
+
+    async def _feedback(self, body, ctype, query) -> Result:
+        try:
+            fb = Feedback.from_json(_payload_text(body, ctype))
+        except SeldonMessageError as e:
+            return 400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
+        ack = await self.engine.send_feedback(fb)
+        ok = ack.status is None or ack.status.status == "SUCCESS"
+        status = 200 if ok else (ack.status.code or 200)
+        return status or 200, ack.to_json().encode(), _JSON
+
+    async def _ping(self, body, ctype, query) -> Result:
+        return 200, b"pong", "text/plain"
+
+    async def _ready(self, body, ctype, query) -> Result:
+        if self.engine.ready():
+            return 200, b"ready", "text/plain"
+        return 503, b"paused", "text/plain"
+
+    async def _pause(self, body, ctype, query) -> Result:
+        self.engine.pause()
+        return 200, b"paused", "text/plain"
+
+    async def _unpause(self, body, ctype, query) -> Result:
+        self.engine.unpause()
+        return 200, b"unpaused", "text/plain"
+
+    async def _prometheus(self, body, ctype, query) -> Result:
+        return 200, self.engine.metrics.exposition(), CONTENT_TYPE_LATEST
+
+    async def _trace(self, body, ctype, query) -> Result:
+        import json as _json
+
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        q = parse_qs(query)
+        puid = q.get("puid", [""])[0]
+        limit = int(q.get("limit", ["100"])[0])
+        spans = TRACER.trace(puid) if puid else TRACER.recent(limit)
+        doc = {"enabled": TRACER.enabled,
+               "spans": [s.to_json_dict() for s in spans]}
+        return 200, _json.dumps(doc).encode(), _JSON
+
+    async def _trace_enable(self, body, ctype, query) -> Result:
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        TRACER.enable()
+        return 200, b"tracing enabled", "text/plain"
+
+    async def _trace_disable(self, body, ctype, query) -> Result:
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        TRACER.disable()
+        return 200, b"tracing disabled", "text/plain"
+
+
+_MAX_INFLIGHT = 128  # per-connection pipelined requests before pause_reading
+
+
+def _header_value(lower: bytes, name: bytes) -> Optional[bytes]:
+    """Value of ``name`` (lower-case, colon included) anchored at a line
+    start — an unanchored substring search would match inside other header
+    names (X-Content-Length) or values."""
+    j = lower.find(b"\r\n" + name)
+    if j < 0:
+        return None
+    start = j + 2 + len(name)
+    stop = lower.find(b"\r", start)
+    return lower[start: stop if stop > 0 else None].strip()
+
+
+class _FastHttpProtocol(asyncio.Protocol):
+    def __init__(self, routes: _EngineRoutes, protocols: Optional[set] = None):
+        self.routes = routes
+        self.protocols = protocols
+        self.buf = bytearray()
+        self.body_need = -1  # >= 0: header parsed, waiting for body bytes
+        self.scan_from = 0   # resume point for the \r\n\r\n scan
+        self.transport: Optional[asyncio.Transport] = None
+        self.queue: "asyncio.Queue" = asyncio.Queue()
+        self.writer_task: Optional[asyncio.Task] = None
+        self.closing = False
+        self.paused_read = False
+        self._can_write = asyncio.Event()
+        self._can_write.set()
+
+    # -- connection lifecycle ------------------------------------------------
+
+    def connection_made(self, transport):
+        self.transport = transport
+        transport.set_write_buffer_limits(high=1 << 20)
+        if self.protocols is not None:
+            self.protocols.add(self)
+        self.writer_task = asyncio.get_running_loop().create_task(
+            self._writer()
+        )
+
+    def connection_lost(self, exc):
+        self.closing = True
+        if self.protocols is not None:
+            self.protocols.discard(self)
+        if self.writer_task is not None:
+            self.writer_task.cancel()
+
+    def pause_writing(self):
+        self._can_write.clear()
+
+    def resume_writing(self):
+        self._can_write.set()
+
+    def _maybe_pause_reading(self):
+        """Backpressure: a connection may pipeline at most _MAX_INFLIGHT
+        requests; beyond that the socket stops being read until the writer
+        drains the queue."""
+        if (
+            not self.paused_read
+            and self.queue.qsize() > _MAX_INFLIGHT
+            and self.transport is not None
+        ):
+            self.paused_read = True
+            self.transport.pause_reading()
+
+    async def _writer(self):
+        """Drain handler results in request order (pipelining-safe)."""
+        while True:
+            task, close = await self.queue.get()
+            try:
+                status, body, ctype = await task
+            except (SeldonMessageError, GraphSpecError) as e:
+                status, body, ctype = (
+                    400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # unexpected: 500, keep serving
+                status, body, ctype = (
+                    500,
+                    SeldonMessage.failure(str(e), code=500).to_json().encode(),
+                    _JSON,
+                )
+            if not self._can_write.is_set():
+                await self._can_write.wait()  # transport buffer full
+            self._write_response(status, body, ctype, close)
+            if (
+                self.paused_read
+                and self.queue.qsize() <= _MAX_INFLIGHT // 2
+                and self.transport is not None
+            ):
+                self.paused_read = False
+                self.transport.resume_reading()
+            if close and self.transport is not None:
+                self.transport.close()
+
+    def _write_response(self, status, body, ctype, close):
+        if self.transport is None or self.transport.is_closing():
+            return
+        head = (
+            _STATUS_LINE.get(status) or f"HTTP/1.1 {status} X\r\n".encode()
+        ) + (
+            b"Content-Length: %d\r\nContent-Type: %s\r\n%s\r\n"
+            % (
+                len(body),
+                ctype.encode(),
+                b"Connection: close\r\n" if close else b"",
+            )
+        )
+        self.transport.write(head + body)
+
+    # -- parsing -------------------------------------------------------------
+
+    def data_received(self, data):
+        # bytearray append + one prefix trim per chunk: O(chunk + leftover),
+        # never O(total^2) on large bodies arriving in many TCP segments
+        self.buf += data
+        consumed = 0
+        while not self.closing:
+            if self.body_need >= 0:
+                # mid-body: wait for the rest without rescanning headers
+                if len(self.buf) - consumed < self._head_len + self.body_need:
+                    break
+                start = consumed + self._head_len
+                body = bytes(self.buf[start: start + self.body_need])
+                consumed = start + self.body_need
+                self.body_need = -1
+                self._dispatch(self._head, self._lower, body)
+                continue
+            end = self.buf.find(b"\r\n\r\n", max(consumed, self.scan_from))
+            if end < 0:
+                if len(self.buf) - consumed > _MAX_HEAD:
+                    self._reject(413, b"headers too large", close=True)
+                # resume the scan where it left off (minus the 3 bytes a
+                # split terminator could span)
+                self.scan_from = max(consumed, len(self.buf) - 3)
+                break
+            head = bytes(self.buf[consumed:end])
+            lower = head.lower()
+            # RFC 7230: Transfer-Encoding wins over Content-Length; a request
+            # carrying both must not be framed by Content-Length (smuggling)
+            if _header_value(lower, b"transfer-encoding:") is not None:
+                self._reject(501, b"chunked bodies not supported", close=True)
+                break
+            clen = 0
+            clv = _header_value(lower, b"content-length:")
+            if clv is not None:
+                # digits only: int() would accept "-5" (consumed moves
+                # backwards -> phantom pipelined request) and "1_0"
+                if not clv.isdigit():
+                    self._reject(400, b"bad content-length", close=True)
+                    break
+                clen = int(clv)
+            if clen > _MAX_BODY:
+                self._reject(413, b"body too large", close=True)
+                break
+            if len(self.buf) - consumed < end - consumed + 4 + clen:
+                # body incomplete: remember the parse so the next chunk
+                # resumes in state BODY
+                self._head, self._lower = head, lower
+                self._head_len = end - consumed + 4
+                self.body_need = clen
+                break
+            start = end + 4
+            body = bytes(self.buf[start: start + clen])
+            consumed = start + clen
+            self._dispatch(head, lower, body)
+        if consumed:
+            del self.buf[:consumed]
+            self.scan_from = 0
+        self._maybe_pause_reading()
+
+    def _reject(self, status, text, close=False):
+        self.closing = self.closing or close
+        fut = asyncio.get_running_loop().create_future()
+        fut.set_result((status, text, "text/plain"))
+        self.queue.put_nowait((fut, close))
+
+    def _dispatch(self, head: bytes, lower: bytes, body: bytes):
+        line_end = head.find(b"\r\n")
+        request_line = head[: line_end if line_end > 0 else len(head)]
+        try:
+            method, target, _ = request_line.split(b" ", 2)
+        except ValueError:
+            self._reject(400, b"malformed request line", close=True)
+            return
+        qpos = target.find(b"?")
+        path, query = (
+            (target[:qpos], target[qpos + 1:]) if qpos >= 0 else (target, b"")
+        )
+        conn = _header_value(lower, b"connection:")
+        close = conn is not None and b"close" in (
+            p.strip() for p in conn.split(b",")
+        )
+        table = (
+            self.routes.post if method == b"POST"
+            else self.routes.get if method == b"GET"
+            else None
+        )
+        if table is None:
+            self._reject(405, b"method not allowed")
+            return
+        handler = table.get(path)
+        if handler is None:
+            self._reject(404, b"not found")
+            return
+        ctv = _header_value(lower, b"content-type:")
+        ctype = ctv.decode() if ctv is not None else ""
+        task = asyncio.get_running_loop().create_task(
+            handler(body, ctype, query.decode("latin-1"))
+        )
+        self.queue.put_nowait((task, close))
+
+
+class FastHttpServer:
+    """Owns the listening socket; ``await start()`` / ``await stop()``."""
+
+    def __init__(self, engine):
+        self.routes = _EngineRoutes(engine)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._protocols: set = set()
+
+    async def start(self, host: str, port: int) -> None:
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(
+            lambda: _FastHttpProtocol(self.routes, self._protocols),
+            host, port,
+        )
+
+    async def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.close()
+        # Server.wait_closed (3.12.1+) waits for every connection handler;
+        # idle keepalive connections never finish on their own, so close
+        # their transports first or shutdown hangs forever
+        for proto in list(self._protocols):
+            if proto.transport is not None:
+                proto.transport.close()
+        try:
+            await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+        except asyncio.TimeoutError:
+            pass  # listener is closed either way; don't wedge shutdown
+        self._server = None
+
+
+async def serve_fast(engine, host: str, port: int) -> FastHttpServer:
+    server = FastHttpServer(engine)
+    await server.start(host, port)
+    return server

@@ -34,8 +34,22 @@ async def run_load(
     batch_size: int = 1,
     oauth_key: Optional[str] = None,
     oauth_secret: Optional[str] = None,
+    fast: bool = False,
+    decimals: Optional[int] = 2,
 ) -> dict:
     payload_msg = generate_batch(contract, batch_size, seed=0)
+    if decimals is not None:
+        # the reference's locust rig sends round(random(), 2)
+        # (util/loadtester/scripts/predict_rest_locust.py:129) — full-precision
+        # random doubles would make the payload ~2.4x larger than anything the
+        # reference's benchmark ever parsed
+        try:
+            arr = np.round(
+                np.asarray(payload_msg.array(), np.float64), decimals
+            )
+            payload_msg = payload_msg.with_array(arr)
+        except Exception:
+            pass  # non-numeric contract: send as generated
     stop_at = time.perf_counter() + duration_s
     latencies: list = []
     failures = 0
@@ -45,6 +59,59 @@ async def run_load(
         from seldon_core_tpu.testing.api_tester import _rest_token
 
         token = await _rest_token(host, port, oauth_key, oauth_secret or "")
+
+    if api == "rest" and fast:
+        # locust FastHttpUser analogue: raw keepalive HTTP/1.1 connections,
+        # one per client, minimal parsing — the aiohttp client costs ~3x as
+        # much CPU per request, which matters when clients and server share
+        # cores (docs/benchmarking.md methodology note)
+        body = payload_msg.to_json().encode()
+        auth = f"Authorization: Bearer {token}\r\n" if token else ""
+        request = (
+            f"POST /api/v0.1/predictions HTTP/1.1\r\nHost: {host}\r\n"
+            f"Content-Type: application/json\r\n{auth}"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode() + body
+
+        async def client():
+            nonlocal failures
+            reader = writer = None
+            while time.perf_counter() < stop_at:
+                if writer is None:
+                    try:
+                        reader, writer = await asyncio.open_connection(
+                            host, port
+                        )
+                    except OSError:
+                        failures += 1
+                        await asyncio.sleep(0.05)  # connect storm relief
+                        continue
+                t0 = time.perf_counter()
+                try:
+                    writer.write(request)
+                    head = await reader.readuntil(b"\r\n\r\n")
+                    lower = head.lower()
+                    j = lower.find(b"content-length:")
+                    clen = int(lower[j + 15: lower.find(b"\r", j)])
+                    await reader.readexactly(clen)
+                except (OSError, asyncio.IncompleteReadError, ValueError):
+                    # transient: count it, reconnect, keep loading (the
+                    # aiohttp lane behaves the same way)
+                    failures += 1
+                    writer.close()
+                    reader = writer = None
+                    continue
+                if head[9:12] == b"200":
+                    latencies.append(time.perf_counter() - t0)
+                else:
+                    failures += 1
+            if writer is not None:
+                writer.close()
+
+        t_start = time.perf_counter()
+        await asyncio.gather(*[client() for _ in range(clients)])
+        wall = time.perf_counter() - t_start
+        return _report(latencies, failures, wall, clients, duration_s)
 
     if api == "grpc":
         import grpc
@@ -88,14 +155,20 @@ async def run_load(
             except Exception:
                 failures += 1
 
+    t_start = time.perf_counter()
     try:
         await asyncio.gather(*[client() for _ in range(clients)])
     finally:
+        wall = time.perf_counter() - t_start
         if api == "grpc":
             await channel.close()
         else:
             await session.close()
 
+    return _report(latencies, failures, wall, clients, duration_s)
+
+
+def _report(latencies, failures, wall, clients, duration_s) -> dict:
     lat = np.asarray(latencies)
     pct = (
         {
@@ -108,7 +181,7 @@ async def run_load(
     return {
         "requests": len(latencies),
         "failures": failures,
-        "qps": round(len(latencies) / duration_s, 1),
+        "qps": round(len(latencies) / max(wall, 1e-9), 1),
         "clients": clients,
         "duration_s": duration_s,
         **pct,
@@ -121,6 +194,14 @@ def main(argv=None) -> None:
     parser.add_argument("host")
     parser.add_argument("port", type=int)
     parser.add_argument("--api", choices=["rest", "grpc"], default="rest")
+    parser.add_argument(
+        "--fast", action="store_true",
+        help="REST: raw keepalive connections (locust FastHttpUser analogue)",
+    )
+    parser.add_argument(
+        "--decimals", type=int, default=2,
+        help="round generated features (reference locust: 2); -1 = full precision",
+    )
     parser.add_argument("--clients", type=int, default=16)
     parser.add_argument("--duration", type=float, default=10.0)
     parser.add_argument("--batch-size", type=int, default=1)
@@ -132,7 +213,8 @@ def main(argv=None) -> None:
             Contract.from_file(args.contract), args.host, args.port,
             api=args.api, clients=args.clients, duration_s=args.duration,
             batch_size=args.batch_size, oauth_key=args.oauth_key,
-            oauth_secret=args.oauth_secret,
+            oauth_secret=args.oauth_secret, fast=args.fast,
+            decimals=None if args.decimals < 0 else args.decimals,
         )
     )
     print(json.dumps(result))

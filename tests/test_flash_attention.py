@@ -57,3 +57,86 @@ def test_flash_constraint_errors():
             jnp.zeros((1, 1, 128, 64)), jnp.zeros((1, 1, 128, 32)),
             jnp.zeros((1, 1, 128, 64)),
         )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_vjp_matches_xla(causal):
+    """Flash backward (recompute-from-lse, two-pass dQ / dKV) vs the XLA
+    attention VJP, f32, multi-block so accumulator carries are exercised."""
+    B, H, S, D = 1, 2, 384, 32
+    q, k, v = (_rand((B, H, S, D), 20 + i) for i in range(3))
+    co = _rand((B, H, S, D), 99)  # cotangent
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) * co)
+
+    def xla_loss(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, causal) * co)
+
+    got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(xla_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), atol=3e-4, rtol=3e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_vjp_under_jit_and_value_and_grad():
+    """The custom VJP composes with jit and value_and_grad (the lm_train_step
+    usage shape)."""
+    B, H, S, D = 1, 1, 256, 64
+    q, k, v = (_rand((B, H, S, D), 30 + i) for i in range(3))
+
+    @jax.jit
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    val, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+    ref = jnp.sum(_xla_attention(q, k, v, True) ** 2)
+    np.testing.assert_allclose(float(val), float(ref), rtol=1e-5)
+    assert all(g.shape == q.shape for g in grads)
+
+
+def test_lm_train_step_with_flash_matches_xla_attention():
+    """lm_train_step(use_flash=True) (flash VJP, interpret-mode pallas via
+    monkeypatched interpret default is not available here, so call the loss
+    directly) must produce the same gradients as the XLA attention path."""
+    import optax
+
+    from seldon_core_tpu.models.transformer import LMConfig, lm_init, lm_loss
+
+    cfg = LMConfig(vocab=64, d_model=64, n_heads=2, n_layers=1, d_ff=128,
+                   dtype=jnp.float32)
+    params = lm_init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 129), 0, 64)
+    batch = {"tokens": tokens}
+
+    # S=128 satisfies the flash constraint; interpret mode is selected inside
+    # flash_attention only via the arg, so patch it through _attention by
+    # running on CPU where pallas interpret is implied not available ->
+    # instead compare the pure loss fns explicitly
+    import seldon_core_tpu.models.transformer as T
+
+    orig = T._attention
+
+    def flash_forced(q, k, v, mesh, causal, use_flash=False):
+        if use_flash:
+            return flash_attention(q, k, v, causal=causal, interpret=True)
+        return orig(q, k, v, mesh, causal, use_flash=False)
+
+    T._attention = flash_forced
+    try:
+        g_flash = jax.grad(
+            lambda p: lm_loss(p, batch, cfg, use_flash=True)
+        )(params)
+        g_xla = jax.grad(
+            lambda p: lm_loss(p, batch, cfg, use_flash=False)
+        )(params)
+    finally:
+        T._attention = orig
+    flat_f, _ = jax.tree_util.tree_flatten(g_flash)
+    flat_x, _ = jax.tree_util.tree_flatten(g_xla)
+    for a, b in zip(flat_f, flat_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)

@@ -1,0 +1,275 @@
+"""Fast HTTP front (runtime/httpfast.py): same engine semantics as the
+aiohttp app over a raw asyncio.Protocol — exercised with a real aiohttp
+client (interop) and raw sockets (keepalive, pipelining, protocol edges)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.httpfast import serve_fast
+
+
+def deployment(graph, components=None, name="dep"):
+    return SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": name,
+                "predictors": [
+                    {"name": "p", "graph": graph, "components": components or []}
+                ],
+            }
+        }
+    )
+
+
+SIMPLE = {"name": "m", "implementation": "SIMPLE_MODEL", "type": "MODEL"}
+
+
+async def _serve():
+    import socket
+
+    engine = EngineService(deployment(SIMPLE))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = await serve_fast(engine, "127.0.0.1", port)
+    return engine, server, port
+
+
+def test_fast_predict_aiohttp_interop():
+    """A stock aiohttp client round-trips predictions + admin routes."""
+
+    async def run():
+        engine, server, port = await _serve()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{base}/api/v0.1/predictions",
+                    data='{"data":{"ndarray":[[1,2]]}}',
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                assert d["data"]["ndarray"][0] == [
+                    pytest.approx(0.1), pytest.approx(0.9), pytest.approx(0.5)
+                ]
+                assert len(d["meta"]["puid"]) == 26
+
+                # form-encoded json= convention
+                async with s.post(
+                    f"{base}/api/v0.1/predictions",
+                    data={"json": '{"data":{"ndarray":[[1,2]]}}'},
+                ) as r:
+                    assert r.status == 200
+
+                # malformed payload -> FAILURE, 400
+                async with s.post(
+                    f"{base}/api/v0.1/predictions", data="not json"
+                ) as r:
+                    assert r.status == 400
+                    assert json.loads(await r.text())["status"]["status"] == "FAILURE"
+
+                async with s.get(f"{base}/ping") as r:
+                    assert await r.text() == "pong"
+                async with s.get(f"{base}/pause") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/ready") as r:
+                    assert r.status == 503
+                async with s.get(f"{base}/unpause") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/ready") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/prometheus") as r:
+                    assert r.status == 200
+                    assert "seldon_api_engine" in await r.text()
+                async with s.get(f"{base}/nope") as r:
+                    assert r.status == 404
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_keepalive_and_pipelining():
+    """Two pipelined requests on one raw connection answer in order; the
+    connection survives for a third request (keepalive)."""
+
+    async def run():
+        engine, server, port = await _serve()
+        body1 = b'{"data":{"ndarray":[[1,2]]}}'
+        body2 = b'{"meta":{"tags":{"n":2}},"data":{"ndarray":[[3,4]]}}'
+
+        def req(body):
+            return (
+                b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+            )
+
+        async def read_response(reader):
+            head = await reader.readuntil(b"\r\n\r\n")
+            lower = head.lower()
+            j = lower.find(b"content-length:")
+            clen = int(lower[j + 15: lower.find(b"\r", j)])
+            return head, await reader.readexactly(clen)
+
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            # pipelined: both requests before any response
+            writer.write(req(body1) + req(body2))
+            h1, b1 = await read_response(reader)
+            h2, b2 = await read_response(reader)
+            assert h1[9:12] == h2[9:12] == b"200"
+            assert json.loads(b2)["meta"]["tags"] == {"n": 2}  # order held
+            # keepalive: same socket, one more
+            writer.write(req(body1))
+            h3, _ = await read_response(reader)
+            assert h3[9:12] == b"200"
+            writer.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_protocol_edges():
+    """chunked -> 501, Connection: close honoured, bad request line -> 400."""
+
+    async def run():
+        engine, server, port = await _serve()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: x\r\n"
+                b"Transfer-Encoding: chunked\r\n\r\n"
+            )
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert head[9:12] == b"501"
+
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"GET /ping HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+            )
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert head[9:12] == b"200"
+            body = await reader.read()  # server closes after the response
+            assert body == b"pong"
+
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(b"garbage\r\n\r\n")
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert head[9:12] == b"400"
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_feedback_route():
+    async def run():
+        engine, server, port = await _serve()
+        fb = json.dumps(
+            {
+                "request": {"data": {"ndarray": [[1, 2]]}},
+                "response": {"data": {"ndarray": [[0.1, 0.9, 0.5]]}},
+                "reward": 1.0,
+            }
+        ).encode()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/feedback", data=fb
+                ) as r:
+                    assert r.status == 200
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_te_with_content_length_rejected():
+    """RFC 7230 smuggling guard: Transfer-Encoding wins over Content-Length,
+    so a request carrying both is 501'd, not framed by Content-Length."""
+
+    async def run():
+        engine, server, port = await _serve()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 0\r\nTransfer-Encoding: chunked\r\n\r\n"
+                b"5\r\nhello\r\n0\r\n\r\n"
+            )
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert head[9:12] == b"501"
+            # connection closes (no desynced parse of the chunked bytes)
+            lower = head.lower()
+            j = lower.find(b"content-length:")
+            clen = int(lower[j + 15: lower.find(b"\r", j)])
+            await reader.readexactly(clen)
+            assert await reader.read() == b""
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_body_split_across_chunks():
+    """Header and body arriving in separate TCP segments exercise the
+    mid-body resume state (body_need)."""
+
+    async def run():
+        engine, server, port = await _serve()
+        body = b'{"data":{"ndarray":[[1,2]]}}'
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"POST /api/v0.1/predictions HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(body)
+            )
+            await writer.drain()
+            await asyncio.sleep(0.05)
+            writer.write(body[:10])
+            await writer.drain()
+            await asyncio.sleep(0.05)
+            writer.write(body[10:])
+            head = await reader.readuntil(b"\r\n\r\n")
+            assert head[9:12] == b"200"
+            writer.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_header_edges_and_stop_with_idle_keepalive():
+    """X-Content-Length must not frame the body; negative Content-Length is
+    400; stop() returns promptly even with an idle keepalive connection."""
+
+    async def run():
+        engine, server, port = await _serve()
+        # header-name suffix collision: a legal request with X-Content-Length
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            b"GET /ping HTTP/1.1\r\nHost: x\r\nX-Content-Length: 5\r\n\r\n"
+        )
+        head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 2)
+        assert head[9:12] == b"200"
+
+        # negative Content-Length: exactly one response (400), no phantom
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        w2.write(b"GET /ping HTTP/1.1\r\nHost: x\r\nContent-Length: -5\r\n\r\n")
+        h2 = await asyncio.wait_for(r2.readuntil(b"\r\n\r\n"), 2)
+        assert h2[9:12] == b"400"
+
+        # the first connection is still open and idle -> stop() must not hang
+        await asyncio.wait_for(server.stop(), 5)
+        writer.close()
+        w2.close()
+
+    asyncio.run(run())
