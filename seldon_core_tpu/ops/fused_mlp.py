@@ -127,10 +127,31 @@ def fused_mlp_softmax(
     return fn(*flat_inputs)
 
 
-@functools.cache
+_PALLAS_PROBE: "bool | None" = None
+
+
 def pallas_supported() -> bool:
     """True when the default backend compiles+runs a trivial Pallas TPU
-    kernel.  Cached: probe once per process."""
+    kernel.  Probed once per process — but NEVER probed (or cached) inside
+    a jit trace, where the float() readback would raise and pin a spurious
+    False for the whole process; under a trace we answer from the backend
+    platform instead."""
+    global _PALLAS_PROBE
+    if _PALLAS_PROBE is not None:
+        return _PALLAS_PROBE
+    try:
+        from jax._src.core import trace_state_clean
+    except ImportError:  # pragma: no cover - private-API drift
+        trace_state_clean = None
+    if trace_state_clean is not None and not trace_state_clean():
+        import jax
+
+        return jax.default_backend() == "tpu"  # uncached best answer
+    _PALLAS_PROBE = _pallas_probe()
+    return _PALLAS_PROBE
+
+
+def _pallas_probe() -> bool:
     try:
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu

@@ -99,8 +99,20 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
     else:
         fast_server = None
         runner = await serve_app(make_engine_app(engine), host, rest_port)
-    grpc_server = make_engine_grpc_server(engine, host, grpc_port)
-    await grpc_server.start()
+    # gRPC data plane: wire-level HTTP/2 lane by default (runtime/grpcfast.py,
+    # unary Predict/SendFeedback — the whole Seldon service surface);
+    # ENGINE_GRPC_IMPL=aio keeps the stock grpc.aio server
+    if os.environ.get("ENGINE_GRPC_IMPL", "fast") == "fast":
+        from seldon_core_tpu.runtime.grpcfast import serve_grpc_fast
+
+        grpc_server = await serve_grpc_fast(engine, host, grpc_port)
+        grpc_stop = grpc_server.stop
+    else:
+        grpc_server = make_engine_grpc_server(engine, host, grpc_port)
+        await grpc_server.start()
+
+        async def grpc_stop():
+            await grpc_server.stop(grace=5.0)
     print(
         f"engine up: predictor={engine.predictor.name} mode={engine.mode} "
         f"rest=:{rest_port} grpc=:{grpc_port}",
@@ -140,7 +152,7 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         print("drain skipped by second signal", flush=True)
     except asyncio.TimeoutError:
         pass  # full drain window elapsed
-    await grpc_server.stop(grace=5.0)
+    await grpc_stop()
     if runner is not None:
         await runner.cleanup()
     if fast_server is not None:

@@ -1,0 +1,75 @@
+"""Worker process for the true multi-process multihost test.
+
+Each worker joins the JAX multi-controller runtime through the SELDON_*
+env contract (parallel/multihost.py), builds a global mesh spanning both
+processes, round-trips host-local data to a global array, runs a jitted
+cross-process reduction, syncs on the barrier, and prints one JSON line
+the parent asserts on.  This is the minikube-E2E role of the reference
+(notebooks/kubectl_demo_minikube_rbac.ipynb) mapped to the
+multi-controller world.
+"""
+
+import json
+import os
+import sys
+
+# must be set before any backend use; the parent exports JAX_PLATFORMS=cpu
+# and --xla_force_host_platform_device_count in our env
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from seldon_core_tpu.parallel import multihost as mh  # noqa: E402
+
+
+def main() -> None:
+    joined = mh.initialize()  # env contract: SELDON_COORDINATOR_ADDRESS etc.
+    assert joined, "coordinator env missing"
+    info = mh.process_info()
+    assert info["process_count"] == 2, info
+    pid = info["process_index"]
+    n_local = info["local_device_count"]
+
+    mesh = mh.global_mesh({"dp": 2 * n_local})
+    assert mesh.devices.size == 2 * n_local
+
+    # host-local rows -> global array: process i contributes rows of value
+    # (i + 1); the global sum is invariant across processes
+    local = np.full((n_local, 4), float(pid + 1), np.float32)
+    gx = mh.host_local_to_global(mesh, P("dp"), local)
+    assert gx.shape == (2 * n_local, 4)
+
+    total = jax.jit(lambda x: x.sum())(gx)  # cross-process reduction
+    want = float(n_local * 4 * 1 + n_local * 4 * 2)
+    got = float(np.asarray(total))
+    assert got == want, (got, want)
+
+    # per-device psum through shard_map: every process sees the same value
+    psummed = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x.sum(), "dp"),
+            mesh=mesh, in_specs=P("dp"), out_specs=P(),
+        )
+    )(gx)
+    assert float(np.asarray(psummed)) == want
+
+    mh.barrier("test_sync")
+
+    # global -> host-local round trip returns this host's own rows
+    back = mh.global_to_host_local(mesh, P("dp"), gx)
+    assert back.shape == (n_local, 4)
+    np.testing.assert_array_equal(np.asarray(back), local)
+
+    print(json.dumps({
+        "process": pid, "sum": got, "devices": info["global_device_count"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,267 @@
+"""Wire-level gRPC lane (runtime/grpcfast.py) interop: the HTTP/2 + HPACK
+implementation is pinned BOTH ways against the stock grpc runtime —
+a stock grpc.aio client against FastGrpcServer, and FastGrpcChannel
+against the stock grpc.aio server — plus fast-to-fast multiplexing,
+large messages, and error mapping."""
+
+import asyncio
+
+import grpc
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.grpc_server import make_engine_grpc_server
+from seldon_core_tpu.runtime.grpcfast import (
+    FastGrpcChannel,
+    GrpcCallError,
+    serve_grpc_fast,
+)
+
+PREDICT = b"/seldon.protos.Seldon/Predict"
+
+
+async def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _engine():
+    return EngineService(
+        SeldonDeploymentSpec.from_json_dict(
+            {
+                "spec": {
+                    "name": "d",
+                    "predictors": [
+                        {
+                            "name": "p",
+                            "graph": {
+                                "name": "m",
+                                "implementation": "SIMPLE_MODEL",
+                                "type": "MODEL",
+                            },
+                        }
+                    ],
+                }
+            }
+        )
+    )
+
+
+def _request(x=1.0):
+    return pb.SeldonMessage(
+        data=pb.DefaultData(tensor=pb.Tensor(shape=[1, 2], values=[x, 2.0]))
+    )
+
+
+def test_stock_grpc_client_against_fast_server():
+    """A completely stock grpc.aio client (C-core HTTP/2 + HPACK with
+    Huffman and dynamic table) round-trips against FastGrpcServer."""
+
+    async def run():
+        port = await _free_port()
+        server = await serve_grpc_fast(_engine(), "127.0.0.1", port)
+        try:
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            stub = channel.unary_unary(
+                "/seldon.protos.Seldon/Predict",
+                request_serializer=pb.SeldonMessage.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            for i in range(3):  # repeated calls exercise HPACK dynamic state
+                resp = await asyncio.wait_for(stub(_request(float(i))), 10)
+                assert resp.status.code == 200
+                vals = list(resp.data.tensor.values)
+                assert vals == pytest.approx([0.1, 0.9, 0.5])
+
+            # unknown method -> UNIMPLEMENTED via trailers-only response
+            bad = channel.unary_unary(
+                "/seldon.protos.Seldon/Nope",
+                request_serializer=pb.SeldonMessage.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await asyncio.wait_for(bad(_request()), 10)
+            assert e.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+            # SendFeedback
+            fb_stub = channel.unary_unary(
+                "/seldon.protos.Seldon/SendFeedback",
+                request_serializer=pb.Feedback.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            ack = await asyncio.wait_for(
+                fb_stub(pb.Feedback(request=_request(), reward=1.0)), 10
+            )
+            assert ack is not None
+            await channel.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_client_against_stock_grpc_server():
+    """FastGrpcChannel (our HTTP/2 + HPACK) against the stock grpc.aio
+    server."""
+
+    async def run():
+        port = await _free_port()
+        server = make_engine_grpc_server(_engine(), "127.0.0.1", port)
+        await server.start()
+        try:
+            ch = await FastGrpcChannel().connect("127.0.0.1", port)
+            wire = _request().SerializeToString()
+            resp_wire = await asyncio.wait_for(ch.call(PREDICT, wire), 10)
+            resp = pb.SeldonMessage.FromString(resp_wire)
+            assert list(resp.data.tensor.values) == pytest.approx(
+                [0.1, 0.9, 0.5]
+            )
+            await ch.close()
+        finally:
+            await server.stop(grace=0.1)
+
+    asyncio.run(run())
+
+
+def test_fast_to_fast_multiplexed_concurrency():
+    """100 concurrent unary calls multiplex over ONE fast connection."""
+
+    async def run():
+        port = await _free_port()
+        server = await serve_grpc_fast(_engine(), "127.0.0.1", port)
+        try:
+            ch = await FastGrpcChannel().connect("127.0.0.1", port)
+            wire = _request().SerializeToString()
+            resps = await asyncio.wait_for(
+                asyncio.gather(*[ch.call(PREDICT, wire) for _ in range(100)]),
+                30,
+            )
+            assert len(resps) == 100
+            for rw in resps:
+                resp = pb.SeldonMessage.FromString(rw)
+                assert resp.status.code == 200
+            await ch.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_large_message_both_ways():
+    """A request above the 16 KiB HTTP/2 frame size forces multi-frame DATA
+    in both directions (client chunking, server reassembly)."""
+
+    async def run():
+        port = await _free_port()
+        server = await serve_grpc_fast(_engine(), "127.0.0.1", port)
+        try:
+            ch = await FastGrpcChannel().connect("127.0.0.1", port)
+            n = 6000  # 6000 doubles ~ 48 KB on the wire
+            req = pb.SeldonMessage(
+                data=pb.DefaultData(
+                    tensor=pb.Tensor(shape=[1, n], values=[0.5] * n)
+                )
+            )
+            # SIMPLE_MODEL takes any width; response is small
+            resp_wire = await asyncio.wait_for(
+                ch.call(PREDICT, req.SerializeToString()), 30
+            )
+            resp = pb.SeldonMessage.FromString(resp_wire)
+            assert resp.status.code == 200
+            await ch.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_fast_server_failure_semantics_match_stock_lane():
+    """Typed errors surface as FAILURE SeldonMessages with grpc-status 0 —
+    identical to grpc_server.make_engine_grpc_server's predict_wire."""
+
+    async def run():
+        port = await _free_port()
+        server = await serve_grpc_fast(_engine(), "127.0.0.1", port)
+        try:
+            ch = await FastGrpcChannel().connect("127.0.0.1", port)
+            # strData payload: the engine's proto path rejects it as a typed
+            # error -> FAILURE message, not a transport error
+            req = pb.SeldonMessage(strData="nope")
+            resp = pb.SeldonMessage.FromString(
+                await asyncio.wait_for(
+                    ch.call(PREDICT, req.SerializeToString()), 10
+                )
+            )
+            assert resp.status.status == pb.Status.StatusFlag.FAILURE
+            # malformed grpc frame -> INTERNAL
+            with pytest.raises(GrpcCallError) as e:
+                conn = ch._conn
+                from seldon_core_tpu.runtime import grpcfast as gf
+
+                sid = conn.next_stream
+                conn.next_stream += 2
+                fut = asyncio.get_running_loop().create_future()
+                conn.calls[sid] = {
+                    "future": fut, "body": bytearray(), "status": None
+                }
+                from seldon_core_tpu.native.hpackcodec import encode_headers
+
+                block = encode_headers([
+                    (b":method", b"POST"), (b":scheme", b"http"),
+                    (b":path", PREDICT), (b":authority", b"x"),
+                    (b"content-type", b"application/grpc"),
+                    (b"te", b"trailers"),
+                ])
+                conn.transport.write(
+                    gf._frame(gf._HEADERS, gf._F_END_HEADERS, sid, block)
+                    + gf._frame(
+                        gf._DATA, gf._F_END_STREAM, sid, b"\x01\x00\x00"
+                    )
+                )
+                await asyncio.wait_for(fut, 10)
+            assert e.value.status == 13
+            await ch.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_partial_send_resumes_on_window_update():
+    """A response bigger than the stock client's 65535-byte initial stream
+    window forces the server to stall mid-payload and resume on the
+    client's WINDOW_UPDATEs (the all-or-nothing defer would deadlock)."""
+
+    async def run():
+        from seldon_core_tpu.runtime.grpcfast import FastGrpcServer
+
+        big = bytes(range(256)) * 1024  # 256 KiB
+
+        async def echo(message: bytes) -> bytes:
+            return big
+
+        port = await _free_port()
+        server = FastGrpcServer({b"/t.T/Big": echo})
+        await server.start("127.0.0.1", port)
+        try:
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            stub = channel.unary_unary("/t.T/Big")  # raw bytes in/out
+            resp = await asyncio.wait_for(stub(b"x"), 15)
+            assert resp == big
+            # stream window bookkeeping must not leak entries
+            conn = next(iter(server._protocols))
+            assert not conn.stream_send_windows
+            assert not conn._tx
+            await channel.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())

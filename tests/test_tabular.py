@@ -112,6 +112,7 @@ _EXAMPLE_FEATURES = {
     "mean_transformer_deployment.json": 6,
     "gbm_deployment.json": 8,
     "generator_deployment.json": 5,  # 5-token prompts -> generated tokens
+    "stub_deployment.json": 1,  # the reference's max-throughput stub graph
 }
 
 
