@@ -98,8 +98,9 @@ def build_units(predictor: PredictorSpec, rng=None) -> Dict[str, Unit]:
                     f"node {node.name!r} is not an in-process unit; compiled mode "
                     f"requires every node in-process (use the host interpreter)"
                 )
-            cls = resolve_unit_class(binding.class_path)
-            unit = cls(**params_to_kwargs(binding.parameters or node.parameters))
+            from seldon_core_tpu.graph.units import instantiate_bound_unit
+
+            unit = instantiate_bound_unit(binding, node)
         if not unit.pure:
             raise GraphSpecError(
                 f"unit {node.name!r} ({type(unit).__name__}) is not pure; compiled "

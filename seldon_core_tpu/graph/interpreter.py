@@ -288,10 +288,11 @@ class GraphExecutor:
                     f"node {node.name!r} has no implementation, binding, or runtime"
                 )
             if binding.runtime == "inprocess":
-                cls = resolve_unit_class(binding.class_path)
-                params = params_to_kwargs(binding.parameters or node.parameters)
+                from seldon_core_tpu.graph.units import instantiate_bound_unit
+
                 self.runtimes[node.name] = InProcessNodeRuntime(
-                    node, cls(**params), rngs[node.name]
+                    node, instantiate_bound_unit(binding, node),
+                    rngs[node.name]
                 )
             else:
                 # remote runtimes are attached by the engine service

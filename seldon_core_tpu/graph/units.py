@@ -47,6 +47,7 @@ __all__ = [
     "normalize_output",
     "register_unit",
     "resolve_unit_class",
+    "instantiate_bound_unit",
     "UNIT_REGISTRY",
     "SimpleModelUnit",
     "SimpleRouterUnit",
@@ -168,6 +169,32 @@ def resolve_unit_class(class_path: str) -> Type[Unit]:
     raise ValueError(
         f"unknown unit {class_path!r}: not registered and not a module:Class path"
     )
+
+
+def instantiate_bound_unit(binding, node) -> Unit:
+    """Build the in-process Unit for a component binding, honouring the
+    binding's ``mesh_axes``: a declared mesh (e.g. ``{"tp": 4}`` or
+    ``{"ens": 8}``) is constructed over the local devices and handed to the
+    unit, so one graph node spans a multi-chip mesh through the standard
+    deployment JSON (SURVEY.md §2.7's graph-node-spans-a-mesh design).
+    Units that cannot shard reject the binding loudly."""
+    from seldon_core_tpu.graph.spec import GraphSpecError, params_to_kwargs
+
+    cls = resolve_unit_class(binding.class_path)
+    kwargs = params_to_kwargs(binding.parameters or node.parameters)
+    if binding.mesh_axes:
+        import inspect
+
+        from seldon_core_tpu.parallel.mesh import build_mesh
+
+        if "mesh" not in inspect.signature(cls.__init__).parameters:
+            raise GraphSpecError(
+                f"component {binding.name!r} declares mesh_axes "
+                f"{dict(binding.mesh_axes)} but unit {cls.__name__} takes no "
+                f"mesh; drop mesh_axes or use a mesh-capable unit"
+            )
+        kwargs["mesh"] = build_mesh(dict(binding.mesh_axes))
+    return cls(**kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -232,7 +232,12 @@ class TransformerGenerator(Unit):
                  n_layers: int = 2, d_ff: int = 512, seed: int = 0,
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  dtype: str = "bfloat16", moe_every: int = 0,
-                 n_experts: int = 8, moe_k: int = 2):
+                 n_experts: int = 8, moe_k: int = 2, mesh=None):
+        # mesh (from the binding's mesh_axes, e.g. {"tp": 4}): params are
+        # laid out with the LM's tp shardings and GSPMD partitions the
+        # whole prefill+decode program across the mesh — one generator
+        # graph node spans multiple chips through the deployment JSON
+        self.mesh = mesh
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff),
@@ -258,6 +263,12 @@ class TransformerGenerator(Unit):
         if rng is None:
             rng = jax.random.key(self.seed)
         params = lm_init(jax.random.fold_in(rng, self.seed), self.cfg)
+        if self.mesh is not None:
+            from seldon_core_tpu.models.transformer import param_shardings
+
+            params = jax.device_put(
+                params, param_shardings(self.mesh, params)
+            )
         return {"params": params, "requests": jnp.zeros((), jnp.int32)}
 
     def predict(self, state, X):
@@ -266,12 +277,15 @@ class TransformerGenerator(Unit):
         prompt = sanitize_prompt(X, self.cfg.vocab)
         key = jax.random.fold_in(jax.random.key(self.seed),
                                  state["requests"])
+        # pallas_call is not auto-partitionable under GSPMD: any multi-chip
+        # mesh keeps the XLA attention path (same rule as _attention)
+        multi = self.mesh is not None and self.mesh.size > 1
         y = generate(
             state["params"], prompt, self.cfg,
             max_new_tokens=self.max_new_tokens,
             temperature=self.temperature,
             rng=key,
-            use_flash=pallas_supported(),
+            use_flash=pallas_supported() and not multi,
         ).astype(jnp.float32)
         if self.temperature > 0.0:
             new_state = {"params": state["params"],

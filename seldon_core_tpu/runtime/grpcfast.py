@@ -204,6 +204,9 @@ class _H2Endpoint(asyncio.Protocol):
                     # ours never references dynamic entries, so ignore it;
                     # our decoder's table is sized by OUR advertised default
                 self.transport.write(_frame(_SETTINGS, _F_ACK, 0, b""))
+                # RFC 7540 §6.9.2: a SETTINGS raising INITIAL_WINDOW_SIZE
+                # can make stalled streams sendable — resume them
+                self._drain_pending()
         elif ftype == _WINDOW_UPDATE:
             (inc,) = struct.unpack(">I", payload)
             inc &= 0x7FFFFFFF
@@ -353,6 +356,7 @@ class _ServerConnection(_H2Endpoint):
         self.handlers = handlers
         self.protocols = protocols
         self.streams: Dict[int, Tuple[bytes, bytearray]] = {}  # sid -> (path, body)
+        self._tasks: set = set()  # strong refs: create_task alone can be GC'd
         # response HEADERS + OK trailers are constant: build once per conn
         self._resp_headers = encode_headers(
             [(b":status", b"200"), (b"content-type", b"application/grpc")]
@@ -410,9 +414,11 @@ class _ServerConnection(_H2Endpoint):
                     sid, GRPC_INTERNAL, b"grpc frame length mismatch"
                 )
                 return
-            asyncio.get_running_loop().create_task(
+            task = asyncio.get_running_loop().create_task(
                 self._run(sid, handler, bytes(buf[5:]))
             )
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
 
     def _on_rst(self, sid):
         self.streams.pop(sid, None)

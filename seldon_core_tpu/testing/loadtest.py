@@ -129,6 +129,7 @@ async def run_load(
         channels = []
         for _ in range(n_conns):
             channels.append(await FastGrpcChannel().connect(host, port))
+        locks = [asyncio.Lock() for _ in range(n_conns)]
 
         async def client(i):
             nonlocal failures
@@ -137,22 +138,21 @@ async def run_load(
                 ch = channels[slot]
                 t0 = time.perf_counter()
                 try:
-                    await ch.call(path, wire)
+                    # same 30s deadline as the stock-lane stub calls
+                    await asyncio.wait_for(ch.call(path, wire), 30)
                     latencies.append(time.perf_counter() - t0)
-                except (GrpcCallError, OSError):
+                except (GrpcCallError, OSError, asyncio.TimeoutError):
                     failures += 1
-                    conn = ch._conn
-                    if (
-                        channels[slot] is ch
-                        and (conn is None or conn.transport is None
-                             or conn.transport.is_closing())
-                    ):
-                        try:  # first client to notice reconnects the slot
-                            channels[slot] = await FastGrpcChannel().connect(
-                                host, port
-                            )
-                        except OSError:
-                            await asyncio.sleep(0.05)
+                    async with locks[slot]:  # one reconnect per dead conn
+                        conn = channels[slot]._conn
+                        if (conn is None or conn.transport is None
+                                or conn.transport.is_closing()):
+                            try:
+                                channels[slot] = (
+                                    await FastGrpcChannel().connect(host, port)
+                                )
+                            except OSError:
+                                await asyncio.sleep(0.05)
 
         t_start = time.perf_counter()
         await asyncio.gather(*[client(i) for i in range(clients)])

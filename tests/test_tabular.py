@@ -113,6 +113,7 @@ _EXAMPLE_FEATURES = {
     "gbm_deployment.json": 8,
     "generator_deployment.json": 5,  # 5-token prompts -> generated tokens
     "stub_deployment.json": 1,  # the reference's max-throughput stub graph
+    "generator_tp_deployment.json": 5,  # tp=4 mesh-sharded LM generator
 }
 
 
