@@ -205,6 +205,43 @@ class EngineService:
             native_available()
 
 
+    def prewarm(self, widths) -> int:
+        """Compile every batch-bucket shape for the given feature widths
+        before serving (boot-time analogue of the reference's JVM/Tomcat
+        warm-up concern; the readiness probe only flips after this returns).
+
+        Padding batchers dispatch power-of-two sizes capped at max_batch
+        (runtime/batching.py:_dispatch_chunked), so the compiled-shape set
+        per width is {1, 2, 4, ..., max_batch}; compiling them here (backed
+        by the persistent compile cache) means no first-request XLA compile
+        ever stalls live traffic.  Stateful graphs run UNPADDED
+        (pad_to_buckets=False: fake rows must not enter streaming
+        statistics), so their live batch sizes are arbitrary and cannot be
+        enumerated — for those only the single-row shape is compiled and
+        first-burst compiles may still occur.  Returns the number of shapes
+        compiled."""
+        if self.compiled is None or self.batcher is None:
+            return 0
+        import numpy as _np
+
+        max_batch = self.batcher.max_batch
+        if self.batcher.pad_to_buckets:
+            # powers of two capped at max_batch; a non-power-of-two
+            # max_batch is itself a bucket shape and must be compiled too
+            sizes = [1 << i for i in range(max_batch.bit_length())
+                     if (1 << i) < max_batch] + [max_batch]
+        else:
+            sizes = [1]
+        compiled = 0
+        for width in widths:
+            shape = (width,) if isinstance(width, int) else tuple(width)
+            for b in sizes:
+                x = _np.zeros((b,) + shape, dtype=_np.float64)
+                self.compiled.predict_arrays(x, update_states=False)
+                self._known_good_widths.add(x.shape[1:])
+                compiled += 1
+        return compiled
+
     async def _submit(self, rows):
         """Batched dispatch under the engine deadline — the reference's
         per-call budget (5 s gRPC deadlines,

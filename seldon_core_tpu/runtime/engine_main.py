@@ -89,6 +89,19 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         max_wait_ms=float(os.environ.get("ENGINE_BATCH_WAIT_MS", "2.0")),
         pipeline_depth=int(os.environ.get("ENGINE_PIPELINE_DEPTH", "8")),
     )
+    # boot-time shape compilation: ENGINE_PREWARM_WIDTHS="784,16" compiles
+    # every batch bucket of those feature widths before the server binds,
+    # so live traffic never waits on an XLA compile (engine.prewarm)
+    prewarm_raw = os.environ.get("ENGINE_PREWARM_WIDTHS", "")
+    if prewarm_raw.strip():
+        widths = [int(w) for w in prewarm_raw.split(",") if w.strip()]
+        t0 = asyncio.get_event_loop().time()
+        n = engine.prewarm(widths)
+        print(
+            f"prewarmed {n} batch shapes for widths {widths} "
+            f"in {asyncio.get_event_loop().time() - t0:.1f}s",
+            flush=True,
+        )
     # data plane: raw-protocol HTTP front by default (runtime/httpfast.py);
     # ENGINE_HTTP_IMPL=aiohttp keeps the full aiohttp app on the port
     if os.environ.get("ENGINE_HTTP_IMPL", "fast") == "fast":

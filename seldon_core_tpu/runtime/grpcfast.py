@@ -507,7 +507,7 @@ class FastGrpcServer:
         loop = asyncio.get_running_loop()
         self._server = await loop.create_server(
             lambda: _ServerConnection(self.handlers, self._protocols),
-            host, port,
+            host, port, backlog=4096,
         )
 
     async def stop(self) -> None:

@@ -381,7 +381,7 @@ class FastHttpServer:
         loop = asyncio.get_running_loop()
         self._server = await loop.create_server(
             lambda: _FastHttpProtocol(self.routes, self._protocols),
-            host, port,
+            host, port, backlog=4096,
         )
 
     async def stop(self) -> None:
