@@ -77,6 +77,7 @@ class MicroBatcher:
         # partially applied — reject oversized requests instead
         self.atomic_chunks = bool(atomic_chunks)
         self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
         self.coalesce_s = min(float(coalesce_ms), float(max_wait_ms)) / 1e3
         # pad stacked batches up to power-of-two sizes so jit sees a handful
         # of shapes instead of retracing for every distinct row total; callers

@@ -102,15 +102,39 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
             f"in {asyncio.get_event_loop().time() - t0:.1f}s",
             flush=True,
         )
-    # data plane: raw-protocol HTTP front by default (runtime/httpfast.py);
-    # ENGINE_HTTP_IMPL=aiohttp keeps the full aiohttp app on the port
-    if os.environ.get("ENGINE_HTTP_IMPL", "fast") == "fast":
+    # data plane, fastest eligible lane first:
+    #   native (C++ HTTP termination + batching, runtime/nativeplane.py)
+    #   fast   (asyncio.Protocol, runtime/httpfast.py)
+    #   aiohttp (full framework app, runtime/rest.py)
+    # ENGINE_HTTP_IMPL picks explicitly; the default tries native and falls
+    # back per-lane (ineligible graph, missing toolchain)
+    http_impl = os.environ.get("ENGINE_HTTP_IMPL", "native").strip().lower()
+    if http_impl not in ("native", "fast", "aiohttp"):
+        # never boot with NO data plane: unknown names get the most
+        # compatible lane plus a loud line in the pod log
+        print(f"unknown ENGINE_HTTP_IMPL={http_impl!r}; serving aiohttp",
+              flush=True)
+        http_impl = "aiohttp"
+    native_plane = None
+    fast_server = None
+    runner = None
+    if http_impl == "native":
+        try:
+            from seldon_core_tpu.runtime.nativeplane import serve_native
+
+            # the C++ listener binds a single address; 0.0.0.0 maps to ANY
+            native_plane = await serve_native(
+                engine, host if host != "0.0.0.0" else "", rest_port
+            )
+        except (RuntimeError, OSError) as e:
+            print(f"native data plane unavailable ({e}); "
+                  f"serving the Python fast lane", flush=True)
+            http_impl = "fast"
+    if http_impl == "fast":
         from seldon_core_tpu.runtime.httpfast import serve_fast
 
         fast_server = await serve_fast(engine, host, rest_port)
-        runner = None
-    else:
-        fast_server = None
+    elif http_impl == "aiohttp":
         runner = await serve_app(make_engine_app(engine), host, rest_port)
     # gRPC data plane: wire-level HTTP/2 lane by default (runtime/grpcfast.py,
     # unary Predict/SendFeedback — the whole Seldon service surface);
@@ -170,6 +194,8 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         await runner.cleanup()
     if fast_server is not None:
         await fast_server.stop()
+    if native_plane is not None:
+        await native_plane.stop()
     print("engine stopped", flush=True)
 
 
