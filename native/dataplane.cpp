@@ -135,13 +135,18 @@ struct PuidGen {
 struct ReqInfo {
   int conn_id;
   uint32_t conn_gen;
-  uint64_t seq;       // per-conn response order
-  int kind;           // KIND_TENSOR / KIND_NDARRAY
+  uint64_t seq;       // per-conn response order (HTTP/1.1 lane)
+  int kind;           // KIND_TENSOR / KIND_NDARRAY / KIND_PROTO
   long long rows;
   bool close_c = false;  // request asked Connection: close
-  std::string meta;   // verbatim client meta object ("" if absent)
+  bool h2 = false;       // gRPC lane: respond by stream, not by seq
+  uint32_t stream = 0;   // h2 stream id
+  std::string meta;   // HTTP lane: verbatim client meta object ("" if absent)
+  std::string puid;   // gRPC lane: client puid ("" -> generate)
   double t0;          // parse time, for the latency histogram
 };
+
+constexpr int KIND_PROTO = 100;  // gRPC tensor request (proto wire response)
 
 struct Batch {
   long long id;
@@ -157,6 +162,8 @@ struct MiscReq {
   uint32_t conn_gen;
   uint64_t seq;
   bool close_c = false;
+  bool h2 = false;       // gRPC misc: method="GRPC", body = message bytes
+  uint32_t stream = 0;
   std::string method;  // "GET" / "POST"
   std::string path;    // without query
   std::string query;
@@ -164,9 +171,13 @@ struct MiscReq {
   std::string body;
 };
 
+struct H2State;  // defined in the gRPC lane section below
+
 struct Conn {
   int fd = -1;
   uint32_t gen = 0;
+  bool h2 = false;
+  std::unique_ptr<H2State> h2s;
   std::string in;
   size_t scan_from = 0;
   ssize_t head_end = -1;
@@ -195,7 +206,8 @@ struct Plane {
   long long max_batch;
   double max_wait_s;
   int depth;
-  std::string names_frag;  // '"names":["a","b"],' or ""
+  std::string names_frag;        // JSON: '"names":["a","b"],' or ""
+  std::string proto_names_frag;  // proto: DefaultData.names fields wire bytes
 
   std::vector<std::unique_ptr<Conn>> conns;
   std::vector<int> free_conns;
@@ -215,9 +227,22 @@ struct Plane {
   int inflight_count = 0;
 
   // completions: responses composed off-thread, flushed by the IO thread
+  struct Completion {
+    int conn_id;
+    uint32_t gen;
+    bool h2;
+    uint64_t seq;      // HTTP lane: response order slot
+    uint32_t stream;   // gRPC lane: stream id
+    int grpc_status;   // gRPC lane: 0 = data+OK trailers, else trailers-only
+    std::string data;  // HTTP: full response; h2 ok: grpc message frame;
+                       // h2 error: grpc-message text
+  };
   std::mutex cmu;
-  std::vector<std::pair<std::pair<int, uint32_t>,
-                        std::pair<uint64_t, std::string>>> completions;
+  std::vector<Completion> completions;
+
+  // gRPC listener (0 = lane disabled)
+  int grpc_listen_fd = -1;
+  int grpc_port = 0;
 
   // io-thread-local: conns needing a parse retry after backpressure resume
   std::vector<int> resume_parse;
@@ -335,9 +360,20 @@ std::string response_meta(Plane* pl, const std::string& client_meta) {
 void queue_completion(Plane* pl, const ReqInfo& r, std::string&& resp) {
   {
     std::lock_guard<std::mutex> lk(pl->cmu);
-    pl->completions.emplace_back(
-        std::make_pair(r.conn_id, r.conn_gen),
-        std::make_pair(r.seq, std::move(resp)));
+    pl->completions.push_back(Plane::Completion{
+        r.conn_id, r.conn_gen, false, r.seq, 0, 0, std::move(resp)});
+  }
+  uint64_t one = 1;
+  (void)!write(pl->evfd, &one, 8);
+}
+
+void queue_completion_h2(Plane* pl, int conn_id, uint32_t gen,
+                         uint32_t stream, int grpc_status,
+                         std::string&& data) {
+  {
+    std::lock_guard<std::mutex> lk(pl->cmu);
+    pl->completions.push_back(Plane::Completion{
+        conn_id, gen, true, 0, stream, grpc_status, std::move(data)});
   }
   uint64_t one = 1;
   (void)!write(pl->evfd, &one, 8);
@@ -348,7 +384,7 @@ void queue_completion(Plane* pl, const ReqInfo& r, std::string&& resp) {
 // ---------------------------------------------------------------------------
 
 struct EvTag {  // epoll user data: fd class + conn index
-  enum { LISTEN = -1, EVENT = -2 };
+  enum { LISTEN = -1, EVENT = -2, LISTEN_GRPC = -3 };
 };
 
 void arm(Plane* pl, int fd, int idx, uint32_t events, int op) {
@@ -379,7 +415,9 @@ void conn_close(Plane* pl, int ci) {
 void conn_flush(Plane* pl, int ci) {
   Conn& c = *pl->conns[ci];
   if (c.fd < 0) return;
-  while (c.next_write <= c.close_after) {
+  // HTTP lane: responses drain strictly in request order; the h2 lane
+  // writes frames straight into c.out (streams are self-identifying)
+  while (!c.h2 && c.next_write <= c.close_after) {
     auto it = c.done.find(c.next_write);
     if (it == c.done.end()) break;
     c.out += it->second;
@@ -405,7 +443,7 @@ void conn_flush(Plane* pl, int ci) {
     c.want_write = false;
     arm(pl, c.fd, ci, EPOLLIN, EPOLL_CTL_MOD);
   }
-  if (c.next_write > c.close_after) {
+  if (!c.h2 && c.next_write > c.close_after) {
     conn_close(pl, ci);
     return;
   }
@@ -440,6 +478,40 @@ void flush_batch_locked(Plane* pl, long long width) {
   pl->cv_batch.notify_one();
 }
 
+// Shared batch admission for both lanes: append `rows x width` doubles and
+// the request's ReqInfo to the width-keyed accumulation, flushing on
+// overflow/full.  Returns false when the global row backstop is hit (the
+// caller answers 503 / RESOURCE_EXHAUSTED).
+bool enqueue_rows(Plane* pl, ReqInfo&& r, const void* vals, long long rows,
+                  long long width) {
+  std::lock_guard<std::mutex> lk(pl->mu);
+  if (pl->queued_rows + rows > MAX_QUEUED_ROWS) return false;
+  {
+    auto pre = pl->accum.find(width);
+    if (pre != pl->accum.end() && pre->second &&
+        (long long)(pre->second->data.size() / width) + rows > pl->max_batch)
+      flush_batch_locked(pl, width);  // this request would overflow: flush
+  }
+  auto& slot = pl->accum[width];
+  if (!slot) {
+    slot.reset(new Batch());
+    slot->id = pl->next_batch_id++;
+    slot->width = width;
+    slot->data.reserve((size_t)std::min<long long>(pl->max_batch, 4096) *
+                       width);
+    slot->t_first = now_s();
+  }
+  Batch& b = *slot;
+  size_t off = b.data.size();
+  b.data.resize(off + (size_t)(rows * width));
+  memcpy(b.data.data() + off, vals, sizeof(double) * (size_t)(rows * width));
+  b.reqs.push_back(std::move(r));
+  pl->queued_rows += rows;
+  if ((long long)(b.data.size() / width) >= pl->max_batch)
+    flush_batch_locked(pl, width);
+  return true;
+}
+
 // returns false if the request was NOT eligible for the fast lane
 bool try_fast_predict(Plane* pl, int ci, const char* body, size_t blen,
                       bool close_c) {
@@ -465,34 +537,6 @@ bool try_fast_predict(Plane* pl, int ci, const char* body, size_t blen,
     if (p) sm_free(p);
     return false;
   }
-  std::unique_lock<std::mutex> lk(pl->mu);
-  if (pl->queued_rows + rows > MAX_QUEUED_ROWS) {
-    lk.unlock();
-    sm_free(p);
-    respond_now(pl, ci, 503,
-                "{\"status\":{\"code\":503,\"status\":\"FAILURE\","
-                "\"reason\":\"overloaded\"}}", close_c);
-    return true;  // consumed (with a 503), not misc-lane material
-  }
-  {
-    auto pre = pl->accum.find(width);
-    if (pre != pl->accum.end() && pre->second &&
-        (long long)(pre->second->data.size() / width) + rows > pl->max_batch)
-      flush_batch_locked(pl, width);  // this request would overflow: flush
-  }
-  auto& slot = pl->accum[width];
-  if (!slot) {
-    slot.reset(new Batch());
-    slot->id = pl->next_batch_id++;
-    slot->width = width;
-    slot->data.reserve((size_t)std::min<long long>(pl->max_batch, 4096) *
-                       width);
-    slot->t_first = now_s();
-  }
-  Batch& b = *slot;
-  size_t off = b.data.size();
-  b.data.resize(off + (size_t)rows * width);
-  memcpy(b.data.data() + off, v.values, sizeof(double) * rows * width);
   ReqInfo r;
   r.conn_id = ci;
   r.conn_gen = c.gen;
@@ -502,12 +546,20 @@ bool try_fast_predict(Plane* pl, int ci, const char* body, size_t blen,
   r.close_c = close_c;
   r.meta = std::move(meta);
   r.t0 = now_s();
-  b.reqs.push_back(std::move(r));
-  pl->queued_rows += rows;
-  if ((long long)(b.data.size() / width) >= pl->max_batch)
-    flush_batch_locked(pl, width);
-  lk.unlock();
+  uint64_t seq = r.seq;
+  bool accepted = enqueue_rows(pl, std::move(r), v.values, rows, width);
   sm_free(p);
+  if (!accepted) {
+    // seq was already assigned: answer it, keeping per-conn order intact
+    static const std::string overload =
+        "{\"status\":{\"code\":503,\"status\":\"FAILURE\","
+        "\"reason\":\"overloaded\"}}";
+    Conn& cc = *pl->conns[ci];
+    cc.done[seq] = http_response(503, "application/json", overload.data(),
+                                 overload.size(), close_c);
+    if (close_c) cc.close_after = seq;
+    pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
+  }
   return true;
 }
 
@@ -671,6 +723,8 @@ void conn_parse(Plane* pl, int ci) {
   }
 }
 
+void h2_parse(Plane* pl, int ci);  // gRPC lane, defined below
+
 void conn_data(Plane* pl, int ci) {
   Conn& c = *pl->conns[ci];
   char buf[65536];
@@ -691,14 +745,942 @@ void conn_data(Plane* pl, int ci) {
     }
     break;
   }
-  conn_parse(pl, ci);
+  if (c.h2) h2_parse(pl, ci);
+  else conn_parse(pl, ci);
+}
+
+// ---------------------------------------------------------------------------
+// gRPC lane: HTTP/2 + HPACK + protobuf tensor fast path.
+//
+// The HPACK decoder is a C++ port of this framework's own
+// seldon_core_tpu/native/hpackcodec.py (RFC 7541: static+dynamic tables,
+// Huffman via a bit trie built from the spec table); the proto scanner
+// mirrors seldon_core_tpu/native/protowire.py exactly — any message shape
+// the Python fast lane declines, this lane declines to the misc queue, so
+// wire semantics never diverge between planes.
+// ---------------------------------------------------------------------------
+
+// RFC 7541 Appendix B Huffman code table (public spec data)
+const uint32_t kHuffCodes[257] = {
+    8184, 8388568, 268435426, 268435427, 268435428, 268435429, 268435430,
+    268435431, 268435432, 16777194, 1073741820, 268435433, 268435434,
+    1073741821, 268435435, 268435436, 268435437, 268435438, 268435439,
+    268435440, 268435441, 268435442, 1073741822, 268435443, 268435444,
+    268435445, 268435446, 268435447, 268435448, 268435449, 268435450,
+    268435451, 20, 1016, 1017, 4090, 8185, 21, 248, 2042, 1018, 1019, 249,
+    2043, 250, 22, 23, 24, 0, 1, 2, 25, 26, 27, 28, 29, 30, 31, 92, 251,
+    32764, 32, 4091, 1020, 8186, 33, 93, 94, 95, 96, 97, 98, 99, 100, 101,
+    102, 103, 104, 105, 106, 107, 108, 109, 110, 111, 112, 113, 114, 252,
+    115, 253, 8187, 524272, 8188, 16380, 34, 32765, 3, 35, 4, 36, 5, 37, 38,
+    39, 6, 116, 117, 40, 41, 42, 7, 43, 118, 44, 8, 9, 45, 119, 120, 121,
+    122, 123, 32766, 2044, 16381, 8189, 268435452, 1048550, 4194258, 1048551,
+    1048552, 4194259, 4194260, 4194261, 8388569, 4194262, 8388570, 8388571,
+    8388572, 8388573, 8388574, 16777195, 8388575, 16777196, 16777197,
+    4194263, 8388576, 16777198, 8388577, 8388578, 8388579, 8388580, 2097116,
+    4194264, 8388581, 4194265, 8388582, 8388583, 16777199, 4194266, 2097117,
+    1048553, 4194267, 4194268, 8388584, 8388585, 2097118, 8388586, 4194269,
+    4194270, 16777200, 2097119, 4194271, 8388587, 8388588, 2097120, 2097121,
+    4194272, 2097122, 8388589, 4194273, 8388590, 8388591, 1048554, 4194274,
+    4194275, 4194276, 8388592, 4194277, 4194278, 8388593, 67108832, 67108833,
+    1048555, 524273, 4194279, 8388594, 4194280, 33554412, 67108834, 67108835,
+    67108836, 134217694, 134217695, 67108837, 16777201, 33554413, 524274,
+    2097123, 67108838, 134217696, 134217697, 67108839, 134217698, 16777202,
+    2097124, 2097125, 67108840, 67108841, 268435453, 134217699, 134217700,
+    134217701, 1048556, 16777203, 1048557, 2097126, 4194281, 2097127,
+    2097128, 8388595, 4194282, 4194283, 33554414, 33554415, 16777204,
+    16777205, 67108842, 8388596, 67108843, 134217702, 67108844, 67108845,
+    134217703, 134217704, 134217705, 134217706, 134217707, 268435454,
+    134217708, 134217709, 134217710, 134217711, 134217712, 67108846,
+    1073741823};
+const uint8_t kHuffLens[257] = {
+    13, 23, 28, 28, 28, 28, 28, 28, 28, 24, 30, 28, 28, 30, 28, 28, 28, 28,
+    28, 28, 28, 28, 30, 28, 28, 28, 28, 28, 28, 28, 28, 28, 6,  10, 10, 12,
+    13, 6,  8,  11, 10, 10, 8,  11, 8,  6,  6,  6,  5,  5,  5,  6,  6,  6,
+    6,  6,  6,  6,  7,  8,  15, 6,  12, 10, 13, 6,  7,  7,  7,  7,  7,  7,
+    7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  7,  8,  7,
+    8,  13, 19, 13, 14, 6,  15, 5,  6,  5,  6,  5,  6,  6,  6,  5,  7,  7,
+    6,  6,  6,  5,  6,  7,  6,  5,  5,  6,  7,  7,  7,  7,  7,  15, 11, 14,
+    13, 28, 20, 22, 20, 20, 22, 22, 22, 23, 22, 23, 23, 23, 23, 23, 24, 23,
+    24, 24, 22, 23, 24, 23, 23, 23, 23, 21, 22, 23, 22, 23, 23, 24, 22, 21,
+    20, 22, 22, 23, 23, 21, 23, 22, 22, 24, 21, 22, 23, 23, 21, 21, 22, 21,
+    23, 22, 23, 23, 20, 22, 22, 22, 23, 22, 22, 23, 26, 26, 20, 19, 22, 23,
+    22, 25, 26, 26, 26, 27, 27, 26, 24, 25, 19, 21, 26, 27, 27, 26, 27, 24,
+    21, 21, 26, 26, 28, 27, 27, 27, 20, 24, 20, 21, 22, 21, 21, 23, 22, 22,
+    25, 25, 24, 24, 26, 23, 26, 27, 26, 26, 27, 27, 27, 27, 27, 28, 27, 27,
+    27, 27, 27, 26, 30};
+
+// Huffman decode trie: node pairs [zero_child, one_child], symbol per node.
+struct HuffTrie {
+  std::vector<int32_t> child;  // 2 per node, -1 = none
+  std::vector<int16_t> sym;    // -1 = internal, 256 = EOS
+  std::vector<bool> accept;    // all-ones-path states (legal padding ends)
+  HuffTrie() {
+    child.assign(2, -1);
+    sym.assign(1, -1);
+    for (int s = 0; s <= 256; s++) {
+      uint32_t code = kHuffCodes[s];
+      int len = kHuffLens[s];
+      int n = 0;
+      for (int i = len - 1; i >= 0; i--) {
+        int bit = (code >> i) & 1;
+        if (child[n * 2 + bit] < 0) {
+          child[n * 2 + bit] = (int32_t)sym.size();
+          child.push_back(-1);
+          child.push_back(-1);
+          sym.push_back(-1);
+        }
+        n = child[n * 2 + bit];
+      }
+      sym[n] = (int16_t)s;
+    }
+    accept.assign(sym.size(), false);
+    accept[0] = true;
+    int n = 0;
+    for (;;) {
+      n = child[n * 2 + 1];
+      if (n < 0 || sym[n] >= 0) break;
+      accept[n] = true;
+    }
+  }
+};
+const HuffTrie& huff_trie() {
+  static HuffTrie t;
+  return t;
+}
+
+bool huffman_decode(const uint8_t* data, size_t len, std::string& out) {
+  const HuffTrie& t = huff_trie();
+  int n = 0;
+  for (size_t i = 0; i < len; i++) {
+    for (int b = 7; b >= 0; b--) {
+      int bit = (data[i] >> b) & 1;
+      n = t.child[n * 2 + bit];
+      if (n < 0) return false;
+      int s = t.sym[n];
+      if (s >= 0) {
+        if (s == 256) return false;  // EOS in the body is an error
+        out += (char)s;
+        n = 0;
+      }
+    }
+  }
+  return t.accept[n];
+}
+
+struct HeaderPair {
+  std::string name, value;
+};
+
+const HeaderPair kStaticTable[61] = {
+    {":authority", ""}, {":method", "GET"}, {":method", "POST"},
+    {":path", "/"}, {":path", "/index.html"}, {":scheme", "http"},
+    {":scheme", "https"}, {":status", "200"}, {":status", "204"},
+    {":status", "206"}, {":status", "304"}, {":status", "400"},
+    {":status", "404"}, {":status", "500"}, {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+    {"accept-ranges", ""}, {"accept", ""},
+    {"access-control-allow-origin", ""}, {"age", ""}, {"allow", ""},
+    {"authorization", ""}, {"cache-control", ""}, {"content-disposition", ""},
+    {"content-encoding", ""}, {"content-language", ""}, {"content-length", ""},
+    {"content-location", ""}, {"content-range", ""}, {"content-type", ""},
+    {"cookie", ""}, {"date", ""}, {"etag", ""}, {"expect", ""},
+    {"expires", ""}, {"from", ""}, {"host", ""}, {"if-match", ""},
+    {"if-modified-since", ""}, {"if-none-match", ""}, {"if-range", ""},
+    {"if-unmodified-since", ""}, {"last-modified", ""}, {"link", ""},
+    {"location", ""}, {"max-forwards", ""}, {"proxy-authenticate", ""},
+    {"proxy-authorization", ""}, {"range", ""}, {"referer", ""},
+    {"refresh", ""}, {"retry-after", ""}, {"server", ""}, {"set-cookie", ""},
+    {"strict-transport-security", ""}, {"transfer-encoding", ""},
+    {"user-agent", ""}, {"vary", ""}, {"via", ""}, {"www-authenticate", ""}};
+
+class HpackDec {
+ public:
+  explicit HpackDec(size_t max_table = 4096) : max_size_(max_table) {}
+
+  // decode one header block; false on malformed (connection error)
+  bool decode(const uint8_t* p, size_t len,
+              std::vector<HeaderPair>& out) {
+    size_t pos = 0;
+    while (pos < len) {
+      uint8_t b = p[pos];
+      if (b & 0x80) {  // indexed
+        uint64_t idx;
+        if (!dec_int(p, len, pos, 7, idx) || idx == 0) return false;
+        HeaderPair hp;
+        if (!entry(idx, hp)) return false;
+        out.push_back(std::move(hp));
+      } else if ((b & 0xC0) == 0x40) {  // literal, incremental indexing
+        uint64_t idx;
+        if (!dec_int(p, len, pos, 6, idx)) return false;
+        HeaderPair hp;
+        if (!literal(p, len, pos, idx, hp)) return false;
+        insert(hp);
+        out.push_back(std::move(hp));
+      } else if ((b & 0xE0) == 0x20) {  // dynamic table size update
+        uint64_t sz;
+        if (!dec_int(p, len, pos, 5, sz)) return false;
+        if (sz > max_size_limit_) return false;
+        max_size_ = (size_t)sz;
+        evict();
+      } else {  // literal without indexing / never indexed (4-bit prefix)
+        uint64_t idx;
+        if (!dec_int(p, len, pos, 4, idx)) return false;
+        HeaderPair hp;
+        if (!literal(p, len, pos, idx, hp)) return false;
+        out.push_back(std::move(hp));
+      }
+    }
+    return true;
+  }
+
+ private:
+  std::deque<HeaderPair> dyn_;
+  size_t dyn_size_ = 0;
+  size_t max_size_;
+  size_t max_size_limit_ = 4096;
+
+  bool entry(uint64_t idx, HeaderPair& out) {
+    if (idx >= 1 && idx <= 61) {
+      out = kStaticTable[idx - 1];
+      return true;
+    }
+    size_t d = (size_t)idx - 62;
+    if (d >= dyn_.size()) return false;
+    out = dyn_[d];
+    return true;
+  }
+
+  void insert(const HeaderPair& hp) {
+    size_t sz = hp.name.size() + hp.value.size() + 32;
+    dyn_.push_front(hp);
+    dyn_size_ += sz;
+    evict();
+  }
+
+  void evict() {
+    while (dyn_size_ > max_size_ && !dyn_.empty()) {
+      dyn_size_ -= dyn_.back().name.size() + dyn_.back().value.size() + 32;
+      dyn_.pop_back();
+    }
+  }
+
+  static bool dec_int(const uint8_t* p, size_t len, size_t& pos, int prefix,
+                      uint64_t& out) {
+    if (pos >= len) return false;
+    uint64_t mask = (1u << prefix) - 1;
+    out = p[pos++] & mask;
+    if (out < mask) return true;
+    int shift = 0;
+    for (;;) {
+      if (pos >= len || shift > 35) return false;
+      uint8_t b = p[pos++];
+      out += (uint64_t)(b & 0x7F) << shift;
+      shift += 7;
+      if (!(b & 0x80)) return true;
+    }
+  }
+
+  static bool dec_str(const uint8_t* p, size_t len, size_t& pos,
+                      std::string& out) {
+    if (pos >= len) return false;
+    bool huff = p[pos] & 0x80;
+    uint64_t n;
+    if (!dec_int(p, len, pos, 7, n)) return false;
+    if (pos + n > len) return false;
+    if (huff) {
+      if (!huffman_decode(p + pos, (size_t)n, out)) return false;
+    } else {
+      out.assign((const char*)p + pos, (size_t)n);
+    }
+    pos += (size_t)n;
+    return true;
+  }
+
+  bool literal(const uint8_t* p, size_t len, size_t& pos, uint64_t name_idx,
+               HeaderPair& out) {
+    if (name_idx) {
+      HeaderPair nm;
+      if (!entry(name_idx, nm)) return false;
+      out.name = std::move(nm.name);
+    } else if (!dec_str(p, len, pos, out.name)) {
+      return false;
+    }
+    return dec_str(p, len, pos, out.value);
+  }
+};
+
+// --- protobuf tensor scan (mirrors native/protowire.py exactly) ------------
+
+bool pw_varint(const uint8_t* p, size_t len, size_t& pos, uint64_t& out) {
+  out = 0;
+  int shift = 0;
+  while (pos < len) {
+    uint8_t b = p[pos++];
+    out |= (uint64_t)(b & 0x7F) << shift;
+    if (!(b & 0x80)) return true;
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+bool pw_skip(const uint8_t* p, size_t len, size_t& pos, int wt) {
+  uint64_t n;
+  switch (wt) {
+    case 0: return pw_varint(p, len, pos, n);
+    case 1: pos += 8; return pos <= len;
+    case 2:
+      if (!pw_varint(p, len, pos, n) || pos + n > len) return false;
+      pos += (size_t)n;
+      return true;
+    case 5: pos += 4; return pos <= len;
+    default: return false;
+  }
+}
+
+// SeldonMessage{meta{puid only}, data{names*, tensor{shape packed, values
+// packed}}} -> rows/width/values-span/puid; anything else declines (misc
+// lane = full protobuf semantics), exactly like protowire.parse_tensor_request
+struct PwTensor {
+  const uint8_t* values = nullptr;
+  long long nvalues = 0;
+  std::vector<long long> shape;
+  std::string puid;
+};
+
+bool pw_scan_meta(const uint8_t* p, size_t len, std::string& puid) {
+  size_t pos = 0;
+  while (pos < len) {
+    uint64_t key;
+    if (!pw_varint(p, len, pos, key)) return false;
+    if ((key >> 3) == 1 && (key & 7) == 2) {
+      uint64_t n;
+      if (!pw_varint(p, len, pos, n) || pos + n > len) return false;
+      puid.assign((const char*)p + pos, (size_t)n);
+      pos += (size_t)n;
+    } else {
+      return false;  // tags/routing/requestPath present -> full parser
+    }
+  }
+  return true;
+}
+
+bool pw_scan_tensor(const uint8_t* p, size_t len, PwTensor& t) {
+  size_t pos = 0;
+  bool have_values = false;
+  while (pos < len) {
+    uint64_t key;
+    if (!pw_varint(p, len, pos, key)) return false;
+    int field = (int)(key >> 3), wt = (int)(key & 7);
+    if (field == 1) {  // shape, packed (or repeated varint)
+      if (wt == 2) {
+        uint64_t n;
+        if (!pw_varint(p, len, pos, n) || pos + n > len) return false;
+        size_t sub_end = pos + (size_t)n;
+        while (pos < sub_end) {
+          uint64_t d;
+          if (!pw_varint(p, sub_end, pos, d)) return false;
+          t.shape.push_back((long long)d);
+        }
+      } else if (wt == 0) {
+        uint64_t d;
+        if (!pw_varint(p, len, pos, d)) return false;
+        t.shape.push_back((long long)d);
+      } else {
+        return false;
+      }
+    } else if (field == 2) {  // values, packed doubles
+      if (wt != 2 || have_values) return false;  // split packed -> merge
+      uint64_t n;
+      if (!pw_varint(p, len, pos, n) || pos + n > len || n % 8) return false;
+      t.values = p + pos;
+      t.nvalues = (long long)(n / 8);
+      have_values = true;
+      pos += (size_t)n;
+    } else {
+      if (!pw_skip(p, len, pos, wt)) return false;
+    }
+  }
+  return have_values;
+}
+
+bool pw_parse_request(const uint8_t* p, size_t len, PwTensor& t) {
+  size_t pos = 0;
+  bool seen_meta = false, seen_data = false, have_tensor = false;
+  while (pos < len) {
+    uint64_t key;
+    if (!pw_varint(p, len, pos, key)) return false;
+    int field = (int)(key >> 3), wt = (int)(key & 7);
+    if (field == 2 && wt == 2) {  // meta
+      if (seen_meta) return false;  // repeated -> merge semantics
+      seen_meta = true;
+      uint64_t n;
+      if (!pw_varint(p, len, pos, n) || pos + n > len) return false;
+      if (!pw_scan_meta(p + pos, (size_t)n, t.puid)) return false;
+      pos += (size_t)n;
+    } else if (field == 3 && wt == 2) {  // data
+      if (seen_data) return false;
+      seen_data = true;
+      uint64_t n;
+      if (!pw_varint(p, len, pos, n) || pos + n > len) return false;
+      const uint8_t* sub = p + pos;
+      size_t slen = (size_t)n, spos = 0;
+      pos += (size_t)n;
+      while (spos < slen) {
+        uint64_t skey;
+        if (!pw_varint(sub, slen, spos, skey)) return false;
+        int sf = (int)(skey >> 3), swt = (int)(skey & 7);
+        if (sf == 2 && swt == 2) {  // tensor
+          if (have_tensor) return false;
+          uint64_t sn;
+          if (!pw_varint(sub, slen, spos, sn) || spos + sn > slen)
+            return false;
+          if (!pw_scan_tensor(sub + spos, (size_t)sn, t)) return false;
+          have_tensor = true;
+          spos += (size_t)sn;
+        } else if (sf == 1 && swt == 2) {  // names: ignored on input
+          if (!pw_skip(sub, slen, spos, swt)) return false;
+        } else {
+          return false;  // ndarray and friends -> full parser
+        }
+      }
+    } else if (field == 1 || field == 4 || field == 5) {
+      return false;  // status / binData / strData
+    } else {
+      if (!pw_skip(p, len, pos, wt)) return false;
+    }
+  }
+  if (!have_tensor) return false;
+  if (t.shape.empty()) t.shape.push_back(t.nvalues);
+  long long prod = 1;
+  for (long long d : t.shape) {
+    // overflow-guarded product: a crafted shape like [4, 2^62] must
+    // decline (the Python lane's np.reshape raises), not wrap around
+    if (d < 0 || (d > 0 && prod > (1LL << 40) / d)) return false;
+    prod *= d;
+  }
+  return prod == t.nvalues && t.nvalues > 0;
+}
+
+void pw_append_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out += (char)((v & 0x7F) | 0x80);
+    v >>= 7;
+  }
+  out += (char)v;
+}
+
+void pw_append_len_field(std::string& out, int field,
+                         const std::string& payload) {
+  out += (char)((field << 3) | 2);
+  pw_append_varint(out, payload.size());
+  out += payload;
+}
+
+// SUCCESS SeldonMessage wire bytes — protowire.build_tensor_response port
+std::string pw_build_response(const std::string& puid, const double* y,
+                              long long rows, long long cols,
+                              const std::string& names_frag) {
+  std::string tensor;
+  std::string shape_payload;
+  pw_append_varint(shape_payload, (uint64_t)rows);
+  pw_append_varint(shape_payload, (uint64_t)cols);
+  pw_append_len_field(tensor, 1, shape_payload);
+  std::string values((const char*)y, (size_t)(rows * cols) * 8);
+  pw_append_len_field(tensor, 2, values);
+  std::string data = names_frag;
+  pw_append_len_field(data, 2, tensor);
+  std::string meta;
+  pw_append_len_field(meta, 1, puid);
+  // Status{code=200, status=SUCCESS(0)}: zero enum omitted on the wire
+  std::string status;
+  status += (char)0x08;
+  pw_append_varint(status, 200);
+  std::string out;
+  out.reserve(status.size() + meta.size() + data.size() + 16);
+  pw_append_len_field(out, 1, status);
+  pw_append_len_field(out, 2, meta);
+  pw_append_len_field(out, 3, data);
+  return out;
+}
+
+// --- HTTP/2 connection state ----------------------------------------------
+
+constexpr uint8_t H2_DATA = 0, H2_HEADERS = 1, H2_RST = 3, H2_SETTINGS = 4,
+                  H2_PING = 6, H2_GOAWAY = 7, H2_WINDOW_UPDATE = 8,
+                  H2_CONTINUATION = 9;
+constexpr uint8_t H2F_END_STREAM = 0x1, H2F_ACK = 0x1, H2F_END_HEADERS = 0x4,
+                  H2F_PADDED = 0x8, H2F_PRIORITY = 0x20;
+constexpr int64_t H2_DEFAULT_WINDOW = 65535;
+constexpr int64_t H2_BIG_WINDOW = 0x7fffffff;
+constexpr size_t H2_MAX_MESSAGE = 64u * 1024 * 1024;
+const char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+struct H2State {
+  bool preface_done = false;
+  HpackDec hpack;
+  struct Stream {
+    std::string path;
+    std::string body;
+  };
+  std::unordered_map<uint32_t, Stream> streams;
+  size_t buffered = 0;  // sum of open-stream body bytes (backpressure cap)
+  // fast-lane / misc work in flight, keyed by stream; false after RST
+  std::unordered_map<uint32_t, bool> live;
+  int64_t conn_send_window = H2_DEFAULT_WINDOW;
+  int64_t peer_initial_window = H2_DEFAULT_WINDOW;
+  std::unordered_map<uint32_t, int64_t> stream_windows;
+  uint32_t peer_max_frame = 16384;
+  uint64_t recv_since_update = 0;
+  // flow-stalled sends: payload remainder + trailer per stream, FIFO
+  struct Tx {
+    uint32_t sid;
+    std::string data;
+    size_t off;
+    std::string trailer;
+  };
+  std::deque<Tx> txq;
+  // CONTINUATION accumulation
+  bool in_headers = false;
+  uint32_t headers_sid = 0;
+  bool headers_end_stream = false;
+  std::string headers_accum;
+};
+
+void h2_frame_header(std::string& out, uint32_t len, uint8_t type,
+                     uint8_t flags, uint32_t sid) {
+  out += (char)((len >> 16) & 0xff);
+  out += (char)((len >> 8) & 0xff);
+  out += (char)(len & 0xff);
+  out += (char)type;
+  out += (char)flags;
+  out += (char)((sid >> 24) & 0x7f);
+  out += (char)((sid >> 16) & 0xff);
+  out += (char)((sid >> 8) & 0xff);
+  out += (char)(sid & 0xff);
+}
+
+// response HEADERS / OK-trailers header blocks: static-table + literal
+// encodings only (no dynamic-table state), constant for every response
+const std::string& h2_resp_headers_block() {
+  static const std::string block = [] {
+    std::string b;
+    b += (char)0x88;  // :status 200 (static index 8)
+    // content-type: application/grpc — literal w/o indexing, name idx 31
+    b += (char)0x0f;
+    b += (char)0x10;
+    const char* v = "application/grpc";
+    b += (char)strlen(v);
+    b += v;
+    return b;
+  }();
+  return block;
+}
+
+std::string h2_trailers_block(int grpc_status, const std::string& msg) {
+  std::string b;
+  auto lit = [&](const char* name, const std::string& value) {
+    b += (char)0x00;
+    b += (char)strlen(name);
+    b += name;
+    // 7-bit prefixed length, no huffman
+    if (value.size() < 127) {
+      b += (char)value.size();
+    } else {
+      b += (char)0x7f;
+      uint64_t v = value.size() - 127;
+      while (v >= 0x80) { b += (char)((v & 0x7f) | 0x80); v >>= 7; }
+      b += (char)v;
+    }
+    b += value;
+  };
+  lit("grpc-status", std::to_string(grpc_status));
+  lit("grpc-message", msg.substr(0, 1024));
+  return b;
+}
+
+void h2_fatal(Plane* pl, int ci, const char* reason) {
+  Conn& c = *pl->conns[ci];
+  if (c.fd >= 0) {
+    std::string go;
+    h2_frame_header(go, 8 + (uint32_t)strlen(reason), H2_GOAWAY, 0, 0);
+    uint32_t last = 0;
+    go += (char)((last >> 24) & 0x7f);
+    go += (char)((last >> 16) & 0xff);
+    go += (char)((last >> 8) & 0xff);
+    go += (char)(last & 0xff);
+    uint32_t err = 1;  // PROTOCOL_ERROR
+    go += (char)((err >> 24) & 0xff);
+    go += (char)((err >> 16) & 0xff);
+    go += (char)((err >> 8) & 0xff);
+    go += (char)(err & 0xff);
+    go += reason;
+    (void)!write(c.fd, go.data(), go.size());  // best effort
+  }
+  conn_close(pl, ci);
+}
+
+// append DATA frames for [payload+off ..) within window limits; returns new
+// offset.  Trailer is sent once the payload fully drains.
+size_t h2_pump_stream(Plane* pl, int ci, uint32_t sid,
+                      const std::string& payload, size_t off,
+                      const std::string& trailer) {
+  Conn& c = *pl->conns[ci];
+  H2State& h = *c.h2s;
+  while (off < payload.size()) {
+    auto itw = h.stream_windows.find(sid);
+    int64_t sw = itw != h.stream_windows.end() ? itw->second
+                                               : h.peer_initial_window;
+    int64_t window = std::min(h.conn_send_window, sw);
+    int64_t n = std::min<int64_t>(
+        {(int64_t)(payload.size() - off), window, (int64_t)h.peer_max_frame});
+    if (n <= 0) return off;  // stalled; resumes on WINDOW_UPDATE
+    h2_frame_header(c.out, (uint32_t)n, H2_DATA, 0, sid);
+    c.out.append(payload, off, (size_t)n);
+    off += (size_t)n;
+    h.conn_send_window -= n;
+    h.stream_windows[sid] = sw - n;
+  }
+  c.out += trailer;
+  h.stream_windows.erase(sid);
+  return off;
+}
+
+void h2_pump_txq(Plane* pl, int ci) {
+  Conn& c = *pl->conns[ci];
+  H2State& h = *c.h2s;
+  while (!h.txq.empty()) {
+    H2State::Tx& tx = h.txq.front();
+    tx.off = h2_pump_stream(pl, ci, tx.sid, tx.data, tx.off, tx.trailer);
+    if (tx.off < tx.data.size()) return;  // still stalled
+    h.txq.pop_front();
+  }
+}
+
+// queue a complete gRPC response (HEADERS + DATA + trailers) on the conn
+void h2_send_response(Plane* pl, int ci, uint32_t sid,
+                      const std::string& grpc_payload) {
+  Conn& c = *pl->conns[ci];
+  H2State& h = *c.h2s;
+  h2_frame_header(c.out, (uint32_t)h2_resp_headers_block().size(), H2_HEADERS,
+                  H2F_END_HEADERS, sid);
+  c.out += h2_resp_headers_block();
+  std::string trailer;
+  static const std::string ok_trailers = h2_trailers_block(0, "");
+  h2_frame_header(trailer, (uint32_t)ok_trailers.size(), H2_HEADERS,
+                  H2F_END_HEADERS | H2F_END_STREAM, sid);
+  trailer += ok_trailers;
+  if (!h.txq.empty()) {
+    // keep per-conn FIFO so stalled streams don't reorder DATA
+    h.txq.push_back({sid, grpc_payload, 0, std::move(trailer)});
+    h2_pump_txq(pl, ci);
+    return;
+  }
+  size_t off = h2_pump_stream(pl, ci, sid, grpc_payload, 0, trailer);
+  if (off < grpc_payload.size())
+    h.txq.push_back({sid, grpc_payload.substr(off), 0, std::move(trailer)});
+}
+
+void h2_trailers_only(Plane* pl, int ci, uint32_t sid, int grpc_status,
+                      const std::string& msg) {
+  Conn& c = *pl->conns[ci];
+  std::string block;
+  block += (char)0x88;  // :status 200
+  block += (char)0x0f;
+  block += (char)0x10;
+  const char* v = "application/grpc";
+  block += (char)strlen(v);
+  block += v;
+  block += h2_trailers_block(grpc_status, msg);
+  h2_frame_header(c.out, (uint32_t)block.size(), H2_HEADERS,
+                  H2F_END_HEADERS | H2F_END_STREAM, sid);
+  c.out += block;
+}
+
+// dispatch one complete gRPC unary message (frame prefix already verified)
+void h2_handle_message(Plane* pl, int ci, uint32_t sid,
+                       const std::string& path, const uint8_t* msg,
+                       size_t mlen, bool& want_flush) {
+  Conn& c = *pl->conns[ci];
+  H2State& h = *c.h2s;
+  want_flush = true;
+  if (path == "/seldon.protos.Seldon/Predict") {
+    PwTensor t;
+    if (pw_parse_request(msg, mlen, t)) {
+      long long rows = t.shape.size() >= 2 ? t.shape[0] : 1;
+      long long width = t.shape.size() >= 2 ? t.nvalues / t.shape[0]
+                                            : t.nvalues;
+      // >2-D tensors flatten per leading dim like protowire's reshape
+      if (rows > 0 && width > 0 && rows * width == t.nvalues &&
+          rows <= pl->max_batch) {
+        ReqInfo r;
+        r.conn_id = ci;
+        r.conn_gen = c.gen;
+        r.seq = 0;
+        r.kind = KIND_PROTO;
+        r.rows = rows;
+        r.h2 = true;
+        r.stream = sid;
+        r.puid = std::move(t.puid);
+        r.t0 = now_s();
+        // packed doubles are little-endian on the wire; memcpy inside
+        // enqueue_rows is exact on this platform (x86/ARM LE)
+        if (!enqueue_rows(pl, std::move(r), t.values, rows, width)) {
+          h2_trailers_only(pl, ci, sid, 8 /* RESOURCE_EXHAUSTED */,
+                           "overloaded");
+          return;
+        }
+        h.live[sid] = true;
+        // open the send window slot now so stream WINDOW_UPDATEs arriving
+        // before the response (INITIAL_WINDOW_SIZE=0 clients) accumulate
+        h.stream_windows.emplace(sid, h.peer_initial_window);
+        return;
+      }
+    }
+  }
+  // misc lane: full protobuf/service semantics in Python
+  auto m = std::make_unique<MiscReq>();
+  m->conn_id = ci;
+  m->conn_gen = c.gen;
+  m->seq = 0;
+  m->close_c = false;
+  m->method = "GRPC";
+  m->path = path;
+  m->body.assign((const char*)msg, mlen);
+  h.live[sid] = true;
+  h.stream_windows.emplace(sid, h.peer_initial_window);
+  m->h2 = true;
+  m->stream = sid;
+  std::lock_guard<std::mutex> lk(pl->mu);
+  m->id = pl->next_misc_id++;
+  pl->misc_q.push_back(std::move(m));
+  pl->cv_misc.notify_one();
+}
+
+void h2_parse(Plane* pl, int ci) {
+  Conn& c = *pl->conns[ci];
+  H2State& h = *c.h2s;
+  size_t consumed = 0;
+  bool want_flush = false;
+  while (c.fd >= 0) {
+    if (!h.preface_done) {
+      if (c.in.size() - consumed < 24) break;
+      if (memcmp(c.in.data() + consumed, kH2Preface, 24) != 0) {
+        h2_fatal(pl, ci, "bad preface");
+        return;
+      }
+      consumed += 24;
+      h.preface_done = true;
+      continue;
+    }
+    if (c.in.size() - consumed < 9) break;
+    const uint8_t* p = (const uint8_t*)c.in.data() + consumed;
+    uint32_t len = (p[0] << 16) | (p[1] << 8) | p[2];
+    if (len > (1u << 24) - 1 || len > 16u * 1024 * 1024) {
+      h2_fatal(pl, ci, "frame too large");
+      return;
+    }
+    if (c.in.size() - consumed < 9 + (size_t)len) break;
+    uint8_t type = p[3], flags = p[4];
+    uint32_t sid = ((p[5] & 0x7f) << 24) | (p[6] << 16) | (p[7] << 8) | p[8];
+    const uint8_t* payload = p + 9;
+    consumed += 9 + len;
+    if (h.in_headers && type != H2_CONTINUATION) {
+      h2_fatal(pl, ci, "expected CONTINUATION");
+      return;
+    }
+    switch (type) {
+      case H2_SETTINGS: {
+        if (flags & H2F_ACK) break;
+        if (len % 6) { h2_fatal(pl, ci, "bad SETTINGS"); return; }
+        for (uint32_t i = 0; i + 6 <= len; i += 6) {
+          uint16_t k = (payload[i] << 8) | payload[i + 1];
+          uint32_t v = (payload[i + 2] << 24) | (payload[i + 3] << 16) |
+                       (payload[i + 4] << 8) | payload[i + 5];
+          if (k == 0x4) {  // INITIAL_WINDOW_SIZE
+            int64_t delta = (int64_t)v - h.peer_initial_window;
+            h.peer_initial_window = v;
+            for (auto& kv : h.stream_windows) kv.second += delta;
+          } else if (k == 0x5) {  // MAX_FRAME_SIZE
+            if (v >= 16384 && v <= 16777215) h.peer_max_frame = v;
+          }
+        }
+        h2_frame_header(c.out, 0, H2_SETTINGS, H2F_ACK, 0);
+        h2_pump_txq(pl, ci);  // a raised INITIAL_WINDOW_SIZE unstalls
+        want_flush = true;
+        break;
+      }
+      case H2_PING:
+        if (!(flags & H2F_ACK) && len == 8) {
+          h2_frame_header(c.out, 8, H2_PING, H2F_ACK, 0);
+          c.out.append((const char*)payload, 8);
+          want_flush = true;
+        }
+        break;
+      case H2_WINDOW_UPDATE: {
+        if (len != 4) { h2_fatal(pl, ci, "bad WINDOW_UPDATE"); return; }
+        uint32_t inc = ((payload[0] & 0x7f) << 24) | (payload[1] << 16) |
+                       (payload[2] << 8) | payload[3];
+        if (sid == 0) h.conn_send_window += inc;
+        else {
+          auto it = h.stream_windows.find(sid);
+          if (it != h.stream_windows.end()) it->second += inc;
+        }
+        h2_pump_txq(pl, ci);
+        want_flush = true;
+        break;
+      }
+      case H2_HEADERS: {
+        size_t off = 0;
+        uint8_t pad = 0;
+        if (flags & H2F_PADDED) { if (len < 1) { h2_fatal(pl, ci, "pad"); return; } pad = payload[off++]; }
+        if (flags & H2F_PRIORITY) { off += 5; }
+        if (off + pad > len) { h2_fatal(pl, ci, "pad"); return; }
+        h.headers_sid = sid;
+        h.headers_end_stream = flags & H2F_END_STREAM;
+        h.headers_accum.assign((const char*)payload + off,
+                               len - off - pad);
+        if (flags & H2F_END_HEADERS) {
+          std::vector<HeaderPair> headers;
+          if (!h.hpack.decode((const uint8_t*)h.headers_accum.data(),
+                              h.headers_accum.size(), headers)) {
+            h2_fatal(pl, ci, "hpack error");
+            return;
+          }
+          std::string path;
+          for (auto& hp : headers)
+            if (hp.name == ":path") { path = hp.value; break; }
+          if (h.streams.size() >= 65536) {
+            h2_fatal(pl, ci, "too many open streams");
+            return;
+          }
+          h.streams[sid] = {std::move(path), {}};
+          if (h.headers_end_stream) {
+            h.streams.erase(sid);
+            h2_trailers_only(pl, ci, sid, 13, "missing request body");
+            want_flush = true;
+          }
+        } else {
+          h.in_headers = true;
+        }
+        break;
+      }
+      case H2_CONTINUATION: {
+        if (!h.in_headers || sid != h.headers_sid) {
+          h2_fatal(pl, ci, "unexpected CONTINUATION");
+          return;
+        }
+        h.headers_accum.append((const char*)payload, len);
+        if (h.headers_accum.size() > 1u << 20) {
+          h2_fatal(pl, ci, "headers too large");
+          return;
+        }
+        if (flags & H2F_END_HEADERS) {
+          h.in_headers = false;
+          std::vector<HeaderPair> headers;
+          if (!h.hpack.decode((const uint8_t*)h.headers_accum.data(),
+                              h.headers_accum.size(), headers)) {
+            h2_fatal(pl, ci, "hpack error");
+            return;
+          }
+          std::string path;
+          for (auto& hp : headers)
+            if (hp.name == ":path") { path = hp.value; break; }
+          h.streams[h.headers_sid] = {std::move(path), {}};
+          if (h.headers_end_stream) {
+            h.streams.erase(h.headers_sid);
+            h2_trailers_only(pl, ci, h.headers_sid, 13,
+                             "missing request body");
+            want_flush = true;
+          }
+        }
+        break;
+      }
+      case H2_DATA: {
+        size_t off = 0;
+        uint8_t pad = 0;
+        if (flags & H2F_PADDED) { if (len < 1) { h2_fatal(pl, ci, "pad"); return; } pad = payload[off++]; }
+        if (off + pad > len) { h2_fatal(pl, ci, "pad"); return; }
+        h.recv_since_update += len;
+        if (h.recv_since_update >= (1u << 20)) {
+          h2_frame_header(c.out, 4, H2_WINDOW_UPDATE, 0, 0);
+          uint32_t inc = (uint32_t)h.recv_since_update;
+          c.out += (char)((inc >> 24) & 0x7f);
+          c.out += (char)((inc >> 16) & 0xff);
+          c.out += (char)((inc >> 8) & 0xff);
+          c.out += (char)(inc & 0xff);
+          h.recv_since_update = 0;
+          want_flush = true;
+        }
+        auto it = h.streams.find(sid);
+        if (it == h.streams.end()) break;  // unknown/aborted stream
+        it->second.body.append((const char*)payload + off, len - off - pad);
+        h.buffered += len - off - pad;
+        if (it->second.body.size() > H2_MAX_MESSAGE + 5) {
+          h.buffered -= it->second.body.size();
+          h2_trailers_only(pl, ci, sid, 8, "message too large");
+          h.streams.erase(it);
+          want_flush = true;
+          break;
+        }
+        if (h.buffered > 256u * 1024 * 1024) {
+          // connection-level memory backstop: a client streaming unbounded
+          // bodies across many open streams is killed, the same budget the
+          // HTTP lane enforces per body (_MAX_BODY)
+          h2_fatal(pl, ci, "connection buffer budget exceeded");
+          return;
+        }
+        if (flags & H2F_END_STREAM) {
+          std::string path = std::move(it->second.path);
+          std::string body = std::move(it->second.body);
+          h.buffered -= body.size();
+          h.streams.erase(it);
+          if (body.size() < 5 || body[0] != 0) {
+            h2_trailers_only(pl, ci, sid, 13,
+                             "compressed or malformed grpc frame");
+            want_flush = true;
+            break;
+          }
+          uint32_t mlen = ((uint8_t)body[1] << 24) | ((uint8_t)body[2] << 16) |
+                          ((uint8_t)body[3] << 8) | (uint8_t)body[4];
+          if (mlen != body.size() - 5) {
+            h2_trailers_only(pl, ci, sid, 13, "grpc frame length mismatch");
+            want_flush = true;
+            break;
+          }
+          bool wf = false;
+          h2_handle_message(pl, ci, sid, path,
+                            (const uint8_t*)body.data() + 5, mlen, wf);
+          want_flush = want_flush || wf;
+        }
+        break;
+      }
+      case H2_RST: {
+        auto sit = h.streams.find(sid);
+        if (sit != h.streams.end()) {
+          h.buffered -= sit->second.body.size();
+          h.streams.erase(sit);
+        }
+        h.stream_windows.erase(sid);
+        auto it = h.live.find(sid);
+        if (it != h.live.end()) it->second = false;  // drop the response
+        break;
+      }
+      case H2_GOAWAY:
+        conn_close(pl, ci);
+        return;
+      default:
+        break;  // PRIORITY / PUSH_PROMISE / unknown: ignore
+    }
+  }
+  if (c.fd >= 0 && consumed) c.in.erase(0, consumed);
+  if (c.fd >= 0 && want_flush) conn_flush(pl, ci);
 }
 
 void drain_completions(Plane* pl) {
   uint64_t junk;
   (void)!read(pl->evfd, &junk, 8);
-  std::vector<std::pair<std::pair<int, uint32_t>,
-                        std::pair<uint64_t, std::string>>> local;
+  std::vector<Plane::Completion> local;
   {
     std::lock_guard<std::mutex> lk(pl->cmu);
     local.swap(pl->completions);
@@ -706,12 +1688,26 @@ void drain_completions(Plane* pl) {
   // group flushes: mark conns dirty, flush each once
   std::vector<int> dirty;
   for (auto& item : local) {
-    int ci = item.first.first;
-    uint32_t gen = item.first.second;
+    int ci = item.conn_id;
     if (ci < 0 || ci >= (int)pl->conns.size()) continue;
     Conn& c = *pl->conns[ci];
-    if (c.fd < 0 || c.gen != gen) continue;  // conn died meanwhile
-    c.done[item.second.first] = std::move(item.second.second);
+    if (c.fd < 0 || c.gen != item.gen) continue;  // conn died meanwhile
+    if (item.h2) {
+      H2State& h = *c.h2s;
+      auto it = h.live.find(item.stream);
+      bool alive = it == h.live.end() || it->second;  // RST'd -> drop
+      if (it != h.live.end()) h.live.erase(it);
+      if (!alive) {
+        h.stream_windows.erase(item.stream);
+        continue;
+      }
+      if (item.grpc_status == 0)
+        h2_send_response(pl, ci, item.stream, item.data);
+      else
+        h2_trailers_only(pl, ci, item.stream, item.grpc_status, item.data);
+    } else {
+      c.done[item.seq] = std::move(item.data);
+    }
     dirty.push_back(ci);
   }
   std::sort(dirty.begin(), dirty.end());
@@ -739,9 +1735,11 @@ void io_loop(Plane* pl) {
     if (n < 0 && errno != EINTR) break;
     for (int e = 0; e < n; e++) {
       int idx = (int)(int32_t)events[e].data.u64;
-      if (idx == EvTag::LISTEN) {
+      if (idx == EvTag::LISTEN || idx == EvTag::LISTEN_GRPC) {
+        bool h2 = idx == EvTag::LISTEN_GRPC;
+        int lfd = h2 ? pl->grpc_listen_fd : pl->listen_fd;
         for (;;) {
-          int fd = accept4(pl->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          int fd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
           if (fd < 0) break;
           int one = 1;
           setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
@@ -755,6 +1753,7 @@ void io_loop(Plane* pl) {
           }
           Conn& c = *pl->conns[ci];
           c.fd = fd;
+          c.h2 = h2;
           c.scan_from = 0;
           c.head_end = -1;
           c.clen = -1;
@@ -764,7 +1763,34 @@ void io_loop(Plane* pl) {
           c.out_off = 0;
           c.want_write = false;
           c.paused = false;
+          if (h2) {
+            c.h2s.reset(new H2State());
+            // server bootstrap: big receive windows so uploads never
+            // stall on us (the same bootstrap grpcfast.py performs)
+            std::string boot;
+            h2_frame_header(boot, 12, H2_SETTINGS, 0, 0);
+            auto put_setting = [&](uint16_t k, uint32_t v) {
+              boot += (char)(k >> 8);
+              boot += (char)(k & 0xff);
+              boot += (char)((v >> 24) & 0xff);
+              boot += (char)((v >> 16) & 0xff);
+              boot += (char)((v >> 8) & 0xff);
+              boot += (char)(v & 0xff);
+            };
+            put_setting(0x4, (uint32_t)H2_BIG_WINDOW);
+            put_setting(0x3, 1u << 20);
+            h2_frame_header(boot, 4, H2_WINDOW_UPDATE, 0, 0);
+            uint32_t inc = (uint32_t)(H2_BIG_WINDOW - H2_DEFAULT_WINDOW);
+            boot += (char)((inc >> 24) & 0x7f);
+            boot += (char)((inc >> 16) & 0xff);
+            boot += (char)((inc >> 8) & 0xff);
+            boot += (char)(inc & 0xff);
+            c.out += boot;
+          } else {
+            c.h2s.reset();
+          }
           arm(pl, fd, ci, EPOLLIN, EPOLL_CTL_ADD);
+          if (h2) conn_flush(pl, ci);
         }
         continue;
       }
@@ -792,7 +1818,8 @@ void io_loop(Plane* pl) {
       resumed.swap(pl->resume_parse);
       for (int ci : resumed) {
         if (pl->conns[ci]->fd < 0) continue;
-        conn_parse(pl, ci);
+        if (pl->conns[ci]->h2) h2_parse(pl, ci);
+        else conn_parse(pl, ci);
         if (pl->conns[ci]->fd >= 0) conn_flush(pl, ci);
       }
     }
@@ -813,6 +1840,7 @@ void io_loop(Plane* pl) {
   for (size_t i = 0; i < pl->conns.size(); i++)
     if (pl->conns[i]->fd >= 0) conn_close(pl, (int)i);
   if (pl->listen_fd >= 0) close(pl->listen_fd);
+  if (pl->grpc_listen_fd >= 0) close(pl->grpc_listen_fd);
   pl->cv_batch.notify_all();
   pl->cv_misc.notify_all();
 }
@@ -846,37 +1874,57 @@ struct DpMiscView {
   long long body_len;
 };
 
-void* dp_start(const char* host, int port, long long max_batch,
-               double max_wait_ms, int depth, const char* names_frag,
-               long long names_len) {
-  auto pl = std::make_unique<Plane>();
-  pl->max_batch = max_batch > 0 ? max_batch : 1024;
-  pl->max_wait_s = max_wait_ms > 0 ? max_wait_ms / 1e3 : 0.002;
-  pl->depth = depth > 0 ? depth : 8;
-  if (names_frag && names_len > 0) pl->names_frag.assign(names_frag, names_len);
-
-  pl->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
-  if (pl->listen_fd < 0) return nullptr;
+static int dp_listen(const char* host, int port, int* bound_port) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -1;
   int one = 1;
-  setsockopt(pl->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   struct sockaddr_in addr;
   memset(&addr, 0, sizeof addr);
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)port);
   if (inet_pton(AF_INET, host && *host ? host : "0.0.0.0", &addr.sin_addr) != 1)
     addr.sin_addr.s_addr = INADDR_ANY;
-  if (bind(pl->listen_fd, (struct sockaddr*)&addr, sizeof addr) < 0 ||
-      listen(pl->listen_fd, 4096) < 0) {
-    close(pl->listen_fd);
-    return nullptr;
+  if (bind(fd, (struct sockaddr*)&addr, sizeof addr) < 0 ||
+      listen(fd, 4096) < 0) {
+    close(fd);
+    return -1;
   }
   socklen_t alen = sizeof addr;
-  getsockname(pl->listen_fd, (struct sockaddr*)&addr, &alen);
-  pl->port = ntohs(addr.sin_port);
+  getsockname(fd, (struct sockaddr*)&addr, &alen);
+  if (bound_port) *bound_port = ntohs(addr.sin_port);
+  return fd;
+}
+
+// grpc_port: -1 disables the gRPC lane, 0 binds an ephemeral port
+void* dp_start(const char* host, int port, int grpc_port, long long max_batch,
+               double max_wait_ms, int depth, const char* names_frag,
+               long long names_len, const char* proto_names,
+               long long proto_names_len) {
+  auto pl = std::make_unique<Plane>();
+  pl->max_batch = max_batch > 0 ? max_batch : 1024;
+  pl->max_wait_s = max_wait_ms > 0 ? max_wait_ms / 1e3 : 0.002;
+  pl->depth = depth > 0 ? depth : 8;
+  if (names_frag && names_len > 0) pl->names_frag.assign(names_frag, names_len);
+  if (proto_names && proto_names_len > 0)
+    pl->proto_names_frag.assign(proto_names, proto_names_len);
+
+  pl->listen_fd = dp_listen(host, port, &pl->port);
+  if (pl->listen_fd < 0) return nullptr;
+  if (grpc_port >= 0) {
+    pl->grpc_listen_fd = dp_listen(host, grpc_port, &pl->grpc_port);
+    if (pl->grpc_listen_fd < 0) {
+      close(pl->listen_fd);
+      return nullptr;
+    }
+  }
 
   pl->ep = epoll_create1(0);
   pl->evfd = eventfd(0, EFD_NONBLOCK);
   arm(pl.get(), pl->listen_fd, EvTag::LISTEN, EPOLLIN, EPOLL_CTL_ADD);
+  if (pl->grpc_listen_fd >= 0)
+    arm(pl.get(), pl->grpc_listen_fd, EvTag::LISTEN_GRPC, EPOLLIN,
+        EPOLL_CTL_ADD);
   arm(pl.get(), pl->evfd, EvTag::EVENT, EPOLLIN, EPOLL_CTL_ADD);
   Plane* raw = pl.release();
   raw->io_thread = std::thread(io_loop, raw);
@@ -884,6 +1932,7 @@ void* dp_start(const char* host, int port, long long max_batch,
 }
 
 int dp_port(void* h) { return h ? ((Plane*)h)->port : 0; }
+int dp_grpc_port(void* h) { return h ? ((Plane*)h)->grpc_port : 0; }
 
 int dp_next_batch(void* h, DpBatchView* out) {
   Plane* pl = (Plane*)h;
@@ -935,19 +1984,48 @@ int dp_complete_batch(void* h, long long id, const double* y, long long rows,
   if (rows != in_rows || cols <= 0 || !y) {
     // row-count mismatch is a server defect: fail every caller
     for (ReqInfo& r : b->reqs) {
+      pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
+      if (r.h2) {
+        queue_completion_h2(pl, r.conn_id, r.conn_gen, r.stream,
+                            13 /* INTERNAL */, "batch shape mismatch");
+        continue;
+      }
       std::string body =
           "{\"status\":{\"code\":500,\"status\":\"FAILURE\","
           "\"reason\":\"batch shape mismatch\"}}";
       queue_completion(pl, r,
                        http_response(500, "application/json", body.data(),
                                      body.size(), r.close_c));
-      pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
     }
     return 0;
   }
   long long off = 0;
   double tdone = now_s();
   for (ReqInfo& r : b->reqs) {
+    if (r.h2) {
+      // gRPC lane: proto wire response + 5-byte message frame
+      std::string puid = r.puid;
+      if (puid.empty()) {
+        char pbuf[26];
+        pl->puid.fill(pbuf);
+        puid.assign(pbuf, 26);
+      }
+      std::string proto = pw_build_response(
+          puid, y + off * cols, r.rows, cols, pl->proto_names_frag);
+      off += r.rows;
+      std::string framed;
+      framed.reserve(proto.size() + 5);
+      framed += (char)0;
+      framed += (char)((proto.size() >> 24) & 0xff);
+      framed += (char)((proto.size() >> 16) & 0xff);
+      framed += (char)((proto.size() >> 8) & 0xff);
+      framed += (char)(proto.size() & 0xff);
+      framed += proto;
+      pl->stats.observe_ok(tdone - r.t0);
+      queue_completion_h2(pl, r.conn_id, r.conn_gen, r.stream, 0,
+                          std::move(framed));
+      continue;
+    }
     long long shape[2] = {r.rows, cols};
     long long frag_len = 0;
     char* frag = sm_format(y + off * cols, shape, 2, r.kind, &frag_len);
@@ -990,9 +2068,20 @@ int dp_fail_batch(void* h, long long id, int http_code, const char* body,
   std::string bs(body ? body : "", body ? (size_t)body_len : 0);
   if (bs.empty())
     bs = "{\"status\":{\"code\":500,\"status\":\"FAILURE\"}}";
+  // gRPC status mapping for h2 callers in the same failed batch
+  int grpc_status = http_code == 400 ? 3 /* INVALID_ARGUMENT */
+                    : http_code == 503 ? 8 /* RESOURCE_EXHAUSTED */
+                    : http_code == 504 ? 4 /* DEADLINE_EXCEEDED */
+                                       : 13 /* INTERNAL */;
   for (ReqInfo& r : b->reqs) {
     if (http_code >= 500) pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
     else if (http_code >= 400) pl->stats.n4xx.fetch_add(1, std::memory_order_relaxed);
+    if (r.h2) {
+      // same diagnostic text the HTTP callers get (trimmed for grpc-message)
+      queue_completion_h2(pl, r.conn_id, r.conn_gen, r.stream, grpc_status,
+                          std::string(bs));
+      continue;
+    }
     queue_completion(pl, r,
                      http_response(http_code, "application/json", bs.data(),
                                    bs.size(), r.close_c));
@@ -1025,6 +2114,41 @@ int dp_next_misc(void* h, DpMiscView* out) {
   return 1;
 }
 
+// gRPC misc response: status 0 sends payload + OK trailers, else
+// trailers-only with `message`
+int dp_respond_grpc(void* h, long long id, int grpc_status,
+                    const char* message, long long message_len,
+                    const char* payload, long long payload_len) {
+  Plane* pl = (Plane*)h;
+  std::unique_ptr<MiscReq> m;
+  {
+    std::lock_guard<std::mutex> lk(pl->mu);
+    auto it = pl->misc_inflight.find(id);
+    if (it == pl->misc_inflight.end()) return -1;
+    m = std::move(it->second);
+    pl->misc_inflight.erase(it);
+  }
+  if (!m->h2) return -1;
+  if (grpc_status == 0) pl->stats.n2xx.fetch_add(1, std::memory_order_relaxed);
+  else pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
+  std::string data;
+  if (grpc_status == 0) {
+    size_t n = payload ? (size_t)payload_len : 0;
+    data.reserve(n + 5);
+    data += (char)0;
+    data += (char)((n >> 24) & 0xff);
+    data += (char)((n >> 16) & 0xff);
+    data += (char)((n >> 8) & 0xff);
+    data += (char)(n & 0xff);
+    data.append(payload ? payload : "", n);
+  } else {
+    data.assign(message ? message : "", message ? (size_t)message_len : 0);
+  }
+  queue_completion_h2(pl, m->conn_id, m->conn_gen, m->stream, grpc_status,
+                      std::move(data));
+  return 0;
+}
+
 int dp_respond_misc(void* h, long long id, int http_code, const char* ctype,
                     const char* body, long long body_len) {
   Plane* pl = (Plane*)h;
@@ -1036,6 +2160,7 @@ int dp_respond_misc(void* h, long long id, int http_code, const char* ctype,
     m = std::move(it->second);
     pl->misc_inflight.erase(it);
   }
+  if (m->h2) return -1;  // gRPC misc must answer via dp_respond_grpc
   if (http_code >= 500) pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
   else if (http_code >= 400) pl->stats.n4xx.fetch_add(1, std::memory_order_relaxed);
   else pl->stats.n2xx.fetch_add(1, std::memory_order_relaxed);

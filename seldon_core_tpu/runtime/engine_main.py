@@ -115,6 +115,16 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         print(f"unknown ENGINE_HTTP_IMPL={http_impl!r}; serving aiohttp",
               flush=True)
         http_impl = "aiohttp"
+    # gRPC lane selection: native (C++ HTTP/2 in the same plane), fast
+    # (runtime/grpcfast.py asyncio lane), aio (stock grpc.aio server).
+    # Default rides the native plane when the HTTP lane does.
+    grpc_impl = os.environ.get(
+        "ENGINE_GRPC_IMPL", "native" if http_impl == "native" else "fast"
+    ).strip().lower()
+    if grpc_impl not in ("native", "fast", "aio"):
+        print(f"unknown ENGINE_GRPC_IMPL={grpc_impl!r}; serving fast lane",
+              flush=True)
+        grpc_impl = "fast"
     native_plane = None
     fast_server = None
     runner = None
@@ -124,7 +134,8 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
 
             # the C++ listener binds a single address; 0.0.0.0 maps to ANY
             native_plane = await serve_native(
-                engine, host if host != "0.0.0.0" else "", rest_port
+                engine, host if host != "0.0.0.0" else "", rest_port,
+                grpc_port=grpc_port if grpc_impl == "native" else None,
             )
         except (RuntimeError, OSError) as e:
             print(f"native data plane unavailable ({e}); "
@@ -136,10 +147,16 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         fast_server = await serve_fast(engine, host, rest_port)
     elif http_impl == "aiohttp":
         runner = await serve_app(make_engine_app(engine), host, rest_port)
-    # gRPC data plane: wire-level HTTP/2 lane by default (runtime/grpcfast.py,
-    # unary Predict/SendFeedback — the whole Seldon service surface);
-    # ENGINE_GRPC_IMPL=aio keeps the stock grpc.aio server
-    if os.environ.get("ENGINE_GRPC_IMPL", "fast") == "fast":
+    if grpc_impl == "native" and (
+        native_plane is None or native_plane.grpc_port is None
+    ):
+        print("native gRPC lane unavailable (no native plane); "
+              "serving the Python fast lane", flush=True)
+        grpc_impl = "fast"
+    if grpc_impl == "native":
+        async def grpc_stop():
+            pass  # stopped with the shared native plane below
+    elif grpc_impl == "fast":
         from seldon_core_tpu.runtime.grpcfast import serve_grpc_fast
 
         grpc_server = await serve_grpc_fast(engine, host, grpc_port)

@@ -112,11 +112,20 @@ def _load():
             return None
         lib.dp_start.restype = ctypes.c_void_p
         lib.dp_start.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
             ctypes.c_double, ctypes.c_int, ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_char_p, ctypes.c_longlong,
         ]
         lib.dp_port.restype = ctypes.c_int
         lib.dp_port.argtypes = [ctypes.c_void_p]
+        lib.dp_grpc_port.restype = ctypes.c_int
+        lib.dp_grpc_port.argtypes = [ctypes.c_void_p]
+        lib.dp_respond_grpc.restype = ctypes.c_int
+        lib.dp_respond_grpc.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_longlong,
+            ctypes.c_char_p, ctypes.c_longlong,
+        ]
         lib.dp_next_batch.restype = ctypes.c_int
         lib.dp_next_batch.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(_DpBatchView)
@@ -185,6 +194,7 @@ class NativeDataPlane:
     """Owns the C++ plane handle plus the Python dispatch/misc threads."""
 
     def __init__(self, engine, host: str, port: int,
+                 grpc_port: Optional[int] = None,
                  workers: Optional[int] = None):
         self.engine = engine
         self.lib = _load()
@@ -202,16 +212,24 @@ class NativeDataPlane:
                 "does not merge tags into meta — use the Python plane"
             )
         names_frag = getattr(engine, "_names_fragment", "") or ""
+        proto_names = bytes(getattr(engine, "_proto_names_frag", b"") or b"")
         self.max_batch = engine.batcher.max_batch
         depth = workers or engine.batcher.max_inflight
         self.handle = self.lib.dp_start(
-            host.encode(), int(port), int(self.max_batch),
+            host.encode(), int(port),
+            -1 if grpc_port is None else int(grpc_port),
+            int(self.max_batch),
             float(engine.batcher.max_wait_ms), int(depth),
             names_frag.encode(), len(names_frag.encode()),
+            proto_names, len(proto_names),
         )
         if not self.handle:
             raise RuntimeError(f"native dataplane failed to bind {host}:{port}")
         self.port = self.lib.dp_port(self.handle)
+        self.grpc_port = (
+            self.lib.dp_grpc_port(self.handle) if grpc_port is not None
+            else None
+        )
         self._probe_no_tags()
         self._loop = None  # captured by start() for misc dispatch
         self._threads = []
@@ -248,6 +266,13 @@ class NativeDataPlane:
         from seldon_core_tpu.runtime.httpfast import _EngineRoutes
 
         self._routes = _EngineRoutes(self.engine)
+        self._grpc_handlers = {}
+        if self.grpc_port is not None:
+            from seldon_core_tpu.runtime.grpcfast import FastGrpcServer
+
+            self._grpc_handlers = FastGrpcServer.for_engine(
+                self.engine
+            ).handlers
         for i in range(self._workers):
             t = threading.Thread(
                 target=self._dispatch_loop, name=f"dp-dispatch-{i}",
@@ -341,6 +366,14 @@ class NativeDataPlane:
             query = ctypes.string_at(view.query, view.query_len)
             ctype = ctypes.string_at(view.ctype, view.ctype_len)
             body = ctypes.string_at(view.body, view.body_len)
+            if method == b"GRPC":
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._handle_grpc(path, body), self._loop,
+                )
+                fut.add_done_callback(
+                    lambda f, mid=mid: self._grpc_done(mid, f)
+                )
+                continue
             fut = asyncio.run_coroutine_threadsafe(
                 self._handle_misc(method, path, query, ctype, body),
                 self._loop,
@@ -362,6 +395,34 @@ class NativeDataPlane:
             status, resp, rctype = 500, str(e).encode(), "text/plain"
         self.lib.dp_respond_misc(
             self.handle, mid, int(status), rctype.encode(), resp, len(resp)
+        )
+
+    async def _handle_grpc(self, path: bytes, message: bytes):
+        """gRPC misc lane: same handler table and status mapping as the
+        Python fast gRPC server (grpcfast._ServerConnection._run)."""
+        handler = self._grpc_handlers.get(path)
+        if handler is None:
+            return 12, b"unknown method " + path, b""  # UNIMPLEMENTED
+        try:
+            response = await handler(message)
+        except NotImplementedError as e:
+            return 12, str(e).encode(), b""
+        except Exception as e:  # handler bug: surface as INTERNAL
+            logger.exception("grpc misc handler failed")
+            return 13, str(e).encode(), b""
+        return 0, b"", response
+
+    def _grpc_done(self, mid: int, fut) -> None:
+        if self._stopped or self.handle is None:
+            return
+        try:
+            status, message, payload = fut.result()
+        except Exception as e:
+            logger.exception("grpc misc handler failed")
+            status, message, payload = 13, str(e).encode(), b""
+        self.lib.dp_respond_grpc(
+            self.handle, mid, int(status), message, len(message),
+            payload, len(payload),
         )
 
     async def _handle_misc(self, method, path, query, ctype, body):
@@ -455,9 +516,10 @@ class NativeDataPlane:
             )
 
 
-async def serve_native(engine, host: str, port: int) -> NativeDataPlane:
+async def serve_native(engine, host: str, port: int,
+                       grpc_port: Optional[int] = None) -> NativeDataPlane:
     import asyncio
 
-    plane = NativeDataPlane(engine, host, port)
+    plane = NativeDataPlane(engine, host, port, grpc_port=grpc_port)
     plane.start(asyncio.get_running_loop())
     return plane

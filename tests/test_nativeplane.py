@@ -271,6 +271,114 @@ def test_keepalive_and_connection_close(plane_engine):
     asyncio.run(run())
 
 
+def test_grpc_lane_stock_client(plane_engine):
+    """Native h2 lane vs an unmodified grpc.aio client (Huffman + dynamic
+    table HPACK, real flow control): tensor fast lane, puid echo, ndarray
+    through the misc lane, unknown method -> UNIMPLEMENTED."""
+    import grpc
+
+    from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+
+    async def run():
+        plane = await serve_native(plane_engine, "127.0.0.1", 0, grpc_port=0)
+        try:
+            ch = grpc.aio.insecure_channel(f"127.0.0.1:{plane.grpc_port}")
+            stub = ch.unary_unary(
+                "/seldon.protos.Seldon/Predict",
+                request_serializer=pb.SeldonMessage.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            r = await stub(
+                pb.SeldonMessage(
+                    data=pb.DefaultData(
+                        tensor=pb.Tensor(shape=[2, 1], values=[0.5, 0.6])
+                    )
+                ),
+                timeout=30,
+            )
+            assert list(r.data.tensor.shape) == [2, 3]
+            assert len(r.data.tensor.values) == 6
+            assert r.status.code == 200
+            assert len(r.meta.puid) == 26
+            assert list(r.data.names) == plane_engine.compiled._output_names(
+                plane_engine.predictor.graph, {}
+            )
+            r2 = await stub(
+                pb.SeldonMessage(
+                    meta=pb.Meta(puid="echo-me"),
+                    data=pb.DefaultData(
+                        tensor=pb.Tensor(shape=[1, 1], values=[0.1])
+                    ),
+                ),
+                timeout=30,
+            )
+            assert r2.meta.puid == "echo-me"
+            # ndarray payloads decline to the misc lane (full proto path)
+            from google.protobuf import struct_pb2
+
+            lv = struct_pb2.ListValue()
+            row = struct_pb2.ListValue()
+            row.values.add().number_value = 0.7
+            lv.values.add().list_value.CopyFrom(row)
+            r3 = await stub(
+                pb.SeldonMessage(data=pb.DefaultData(ndarray=lv)), timeout=30
+            )
+            assert r3.status.code == 200
+            assert r3.data.WhichOneof("data_oneof") == "ndarray"
+            # unknown method -> UNIMPLEMENTED via trailers-only
+            bad = ch.unary_unary(
+                "/seldon.protos.Seldon/Nope",
+                request_serializer=pb.SeldonMessage.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+            with pytest.raises(grpc.aio.AioRpcError) as ei:
+                await bad(pb.SeldonMessage(), timeout=30)
+            assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+            await ch.close()
+        finally:
+            await plane.stop()
+
+    asyncio.run(run())
+
+
+def test_grpc_lane_concurrent_burst(plane_engine):
+    import grpc
+
+    from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+
+    async def run():
+        plane = await serve_native(plane_engine, "127.0.0.1", 0, grpc_port=0)
+        try:
+            ch = grpc.aio.insecure_channel(f"127.0.0.1:{plane.grpc_port}")
+            stub = ch.unary_unary(
+                "/seldon.protos.Seldon/Predict",
+                request_serializer=pb.SeldonMessage.SerializeToString,
+                response_deserializer=pb.SeldonMessage.FromString,
+            )
+
+            async def one(i):
+                r = await stub(
+                    pb.SeldonMessage(
+                        data=pb.DefaultData(
+                            tensor=pb.Tensor(shape=[1, 1], values=[i / 64])
+                        )
+                    ),
+                    timeout=30,
+                )
+                assert list(r.data.tensor.values) == [
+                    pytest.approx(0.1, abs=1e-6),
+                    pytest.approx(0.9, abs=1e-6),
+                    pytest.approx(0.5, abs=1e-6),
+                ]
+
+            await asyncio.gather(*[one(i) for i in range(80)])
+            await ch.close()
+        finally:
+            await plane.stop()
+
+    asyncio.run(run())
+
+
 def test_ineligible_graph_rejected():
     # router graph (per-request routing, stateful PRNG) must refuse the
     # native plane — it serves through the Python lanes with full meta
