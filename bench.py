@@ -1,100 +1,207 @@
-"""Benchmark — MNIST inference-graph serving on the real TPU chip.
+"""Benchmark — socketed serving throughput on the real TPU chip.
 
-Reproduces the shape of the reference's published benchmark (256 concurrent
-locust clients firing at the engine + stub model, docs/benchmarking.md:20-36,
-12,088.95 req/s REST) against this framework's engine: K concurrent clients
-issue predict requests through the full data plane (JSON wire parse ->
-micro-batched compiled-graph dispatch on TPU -> JSON response), except the
-model is a REAL MNIST MLP, not a stub.
+Reproduces the reference's published methodology end to end: its headline
+12,088.95 req/s REST / 28,256.39 req/s gRPC numbers come from locust workers
+on three dedicated client nodes firing at an engine + in-engine stub model
+over real sockets, reported as a "maximum throughput" test
+(docs/benchmarking.md:20-64, notebooks/benchmark_simple_model.ipynb).
 
-NOTE on this environment: the TPU is reached through a relay that costs
-~65 ms per device->host readback RPC regardless of size.  Micro-batching
-amortises that fixed cost across concurrent requests (the same way the
-production design amortises PCIe/dispatch overhead), so throughput is the
-meaningful headline here; single-request p50 is floored by the relay RPC,
-not by the framework (aux key ``relay_floor_ms`` reports the measured floor
-of a bare 1-element readback for comparison).
+This bench does the same against this framework:
 
-Prints ONE JSON line: metric=mnist_graph_max_qps — the maximum-throughput
-result across the probed configs, matching the reference's own methodology
-(its 12,088.95 req/s REST figure is explicitly a "maximum throughput" test,
-docs/benchmarking.md:20-36); vs_baseline = value / 12088.95.  The
-256-client run's qps/p50/p99 are reported as aux keys for the latency view.
+  * the engine runs as a REAL PROCESS (runtime/engine_main.py) serving the
+    native C++ data plane (native/dataplane.cpp) on loopback TCP;
+  * load comes from the native closed-loop client (native/loadgen.cpp) —
+    the single-host analogue of the reference's dedicated locust nodes
+    (a Python client would charge its own per-request cost against the
+    one shared CPU core);
+  * the SAME stub graph (SIMPLE_MODEL) is the headline, and both the
+    matched-256-client config and the saturation peak are reported;
+  * a real MNIST MLP, a members-vs-qps ensemble series, and the gRPC lane
+    are reported alongside.
+
+Environment note: the TPU is reached through a relay costing ~100 ms per
+dispatch round-trip regardless of size; micro-batching amortises it, so
+throughput is meaningful while single-request p50 is floored by the relay
+(aux ``relay_floor_ms``).  ``span_*`` aux keys break a Python-lane request
+into parse/dispatch/format so the framework-added latency is visible
+separately from the relay.
+
+Prints ONE JSON line: metric=stub_rest_socketed_max_qps,
+vs_baseline = value / 12088.95.
 """
 
 from __future__ import annotations
 
 import argparse
-import asyncio
+import base64
 import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
 import time
-
-import numpy as np
 
 REFERENCE_REST_QPS = 12088.95  # docs/benchmarking.md:44
 REFERENCE_GRPC_QPS = 28256.39  # docs/benchmarking.md:58
-NORTH_STAR_P50_MS = 5.0  # BASELINE.md
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+STUB_DEPLOYMENT = {
+    "spec": {
+        "name": "bench-stub",
+        "predictors": [
+            {
+                "name": "main",
+                "graph": {"name": "stub", "implementation": "SIMPLE_MODEL",
+                          "type": "MODEL"},
+            }
+        ],
+    }
+}
+
+STUB_CONTRACT = os.path.join(REPO, "examples", "stub_contract.json")
+MNIST_CONTRACT = os.path.join(REPO, "examples", "mnist_contract.json")
 
 
-def _deployment(graph, components=None, name="bench"):
-    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
-
-    return SeldonDeploymentSpec.from_json_dict(
-        {
-            "spec": {
-                "name": name,
-                "predictors": [
-                    {"name": "p", "graph": graph, "components": components or []}
+def mnist_deployment(n_members: int, hidden: int = 256) -> dict:
+    if n_members == 1:
+        graph = {"name": "m0", "type": "MODEL"}
+        comps = [
+            {
+                "name": "m0",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [
+                    {"name": "hidden", "value": str(hidden), "type": "INT"}
                 ],
             }
-        }
-    )
-
-
-def _mnist_graph(n_members: int, hidden: int = 256):
-    if n_members == 1:
-        return (
-            {"name": "m0", "type": "MODEL"},
-            [
-                {
-                    "name": "m0",
-                    "runtime": "inprocess",
-                    "class_path": "MnistClassifier",
-                    "parameters": [
-                        {"name": "hidden", "value": str(hidden), "type": "INT"}
-                    ],
-                }
-            ],
-        )
-    children = [{"name": f"m{i}", "type": "MODEL"} for i in range(n_members)]
-    comps = [
-        {
-            "name": f"m{i}",
-            "runtime": "inprocess",
-            "class_path": "MnistClassifier",
-            "parameters": [
-                {"name": "hidden", "value": str(hidden), "type": "INT"},
-                {"name": "seed", "value": str(i), "type": "INT"},
-            ],
-        }
-        for i in range(n_members)
-    ]
-    return (
-        {
+        ]
+    else:
+        graph = {
             "name": "ens",
             "type": "COMBINER",
             "implementation": "AVERAGE_COMBINER",
-            "children": children,
-        },
-        comps,
+            "children": [
+                {"name": f"m{i}", "type": "MODEL"} for i in range(n_members)
+            ],
+        }
+        comps = [
+            {
+                "name": f"m{i}",
+                "runtime": "inprocess",
+                "class_path": "MnistClassifier",
+                "parameters": [
+                    {"name": "hidden", "value": str(hidden), "type": "INT"},
+                    {"name": "seed", "value": str(i), "type": "INT"},
+                ],
+            }
+            for i in range(n_members)
+        ]
+    return {
+        "spec": {
+            "name": f"bench-mnist{n_members}",
+            "predictors": [
+                {"name": "main", "graph": graph, "components": comps}
+            ],
+        }
+    }
+
+
+class Engine:
+    """One engine process on the TPU, native data plane, loopback ports."""
+
+    REST_PORT = 18090
+    GRPC_PORT = 18091
+
+    def __init__(self, deployment: dict, prewarm_widths: str,
+                 boot_timeout_s: float = 300.0):
+        self.tmp = tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        )
+        json.dump(deployment, self.tmp)
+        self.tmp.flush()
+        self.log = tempfile.NamedTemporaryFile(
+            "w+", suffix=".log", delete=False
+        )
+        env = dict(os.environ)
+        env["ENGINE_PREWARM_WIDTHS"] = prewarm_widths
+        env.setdefault("ENGINE_MAX_BATCH", "1024")
+        env.setdefault("ENGINE_BATCH_WAIT_MS", "2.0")
+        env.setdefault("ENGINE_PIPELINE_DEPTH", "8")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "seldon_core_tpu.runtime.engine_main",
+             "--file", self.tmp.name, "--host", "127.0.0.1",
+             "--rest-port", str(self.REST_PORT),
+             "--grpc-port", str(self.GRPC_PORT)],
+            stdout=self.log, stderr=subprocess.STDOUT, env=env, cwd=REPO,
+        )
+        deadline = time.monotonic() + boot_timeout_s
+        while time.monotonic() < deadline:
+            with open(self.log.name) as f:
+                text = f.read()
+            if "engine up" in text:
+                if "native data plane unavailable" in text:
+                    raise RuntimeError(f"native plane did not start:\n{text}")
+                return
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"engine died at boot:\n{text}")
+            time.sleep(2.0)
+        raise RuntimeError("engine boot timed out")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.send_signal(signal.SIGTERM)  # skip the drain
+                try:
+                    self.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    self.proc.kill()
+        os.unlink(self.tmp.name)
+        # give the relay a beat to release the chip for the next boot
+        time.sleep(5.0)
+
+
+def run_load(contract: str, port: int, api: str, clients: int,
+             duration_s: float, warmup_s: float = 2.0) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.testing.loadtest",
+         contract, "127.0.0.1", str(port), "--native", "--api", api,
+         "--clients", str(clients), "--duration", str(duration_s)],
+        capture_output=True, text=True, cwd=REPO, timeout=duration_s + 120,
     )
+    if out.returncode != 0:
+        raise RuntimeError(f"loadtest failed: {out.stderr[-2000:]}")
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    if report.get("requests", 0) == 0:
+        raise RuntimeError(f"loadtest measured zero requests: {report}")
+    return report
 
 
-def _relay_floor_ms() -> float:
-    """Fixed cost of one tiny device->host readback in this environment."""
+def probe_device(smoke: bool) -> dict:
+    """Relay floor, generation throughput, and the Python-lane span
+    breakdown — run in a subprocess that owns the TPU."""
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_probe"]
+        + (["--smoke"] if smoke else []),
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"device probe failed: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _probe_main(smoke: bool) -> None:
+    import asyncio
+
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
+    # relay floor: fixed cost of one tiny device->host readback
     f = jax.jit(lambda x: x * 2.0)
     x = jnp.zeros((1, 8), jnp.float32)
     np.asarray(f(x))
@@ -103,228 +210,160 @@ def _relay_floor_ms() -> float:
         t0 = time.perf_counter()
         np.asarray(f(x))
         lat.append(time.perf_counter() - t0)
-    return float(np.percentile(lat, 50) * 1e3)
+    relay_floor_ms = float(np.percentile(lat, 50) * 1e3)
 
+    # LLM generation throughput (no reference counterpart: the reference
+    # predates sequence models).  Raw device-dispatch figure.
+    from seldon_core_tpu.models.generate import generate
+    from seldon_core_tpu.models.transformer import LMConfig, lm_init
 
-async def _client_load(engine, payload: str, n_clients: int, duration_s: float):
-    """K concurrent clients, each a closed loop: request -> response -> next.
-    Returns (completed, latencies)."""
-    latencies = []
-    completed = 0
-    stop = time.perf_counter() + duration_s
-
-    async def client():
-        nonlocal completed
-        while time.perf_counter() < stop:
-            t0 = time.perf_counter()
-            # the REST hot path: wire JSON in -> wire JSON out
-            text, status = await engine.predict_json(payload)
-            latencies.append(time.perf_counter() - t0)
-            completed += 1
-
-    t_start = time.perf_counter()
-    await asyncio.gather(*[client() for _ in range(n_clients)])
-    wall = time.perf_counter() - t_start  # includes requests draining past stop
-    return completed, np.asarray(latencies), wall
-
-
-async def _bench_engine_proto(spec, proto_req, n_clients, duration_s,
-                              **engine_kwargs):
-    """gRPC data-path throughput: proto bytes in -> proto bytes out through
-    the engine handler (grpc_server.make_engine_grpc_server semantics),
-    without socket framing — the analogue of predict_json for the
-    reference's gRPC maximum-throughput figure."""
-    from seldon_core_tpu.runtime.engine import EngineService
-
-    engine = EngineService(spec, **engine_kwargs)
-    wire = proto_req.SerializeToString()
-
-    async def handle():
-        # the grpc server's Predict handler is wire-bytes in/out
-        return await engine.predict_proto_wire(wire)
-
-    latencies = []
-    stop = time.perf_counter() + 3.0  # warm-up
-    await asyncio.gather(*[
-        _proto_client(handle, lambda: time.perf_counter() < stop, latencies)
-        for _ in range(n_clients)
-    ])
-    latencies.clear()
-    completed_box = [0]
-    stop = time.perf_counter() + duration_s
+    gcfg = LMConfig(vocab=256, d_model=256, n_heads=8,
+                    n_layers=2 if smoke else 4, d_ff=1024)
+    gparams = lm_init(jax.random.key(0), gcfg)
+    B, new = (4, 16) if smoke else (8, 64)
+    prompt = jnp.zeros((B, 64), jnp.int32)
+    gen = jax.jit(lambda p, t: generate(p, t, gcfg, max_new_tokens=new))
+    np.asarray(gen(gparams, prompt))
+    reps = 3
     t0 = time.perf_counter()
-    await asyncio.gather(*[
-        _proto_client(handle, lambda: time.perf_counter() < stop, latencies,
-                      completed_box)
-        for _ in range(n_clients)
-    ])
-    wall = time.perf_counter() - t0
-    lat = np.asarray(latencies)
-    return {
-        "qps": completed_box[0] / wall,
-        "p50_ms": float(np.percentile(lat, 50) * 1e3) if len(lat) else float("nan"),
-    }
+    for _ in range(reps):
+        np.asarray(gen(gparams, prompt))
+    gen_tps = B * new / ((time.perf_counter() - t0) / reps)
 
-
-async def _proto_client(handle, running, latencies, completed_box=None):
-    while running():
-        t0 = time.perf_counter()
-        await handle()
-        latencies.append(time.perf_counter() - t0)
-        if completed_box is not None:
-            completed_box[0] += 1
-
-
-async def _bench_engine(spec, payload, n_clients, duration_s, **engine_kwargs):
+    # Python-lane span breakdown: where a request's time goes with the
+    # relay in the loop (dispatch span) vs framework work (the rest)
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
     from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.utils.tracing import TRACER
 
-    engine = EngineService(spec, **engine_kwargs)
-    # warm-up at FULL concurrency so every batch-bucket shape the measured
-    # load produces is already compiled (mid-run XLA retrace skews p99)
-    await _client_load(engine, payload, n_clients, 3.0)
-    completed, lat, wall = await _client_load(engine, payload, n_clients, duration_s)
-    return {
-        "qps": completed / wall,
-        "p50_ms": float(np.percentile(lat, 50) * 1e3) if len(lat) else float("nan"),
-        "p99_ms": float(np.percentile(lat, 99) * 1e3) if len(lat) else float("nan"),
-        "mode": engine.mode,
-        "batched": engine.batcher is not None,
-    }
+    spec = SeldonDeploymentSpec.from_json_dict(mnist_deployment(1))
+    engine = EngineService(spec, max_batch=64, max_wait_ms=1.0,
+                           pipeline_depth=4)
+    engine.prewarm([784])
+    TRACER.enable()
+    payload = json.dumps(
+        {"data": {"ndarray": np.zeros((1, 784)).tolist()}}
+    )
+
+    async def drive():
+        for _ in range(20 if smoke else 100):
+            await engine.predict_json(payload)
+
+    asyncio.run(drive())
+    spans = TRACER.recent(100000)
+    req = [s.duration_ms for s in spans if s.kind == "request"]
+    disp = [s.duration_ms for s in spans if s.kind == "dispatch"]
+    span_request_ms = float(np.percentile(req, 50)) if req else None
+    span_dispatch_ms = float(np.percentile(disp, 50)) if disp else None
+    print(json.dumps({
+        "relay_floor_ms": round(relay_floor_ms, 2),
+        "gen_tokens_per_s": round(gen_tps, 1),
+        "span_request_p50_ms": round(span_request_ms, 2),
+        "span_dispatch_p50_ms": round(span_dispatch_ms, 2),
+        # framework-added latency excluding the device/relay hop: the
+        # defensible proxy for the reference's <5 ms p50 north star in an
+        # environment whose relay alone costs ~100 ms
+        "span_framework_p50_ms": round(span_request_ms - span_dispatch_ms, 2),
+        "device": str(jax.devices()[0]),
+    }))
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true")
-    parser.add_argument("--clients", type=int, default=256)
+    parser.add_argument("--_probe", action="store_true")
     parser.add_argument("--duration", type=float, default=None)
     args = parser.parse_args()
-    duration = args.duration or (3.0 if args.smoke else 15.0)
-    clients = args.clients if not args.smoke else min(args.clients, 64)
+    if args._probe:
+        _probe_main(args.smoke)
+        return
+    duration = args.duration or (3.0 if args.smoke else 8.0)
 
-    x = np.zeros((1, 784), dtype=np.float64)
-    payload = json.dumps({"data": {"ndarray": x.tolist()}})
+    # ---- device probe (owns the TPU before any engine boots) -------------
+    probe = probe_device(args.smoke)
 
-    relay_floor = _relay_floor_ms()
-
-    async def run_all():
-        g, c = _mnist_graph(1)
-        spec = _deployment(g, c)
-        # max_batch=128 splits each client wave into several in-flight
-        # dispatches so device RPCs overlap each other and the Python loop
-        single = await _bench_engine(
-            spec, payload, clients, duration, max_wait_ms=3.0, max_batch=128,
-            pipeline_depth=8,
-        )
-        # maximum-throughput probe, the reference's own methodology
-        # (docs/benchmarking.md "maximum throughput test"): saturate with
-        # enough closed-loop clients that the pipeline never starves — on
-        # this relay (~90 ms/RPC, ~32 overlapping RPCs) that takes thousands
-        # of in-process clients where the reference needed 256 over 3 nodes
-        # relay throughput fluctuates run to run; sweep two saturation
-        # configs, two bursts each, and keep the peak (locust-style max)
-        hi_configs = (
-            [(clients, 1024, 32)] if args.smoke
-            else [(8192, 1024, 32), (4096, 512, 32)]
-        )
-        high, hi_clients = None, hi_configs[0][0]
-        for cl, mb, depth in hi_configs:
-            for _ in range(1 if args.smoke else 2):
-                h = await _bench_engine(
-                    spec, payload, cl, max(duration / 2, 6.0),
-                    max_wait_ms=3.0, max_batch=mb, pipeline_depth=depth,
-                )
-                if high is None or h["qps"] > high["qps"]:
-                    high, hi_clients = h, cl
-        g, c = _mnist_graph(4)
-        ens4 = await _bench_engine(
-            _deployment(g, c), payload, clients, max(duration / 2, 3.0),
-            max_wait_ms=3.0, max_batch=128, pipeline_depth=8,
-        )
-        # north star (BASELINE.md): ensemble QPS stays flat as members grow
-        # because the fan-out happens on-device, not over the network
-        g, c = _mnist_graph(8)
-        ens8 = await _bench_engine(
-            _deployment(g, c), payload, clients, max(duration / 2, 3.0),
-            max_wait_ms=3.0, max_batch=128, pipeline_depth=8,
-        )
-        # gRPC data path (proto wire in/out through the engine handler),
-        # Tensor form — packed doubles, same as the reference's locust gRPC
-        # script (util/loadtester/scripts/predict_grpc_locust.py:127-131)
-        from seldon_core_tpu.proto_gen import prediction_pb2 as _pb
-
-        g, c = _mnist_graph(1)
-        proto_req = _pb.SeldonMessage(
-            data=_pb.DefaultData(
-                tensor=_pb.Tensor(shape=[1, 784], values=[0.0] * 784)
-            )
-        )
-        grpc_clients = 4096 if not args.smoke else clients
-        grpc_r = None
-        for _ in range(1 if args.smoke else 3):
-            gr = await _bench_engine_proto(
-                _deployment(g, c), proto_req, grpc_clients,
-                max(duration / 2, 6.0), max_wait_ms=3.0, max_batch=1024,
-                pipeline_depth=32,
-            )
-            if grpc_r is None or gr["qps"] > grpc_r["qps"]:
-                grpc_r = gr
-        return single, high, ens4, ens8, hi_clients, grpc_r
-
-    single, high, ens4, ens8, hi_clients, grpc_r = asyncio.run(run_all())
-
-    # LLM-style generation throughput (no reference counterpart: the
-    # reference predates sequence models).  One KV-cache decode of B x N
-    # tokens is a single device dispatch.  NB this is a RAW device-dispatch
-    # figure (jit call + one readback per rep), not the served wire path —
-    # it isolates the decode-loop cost from codec/batching overhead.
-    def _gen_tokens_per_s():
-        import jax
-        import jax.numpy as jnp
-
-        from seldon_core_tpu.models.generate import generate
-        from seldon_core_tpu.models.transformer import LMConfig, lm_init
-
-        gcfg = LMConfig(vocab=256, d_model=256, n_heads=8,
-                        n_layers=2 if args.smoke else 4, d_ff=1024)
-        gparams = lm_init(jax.random.key(0), gcfg)
-        B, new = (4, 16) if args.smoke else (8, 64)
-        prompt = jnp.zeros((B, 64), jnp.int32)
-        f = jax.jit(lambda p, t: generate(p, t, gcfg, max_new_tokens=new))
-        np.asarray(f(gparams, prompt))  # compile + warm
-        reps = 3
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            np.asarray(f(gparams, prompt))  # asarray forces each dispatch
-        dt = (time.perf_counter() - t0) / reps
-        return B * new / dt
-
-    gen_tps = _gen_tokens_per_s()
-    best, best_clients = (
-        (high, hi_clients) if high["qps"] >= single["qps"] else (single, clients)
+    # ---- stub graph: the reference's own max-throughput methodology ------
+    stub_rest_cfgs = [256] + ([1024] if args.smoke else [4096, 8192])
+    stub_grpc_cfgs = [256] + ([1024] if args.smoke else [4096, 8192])
+    eng = Engine(STUB_DEPLOYMENT, prewarm_widths="1")
+    try:
+        stub_rest = {
+            c: run_load(STUB_CONTRACT, Engine.REST_PORT, "rest", c, duration)
+            for c in stub_rest_cfgs
+        }
+        stub_grpc = {
+            c: run_load(STUB_CONTRACT, Engine.GRPC_PORT, "grpc", c, duration)
+            for c in stub_grpc_cfgs
+        }
+    finally:
+        eng.stop()
+    rest_peak_c, rest_peak = max(
+        stub_rest.items(), key=lambda kv: kv[1]["qps"]
+    )
+    grpc_peak_c, grpc_peak = max(
+        stub_grpc.items(), key=lambda kv: kv[1]["qps"]
     )
 
-    import jax
+    # ---- real model: MNIST MLP ------------------------------------------
+    mnist_cfgs = [256] + ([512] if args.smoke else [1024, 2048])
+    eng = Engine(mnist_deployment(1), prewarm_widths="784")
+    try:
+        mnist = {
+            c: run_load(MNIST_CONTRACT, Engine.REST_PORT, "rest", c, duration)
+            for c in mnist_cfgs
+        }
+    finally:
+        eng.stop()
+    mnist_peak_c, mnist_peak = max(mnist.items(), key=lambda kv: kv[1]["qps"])
+
+    # ---- ensemble series: on-device fan-out should hold QPS flat ---------
+    # (BASELINE.md north star: linear total QPS out to 8 members; probed at
+    # the MNIST saturation config, where it could actually bend)
+    ensemble = {}
+    for members in ([4] if args.smoke else [2, 4, 8]):
+        eng = Engine(mnist_deployment(members), prewarm_widths="784")
+        try:
+            ensemble[members] = run_load(
+                MNIST_CONTRACT, Engine.REST_PORT, "rest", mnist_peak_c,
+                duration,
+            )
+        finally:
+            eng.stop()
 
     result = {
-        "metric": "mnist_graph_max_qps",
-        "value": round(best["qps"], 1),
+        "metric": "stub_rest_socketed_max_qps",
+        "value": round(rest_peak["qps"], 1),
         "unit": "req/s",
-        "vs_baseline": round(best["qps"] / REFERENCE_REST_QPS, 4),
-        "max_qps_clients": best_clients,
-        "max_qps_p50_ms": round(best["p50_ms"], 2),
-        "clients": clients,
-        "qps": round(single["qps"], 1),
-        "p50_ms": round(single["p50_ms"], 2),
-        "p99_ms": round(single["p99_ms"], 2),
-        "ensemble4_qps": round(ens4["qps"], 1),
-        "ensemble4_p50_ms": round(ens4["p50_ms"], 2),
-        "ensemble8_qps": round(ens8["qps"], 1),
-        "ensemble8_p50_ms": round(ens8["p50_ms"], 2),
-        "grpc_path_qps": round(grpc_r["qps"], 1),
-        "grpc_vs_baseline": round(grpc_r["qps"] / REFERENCE_GRPC_QPS, 4),
-        "gen_tokens_per_s": round(gen_tps, 1),
-        "relay_floor_ms": round(relay_floor, 2),
-        "device": str(jax.devices()[0]),
+        "vs_baseline": round(rest_peak["qps"] / REFERENCE_REST_QPS, 4),
+        "methodology": (
+            "engine process + native C++ data plane on loopback TCP, "
+            "native closed-loop load client, stub graph "
+            "(reference docs/benchmarking.md max-throughput test)"
+        ),
+        "max_qps_clients": rest_peak_c,
+        "max_qps_p50_ms": rest_peak["p50_ms"],
+        "rest_256_qps": stub_rest[256]["qps"],
+        "rest_256_p50_ms": stub_rest[256]["p50_ms"],
+        "rest_256_p99_ms": stub_rest[256].get("p99_ms"),
+        "grpc_max_qps": round(grpc_peak["qps"], 1),
+        "grpc_vs_baseline": round(grpc_peak["qps"] / REFERENCE_GRPC_QPS, 4),
+        "grpc_max_qps_clients": grpc_peak_c,
+        "grpc_max_qps_p50_ms": grpc_peak["p50_ms"],
+        "grpc_256_qps": stub_grpc[256]["qps"],
+        "grpc_256_p50_ms": stub_grpc[256]["p50_ms"],
+        "mnist_max_qps": round(mnist_peak["qps"], 1),
+        "mnist_max_qps_clients": mnist_peak_c,
+        "mnist_256_qps": mnist[256]["qps"],
+        "mnist_256_p50_ms": mnist[256]["p50_ms"],
+        "ensemble_members_qps": {
+            str(m): r["qps"] for m, r in sorted(ensemble.items())
+        },
+        "failures": sum(
+            r.get("failures", 0)
+            for r in [*stub_rest.values(), *stub_grpc.values(),
+                      *mnist.values(), *ensemble.values()]
+        ),
+        **probe,
         "duration_s": duration,
     }
     print(json.dumps(result))

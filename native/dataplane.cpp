@@ -424,6 +424,13 @@ void conn_flush(Plane* pl, int ci) {
     c.done.erase(it);
     c.next_write++;
   }
+  if (c.h2 && c.out.size() - c.out_off > 256u * 1024 * 1024) {
+    // h2 write-side backstop: a client that pipelines requests but never
+    // reads responses would grow c.out without bound (the HTTP lane's
+    // MAX_CONN_OUTSTANDING pause covers this for HTTP/1.1)
+    conn_close(pl, ci);
+    return;
+  }
   while (c.out_off < c.out.size()) {
     ssize_t n = write(c.fd, c.out.data() + c.out_off, c.out.size() - c.out_off);
     if (n > 0) { c.out_off += (size_t)n; continue; }
@@ -1384,6 +1391,9 @@ void h2_send_response(Plane* pl, int ci, uint32_t sid,
 void h2_trailers_only(Plane* pl, int ci, uint32_t sid, int grpc_status,
                       const std::string& msg) {
   Conn& c = *pl->conns[ci];
+  // every error path ends the stream here: drop its send-window slot
+  // (opened at dispatch) or the map grows by one entry per failed RPC
+  c.h2s->stream_windows.erase(sid);
   std::string block;
   block += (char)0x88;  // :status 200
   block += (char)0x0f;
@@ -1664,6 +1674,15 @@ void h2_parse(Plane* pl, int ci) {
         h.stream_windows.erase(sid);
         auto it = h.live.find(sid);
         if (it != h.live.end()) it->second = false;  // drop the response
+        // purge any flow-stalled response for the cancelled stream: the
+        // client will never grant it window, and a stalled txq head would
+        // head-of-line-block every later response on this connection
+        for (auto tit = h.txq.begin(); tit != h.txq.end();) {
+          if (tit->sid == sid) tit = h.txq.erase(tit);
+          else ++tit;
+        }
+        h2_pump_txq(pl, ci);
+        want_flush = true;
         break;
       }
       case H2_GOAWAY:

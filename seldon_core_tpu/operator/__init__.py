@@ -1,6 +1,8 @@
 """Deployment operator: materializes SeldonDeployment specs into running
-engines/units, watches a spec directory, tracks status; renders k8s
-manifests (helm-equivalent) and packages model images (s2i-equivalent)."""
+engines/units, watches a spec directory, tracks status; reconciles CRs
+against a (pluggable) Kubernetes API server with CRD bootstrap and status
+write-back; renders k8s manifests (helm-equivalent) and packages model
+images (s2i-equivalent)."""
 
 from seldon_core_tpu.operator.materializer import Materializer  # noqa: F401
 from seldon_core_tpu.operator.manifests import (  # noqa: F401
@@ -8,3 +10,9 @@ from seldon_core_tpu.operator.manifests import (  # noqa: F401
     to_yaml_stream,
 )
 from seldon_core_tpu.operator.packaging import ImageSpec, package_model  # noqa: F401
+from seldon_core_tpu.operator.reconciler import (  # noqa: F401
+    FakeKubeApi,
+    KubeClient,
+    KubectlClient,
+    Reconciler,
+)

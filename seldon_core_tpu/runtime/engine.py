@@ -298,7 +298,10 @@ class EngineService:
                     f"graph rejected input of shape {stacked.shape}: {e}"
                 ) from e
             self._known_good_widths.add(width)
-        return np.asarray(y), (routing, tags)
+            # the readback belongs inside the span: jax dispatch is async,
+            # so the device+relay round-trip is only paid here
+            y = np.asarray(y)
+        return y, (routing, tags)
 
     # ------------------------------------------------------------------
 
