@@ -299,6 +299,13 @@ def make_gateway_app(gateway: ApiGateway):
     async def ping(_):
         return web.Response(text="pong")
 
+    async def ready(_):
+        # readiness = a registered routing table (an empty gateway serves
+        # nothing useful; the bundle's probe gates the Service on this)
+        if gateway.store.deployments() or not gateway.require_auth:
+            return web.Response(text="ready")
+        return web.Response(text="no deployments registered", status=503)
+
     async def prometheus(_):
         return web.Response(
             body=gateway.metrics.exposition(),
@@ -309,6 +316,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
     app.router.add_get("/ping", ping)
+    app.router.add_get("/ready", ready)
     app.router.add_get("/prometheus", prometheus)
 
     async def _cleanup(_app):

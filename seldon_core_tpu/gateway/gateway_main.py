@@ -1,0 +1,128 @@
+"""Gateway process entrypoint — the reference's apife pod boot.
+
+Env contract (rendered by operator/bundle.py, mirroring the apife chart
+values):
+
+  GATEWAY_REST_PORT / GATEWAY_GRPC_PORT   listen ports (8080 / 5000)
+  GATEWAY_OAUTH_ENABLED                   "0" disables auth (single-tenant)
+  GATEWAY_STATE_PATH                      sqlite file for replica-shared
+                                          tokens/registrations (the
+                                          reference's Redis role,
+                                          gateway/state.py); empty =
+                                          per-process in-memory store
+  GATEWAY_SPEC_DIR                        directory of SeldonDeployment
+                                          JSONs to register, polled like
+                                          the operator's watch_dir
+  GATEWAY_ENGINE_URL_TEMPLATE             engine base URL per deployment,
+                                          default "http://{name}:8000"
+                                          ({name} = deployment Service)
+
+    python -m seldon_core_tpu.gateway.gateway_main [--spec-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+
+from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+from seldon_core_tpu.gateway.firehose import Firehose
+from seldon_core_tpu.graph.spec import GraphSpecError, SeldonDeploymentSpec
+
+__all__ = ["main"]
+
+
+def _build_store():
+    path = os.environ.get("GATEWAY_STATE_PATH", "").strip()
+    if path:
+        from seldon_core_tpu.gateway.state import SqliteDeploymentStore
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return SqliteDeploymentStore(path)
+    return DeploymentStore()
+
+
+def _register_specs(store, spec_dir: str, seen: dict) -> None:
+    template = os.environ.get(
+        "GATEWAY_ENGINE_URL_TEMPLATE", "http://{name}:8000"
+    )
+    for path in sorted(glob.glob(os.path.join(spec_dir, "*.json"))):
+        mtime = os.path.getmtime(path)
+        if seen.get(path) == mtime:
+            continue
+        try:
+            with open(path) as f:
+                spec = SeldonDeploymentSpec.from_json_dict(json.load(f))
+            url = template.format(name=spec.name)
+            store.register(
+                spec, {p.name: url for p in spec.predictors}
+            )
+            seen[path] = mtime
+            print(f"registered {spec.name} -> {url}", flush=True)
+        except (GraphSpecError, ValueError, OSError,
+                json.JSONDecodeError) as e:
+            print(f"skipping {path}: {e}", flush=True)
+            seen[path] = mtime
+
+
+async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
+    from seldon_core_tpu.gateway.apife import make_gateway_app
+    from seldon_core_tpu.runtime.grpc_server import make_gateway_grpc_server
+    from seldon_core_tpu.runtime.rest import serve_app
+
+    rest_port = int(os.environ.get("GATEWAY_REST_PORT", "8080"))
+    grpc_port = int(os.environ.get("GATEWAY_GRPC_PORT", "5000"))
+    store = _build_store()
+    firehose_dir = os.environ.get("GATEWAY_FIREHOSE_DIR", "").strip()
+    gateway = ApiGateway(
+        store=store,
+        firehose=Firehose(firehose_dir) if firehose_dir else None,
+        require_auth=os.environ.get("GATEWAY_OAUTH_ENABLED", "1") != "0",
+    )
+    seen: dict = {}
+    if spec_dir:
+        _register_specs(store, spec_dir, seen)
+    runner = await serve_app(make_gateway_app(gateway), host, rest_port)
+    grpc_server = make_gateway_grpc_server(gateway, host, grpc_port)
+    await grpc_server.start()
+    print(
+        f"gateway up: deployments={store.deployments()} "
+        f"rest=:{rest_port} grpc=:{grpc_port}",
+        flush=True,
+    )
+
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+    while not stop.is_set():
+        try:
+            await asyncio.wait_for(stop.wait(), timeout=5.0)
+        except asyncio.TimeoutError:
+            if spec_dir:  # poll for new/changed deployment specs
+                _register_specs(store, spec_dir, seen)
+    await grpc_server.stop(grace=5.0)
+    await runner.cleanup()
+    print("gateway stopped", flush=True)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="seldon_core_tpu gateway")
+    parser.add_argument(
+        "--spec-dir", default=os.environ.get("GATEWAY_SPEC_DIR", "")
+    )
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+    asyncio.run(serve(args.spec_dir, args.host))
+
+
+if __name__ == "__main__":
+    main()

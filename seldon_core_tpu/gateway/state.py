@@ -1,0 +1,182 @@
+"""Shared gateway state — tokens and registrations that survive replicas.
+
+The reference gateway keeps OAuth tokens in Redis so any apife replica can
+validate a token issued by another (api-frontend
+config/RedisConfig.java, TokenStore wiring); deployment registrations
+arrive via the cluster-manager and live in each replica's memory.
+
+This module is that role without an external broker: a single sqlite file
+(on a shared volume) in WAL mode holds both tables, and
+:class:`SqliteDeploymentStore` is a drop-in for
+:class:`~seldon_core_tpu.gateway.apife.DeploymentStore` — same methods,
+same AuthError semantics, same TTL — so ``ApiGateway`` works unchanged
+with N replicas pointed at one ``GATEWAY_STATE_PATH``.
+
+Registrations persisted here reference engines by URL (remote dispatch);
+in-process EngineService objects are inherently per-replica and stay with
+the in-memory store.
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import sqlite3
+import threading
+import time
+from typing import Dict, List
+
+from seldon_core_tpu.gateway.apife import (
+    TOKEN_TTL_S,
+    AuthError,
+    _Registration,
+)
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+__all__ = ["SqliteDeploymentStore"]
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS registrations (
+    oauth_key TEXT PRIMARY KEY,
+    deployment_id TEXT NOT NULL,
+    oauth_secret TEXT NOT NULL,
+    engines_json TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS tokens (
+    token TEXT PRIMARY KEY,
+    oauth_key TEXT NOT NULL,
+    expiry REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS tokens_by_key ON tokens(oauth_key);
+"""
+
+
+class SqliteDeploymentStore:
+    """DeploymentStore drop-in over a shared sqlite file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # -- registrations -----------------------------------------------------
+
+    def register(self, spec: SeldonDeploymentSpec,
+                 engines: Dict[str, object]) -> None:
+        """``engines``: predictor name -> engine base URL (shared state can
+        only carry references another replica can dial)."""
+        weighted = []
+        for p in spec.predictors:
+            if p.name in engines:
+                engine = engines[p.name]
+                if not isinstance(engine, str):
+                    raise TypeError(
+                        "SqliteDeploymentStore carries engine URLs; "
+                        "in-process engines are per-replica "
+                        "(use the in-memory DeploymentStore)"
+                    )
+                weighted.append((p.name, max(int(p.replicas), 0), engine))
+        if not weighted:
+            raise ValueError(
+                f"no engines supplied for deployment {spec.name!r}"
+            )
+        key = spec.oauth_key or spec.name
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO registrations VALUES (?, ?, ?, ?)",
+                (key, spec.name, spec.oauth_secret, json.dumps(weighted)),
+            )
+            self._conn.commit()
+
+    def unregister(self, oauth_key: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM registrations WHERE oauth_key = ?", (oauth_key,)
+            )
+            self._conn.execute(
+                "DELETE FROM tokens WHERE oauth_key = ?", (oauth_key,)
+            )
+            self._conn.commit()
+
+    def _registration(self, oauth_key: str):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT deployment_id, oauth_secret, engines_json "
+                "FROM registrations WHERE oauth_key = ?",
+                (oauth_key,),
+            ).fetchone()
+        if row is None:
+            return None
+        return _Registration(
+            deployment_id=row[0],
+            oauth_key=oauth_key,
+            oauth_secret=row[1],
+            engines=[tuple(e) for e in json.loads(row[2])],
+        )
+
+    # -- auth --------------------------------------------------------------
+
+    def issue_token(self, oauth_key: str, oauth_secret: str) -> str:
+        reg = self._registration(oauth_key)
+        if reg is None or (reg.oauth_secret
+                           and reg.oauth_secret != oauth_secret):
+            raise AuthError("invalid client credentials")
+        token = secrets.token_urlsafe(24)
+        now = time.time()
+        with self._lock:
+            # expired rows are evicted on the write path (the same lazy
+            # policy the in-memory store uses)
+            self._conn.execute("DELETE FROM tokens WHERE expiry <= ?", (now,))
+            self._conn.execute(
+                "INSERT INTO tokens VALUES (?, ?, ?)",
+                (token, oauth_key, now + TOKEN_TTL_S),
+            )
+            self._conn.commit()
+        return token
+
+    def principal_for_token(self, token: str) -> _Registration:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT oauth_key, expiry FROM tokens WHERE token = ?",
+                (token,),
+            ).fetchone()
+        if row is None:
+            raise AuthError("invalid token")
+        key, expiry = row
+        if time.time() > expiry:
+            with self._lock:
+                self._conn.execute(
+                    "DELETE FROM tokens WHERE token = ?", (token,)
+                )
+                self._conn.commit()
+            raise AuthError("token expired")
+        reg = self._registration(key)
+        if reg is None:
+            raise AuthError("client no longer registered")
+        return reg
+
+    def deployments(self) -> List[str]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT deployment_id FROM registrations ORDER BY oauth_key"
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    # ApiGateway._resolve peeks at _by_key when auth is disabled; present
+    # the same mapping view lazily
+    @property
+    def _by_key(self) -> Dict[str, _Registration]:
+        with self._lock:
+            keys = [r[0] for r in self._conn.execute(
+                "SELECT oauth_key FROM registrations"
+            ).fetchall()]
+        return {k: self._registration(k) for k in keys}
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

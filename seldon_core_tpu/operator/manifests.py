@@ -75,7 +75,13 @@ def _topology(chips: int) -> str:
 
 
 def engine_deployment(spec: SeldonDeploymentSpec,
-                      predictor: PredictorSpec) -> dict:
+                      predictor: PredictorSpec,
+                      engine_image: str = "",
+                      engine_env: "Dict[str, str] | None" = None) -> dict:
+    """``engine_image`` / ``engine_env`` are the chart-level knobs the
+    reference wires through its operator properties
+    (ENGINE_CONTAINER_IMAGE_AND_VERSION, cluster-manager
+    application.properties) — rendered values flow operator -> here."""
     pred_b64 = base64.b64encode(
         json.dumps(predictor.to_json_dict(), separators=(",", ":")).encode()
     ).decode()
@@ -116,7 +122,7 @@ def engine_deployment(spec: SeldonDeploymentSpec,
                     "containers": [
                         {
                             "name": "seldon-engine",
-                            "image": ENGINE_IMAGE,
+                            "image": engine_image or ENGINE_IMAGE,
                             "env": [
                                 {"name": "ENGINE_PREDICTOR", "value": pred_b64},
                                 {"name": "SELDON_DEPLOYMENT_ID",
@@ -125,6 +131,12 @@ def engine_deployment(spec: SeldonDeploymentSpec,
                                  "value": str(ENGINE_REST_PORT)},
                                 {"name": "ENGINE_SERVER_GRPC_PORT",
                                  "value": str(ENGINE_GRPC_PORT)},
+                                *(
+                                    {"name": k, "value": str(v)}
+                                    for k, v in sorted(
+                                        (engine_env or {}).items()
+                                    )
+                                ),
                             ],
                             "ports": [
                                 {"containerPort": ENGINE_REST_PORT,
@@ -295,7 +307,9 @@ def deployment_service(spec: SeldonDeploymentSpec) -> dict:
 
 
 def generate_manifests(spec: SeldonDeploymentSpec,
-                       run_defaulting: bool = True) -> List[dict]:
+                       run_defaulting: bool = True,
+                       engine_image: str = "",
+                       engine_env: "Dict[str, str] | None" = None) -> List[dict]:
     """All resources for a deployment, reference createResources order:
     engine Deployments, component Deployments/Services, deployment Service."""
     if run_defaulting:
@@ -310,7 +324,10 @@ def generate_manifests(spec: SeldonDeploymentSpec,
                     f"component name 'engine' is reserved "
                     f"(predictor {predictor.name!r})"
                 )
-        out.append(engine_deployment(spec, predictor))
+        out.append(
+            engine_deployment(spec, predictor, engine_image=engine_image,
+                              engine_env=engine_env)
+        )
         for binding in predictor.components:
             if binding.runtime in ("rest", "grpc"):
                 out.append(component_deployment(spec, predictor, binding))

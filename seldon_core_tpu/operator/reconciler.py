@@ -281,17 +281,30 @@ def _config_hash(obj: dict) -> str:
 class Reconciler:
     """Converge owned resources on each SeldonDeployment CR."""
 
-    def __init__(self, client: KubeClient, namespace: str = "default"):
+    def __init__(self, client: KubeClient, namespace: str = "default",
+                 engine_image: str = "",
+                 engine_env: Optional[Dict[str, str]] = None):
+        # engine_image/engine_env: the chart-level engine knobs
+        # (bundle.py values.engine) flowing into every rendered engine pod,
+        # the reference's ENGINE_CONTAINER_IMAGE_AND_VERSION property role
         self.client = client
         self.namespace = namespace
+        self.engine_image = engine_image
+        self.engine_env = dict(engine_env or {})
 
     # -- CRD bootstrap ---------------------------------------------------
 
     def ensure_crd(self) -> bool:
         """Register the SeldonDeployment CRD if absent (CRDCreator.java's
-        boot path).  Returns True when it had to be created."""
+        boot path).  Returns True when it had to be created.
+
+        CRDs are cluster-scoped: the lookup must use the same namespace
+        key the (namespace-less) SELDON_CRD manifest stores under, NOT
+        this reconciler's working namespace — kubectl ignores -n for
+        cluster-scoped kinds, and the fake API defaults them to
+        'default'."""
         existing = self.client.get(
-            "CustomResourceDefinition", self.namespace, CRD_NAME
+            "CustomResourceDefinition", "default", CRD_NAME
         )
         if existing is not None:
             return False
@@ -302,7 +315,9 @@ class Reconciler:
 
     def _desired(self, cr: dict) -> List[dict]:
         spec = SeldonDeploymentSpec.from_json_dict(cr)
-        manifests = generate_manifests(spec)
+        manifests = generate_manifests(
+            spec, engine_image=self.engine_image, engine_env=self.engine_env
+        )
         name = cr.get("metadata", {}).get("name", spec.name)
         uid = cr.get("metadata", {}).get("uid", "")
         for m in manifests:
@@ -471,7 +486,17 @@ def main(argv=None) -> None:
     parser.add_argument("--kubectl", default="kubectl",
                         help="kubectl binary for the cluster client")
     args = parser.parse_args(argv)
-    rec = Reconciler(KubectlClient(args.kubectl), namespace=args.namespace)
+    import os
+
+    engine_env = {}
+    raw = os.environ.get("SELDON_ENGINE_ENV", "")
+    if raw.strip():
+        engine_env = {str(k): str(v) for k, v in json.loads(raw).items()}
+    rec = Reconciler(
+        KubectlClient(args.kubectl), namespace=args.namespace,
+        engine_image=os.environ.get("SELDON_ENGINE_IMAGE", ""),
+        engine_env=engine_env,
+    )
     if rec.ensure_crd():
         print(f"registered CRD {CRD_NAME}", flush=True)
     while True:

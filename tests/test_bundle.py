@@ -1,0 +1,180 @@
+"""Platform bundle rendering (operator/bundle.py) — values semantics,
+toggles, engine-knob plumbing into the operator env, and a golden-file
+pin of the default render (the role of the reference's committed chart
+templates: any shape change is a conscious diff)."""
+
+import json
+import os
+
+import pytest
+
+from seldon_core_tpu.operator.bundle import (
+    default_values,
+    merge_values,
+    render_bundle,
+)
+
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "resources", "bundle_default.json"
+)
+
+
+def kinds(manifests):
+    return [(m["kind"], m["metadata"]["name"]) for m in manifests]
+
+
+def test_default_bundle_shape():
+    ms = render_bundle()
+    ks = kinds(ms)
+    assert ("CustomResourceDefinition",
+            "seldondeployments.machinelearning.seldon.io") in ks
+    assert ("Deployment", "seldon-operator") in ks
+    assert ("Deployment", "seldon-gateway") in ks
+    assert ("Service", "seldon-gateway") in ks
+    assert ("ServiceAccount", "seldon") in ks
+    assert ("Role", "seldon-operator") in ks
+    assert ("RoleBinding", "seldon-operator") in ks
+    # analytics/loadtest/firehose default off
+    assert not any(n.startswith("seldon-prometheus") for _, n in ks)
+    assert not any(k == "Job" for k, _ in ks)
+
+
+def test_golden_default_render():
+    ms = render_bundle()
+    rendered = json.dumps(ms, indent=1, sort_keys=True)
+    if not os.path.exists(GOLDEN):  # first run writes the pin
+        with open(GOLDEN, "w") as f:
+            f.write(rendered)
+    with open(GOLDEN) as f:
+        assert json.loads(f.read()) == json.loads(rendered)
+
+
+def test_analytics_toggle_renders_monitoring_stack():
+    ms = render_bundle({"analytics": {"enabled": True}})
+    ks = kinds(ms)
+    assert ("Deployment", "seldon-prometheus") in ks
+    assert ("Deployment", "seldon-grafana") in ks
+    cm = next(m for m in ms
+              if m["metadata"]["name"] == "seldon-prometheus-config")
+    assert "prometheus.yml" in cm["data"] and "alerts.yml" in cm["data"]
+    dash = next(m for m in ms
+                if m["metadata"]["name"] == "seldon-grafana-dashboards")
+    assert "predictions-analytics-dashboard.json" in dash["data"]
+
+
+def test_loadtest_job_parameterized():
+    ms = render_bundle({
+        "loadtest": {
+            "enabled": True,
+            "target_host": "iris-deployment",
+            "clients": 64,
+            "api": "grpc",
+        }
+    })
+    job = next(m for m in ms if m["kind"] == "Job")
+    cmd = job["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "iris-deployment" in cmd
+    assert "grpc" in cmd and "64" in cmd
+
+
+def test_engine_values_flow_to_operator_env():
+    ms = render_bundle({
+        "engine": {"image": "registry/engine:v9", "max_batch": 256}
+    })
+    op = next(m for m in ms if m["kind"] == "Deployment"
+              and m["metadata"]["name"] == "seldon-operator")
+    env = {
+        e["name"]: e["value"]
+        for e in op["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["SELDON_ENGINE_IMAGE"] == "registry/engine:v9"
+    assert json.loads(env["SELDON_ENGINE_ENV"])["ENGINE_MAX_BATCH"] == "256"
+
+
+def test_merge_values_scalar_replace_map_merge():
+    v = merge_values({"gateway": {"replicas": 3}})
+    assert v["gateway"]["replicas"] == 3
+    assert v["gateway"]["oauth"]["enabled"] is True  # untouched sibling
+    assert v["namespace"] == default_values()["namespace"]
+
+
+def test_namespace_applies_everywhere():
+    ms = render_bundle({"namespace": "prod"})
+    for m in ms:
+        if m["kind"] == "CustomResourceDefinition":
+            continue  # cluster-scoped
+        assert m["metadata"]["namespace"] == "prod", m["metadata"]["name"]
+
+
+def test_rbac_disabled_drops_rbac_and_service_account():
+    ms = render_bundle({"rbac": {"enabled": False}})
+    ks = kinds(ms)
+    assert not any(k in ("ServiceAccount", "Role", "RoleBinding")
+                   for k, _ in ks)
+    op = next(m for m in ms if m["kind"] == "Deployment"
+              and m["metadata"]["name"] == "seldon-operator")
+    assert "serviceAccountName" not in op["spec"]["template"]["spec"]
+
+
+def test_engine_env_reaches_rendered_engine_pods():
+    # the operator plumb: values.engine -> reconciler -> engine Deployment
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.operator.manifests import generate_manifests
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": "d",
+            "predictors": [
+                {"name": "p",
+                 "graph": {"name": "m", "type": "MODEL",
+                           "implementation": "SIMPLE_MODEL"}}
+            ],
+        }
+    })
+    ms = generate_manifests(
+        spec, engine_image="registry/engine:v9",
+        engine_env={"ENGINE_MAX_BATCH": "256"},
+    )
+    engine = next(m for m in ms if m["kind"] == "Deployment")
+    container = engine["spec"]["template"]["spec"]["containers"][0]
+    assert container["image"] == "registry/engine:v9"
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["ENGINE_MAX_BATCH"] == "256"
+
+
+def test_gateway_replicas_require_shared_state_pvc():
+    with pytest.raises(ValueError, match="state_pvc"):
+        render_bundle({"gateway": {"replicas": 2}})
+    ms = render_bundle({
+        "gateway": {"replicas": 2, "state_pvc": {"enabled": True}}
+    })
+    pvc = next(m for m in ms if m["kind"] == "PersistentVolumeClaim")
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+    gw = next(m for m in ms if m["kind"] == "Deployment"
+              and m["metadata"]["name"] == "seldon-gateway")
+    vols = gw["spec"]["template"]["spec"]["volumes"]
+    assert vols[0]["persistentVolumeClaim"]["claimName"] == \
+        "seldon-gateway-state"
+
+
+def test_gateway_ports_flow_to_process_env():
+    ms = render_bundle({"gateway": {"rest_port": 9000, "grpc_port": 9001}})
+    gw = next(m for m in ms if m["kind"] == "Deployment"
+              and m["metadata"]["name"] == "seldon-gateway")
+    env = {
+        e["name"]: e["value"]
+        for e in gw["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["GATEWAY_REST_PORT"] == "9000"
+    assert env["GATEWAY_GRPC_PORT"] == "9001"
+    probe = gw["spec"]["template"]["spec"]["containers"][0]["readinessProbe"]
+    assert probe["httpGet"]["port"] == 9000
+
+
+def test_cli_set_overrides(capsys):
+    from seldon_core_tpu.operator.bundle import main
+
+    main(["--set", "analytics.enabled=true", "--set", "namespace=stage"])
+    out = capsys.readouterr().out
+    assert "seldon-prometheus" in out
+    assert "namespace: stage" in out

@@ -1,0 +1,97 @@
+"""Replica-shared gateway state (gateway/state.py): a token issued by one
+gateway replica must validate on another pointed at the same sqlite file —
+the property the reference got from Redis (api-frontend RedisConfig.java)."""
+
+import time
+
+import pytest
+
+from seldon_core_tpu.gateway.apife import ApiGateway, AuthError
+from seldon_core_tpu.gateway.state import SqliteDeploymentStore
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+
+def make_spec(name="dep", oauth_key="key", oauth_secret="secret"):
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": name,
+            "oauth_key": oauth_key,
+            "oauth_secret": oauth_secret,
+            "predictors": [
+                {"name": "main",
+                 "replicas": 1,
+                 "graph": {"name": "m", "type": "MODEL",
+                           "implementation": "SIMPLE_MODEL"}}
+            ],
+        }
+    })
+
+
+@pytest.fixture()
+def db_path(tmp_path):
+    return str(tmp_path / "gateway.db")
+
+
+def test_token_issued_on_one_replica_validates_on_another(db_path):
+    a = SqliteDeploymentStore(db_path)
+    b = SqliteDeploymentStore(db_path)  # second gateway replica
+    a.register(make_spec(), {"main": "http://dep:8000"})
+    token = a.issue_token("key", "secret")
+    reg = b.principal_for_token(token)
+    assert reg.deployment_id == "dep"
+    assert reg.engines == [("main", 1, "http://dep:8000")]
+
+
+def test_bad_credentials_and_bad_token(db_path):
+    a = SqliteDeploymentStore(db_path)
+    a.register(make_spec(), {"main": "http://dep:8000"})
+    with pytest.raises(AuthError):
+        a.issue_token("key", "wrong")
+    with pytest.raises(AuthError):
+        a.principal_for_token("no-such-token")
+
+
+def test_unregister_invalidates_tokens_across_replicas(db_path):
+    a = SqliteDeploymentStore(db_path)
+    b = SqliteDeploymentStore(db_path)
+    a.register(make_spec(), {"main": "http://dep:8000"})
+    token = a.issue_token("key", "secret")
+    b.unregister("key")
+    with pytest.raises(AuthError):
+        a.principal_for_token(token)
+    assert a.deployments() == []
+
+
+def test_expired_token_rejected(db_path, monkeypatch):
+    a = SqliteDeploymentStore(db_path)
+    a.register(make_spec(), {"main": "http://dep:8000"})
+    token = a.issue_token("key", "secret")
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() + 3601.0)
+    with pytest.raises(AuthError, match="expired"):
+        a.principal_for_token(token)
+
+
+def test_reregister_updates_engines(db_path):
+    a = SqliteDeploymentStore(db_path)
+    a.register(make_spec(), {"main": "http://old:8000"})
+    a.register(make_spec(), {"main": "http://new:8000"})
+    token = a.issue_token("key", "secret")
+    reg = a.principal_for_token(token)
+    assert reg.engines[0][2] == "http://new:8000"
+
+
+def test_in_process_engines_rejected(db_path):
+    a = SqliteDeploymentStore(db_path)
+    with pytest.raises(TypeError):
+        a.register(make_spec(), {"main": object()})
+
+
+def test_gateway_auth_disabled_resolution(db_path):
+    # ApiGateway._resolve peeks _by_key when auth is off; the sqlite store
+    # must present the same view
+    store = SqliteDeploymentStore(db_path)
+    store.register(make_spec(), {"main": "http://dep:8000"})
+    gw = ApiGateway(store=store, require_auth=False)
+    reg = gw._resolve(None)
+    assert reg.deployment_id == "dep"
