@@ -1,0 +1,271 @@
+// Minimal C++ graph-node microservice — the cross-language conformance
+// demonstration (the role the reference's R and Java s2i wrappers played,
+// wrappers/s2i/R/microservice.R, wrappers/s2i/java).
+//
+// This file deliberately depends on NOTHING from the framework — libc +
+// POSIX sockets only — because that's the point: any language that can
+// serve the internal API (docs/internal-api.md) is a graph node.  The
+// contract it implements:
+//
+//   * listens on PREDICTIVE_UNIT_SERVICE_PORT (default 9000);
+//   * reads typed parameters from PREDICTIVE_UNIT_PARAMETERS
+//     (JSON list [{"name":"scale","value":"2.0","type":"FLOAT"}]);
+//   * POST /predict         SeldonMessage in -> SeldonMessage out, every
+//                           value multiplied by `scale`, wire kind
+//                           (ndarray vs tensor) preserved;
+//   * POST /transform-input same behaviour (TRANSFORMER service type);
+//   * POST /send-feedback   acknowledges with a SUCCESS status;
+//   * GET  /ping            liveness.
+//
+// Build:  g++ -O2 -std=c++17 -pthread -o model_server model_server.cpp
+// Serve:  PREDICTIVE_UNIT_SERVICE_PORT=9000 ./model_server
+//
+// tests/test_conformance.py compiles this file and drives it through the
+// engine's remote REST runtime end to end.
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+double g_scale = 1.0;
+
+// pull "scale" out of PREDICTIVE_UNIT_PARAMETERS without a JSON library:
+// find the entry whose "name" is scale, then its "value" string
+void load_parameters() {
+  const char* raw = getenv("PREDICTIVE_UNIT_PARAMETERS");
+  if (!raw) return;
+  const char* at = strstr(raw, "\"scale\"");
+  if (!at) return;
+  const char* v = strstr(at, "\"value\"");
+  if (!v) return;
+  v = strchr(v + 7, ':');
+  if (!v) return;
+  while (*v && (*v == ':' || *v == ' ' || *v == '"')) v++;
+  char* after = nullptr;
+  double parsed = strtod(v, &after);
+  if (after == v) {  // unparseable value: refuse to serve a wrong model
+    fprintf(stderr, "bad scale parameter: %s\n", v);
+    exit(2);
+  }
+  g_scale = parsed;  // 0.0 is a legal FLOAT parameter
+}
+
+// scale every JSON number inside [start, end) of `body`, appending the
+// rewritten span to `out`; non-numeric bytes pass through untouched
+void scale_span(const std::string& body, size_t start, size_t end,
+                std::string& out) {
+  size_t i = start;
+  bool in_str = false;
+  while (i < end) {
+    char c = body[i];
+    if (in_str) {  // string elements pass through untouched
+      out += c;
+      if (c == '\\' && i + 1 < end) {
+        out += body[i + 1];
+        i += 2;
+        continue;
+      }
+      if (c == '"') in_str = false;
+      i++;
+      continue;
+    }
+    if (c == '"') {
+      in_str = true;
+      out += c;
+      i++;
+      continue;
+    }
+    if (isdigit((unsigned char)c) ||
+        (c == '-' && i + 1 < end && isdigit((unsigned char)body[i + 1]))) {
+      char* after = nullptr;
+      double v = strtod(body.c_str() + i, &after);
+      size_t len = after - (body.c_str() + i);
+      char buf[64];
+      snprintf(buf, sizeof buf, "%.17g", v * g_scale);
+      out += buf;
+      i += len;
+    } else {
+      out += c;
+      i++;
+    }
+  }
+}
+
+// balanced-bracket span starting at body[open] (a '[' or '{')
+size_t span_end(const std::string& body, size_t open) {
+  int depth = 0;
+  bool in_str = false;
+  for (size_t i = open; i < body.size(); i++) {
+    char c = body[i];
+    if (in_str) {
+      if (c == '\\') i++;
+      else if (c == '"') in_str = false;
+      continue;
+    }
+    if (c == '"') in_str = true;
+    else if (c == '[' || c == '{') depth++;
+    else if (c == ']' || c == '}') {
+      if (--depth == 0) return i + 1;
+    }
+  }
+  return body.size();
+}
+
+// the engine's pooled client posts the reference's form encoding
+// (json=<urlencoded document>, engine InternalPredictionService.java:240);
+// raw JSON bodies pass through untouched
+std::string decode_body(const std::string& body) {
+  size_t at = body.rfind("json=", 0) == 0 ? 0 : body.find("&json=");
+  if (at == std::string::npos) return body;
+  size_t start = body.find('=', at) + 1;
+  size_t end = body.find('&', start);
+  if (end == std::string::npos) end = body.size();
+  std::string out;
+  out.reserve(end - start);
+  for (size_t i = start; i < end; i++) {
+    char c = body[i];
+    if (c == '+') {
+      out += ' ';
+    } else if (c == '%' && i + 2 < end) {
+      char hex[3] = {body[i + 1], body[i + 2], 0};
+      out += (char)strtol(hex, nullptr, 16);
+      i += 2;
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+std::string predict_response(const std::string& body) {
+  // preserve the request's wire kind: rewrite only the numeric payload
+  size_t nd = body.find("\"ndarray\"");
+  size_t tn = body.find("\"tensor\"");
+  std::string payload;
+  if (nd != std::string::npos) {
+    size_t open = body.find('[', nd);
+    if (open == std::string::npos) return "";
+    size_t close = span_end(body, open);
+    payload = "\"ndarray\":";
+    scale_span(body, open, close, payload);
+  } else if (tn != std::string::npos) {
+    size_t shape_at = body.find("\"shape\"", tn);
+    size_t values_at = body.find("\"values\"", tn);
+    if (shape_at == std::string::npos || values_at == std::string::npos)
+      return "";
+    size_t sopen = body.find('[', shape_at);
+    size_t vopen = body.find('[', values_at);
+    if (sopen == std::string::npos || vopen == std::string::npos) return "";
+    payload = "\"tensor\":{\"shape\":";
+    payload.append(body, sopen, span_end(body, sopen) - sopen);
+    payload += ",\"values\":";
+    scale_span(body, vopen, span_end(body, vopen), payload);
+    payload += "}";
+  } else {
+    return "";
+  }
+  return "{\"meta\":{},\"data\":{\"names\":[\"scaled\"]," + payload + "}}";
+}
+
+void respond(int fd, int code, const std::string& body) {
+  char head[160];
+  int n = snprintf(head, sizeof head,
+                   "HTTP/1.1 %d %s\r\nContent-Type: application/json\r\n"
+                   "Content-Length: %zu\r\nConnection: keep-alive\r\n\r\n",
+                   code, code == 200 ? "OK" : "Bad Request", body.size());
+  std::string out(head, n);
+  out += body;
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t w = write(fd, out.data() + off, out.size() - off);
+    if (w <= 0) return;
+    off += w;
+  }
+}
+
+void serve_connection(int fd) {
+  std::string buf;
+  char tmp[65536];
+  for (;;) {
+    size_t head_end;
+    long clen = 0;
+    for (;;) {  // read until a full request is buffered
+      head_end = buf.find("\r\n\r\n");
+      if (head_end != std::string::npos) {
+        // search the HEADER BLOCK only: a body (or pipelined request)
+        // containing "content-length:" must not re-frame this request
+        std::string head = buf.substr(0, head_end);
+        const char* cl = strcasestr(head.c_str(), "content-length:");
+        clen = cl ? atol(cl + 15) : 0;
+        if (buf.size() >= head_end + 4 + (size_t)clen) break;
+      }
+      ssize_t r = read(fd, tmp, sizeof tmp);
+      if (r <= 0) return;
+      buf.append(tmp, r);
+    }
+    std::string request_line = buf.substr(0, buf.find("\r\n"));
+    std::string body = decode_body(buf.substr(head_end + 4, clen));
+    buf.erase(0, head_end + 4 + clen);
+    if (request_line.rfind("GET /ping", 0) == 0) {
+      respond(fd, 200, "{\"status\":{\"code\":200,\"status\":\"SUCCESS\"}}");
+    } else if (request_line.rfind("POST /predict", 0) == 0 ||
+               request_line.rfind("POST /transform-input", 0) == 0) {
+      std::string resp = predict_response(body);
+      if (resp.empty())
+        respond(fd, 400,
+                "{\"status\":{\"code\":400,\"status\":\"FAILURE\","
+                "\"info\":\"no numeric payload\"}}");
+      else
+        respond(fd, 200, resp);
+    } else if (request_line.rfind("POST /send-feedback", 0) == 0) {
+      respond(fd, 200, "{\"status\":{\"code\":200,\"status\":\"SUCCESS\"}}");
+    } else {
+      respond(fd, 400,
+              "{\"status\":{\"code\":400,\"status\":\"FAILURE\","
+              "\"info\":\"unknown route\"}}");
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  load_parameters();
+  const char* port_env = getenv("PREDICTIVE_UNIT_SERVICE_PORT");
+  int port = port_env ? atoi(port_env) : 9000;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(lfd, (struct sockaddr*)&addr, sizeof addr) < 0 ||
+      listen(lfd, 64) < 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "cpp model server on :%d scale=%g\n", port, g_scale);
+  for (;;) {
+    int fd = accept(lfd, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    // thread per keepalive connection: the engine's pooled client opens
+    // several parallel connections under concurrent load
+    std::thread([fd] {
+      serve_connection(fd);
+      close(fd);
+    }).detach();
+  }
+}

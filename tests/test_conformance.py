@@ -1,0 +1,195 @@
+"""Cross-language conformance: a zero-dependency C++ microservice
+(examples/cpp_model/model_server.cpp) served as a graph node through the
+engine's remote REST runtime — the guarantee that the internal API
+(docs/internal-api.md) admits any language, the way the reference's R and
+Java wrappers did (wrappers/s2i/R/microservice.R)."""
+
+import asyncio
+import json
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.runtime.engine import EngineService
+
+SRC = os.path.join(
+    os.path.dirname(__file__), "..", "examples", "cpp_model",
+    "model_server.cpp",
+)
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    binary = str(tmp_path_factory.mktemp("cpp") / "model_server")
+    subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-pthread", "-o", binary, SRC],
+        check=True,
+    )
+    port = free_port()
+    env = dict(
+        os.environ,
+        PREDICTIVE_UNIT_SERVICE_PORT=str(port),
+        PREDICTIVE_UNIT_PARAMETERS=json.dumps(
+            [{"name": "scale", "value": "2.0", "type": "FLOAT"}]
+        ),
+    )
+    proc = subprocess.Popen([binary], env=env, stderr=subprocess.PIPE)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.2).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    else:
+        proc.kill()
+        pytest.fail("cpp model server did not come up")
+    yield port
+    proc.kill()
+    proc.wait()
+
+
+def engine_for(port):
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": "cpp-conformance",
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "scaler", "type": "MODEL"},
+                "components": [{
+                    "name": "scaler",
+                    "runtime": "rest",
+                    "host": "127.0.0.1",
+                    "port": port,
+                }],
+            }],
+        }
+    })
+    return EngineService(spec)
+
+
+def test_predict_through_engine_ndarray(server):
+    engine = engine_for(server)
+    assert engine.mode == "host"  # remote node: interpreter + pooled client
+
+    async def run():
+        text, status = await engine.predict_json(
+            '{"data":{"ndarray":[[1.0, 2.5], [3.0, -4.0]]}}'
+        )
+        assert status == 200
+        doc = json.loads(text)
+        np.testing.assert_allclose(
+            doc["data"]["ndarray"], [[2.0, 5.0], [6.0, -8.0]]
+        )
+        assert doc["data"]["names"] == ["scaled"]
+        assert doc["meta"]["puid"]  # engine-assigned correlation id
+
+    asyncio.run(run())
+
+
+def test_predict_preserves_tensor_kind(server):
+    engine = engine_for(server)
+
+    async def run():
+        text, status = await engine.predict_json(
+            '{"data":{"tensor":{"shape":[1,3],"values":[1.0,2.0,3.0]}}}'
+        )
+        assert status == 200
+        doc = json.loads(text)
+        assert doc["data"]["tensor"]["shape"] == [1, 3]
+        np.testing.assert_allclose(
+            doc["data"]["tensor"]["values"], [2.0, 4.0, 6.0]
+        )
+
+    asyncio.run(run())
+
+
+def test_feedback_through_engine(server):
+    engine = engine_for(server)
+
+    async def run():
+        from seldon_core_tpu.messages import Feedback
+
+        fb = Feedback.from_json(json.dumps({
+            "request": {"data": {"ndarray": [[1.0]]}},
+            "response": {"data": {"ndarray": [[2.0]]}},
+            "reward": 1.0,
+        }))
+        ack = await engine.send_feedback(fb)
+        assert ack.status is None or ack.status.status == "SUCCESS"
+
+    asyncio.run(run())
+
+
+def test_contract_validates_cpp_server_responses(server):
+    """Contract-driven conformance against the internal /predict route:
+    generated requests in, responses validated against the declared
+    targets (the language-independent check any new wrapper must pass)."""
+    import aiohttp
+
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.testing.contract import (
+        Contract,
+        generate_batch,
+        validate_response,
+    )
+
+    contract = Contract(
+        features=[
+            {"name": "x", "dtype": "FLOAT", "ftype": "continuous",
+             "range": [0, 1], "repeat": 2}
+        ],
+        targets=[
+            {"name": "scaled", "dtype": "FLOAT", "ftype": "continuous",
+             "range": [0, 2], "repeat": 2}
+        ],
+    )
+
+    async def run():
+        async with aiohttp.ClientSession() as session:
+            for seed in range(4):
+                msg = generate_batch(contract, 2, seed=seed)
+                async with session.post(
+                    f"http://127.0.0.1:{server}/predict",
+                    data=msg.to_json(),
+                ) as r:
+                    assert r.status == 200
+                    resp = SeldonMessage.from_json(await r.text())
+                problems = validate_response(contract, resp)
+                assert problems == [], problems
+
+    asyncio.run(run())
+
+
+def test_concurrent_requests_through_engine(server):
+    engine = engine_for(server)
+
+    async def run():
+        async def one(i):
+            text, status = await engine.predict_json(
+                json.dumps({"data": {"ndarray": [[float(i)]]}})
+            )
+            assert status == 200
+            assert json.loads(text)["data"]["ndarray"] == [[2.0 * i]]
+
+        await asyncio.gather(*[one(i) for i in range(16)])
+
+    asyncio.run(run())
