@@ -1,5 +1,13 @@
+VERSION ?= latest
+IMAGES = engine gateway operator loadtest
+
 proto:
 	protoc -I proto --python_out=seldon_core_tpu/proto_gen proto/prediction.proto proto/seldon_deployment.proto
+
+native:
+	g++ -O3 -std=c++17 -fPIC -shared -pthread -o native/libdataplane.so native/dataplane.cpp native/fastcodec.cpp
+	g++ -O3 -std=c++17 -fPIC -shared -o native/libfastcodec.so native/fastcodec.cpp
+	g++ -O2 -std=c++17 -o native/loadgen native/loadgen.cpp
 
 test:
 	python -m pytest tests/ -q
@@ -7,4 +15,25 @@ test:
 bench:
 	python bench.py
 
-.PHONY: proto test bench
+bundle:
+	python -m seldon_core_tpu.operator.bundle
+
+# component images (ci/docker/Dockerfile multi-stage; the reference's
+# per-service Jenkinsfile build stages)
+images:
+	for t in $(IMAGES); do \
+	  docker build -f ci/docker/Dockerfile --target $$t \
+	    -t seldon-core-tpu/$$t:$(VERSION) . || exit 1 ; \
+	done
+
+publish: images
+	for t in $(IMAGES); do \
+	  docker push seldon-core-tpu/$$t:$(VERSION) || exit 1 ; \
+	done
+
+release-dryrun:
+	@test "$(VERSION)" != "latest" || \
+	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
+	python release/release.py --version $(VERSION)
+
+.PHONY: proto native test bench bundle images publish release-dryrun

@@ -24,6 +24,7 @@
 // engine's remote REST runtime end to end.
 
 #include <cctype>
+#include <csignal>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -240,6 +241,9 @@ void serve_connection(int fd) {
 }  // namespace
 
 int main() {
+  // a peer that closes mid-response must cost one connection, not the
+  // process: write() to a closed socket returns EPIPE instead of killing us
+  signal(SIGPIPE, SIG_IGN);
   load_parameters();
   const char* port_env = getenv("PREDICTIVE_UNIT_SERVICE_PORT");
   int port = port_env ? atoi(port_env) : 9000;

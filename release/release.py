@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Release tool — the reference's ``release.py``/``release/`` role: one
+command moves every version reference in lockstep and (optionally) tags.
+
+    python release/release.py --version 0.2.0 [--apply] [--tag]
+
+Dry-run by default: prints the file edits it WOULD make.  Touches:
+
+  * ``pyproject.toml``                 project version
+  * ``seldon_core_tpu/__init__.py``    ``__version__``
+  * image tags in ``operator/bundle.py`` defaults (``:latest`` stays the
+    dev default; ``--pin-images`` rewrites them to ``:<version>``)
+
+The image build/publish side lives in ``ci/docker`` + the Makefile
+(``make images VERSION=...``), mirroring the Jenkinsfile's gated publish
+stage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VERSION_RE = re.compile(r"^\d+\.\d+\.\d+(?:[ab]\d+|rc\d+)?$")
+
+
+def edit(path: str, pattern: str, replacement: str, apply: bool) -> bool:
+    full = os.path.join(REPO, path)
+    with open(full) as f:
+        text = f.read()
+    new, n = re.subn(pattern, replacement, text)
+    if n == 0:
+        print(f"  !! {path}: pattern not found: {pattern}")
+        return False
+    if new != text:
+        print(f"  {path}: {n} replacement(s)")
+        if apply:
+            with open(full, "w") as f:
+                f.write(new)
+    else:
+        print(f"  {path}: already at target")
+    return True
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description="version/release tool")
+    parser.add_argument("--version", required=True)
+    parser.add_argument("--apply", action="store_true",
+                        help="write the edits (default: dry run)")
+    parser.add_argument("--pin-images", action="store_true",
+                        help="pin bundle image tags to :<version>")
+    parser.add_argument("--tag", action="store_true",
+                        help="git tag v<version> after applying")
+    args = parser.parse_args()
+    if not VERSION_RE.match(args.version):
+        print(f"invalid version {args.version!r} (want e.g. 0.2.0, 1.0.0rc1)")
+        return 2
+    v = args.version
+    mode = "applying" if args.apply else "dry run"
+    print(f"release {v} ({mode}):")
+    ok = True
+    ok &= edit("pyproject.toml",
+               r'(?m)^version = "[^"]+"', f'version = "{v}"', args.apply)
+    ok &= edit("seldon_core_tpu/__init__.py",
+               r'__version__ = "[^"]+"', f'__version__ = "{v}"', args.apply)
+    if args.pin_images:
+        ok &= edit("seldon_core_tpu/operator/bundle.py",
+                   r'(seldon-core-tpu/[a-z]+):[0-9A-Za-z.\-]+',
+                   rf"\1:{v}", args.apply)
+    if not ok:
+        return 1
+    if args.tag:
+        if not args.apply:
+            print("  (skipping tag in dry run)")
+        else:
+            subprocess.run(
+                ["git", "-C", REPO, "tag", "-a", f"v{v}",
+                 "-m", f"release {v}"],
+                check=True,
+            )
+            print(f"  tagged v{v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

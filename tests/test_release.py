@@ -1,0 +1,61 @@
+"""Release tool (release/release.py): version validation, lockstep edits
+(dry-run vs apply against a repo copy) — the reference release.py role."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "release", "release.py"
+)
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def run(args, cwd=None):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args], capture_output=True, text=True,
+        cwd=cwd,
+    )
+
+
+def test_dry_run_reports_edits_without_writing():
+    before = open(os.path.join(REPO, "pyproject.toml")).read()
+    out = run(["--version", "9.9.9"])
+    assert out.returncode == 0, out.stderr
+    assert "dry run" in out.stdout
+    assert "pyproject.toml" in out.stdout
+    assert open(os.path.join(REPO, "pyproject.toml")).read() == before
+
+
+def test_invalid_version_rejected():
+    out = run(["--version", "not-a-version"])
+    assert out.returncode == 2
+    out = run(["--version", "1.2"])
+    assert out.returncode == 2
+    assert run(["--version", "1.2.3rc1"]).returncode == 0
+
+
+def test_apply_edits_repo_copy(tmp_path):
+    # copy only the touched files, preserving layout
+    for rel in ("pyproject.toml", "seldon_core_tpu/__init__.py",
+                "seldon_core_tpu/operator/bundle.py",
+                "release/release.py"):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    out = subprocess.run(
+        [sys.executable, str(tmp_path / "release" / "release.py"),
+         "--version", "2.0.0", "--apply", "--pin-images"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert 'version = "2.0.0"' in (tmp_path / "pyproject.toml").read_text()
+    assert '__version__ = "2.0.0"' in (
+        tmp_path / "seldon_core_tpu" / "__init__.py"
+    ).read_text()
+    bundle = (
+        tmp_path / "seldon_core_tpu" / "operator" / "bundle.py"
+    ).read_text()
+    assert "seldon-core-tpu/engine:2.0.0" in bundle
+    assert ":latest" not in bundle
