@@ -18,9 +18,15 @@ is still a single device dispatch.  Caches are preallocated; partially
 rejected segments need no rewind because attention masks by global position
 and later segments overwrite the stale tail (``dynamic_update_slice``).
 
-Batch: size 1 (the latency-critical case speculative decoding exists for);
-larger batches raise — ragged per-row acceptance would need per-row cache
-offsets.
+Batch: rows decode INDEPENDENTLY (per-row caches, per-row acceptance), so
+B>1 runs the single-row program under ``vmap`` — JAX lifts the
+``while_loop`` to run-until-every-row-finishes with masked carries, which
+is the standard batched-speculative trade: rows advance in lockstep
+rounds, the fastest rows idle (masked) until the slowest accepts its last
+token, and every round's draft scan + target verify is one batched MXU
+pass over all rows.  Per-row outputs are exactly the B=1 outputs (pinned
+by tests in f32); serving coalesces concurrent callers into one such
+batch.
 """
 
 from __future__ import annotations
@@ -50,15 +56,28 @@ def speculative_generate(
     max_new_tokens: int = 32,
     k: int = 4,
 ) -> Tuple[jax.Array, jax.Array]:
-    """prompt [1, S] int32 -> (tokens [1, max_new_tokens] int32,
-    rounds int32 — verify passes used; ~max_new/rounds tokens per target
-    pass, vs exactly 1 for vanilla decoding).
+    """prompt [B, S] int32 -> (tokens [B, max_new_tokens] int32,
+    rounds int32 [B] — verify passes used per row; ~max_new/rounds tokens
+    per target pass, vs exactly 1 for vanilla decoding).
 
-    Greedy only; output is exactly vanilla greedy decoding of the target.
+    Greedy only; per-row output is exactly vanilla greedy decoding of the
+    target.  Rows vmap over the single-row program (see module docstring).
     """
+    return jax.vmap(
+        lambda row: _speculative_row(
+            target_params, draft_params, row, target_cfg, draft_cfg,
+            max_new_tokens, k,
+        )
+    )(prompt)
+
+
+def _speculative_row(
+    target_params, draft_params, row, target_cfg: LMConfig,
+    draft_cfg: LMConfig, max_new_tokens: int, k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """row [S] int32 -> (tokens [max_new_tokens], rounds scalar)."""
+    prompt = row[None, :]
     B, S = prompt.shape
-    if B != 1:
-        raise ValueError("speculative_generate supports batch size 1")
     max_len = S + max_new_tokens + k + 2
     t_cache = init_cache(target_cfg, B, max_len)
     d_cache = init_cache(draft_cfg, B, max_len)
@@ -132,19 +151,19 @@ def speculative_generate(
     n0 = jnp.int32(1)
     n, rounds, out, _, _ = jax.lax.while_loop(
         cond, body, (n0, jnp.int32(0), out, t_cache, d_cache))
-    return out[:max_new_tokens][None, :], rounds
+    return out[:max_new_tokens], rounds
 
 
 @register_unit("SpeculativeGenerator")
 class SpeculativeGenerator(Unit):
     """Serving unit: speculative draft/verify generation over the standard
     data plane.  Target and draft dimensions are graph parameters (draft_*
-    defaults to a quarter-size model).  Requests serve one at a time
-    (batch_coupled: the algorithm is per-sequence), prompt rows handled
-    row-by-row inside predict."""
+    defaults to a quarter-size model).  Concurrent callers coalesce into
+    one vmapped draft/verify loop (rows independent; lockstep rounds)."""
 
     pure = True
-    batch_coupled = True  # B=1 algorithm: never coalesce callers
+    # rows are independent (vmapped row programs): concurrent callers
+    # coalesce into one batched draft/verify loop like any other unit
 
     def __init__(self, vocab: int = 256, d_model: int = 128, n_heads: int = 4,
                  n_layers: int = 2, d_ff: int = 512,
@@ -181,16 +200,9 @@ class SpeculativeGenerator(Unit):
 
     def predict(self, state, X):
         prompt = sanitize_prompt(X, self.target_cfg.vocab)
-
-        def one_row(row):
-            toks, _rounds = speculative_generate(
-                state["target"], state["draft"], row[None, :],
-                self.target_cfg, self.draft_cfg,
-                max_new_tokens=self.max_new_tokens, k=self.k,
-            )
-            return toks[0]
-
-        # rows decode independently (per-sequence algorithm); vmap would
-        # vectorise the while_loop to worst-case length — map keeps each
-        # row's loop at its own length
-        return jax.lax.map(one_row, prompt).astype(jnp.float32)
+        toks, _rounds = speculative_generate(
+            state["target"], state["draft"], prompt,
+            self.target_cfg, self.draft_cfg,
+            max_new_tokens=self.max_new_tokens, k=self.k,
+        )
+        return toks.astype(jnp.float32)
