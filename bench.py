@@ -166,6 +166,10 @@ class Engine:
 
 def run_load(contract: str, port: int, api: str, clients: int,
              duration_s: float) -> dict:
+    # back-to-back runs bias each other through relay backlog (measured:
+    # the same config drops ~30% right after a saturation run); let the
+    # pipeline drain before measuring
+    time.sleep(6.0)
     out = subprocess.run(
         [sys.executable, "-m", "seldon_core_tpu.testing.loadtest",
          contract, "127.0.0.1", str(port), "--native", "--api", api,
