@@ -82,6 +82,8 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
         firehose=Firehose(firehose_dir) if firehose_dir else None,
         require_auth=os.environ.get("GATEWAY_OAUTH_ENABLED", "1") != "0",
     )
+    if gateway.firehose is not None:
+        gateway.firehose.start()  # drain task needs the running loop
     seen: dict = {}
     if spec_dir:
         _register_specs(store, spec_dir, seen)
@@ -111,6 +113,8 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
                 _register_specs(store, spec_dir, seen)
     await grpc_server.stop(grace=5.0)
     await runner.cleanup()
+    if gateway.firehose is not None:
+        await gateway.firehose.stop()  # flush queued events before exit
     print("gateway stopped", flush=True)
 
 
