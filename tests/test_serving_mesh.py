@@ -93,6 +93,42 @@ def test_sharded_generator_through_engine(devices8):
     asyncio.run(run())
 
 
+def test_moe_generator_expert_parallel_through_engine(devices8):
+    """The generator_ep example: MoE FFN layers with experts sharded over
+    ep=4, decoded through the full engine — the MoE serving counterpart of
+    the tp test above."""
+    from pathlib import Path
+
+    spec = SeldonDeploymentSpec.from_json(
+        (Path(__file__).parent.parent / "examples" /
+         "generator_ep_deployment.json").read_text()
+    )
+    engine = EngineService(spec, max_batch=8, max_wait_ms=1.0)
+    assert engine.mode == "compiled"
+    unit = engine.compiled.units["gen"]
+    assert unit.mesh is not None and unit.mesh.shape == {"ep": 4}
+    # expert weights landed SHARDED over ep (replicated placement would
+    # also span 4 devices — assert actual partitioning, not device count)
+    params = engine.compiled.states["gen"]["params"]
+    moe = params["l0"]["moe"]
+    leaf = jax.tree_util.tree_leaves(moe)[0]
+    assert len(leaf.sharding.device_set) == 4
+    assert not leaf.sharding.is_fully_replicated
+
+    async def run():
+        payload = json.dumps({"data": {"ndarray": [[7, 8, 9]]}})
+        t1, s1 = await engine.predict_json(payload)
+        t2, s2 = await engine.predict_json(payload)
+        assert s1 == s2 == 200
+        a1 = np.asarray(json.loads(t1)["data"]["ndarray"])
+        a2 = np.asarray(json.loads(t2)["data"]["ndarray"])
+        assert a1.shape == (1, 12)
+        np.testing.assert_array_equal(a1, a2)  # greedy: deterministic
+        assert ((a1 >= 0) & (a1 < 256)).all()
+
+    asyncio.run(run())
+
+
 def test_mesh_axes_on_meshless_unit_rejected():
     spec = _spec(
         [{
