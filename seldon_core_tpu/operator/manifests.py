@@ -132,6 +132,13 @@ def engine_deployment(spec: SeldonDeploymentSpec,
                                 {"name": "ENGINE_SERVER_GRPC_PORT",
                                  "value": str(ENGINE_GRPC_PORT)},
                                 *(
+                                    [{"name": "ENGINE_PREWARM_WIDTHS",
+                                      "value": str(spec.annotations[
+                                          "seldon.io/prewarm-widths"])}]
+                                    if "seldon.io/prewarm-widths"
+                                    in spec.annotations else []
+                                ),
+                                *(
                                     {"name": k, "value": str(v)}
                                     for k, v in sorted(
                                         (engine_env or {}).items()

@@ -71,6 +71,20 @@ def test_engine_deployment_contract():
     assert node_sel == {"cloud.google.com/gke-tpu-topology": "2x2"}
 
 
+def test_prewarm_annotation_renders_engine_env():
+    """seldon.io/prewarm-widths on the deployment flows into the engine
+    pod's ENGINE_PREWARM_WIDTHS so boot compiles every batch bucket before
+    the readiness probe flips (engine.prewarm)."""
+    spec = _mixed_spec()
+    spec.annotations["seldon.io/prewarm-widths"] = "784,16"
+    manifests = generate_manifests(spec)
+    eng = next(m for m in manifests if m["kind"] == "Deployment"
+               and m["metadata"]["labels"].get("seldon-type") == "engine")
+    env = {e["name"]: e["value"]
+           for e in eng["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["ENGINE_PREWARM_WIDTHS"] == "784,16"
+
+
 def test_component_resources_and_services():
     spec = _mixed_spec()
     manifests = generate_manifests(spec)
