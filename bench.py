@@ -63,6 +63,12 @@ STUB_CONTRACT = os.path.join(REPO, "examples", "stub_contract.json")
 MNIST_CONTRACT = os.path.join(REPO, "examples", "mnist_contract.json")
 
 
+def _host_cores() -> int:
+    if hasattr(os, "sched_getaffinity"):
+        return len(os.sched_getaffinity(0))
+    return os.cpu_count() or 1
+
+
 def mnist_deployment(n_members: int, hidden: int = 256) -> dict:
     if n_members == 1:
         graph = {"name": "m0", "type": "MODEL"}
@@ -366,6 +372,16 @@ def main() -> None:
         "ensemble_members_qps": {
             str(m): r["qps"] for m, r in sorted(ensemble.items())
         },
+        # normalization: the reference's numbers come from an n1-standard-16
+        # engine host plus THREE dedicated client machines; here the engine,
+        # its Python workers, and the load client share ONE core
+        "host_cores": _host_cores(),
+        "rest_qps_per_host_core": round(
+            rest_peak["qps"] / max(1, _host_cores()), 1
+        ),
+        "reference_rest_qps_per_engine_core": round(
+            REFERENCE_REST_QPS / 16, 1
+        ),
         "failures": sum(
             r.get("failures", 0)
             for r in [*stub_rest.values(), *stub_grpc.values(),

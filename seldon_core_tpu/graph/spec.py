@@ -394,11 +394,17 @@ class SeldonDeploymentSpec:
         if not isinstance(spec, Mapping) or "predictors" not in spec:
             raise GraphSpecError("deployment spec missing 'predictors'")
         meta = d.get("metadata", {}) or {}
+        # annotations live in BOTH standard places: metadata.annotations is
+        # where `kubectl annotate` writes; spec.annotations is the
+        # reference's location.  Merge, spec-level winning on conflict
+        # (more specific to this framework's schema).
+        annotations = dict(meta.get("annotations", {}) or {})
+        annotations.update(dict(spec.get("annotations", {}) or {}))
         return SeldonDeploymentSpec(
             name=str(spec.get("name", meta.get("name", "")) or ""),
             metadata_name=str(meta.get("name", "") or ""),
             predictors=[PredictorSpec.from_json_dict(p) for p in spec["predictors"]],
-            annotations=dict(spec.get("annotations", {}) or {}),
+            annotations=annotations,
             oauth_key=str(spec.get("oauth_key", "") or ""),
             oauth_secret=str(spec.get("oauth_secret", "") or ""),
             labels=dict(meta.get("labels", {}) or {}),

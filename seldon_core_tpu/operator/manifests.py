@@ -85,6 +85,17 @@ def engine_deployment(spec: SeldonDeploymentSpec,
     pred_b64 = base64.b64encode(
         json.dumps(predictor.to_json_dict(), separators=(",", ":")).encode()
     ).decode()
+    # validated here so a malformed annotation fails the RECONCILE (CR goes
+    # Failed with a clear message) instead of crash-looping engine pods
+    prewarm = spec.annotations.get("seldon.io/prewarm-widths")
+    if prewarm is not None:
+        prewarm = str(prewarm)
+        parts = [w.strip() for w in prewarm.split(",") if w.strip()]
+        if not parts or any(not w.isdigit() or int(w) <= 0 for w in parts):
+            raise ValueError(
+                f"annotation seldon.io/prewarm-widths must be "
+                f"comma-separated positive integers, got {prewarm!r}"
+            )
     labels = _labels(spec, predictor)
     resources: dict = {"requests": {"cpu": "0.1"}}
     tpu = _tpu_request(predictor)
@@ -132,17 +143,19 @@ def engine_deployment(spec: SeldonDeploymentSpec,
                                 {"name": "ENGINE_SERVER_GRPC_PORT",
                                  "value": str(ENGINE_GRPC_PORT)},
                                 *(
-                                    [{"name": "ENGINE_PREWARM_WIDTHS",
-                                      "value": str(spec.annotations[
-                                          "seldon.io/prewarm-widths"])}]
-                                    if "seldon.io/prewarm-widths"
-                                    in spec.annotations else []
-                                ),
-                                *(
                                     {"name": k, "value": str(v)}
                                     for k, v in sorted(
                                         (engine_env or {}).items()
                                     )
+                                    # the per-CR annotation must beat a
+                                    # chart-wide default; drop the dup
+                                    if not (prewarm is not None
+                                            and k == "ENGINE_PREWARM_WIDTHS")
+                                ),
+                                *(
+                                    [{"name": "ENGINE_PREWARM_WIDTHS",
+                                      "value": prewarm}]
+                                    if prewarm is not None else []
                                 ),
                             ],
                             "ports": [
