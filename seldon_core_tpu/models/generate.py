@@ -21,12 +21,15 @@ TPU-shaped decoding:
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
+
+_stream_counter = itertools.count()  # per-process sampled-stream key source
 from seldon_core_tpu.models.transformer import (
     LMConfig,
     _attention,
@@ -36,7 +39,7 @@ from seldon_core_tpu.models.transformer import (
 )
 
 __all__ = ["init_cache", "prefill", "decode_step", "generate",
-           "TransformerGenerator"]
+           "stream_chunks", "TransformerGenerator"]
 
 
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
@@ -209,6 +212,81 @@ def generate(
     return jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, max_new]
 
 
+def _chunk_step(params, token, cache, pos, key, cfg: LMConfig, n: int,
+                temperature: float):
+    """n cached decode steps as ONE jitted scan: (last token [B], cache,
+    pos, key) -> (tokens [B, n], new carry).  The per-(B, n) executable is
+    cached by jit, so a stream costs ceil(max_new/chunk) device dispatches
+    regardless of length."""
+
+    def pick(logits, k):
+        if temperature > 0.0:
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def step(carry, _):
+        token, cache, pos, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_step(params, token, cache, pos, cfg)
+        nxt = pick(logits, sub).astype(jnp.int32)
+        return (nxt, cache, pos + 1, key), nxt
+
+    (token, cache, pos, key), toks = jax.lax.scan(
+        step, (token, cache, pos, key), None, length=n
+    )
+    return toks.T, (token, cache, pos, key)  # [B, n]
+
+
+_chunk_step_jit = jax.jit(
+    _chunk_step, static_argnames=("cfg", "n", "temperature")
+)
+
+
+def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
+                  chunk: int = 8, temperature: float = 0.0,
+                  rng: Optional[jax.Array] = None,
+                  use_flash: bool = False):
+    """Incremental decoding: yields token arrays [B, <=chunk] whose
+    concatenation equals ``generate(...)`` token-for-token (same pick
+    semantics, same PRNG stream).
+
+    The host loop exists ONLY to surface tokens early — each iteration is
+    one jitted scan over ``chunk`` cached steps, so the device work is the
+    same one-scan-per-chunk shape serving wants; first token arrives after
+    prefill + (chunk-1) steps instead of after max_new_tokens steps."""
+    B, S = prompt.shape
+    cache = init_cache(cfg, B, S + max_new_tokens)
+    logits, cache = prefill(params, prompt, cache, cfg, use_flash)
+    if rng is None:
+        rng = jax.random.key(0)
+    key0, rng = jax.random.split(rng)
+    if temperature > 0.0:
+        first = jax.random.categorical(
+            key0, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+    else:
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # first chunk: the prefill token + (chunk-1) scanned steps
+    carry = (first, cache, jnp.int32(S), rng)
+    n_first = min(chunk - 1, max_new_tokens - 1)
+    if n_first > 0:
+        toks, carry = _chunk_step_jit(
+            params, *carry, cfg=cfg, n=n_first, temperature=temperature
+        )
+        yield jnp.concatenate([first[:, None], toks], axis=1)
+    else:
+        yield first[:, None]
+    done = 1 + n_first
+    while done < max_new_tokens:
+        n = min(chunk, max_new_tokens - done)
+        toks, carry = _chunk_step_jit(
+            params, *carry, cfg=cfg, n=n, temperature=temperature
+        )
+        done += n
+        yield toks
+
+
 @register_unit("TransformerGenerator")
 class TransformerGenerator(Unit):
     """Serving unit: prompt token rows in, generated token rows out, over
@@ -292,3 +370,28 @@ class TransformerGenerator(Unit):
                          "requests": state["requests"] + 1}
             return y, UnitAux(state=new_state)
         return y
+
+    def stream_tokens(self, state, X, chunk: int = 8):
+        """Incremental serving: yields [B, <=chunk] int32 arrays; the
+        concatenation equals ``predict``'s output for greedy decoding
+        (streaming bypasses the batcher and state write-back, so sampled
+        streams draw a fresh key per call instead of threading the request
+        counter — same quality, different stream)."""
+        from seldon_core_tpu.ops.fused_mlp import pallas_supported
+
+        prompt = sanitize_prompt(jnp.asarray(X), self.cfg.vocab)
+        if self.temperature > 0.0:
+            key = jax.random.fold_in(
+                jax.random.key(self.seed), next(_stream_counter)
+            )
+        else:
+            key = jax.random.fold_in(jax.random.key(self.seed), 0)
+        multi = self.mesh is not None and self.mesh.size > 1
+        yield from stream_chunks(
+            state["params"], prompt, self.cfg,
+            max_new_tokens=self.max_new_tokens, chunk=int(chunk),
+            temperature=self.temperature, rng=key,
+            use_flash=pallas_supported() and not multi,
+        )
+
+

@@ -205,6 +205,91 @@ class EngineService:
             native_available()
 
 
+    # -- streaming generation ------------------------------------------
+
+    def can_stream(self) -> bool:
+        """True when the graph is a single streaming-capable unit (a
+        generator exposing ``stream_tokens``)."""
+        return (
+            self.compiled is not None
+            and len(self.compiled.units) == 1
+            and hasattr(
+                next(iter(self.compiled.units.values())), "stream_tokens"
+            )
+        )
+
+    def prepare_stream_request(self, text: str) -> "tuple[str, int]":
+        """Validate a streaming request BEFORE any response bytes exist, so
+        every lane can answer a plain 400 instead of a 200 that dies.
+        Returns ``(payload_text_without_chunk, chunk)``; raises
+        SeldonMessageError on any problem (bad JSON, bad chunk, non-
+        streamable graph, missing numeric prompt)."""
+        import json as _json
+
+        chunk = 8
+        try:
+            doc = _json.loads(text)
+        except ValueError as e:
+            raise SeldonMessageError(f"invalid JSON: {e}")
+        if isinstance(doc, dict) and "chunk" in doc:
+            try:
+                chunk = max(1, min(256, int(doc.pop("chunk"))))
+            except (TypeError, ValueError):
+                raise SeldonMessageError("chunk must be an integer")
+            text = _json.dumps(doc)
+        if not self.can_stream():
+            raise SeldonMessageError(
+                "graph does not support streaming generation "
+                "(need a single generator node)"
+            )
+        msg = SeldonMessage.from_json(text)
+        if msg.data is None or msg.data.array is None:
+            raise SeldonMessageError("streaming needs a numeric prompt")
+        return text, chunk
+
+    async def generate_stream(self, raw, chunk: int = 8):
+        """Incremental generation: yields SSE-able JSON strings —
+        ``{"tokens": [[...]], "done": false}`` per chunk, then a terminal
+        ``{"done": true, "meta": {...}}``.  Beyond-reference surface (the
+        reference predates sequence models); greedy streams concatenate to
+        exactly the ``predict_json`` output.
+
+        Streams bypass the batcher (a stream holds the device for its
+        chunk dispatches; concurrent streams interleave at chunk
+        granularity) and never write unit state back."""
+        import json as _json
+
+        if not self.can_stream():
+            raise SeldonMessageError(
+                "graph does not support streaming generation "
+                "(need a single generator node)"
+            )
+        msg = SeldonMessage.from_json(raw)
+        if msg.data is None or msg.data.array is None:
+            raise SeldonMessageError("streaming needs a numeric prompt")
+        rows = np.asarray(msg.data.array, dtype=np.float64)
+        if rows.ndim < 2:
+            rows = rows.reshape(1, -1)
+        puid = msg.meta.puid or new_puid()
+        name, unit = next(iter(self.compiled.units.items()))
+        state = self.compiled.states[name]
+        loop = asyncio.get_running_loop()
+        gen = unit.stream_tokens(state, rows, chunk=chunk)
+        with self.metrics.time_server("generate-stream", "POST"), \
+                self.tracer.span(puid, "request", kind="request",
+                                 method="generate_stream"):
+            while True:
+                toks = await loop.run_in_executor(
+                    None, next, gen, None
+                )
+                if toks is None:
+                    break
+                yield _json.dumps({
+                    "tokens": np.asarray(toks).astype(float).tolist(),
+                    "done": False,
+                })
+        yield _json.dumps({"done": True, "meta": {"puid": puid}})
+
     def prewarm(self, widths) -> int:
         """Compile every batch-bucket shape for the given feature widths
         before serving (boot-time analogue of the reference's JVM/Tomcat

@@ -49,6 +49,18 @@ _MAX_HEAD = 64 * 1024
 Result = Tuple[int, bytes, str]
 Handler = Callable[[bytes, str, str], Awaitable[Result]]
 
+
+class StreamResult:
+    """Handler result for streaming routes: the writer sends a chunked
+    response, one SSE ``data:`` frame per async-generator item."""
+
+    __slots__ = ("status", "ctype", "agen")
+
+    def __init__(self, status: int, ctype: str, agen):
+        self.status = status
+        self.ctype = ctype
+        self.agen = agen
+
 _STATUS_LINE = {
     code: f"HTTP/1.1 {code} {text}\r\n".encode()
     for code, text in {
@@ -59,6 +71,12 @@ _STATUS_LINE = {
         504: "Gateway Timeout",
     }.items()
 }
+
+
+def _json_str(s: str) -> bytes:
+    import json as _json
+
+    return _json.dumps(s).encode()
 
 
 def _payload_text(body: bytes, ctype: str) -> str:
@@ -78,6 +96,7 @@ class _EngineRoutes:
         self.post: Dict[bytes, Handler] = {
             b"/api/v0.1/predictions": self._predictions,
             b"/api/v0.1/feedback": self._feedback,
+            b"/api/v0.1/generate/stream": self._generate_stream,
         }
         self.get: Dict[bytes, Handler] = {
             b"/ping": self._ping,
@@ -98,6 +117,21 @@ class _EngineRoutes:
         except SeldonMessageError as e:
             return 400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
         return status or 200, text.encode(), _JSON
+
+    async def _generate_stream(self, body, ctype, query):
+        """SSE token streaming (beyond-reference: the reference predates
+        sequence models).  Payload = a SeldonMessage with the prompt plus
+        an optional top-level ``chunk`` (tokens per event)."""
+        try:  # every problem surfaces as a plain 400 BEFORE streaming
+            text, chunk = self.engine.prepare_stream_request(
+                _payload_text(body, ctype)
+            )
+        except SeldonMessageError as e:
+            return 400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
+        return StreamResult(
+            200, "text/event-stream",
+            self.engine.generate_stream(text, chunk=chunk),
+        )
 
     async def _feedback(self, body, ctype, query) -> Result:
         try:
@@ -225,19 +259,25 @@ class _FastHttpProtocol(asyncio.Protocol):
         while True:
             task, close = await self.queue.get()
             try:
-                status, body, ctype = await task
+                result = await task
             except (SeldonMessageError, GraphSpecError) as e:
-                status, body, ctype = (
+                result = (
                     400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
                 )
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # unexpected: 500, keep serving
-                status, body, ctype = (
+                result = (
                     500,
                     SeldonMessage.failure(str(e), code=500).to_json().encode(),
                     _JSON,
                 )
+            if isinstance(result, StreamResult):
+                await self._write_stream(result)
+                if close and self.transport is not None:
+                    self.transport.close()
+                continue
+            status, body, ctype = result
             if not self._can_write.is_set():
                 await self._can_write.wait()  # transport buffer full
             self._write_response(status, body, ctype, close)
@@ -250,6 +290,45 @@ class _FastHttpProtocol(asyncio.Protocol):
                 self.transport.resume_reading()
             if close and self.transport is not None:
                 self.transport.close()
+
+    async def _write_stream(self, result: "StreamResult"):
+        """Chunked transfer encoding, one SSE data: frame per event.  A
+        mid-stream failure can't change the already-sent status — the
+        stream ends with an SSE error event and the connection closes."""
+        if self.transport is None or self.transport.is_closing():
+            return
+        self.transport.write(
+            b"HTTP/1.1 %d OK\r\nContent-Type: %s\r\n"
+            b"Cache-Control: no-cache\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            % (result.status, result.ctype.encode())
+        )
+        try:
+            async for event in result.agen:
+                if self.transport is None or self.transport.is_closing():
+                    return  # client went away; finally closes the generator
+                frame = b"data: " + event.encode() + b"\n\n"
+                self.transport.write(
+                    b"%x\r\n" % len(frame) + frame + b"\r\n"
+                )
+                if not self._can_write.is_set():
+                    await self._can_write.wait()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if self.transport is not None and not self.transport.is_closing():
+                err = (b'data: {"done": true, "error": %s}\n\n'
+                       % _json_str(str(e)))
+                self.transport.write(b"%x\r\n" % len(err) + err + b"\r\n")
+                self.transport.write(b"0\r\n\r\n")
+                self.transport.close()  # stream integrity unknown
+            return
+        finally:
+            # a disconnect mid-stream must not leave the generator (and
+            # its KV caches / open metric+trace spans) suspended until GC
+            await result.agen.aclose()
+        if self.transport is not None and not self.transport.is_closing():
+            self.transport.write(b"0\r\n\r\n")
 
     def _write_response(self, status, body, ctype, close):
         if self.transport is None or self.transport.is_closing():
@@ -383,6 +462,7 @@ class FastHttpServer:
             lambda: _FastHttpProtocol(self.routes, self._protocols),
             host, port, backlog=4096,
         )
+        self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
         if self._server is None:

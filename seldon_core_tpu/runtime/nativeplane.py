@@ -438,10 +438,23 @@ class NativeDataPlane:
                 if table is None else (404, b"not found", "text/plain")
         if path == b"/prometheus":
             self._merge_native_metrics()
-        status, resp, rctype = await handler(
+        result = await handler(
             body, ctype.decode("latin-1"), query.decode("latin-1")
         )
-        return status, resp, rctype
+        from seldon_core_tpu.runtime.httpfast import StreamResult
+
+        if isinstance(result, StreamResult):
+            # the C++ misc bridge sends single complete responses; SSE
+            # streaming lives on the Python lanes (ENGINE_HTTP_IMPL=fast)
+            await result.agen.aclose()
+            return (
+                501,
+                b'{"status":{"code":501,"status":"FAILURE","reason":'
+                b'"streaming is served by the Python data plane '
+                b'(ENGINE_HTTP_IMPL=fast)"}}',
+                "application/json",
+            )
+        return result
 
     # -- metrics -----------------------------------------------------------
 

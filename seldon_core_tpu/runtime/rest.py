@@ -133,8 +133,40 @@ def make_engine_app(engine: EngineService) -> web.Application:
         TRACER.disable()
         return web.Response(text="tracing disabled")
 
+    async def generate_stream(request: web.Request):
+        """SSE token streaming (beyond-reference; see engine.generate_stream).
+        Payload = SeldonMessage prompt + optional top-level ``chunk``."""
+        try:  # full validation BEFORE any bytes: problems are a plain 400
+            text, chunk = engine.prepare_stream_request(
+                await _payload_text(request)
+            )
+        except SeldonMessageError as e:
+            return _error_response(str(e))
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"},
+        )
+        await resp.prepare(request)
+        agen = engine.generate_stream(text, chunk=chunk)
+        try:
+            async for event in agen:
+                await resp.write(b"data: " + event.encode() + b"\n\n")
+        except Exception as e:  # mid-stream: terminal error frame
+            import json as _json
+
+            await resp.write(
+                b'data: {"done": true, "error": %s}\n\n'
+                % _json.dumps(str(e)).encode()
+            )
+        finally:
+            await agen.aclose()
+        await resp.write_eof()
+        return resp
+
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_post("/api/v0.1/generate/stream", generate_stream)
     app.router.add_get("/ping", ping)
     app.router.add_get("/ready", ready)
     app.router.add_get("/pause", pause)

@@ -1,0 +1,184 @@
+"""Streaming generation: chunked decode through the engine and the SSE
+route.  The defining invariant: the concatenated streamed chunks equal the
+one-shot ``generate`` output token-for-token (greedy, f32)."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.models.generate import generate, stream_chunks
+from seldon_core_tpu.models.transformer import LMConfig, lm_init
+from seldon_core_tpu.runtime.engine import EngineService
+
+CFG = LMConfig(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+               dtype=jnp.float32)
+
+
+def _gen_spec(max_new=24, temperature="0.0"):
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "sg", "predictors": [{
+            "name": "p",
+            "graph": {"name": "g", "type": "MODEL"},
+            "components": [{
+                "name": "g", "runtime": "inprocess",
+                "class_path": "TransformerGenerator",
+                "parameters": [
+                    {"name": "vocab", "value": "64", "type": "INT"},
+                    {"name": "d_model", "value": "32", "type": "INT"},
+                    {"name": "n_heads", "value": "4", "type": "INT"},
+                    {"name": "n_layers", "value": "2", "type": "INT"},
+                    {"name": "d_ff", "value": "64", "type": "INT"},
+                    {"name": "max_new_tokens", "value": str(max_new),
+                     "type": "INT"},
+                    {"name": "temperature", "value": temperature,
+                     "type": "FLOAT"},
+                    {"name": "dtype", "value": "float32", "type": "STRING"},
+                ],
+            }],
+        }]}
+    })
+
+
+def test_stream_chunks_equal_one_shot_generate():
+    params = lm_init(jax.random.key(0), CFG)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(2, 5)), jnp.int32
+    )
+    ref = np.asarray(generate(params, prompt, CFG, max_new_tokens=21))
+    got = []
+    for chunk in stream_chunks(params, prompt, CFG, max_new_tokens=21,
+                               chunk=8):
+        arr = np.asarray(chunk)
+        assert arr.shape[0] == 2 and 1 <= arr.shape[1] <= 8
+        got.append(arr)
+    streamed = np.concatenate(got, axis=1)
+    np.testing.assert_array_equal(streamed, ref)
+
+
+def test_stream_chunks_tail_chunk_smaller():
+    params = lm_init(jax.random.key(1), CFG)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    sizes = [np.asarray(c).shape[1]
+             for c in stream_chunks(params, prompt, CFG, max_new_tokens=10,
+                                    chunk=4)]
+    assert sizes == [4, 4, 2]  # 10 tokens in 4+4+2
+
+
+def test_engine_stream_matches_predict():
+    engine = EngineService(_gen_spec(max_new=16))
+    assert engine.can_stream()
+    payload = json.dumps({"data": {"ndarray": [[3, 1, 4, 1, 5]]}})
+
+    async def run():
+        text, status = await engine.predict_json(payload)
+        assert status == 200
+        full = np.asarray(json.loads(text)["data"]["ndarray"])
+        chunks = []
+        async for event in engine.generate_stream(payload, chunk=5):
+            doc = json.loads(event)
+            if doc["done"]:
+                break
+            chunks.append(np.asarray(doc["tokens"], dtype=np.float32))
+        streamed = np.concatenate(chunks, axis=1)
+        np.testing.assert_array_equal(streamed, full)
+
+    asyncio.run(run())
+
+
+def test_engine_stream_rejects_non_generator_graph():
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "m", "predictors": [{
+            "name": "p",
+            "graph": {"name": "s", "type": "MODEL",
+                      "implementation": "SIMPLE_MODEL"},
+        }]}
+    })
+    engine = EngineService(spec)
+    assert not engine.can_stream()
+
+    async def run():
+        with pytest.raises(Exception):
+            async for _ in engine.generate_stream(
+                '{"data":{"ndarray":[[1]]}}'
+            ):
+                pass
+
+    asyncio.run(run())
+
+
+def test_stream_request_validation_is_pre_flight():
+    """Anything wrong with a streaming request — bad JSON, bad chunk, a
+    data-less prompt, a non-streamable graph — must be a plain 400 BEFORE
+    any 200/SSE bytes exist (engine.prepare_stream_request)."""
+    from seldon_core_tpu.messages import SeldonMessageError
+
+    engine = EngineService(_gen_spec(max_new=8))
+    ok_text, chunk = engine.prepare_stream_request(
+        '{"data":{"ndarray":[[1]]},"chunk":3}'
+    )
+    assert chunk == 3 and "chunk" not in json.loads(ok_text)
+    for bad in (
+        "not json",
+        '{"data":{"ndarray":[[1]]},"chunk":"many"}',
+        '{"strData":"hi"}',  # parseable but no numeric prompt
+    ):
+        with pytest.raises(SeldonMessageError):
+            engine.prepare_stream_request(bad)
+
+
+def test_sse_route_on_fast_server():
+    """POST /api/v0.1/generate/stream on the Python fast lane: SSE events
+    whose token chunks concatenate to the one-shot output."""
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+
+    engine = EngineService(_gen_spec(max_new=12))
+
+    async def run():
+        server = await serve_fast(engine, "127.0.0.1", 0)
+        port = server.port
+        try:
+            payload = json.dumps(
+                {"data": {"ndarray": [[9, 8, 7]]}, "chunk": 4}
+            ).encode()
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"POST /api/v0.1/generate/stream HTTP/1.1\r\nHost: t\r\n"
+                b"Content-Length: %d\r\n\r\n" % len(payload) + payload
+            )
+            head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 30)
+            assert b" 200 " in head.split(b"\r\n")[0]
+            assert b"text/event-stream" in head
+            assert b"chunked" in head.lower()
+            events = []
+            body = b""
+            while True:  # de-chunk until the terminal 0-length chunk
+                size_line = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n"), 30
+                )
+                n = int(size_line.strip(), 16)
+                if n == 0:
+                    await reader.readexactly(2)
+                    break
+                body += await reader.readexactly(n)
+                await reader.readexactly(2)
+            for block in body.decode().split("\n\n"):
+                if block.startswith("data: "):
+                    events.append(json.loads(block[len("data: "):]))
+            writer.close()
+            assert events and events[-1]["done"]
+            chunks = [np.asarray(e["tokens"]) for e in events if not e["done"]]
+            streamed = np.concatenate(chunks, axis=1)
+            text, _ = await engine.predict_json(
+                json.dumps({"data": {"ndarray": [[9, 8, 7]]}})
+            )
+            full = np.asarray(json.loads(text)["data"]["ndarray"])
+            np.testing.assert_array_equal(streamed, full)
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
