@@ -239,7 +239,26 @@ def _probe_main(smoke: bool) -> None:
     t0 = time.perf_counter()
     for _ in range(reps):
         np.asarray(gen(gparams, prompt))
-    gen_tps = B * new / ((time.perf_counter() - t0) / reps)
+    dt_oneshot = (time.perf_counter() - t0) / reps
+    gen_tps = B * new / dt_oneshot
+
+    # streaming: time-to-first-token vs the one-shot wait — the value SSE
+    # streaming delivers (models/generate.py:stream_chunks)
+    from seldon_core_tpu.models.generate import stream_chunks
+
+    chunk = 8
+    for _ in range(2):  # compile + warm the chunked executables
+        for c in stream_chunks(gparams, prompt, gcfg, max_new_tokens=new,
+                               chunk=chunk):
+            np.asarray(c)
+    t0 = time.perf_counter()
+    ttft = None
+    for c in stream_chunks(gparams, prompt, gcfg, max_new_tokens=new,
+                           chunk=chunk):
+        np.asarray(c)
+        if ttft is None:
+            ttft = time.perf_counter() - t0
+    stream_total = time.perf_counter() - t0
 
     # Python-lane span breakdown: where a request's time goes with the
     # relay in the loop (dispatch span) vs framework work (the rest)
@@ -267,6 +286,11 @@ def _probe_main(smoke: bool) -> None:
     doc = {
         "relay_floor_ms": round(relay_floor_ms, 2),
         "gen_tokens_per_s": round(gen_tps, 1),
+        # streaming surfaces the first chunk of tokens this much sooner
+        # than the one-shot wait for all max_new_tokens
+        "stream_ttft_ms": round(ttft * 1e3, 1),
+        "oneshot_latency_ms": round(dt_oneshot * 1e3, 1),
+        "stream_total_ms": round(stream_total * 1e3, 1),
         "device": str(jax.devices()[0]),
     }
     if req and disp:
