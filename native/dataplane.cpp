@@ -247,7 +247,9 @@ struct Plane {
   // io-thread-local: conns needing a parse retry after backpressure resume
   std::vector<int> resume_parse;
 
-  Stats stats;
+  Stats stats;     // HTTP/1.1 fast lane
+  Stats stats_h2;  // h2/gRPC fast lane — kept separate so /prometheus can
+                   // attribute each surface to its own metric child
   PuidGen puid;
 
   Plane() : puid((uint64_t)now_s() * 1000003 ^ (uint64_t)(uintptr_t)this) {}
@@ -2003,7 +2005,8 @@ int dp_complete_batch(void* h, long long id, const double* y, long long rows,
   if (rows != in_rows || cols <= 0 || !y) {
     // row-count mismatch is a server defect: fail every caller
     for (ReqInfo& r : b->reqs) {
-      pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
+      (r.h2 ? pl->stats_h2 : pl->stats)
+          .n5xx.fetch_add(1, std::memory_order_relaxed);
       if (r.h2) {
         queue_completion_h2(pl, r.conn_id, r.conn_gen, r.stream,
                             13 /* INTERNAL */, "batch shape mismatch");
@@ -2040,7 +2043,7 @@ int dp_complete_batch(void* h, long long id, const double* y, long long rows,
       framed += (char)((proto.size() >> 8) & 0xff);
       framed += (char)(proto.size() & 0xff);
       framed += proto;
-      pl->stats.observe_ok(tdone - r.t0);
+      pl->stats_h2.observe_ok(tdone - r.t0);
       queue_completion_h2(pl, r.conn_id, r.conn_gen, r.stream, 0,
                           std::move(framed));
       continue;
@@ -2093,8 +2096,9 @@ int dp_fail_batch(void* h, long long id, int http_code, const char* body,
                     : http_code == 504 ? 4 /* DEADLINE_EXCEEDED */
                                        : 13 /* INTERNAL */;
   for (ReqInfo& r : b->reqs) {
-    if (http_code >= 500) pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
-    else if (http_code >= 400) pl->stats.n4xx.fetch_add(1, std::memory_order_relaxed);
+    Stats& st = r.h2 ? pl->stats_h2 : pl->stats;
+    if (http_code >= 500) st.n5xx.fetch_add(1, std::memory_order_relaxed);
+    else if (http_code >= 400) st.n4xx.fetch_add(1, std::memory_order_relaxed);
     if (r.h2) {
       // same diagnostic text the HTTP callers get (trimmed for grpc-message)
       queue_completion_h2(pl, r.conn_id, r.conn_gen, r.stream, grpc_status,
@@ -2148,8 +2152,10 @@ int dp_respond_grpc(void* h, long long id, int grpc_status,
     pl->misc_inflight.erase(it);
   }
   if (!m->h2) return -1;
-  if (grpc_status == 0) pl->stats.n2xx.fetch_add(1, std::memory_order_relaxed);
-  else pl->stats.n5xx.fetch_add(1, std::memory_order_relaxed);
+  if (grpc_status == 0)
+    pl->stats_h2.n2xx.fetch_add(1, std::memory_order_relaxed);
+  else
+    pl->stats_h2.n5xx.fetch_add(1, std::memory_order_relaxed);
   std::string data;
   if (grpc_status == 0) {
     size_t n = payload ? (size_t)payload_len : 0;
@@ -2195,16 +2201,24 @@ int dp_respond_misc(void* h, long long id, int http_code, const char* ctype,
   return 0;
 }
 
-// out[0..2] = 2xx/4xx/5xx counts, out[3] = latency sum (us, fast lane),
-// out[4..18] = 15 histogram buckets (14 finite + +Inf)
+// Two 19-slot blocks, one per fast lane:
+//   out[0..18]  HTTP/1.1: 2xx/4xx/5xx, latency sum (us), 15 hist buckets
+//   out[19..37] h2/gRPC:  same layout
+// Keeping the lanes separate lets /prometheus attribute REST vs gRPC
+// traffic to distinct metric children (parity with the Python lanes).
 void dp_stats(void* h, long long* out) {
   Plane* pl = (Plane*)h;
-  out[0] = pl->stats.n2xx.load(std::memory_order_relaxed);
-  out[1] = pl->stats.n4xx.load(std::memory_order_relaxed);
-  out[2] = pl->stats.n5xx.load(std::memory_order_relaxed);
-  out[3] = pl->stats.sum_us.load(std::memory_order_relaxed);
-  for (int i = 0; i < 15; i++)
-    out[4 + i] = pl->stats.hist[i].load(std::memory_order_relaxed);
+  Stats* lanes[2] = {&pl->stats, &pl->stats_h2};
+  for (int l = 0; l < 2; l++) {
+    long long* o = out + 19 * l;
+    Stats& s = *lanes[l];
+    o[0] = s.n2xx.load(std::memory_order_relaxed);
+    o[1] = s.n4xx.load(std::memory_order_relaxed);
+    o[2] = s.n5xx.load(std::memory_order_relaxed);
+    o[3] = s.sum_us.load(std::memory_order_relaxed);
+    for (int i = 0; i < 15; i++)
+      o[4 + i] = s.hist[i].load(std::memory_order_relaxed);
+  }
 }
 
 // Two-phase shutdown: dp_shutdown stops IO and wakes blocked workers but

@@ -301,8 +301,10 @@ def make_gateway_app(gateway: ApiGateway):
 
     async def ready(_):
         # readiness = a registered routing table (an empty gateway serves
-        # nothing useful; the bundle's probe gates the Service on this)
-        if gateway.store.deployments() or not gateway.require_auth:
+        # nothing useful; the bundle's probe gates the Service on this) —
+        # regardless of auth mode: an open gateway with no deployments can
+        # still only 404
+        if gateway.store.deployments():
             return web.Response(text="ready")
         return web.Response(text="no deployments registered", status=503)
 

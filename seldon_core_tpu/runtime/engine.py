@@ -20,6 +20,7 @@ graceful drain (engine RestClientController.java:57-99), feedback counters
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Dict, Optional
 
@@ -44,6 +45,8 @@ from seldon_core_tpu.messages import (
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 
 __all__ = ["EngineService"]
+
+logger = logging.getLogger(__name__)
 
 
 def _meta_shape_ok(meta_in: dict) -> bool:
@@ -320,11 +323,29 @@ class EngineService:
         compiled = 0
         for width in widths:
             shape = (width,) if isinstance(width, int) else tuple(width)
+            # probe the smallest batch first: an annotation width that is
+            # syntactically valid but incompatible with the graph (e.g. 16
+            # on a 784-input model) must not crash-loop the pod out of
+            # serve() — reconcile-time validation can only check integer
+            # syntax, not width compatibility.  Prewarm is an optimization;
+            # a rejected width is logged and skipped.
+            rejected = False
             for b in sizes:
                 x = _np.zeros((b,) + shape, dtype=_np.float64)
-                self.compiled.predict_arrays(x, update_states=False)
+                try:
+                    self.compiled.predict_arrays(x, update_states=False)
+                except Exception as e:  # noqa: BLE001 - any shape/trace error
+                    logger.warning(
+                        "prewarm: width %s rejected by the graph at batch "
+                        "%d (%s: %s); skipping this width",
+                        shape, b, type(e).__name__, e,
+                    )
+                    rejected = True
+                    break
                 self._known_good_widths.add(x.shape[1:])
                 compiled += 1
+            if rejected:
+                continue
         return compiled
 
     async def _submit(self, rows):

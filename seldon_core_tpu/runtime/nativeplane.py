@@ -99,9 +99,11 @@ def _load():
         if _lib is not None or _load_attempted:
             return _lib
         _load_attempted = True
+        srcs = [p for p in (_SRC, _CODEC_SRC) if os.path.exists(p)]
         fresh = os.path.exists(_LIB_PATH) and (
-            not os.path.exists(_SRC)
-            or os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)
+            not srcs
+            or os.path.getmtime(_LIB_PATH)
+            >= max(os.path.getmtime(p) for p in srcs)
         )
         if not fresh and not _build():
             return None
@@ -234,7 +236,7 @@ class NativeDataPlane:
         self._loop = None  # captured by start() for misc dispatch
         self._threads = []
         self._stopped = False
-        self._last_stats = np.zeros(19, dtype=np.int64)
+        self._last_stats = np.zeros(38, dtype=np.int64)
         self._workers = depth
 
     def _probe_no_tags(self):
@@ -459,36 +461,45 @@ class NativeDataPlane:
     # -- metrics -----------------------------------------------------------
 
     def _merge_native_metrics(self) -> None:
-        """Fold the C++ lane's counters into the engine's prometheus
-        histogram so /prometheus reports one truth.  Deltas since the last
-        scrape are injected bucket-exactly (prometheus_client has no
-        bucket-level API; the private counters are stable across releases
-        and guarded here)."""
-        stats = np.zeros(19, dtype=np.int64)
-        arr = (ctypes.c_longlong * 19)()
+        """Fold the C++ lanes' counters into the engine's prometheus
+        histogram so /prometheus reports one truth.  dp_stats exposes two
+        19-slot blocks — HTTP/1.1 then h2/gRPC — merged into distinct
+        metric children (REST vs gRPC must not be conflated, same as the
+        Python lanes).  Deltas since the last scrape are injected
+        bucket-exactly (prometheus_client has no bucket-level API; the
+        private counters are stable across releases and guarded here)."""
+        stats = np.zeros(38, dtype=np.int64)
+        arr = (ctypes.c_longlong * 38)()
         self.lib.dp_stats(self.handle, arr)
         stats[:] = arr[:]
         delta = stats - self._last_stats
         self._last_stats = stats
         metrics = self.engine.metrics
-        if metrics.registry is None or delta[0] <= 0:
+        if metrics.registry is None:
             return
-        try:
-            child = metrics._server_child("predictions", "POST", "200")
-            buckets = getattr(child, "_buckets", None)
-            csum = getattr(child, "_sum", None)
-            if buckets is None or csum is None:
-                return
-            # child._buckets are per-bucket (non-cumulative) counters
-            # parallel to upper_bounds (finite edges + +Inf); the renderer
-            # accumulates and derives _count
-            for i in range(15):
-                n = int(delta[4 + i])
-                if n:
-                    buckets[i].inc(n)
-            csum.inc(float(delta[3]) / 1e6)
-        except Exception:  # private-API drift: drop native samples, don't 500
-            logger.debug("native metric merge skipped", exc_info=True)
+        lanes = (
+            (delta[:19], ("predictions", "POST", "200")),
+            (delta[19:], ("predictions", "GRPC", "200")),
+        )
+        for d, labels in lanes:
+            if d[0] <= 0:
+                continue
+            try:
+                child = metrics._server_child(*labels)
+                buckets = getattr(child, "_buckets", None)
+                csum = getattr(child, "_sum", None)
+                if buckets is None or csum is None:
+                    continue
+                # child._buckets are per-bucket (non-cumulative) counters
+                # parallel to upper_bounds (finite edges + +Inf); the
+                # renderer accumulates and derives _count
+                for i in range(15):
+                    n = int(d[4 + i])
+                    if n:
+                        buckets[i].inc(n)
+                csum.inc(float(d[3]) / 1e6)
+            except Exception:  # private-API drift: drop samples, don't 500
+                logger.debug("native metric merge skipped", exc_info=True)
 
     # -- lifecycle ---------------------------------------------------------
 
