@@ -100,10 +100,12 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
     S > 1 with ``segment=False`` means prefill from position 0; with
     ``segment=True`` a mid-sequence continuation at traced offset ``start``
     attending causally over the cache; S == 1 is a cached decode step."""
+    from seldon_core_tpu.ops.quant import lm_matmul
+
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
     h = _rmsnorm(x, lp["ln1"])
-    qkv = h @ lp["wqkv"]
+    qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q, k, v = (_heads(t, B, S, cfg.n_heads, hd) for t in (q, k, v))
     cache_k = jax.lax.dynamic_update_slice(
@@ -125,7 +127,7 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
     else:
         a = _attend_cached(q, cache_k, cache_v, n_valid)
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + a @ lp["wo"]
+    x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
     h = _rmsnorm(x, lp["ln2"])
     y, _lb = _ffn(lp, h, cfg, mesh=None)  # dense or MoE FFN
     x = x + y
@@ -310,7 +312,8 @@ class TransformerGenerator(Unit):
                  n_layers: int = 2, d_ff: int = 512, seed: int = 0,
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  dtype: str = "bfloat16", moe_every: int = 0,
-                 n_experts: int = 8, moe_k: int = 2, mesh=None):
+                 n_experts: int = 8, moe_k: int = 2, mesh=None,
+                 quant: str = "none", attention: str = "auto"):
         # mesh (from the binding's mesh_axes, e.g. {"tp": 4}): params are
         # laid out with the LM's tp shardings and GSPMD partitions the
         # whole prefill+decode program across the mesh — one generator
@@ -321,8 +324,11 @@ class TransformerGenerator(Unit):
             n_layers=int(n_layers), d_ff=int(d_ff),
             dtype=jnp.dtype(dtype).type,
             moe_every=int(moe_every), n_experts=int(n_experts),
-            moe_k=int(moe_k),
+            moe_k=int(moe_k), quant=str(quant),
         )
+        from seldon_core_tpu.models.transformer import resolve_flash
+
+        self.use_flash = resolve_flash(str(attention), mesh)
         self.seed = int(seed)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -341,6 +347,10 @@ class TransformerGenerator(Unit):
         if rng is None:
             rng = jax.random.key(self.seed)
         params = lm_init(jax.random.fold_in(rng, self.seed), self.cfg)
+        if self.cfg.quant == "int8":
+            from seldon_core_tpu.ops.quant import quantize_lm_params
+
+            params = quantize_lm_params(params)
         if self.mesh is not None:
             from seldon_core_tpu.models.transformer import param_shardings
 
@@ -350,20 +360,15 @@ class TransformerGenerator(Unit):
         return {"params": params, "requests": jnp.zeros((), jnp.int32)}
 
     def predict(self, state, X):
-        from seldon_core_tpu.ops.fused_mlp import pallas_supported
-
         prompt = sanitize_prompt(X, self.cfg.vocab)
         key = jax.random.fold_in(jax.random.key(self.seed),
                                  state["requests"])
-        # pallas_call is not auto-partitionable under GSPMD: any multi-chip
-        # mesh keeps the XLA attention path (same rule as _attention)
-        multi = self.mesh is not None and self.mesh.size > 1
         y = generate(
             state["params"], prompt, self.cfg,
             max_new_tokens=self.max_new_tokens,
             temperature=self.temperature,
             rng=key,
-            use_flash=pallas_supported() and not multi,
+            use_flash=self.use_flash,
         ).astype(jnp.float32)
         if self.temperature > 0.0:
             new_state = {"params": state["params"],
@@ -377,8 +382,6 @@ class TransformerGenerator(Unit):
         (streaming bypasses the batcher and state write-back, so sampled
         streams draw a fresh key per call instead of threading the request
         counter — same quality, different stream)."""
-        from seldon_core_tpu.ops.fused_mlp import pallas_supported
-
         prompt = sanitize_prompt(jnp.asarray(X), self.cfg.vocab)
         if self.temperature > 0.0:
             key = jax.random.fold_in(
@@ -386,12 +389,11 @@ class TransformerGenerator(Unit):
             )
         else:
             key = jax.random.fold_in(jax.random.key(self.seed), 0)
-        multi = self.mesh is not None and self.mesh.size > 1
         yield from stream_chunks(
             state["params"], prompt, self.cfg,
             max_new_tokens=self.max_new_tokens, chunk=int(chunk),
             temperature=self.temperature, rng=key,
-            use_flash=pallas_supported() and not multi,
+            use_flash=self.use_flash,
         )
 
 

@@ -43,7 +43,7 @@ from seldon_core_tpu.parallel.pipeline import (
 from seldon_core_tpu.parallel.ring_attention import ring_attention
 
 __all__ = ["LMConfig", "lm_init", "lm_apply", "lm_loss", "lm_train_step",
-           "param_shardings", "TransformerLM",
+           "param_shardings", "TransformerLM", "resolve_flash",
            "lm_pipeline_params", "lm_pipeline_apply", "lm_pipeline_loss",
            "lm_pipeline_train_step"]
 
@@ -62,6 +62,10 @@ class LMConfig:
     moe_every: int = 0
     n_experts: int = 8
     moe_k: int = 2
+    # "int8": serve layer matmuls from symmetric per-channel int8 weights
+    # (ops/quant.py) — halves HBM weight traffic (decode is bandwidth-
+    # bound) and runs the dots at the MXU's 2x int8 rate.  Serving-only.
+    quant: str = "none"
 
     def is_moe_layer(self, i: int) -> bool:
         return self.moe_every > 0 and (i + 1) % self.moe_every == 0
@@ -73,6 +77,10 @@ class LMConfig:
             raise ValueError(
                 f"d_model={self.d_model} not divisible by "
                 f"n_heads={self.n_heads}"
+            )
+        if self.quant not in ("none", "int8"):
+            raise ValueError(
+                f"quant={self.quant!r} not supported (none | int8)"
             )
 
 
@@ -127,10 +135,23 @@ def param_shardings(mesh: Mesh, params) -> Any:
         name = names[-1]
         if "moe" in names:
             return moe_leaf_spec(name, leaf, mesh)
+        has_tp = "tp" in mesh.axis_names
+        # int8 layout (quantize_lm_params): w_q shards like w; the
+        # per-output-channel scales follow the output axis' sharding
+        if name.endswith("_q") or name.endswith("_s"):
+            base, kind = name[:-2], name[-1]
+            if base in ("wqkv", "w1"):
+                if kind == "q":
+                    return P(None, "tp") if has_tp else P()
+                return P("tp") if has_tp else P()
+            if base in ("wo", "w2"):
+                # output axis replicated (the psum happens over tp)
+                return P("tp", None) if (has_tp and kind == "q") else P()
+            return P()
         if name in ("wqkv", "w1"):
-            return P(None, "tp") if "tp" in mesh.axis_names else P()
+            return P(None, "tp") if has_tp else P()
         if name in ("wo", "w2"):
-            return P("tp", None) if "tp" in mesh.axis_names else P()
+            return P("tp", None) if has_tp else P()
         return P()
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
@@ -184,10 +205,12 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
            use_flash: bool = False):
     """One decoder block: attn + FFN (dense or MoE) with residuals.
     x [B,S,D] -> (x', lb_loss) where lb_loss is 0 for dense layers."""
+    from seldon_core_tpu.ops.quant import lm_matmul
+
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
     h = _rmsnorm(x, lp["ln1"])
-    qkv = h @ lp["wqkv"]  # [B,S,3D]
+    qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)  # [B,S,3D]
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(t):
@@ -195,7 +218,7 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
 
     a = _attention(heads(q), heads(k), heads(v), mesh, causal, use_flash)
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + a @ lp["wo"]
+    x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
     h = _rmsnorm(x, lp["ln2"])
     y, lb = _ffn(lp, h, cfg, mesh)
     return x + y, lb
@@ -211,7 +234,10 @@ def _ffn(lp, h, cfg: LMConfig, mesh: Optional[Mesh]):
                          dtype=cfg.dtype)
         y, aux = moe_apply(lp["moe"], h, mcfg, mesh=mesh)
         return y, aux["lb_loss"]
-    return jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], jnp.float32(0.0)
+    from seldon_core_tpu.ops.quant import lm_matmul
+
+    u = jax.nn.gelu(lm_matmul(lp, "w1", h, out_dtype=h.dtype))
+    return lm_matmul(lp, "w2", u, out_dtype=h.dtype), jnp.float32(0.0)
 
 
 def lm_apply(
@@ -277,6 +303,10 @@ def _grad_update(loss_fn, params, opt_state, batch, optimizer):
 def lm_train_step(params, opt_state, batch, optimizer, cfg: LMConfig,
                   mesh: Optional[Mesh] = None,
                   use_flash: Optional[bool] = None):
+    if cfg.quant != "none":
+        # int8 weights are not differentiable — quantization is a serving
+        # transform (quantize_lm_params), applied after training
+        raise ValueError("lm_train_step requires quant='none'")
     return _grad_update(
         lambda p, b: lm_loss(p, b, cfg, mesh, use_flash=use_flash), params,
         opt_state, batch, optimizer,
@@ -356,6 +386,30 @@ def lm_pipeline_train_step(pp_params, opt_state, batch, optimizer,
     )
 
 
+def resolve_flash(attention: str, mesh: Optional[Mesh]) -> bool:
+    """Deployment-parameter attention mode -> static use_flash decision.
+
+    ``auto``  — Pallas flash kernel when the runtime supports it and the
+                mesh is single-chip (pallas_call is not auto-partitionable
+                under GSPMD);
+    ``flash`` — prefer the kernel; a runtime without Pallas support or a
+                multi-chip mesh still falls back to XLA (degrade, don't
+                crash-loop the pod — shape constraints additionally fall
+                back per call inside ``_attention``);
+    ``xla``   — force the plain XLA attention (the benchmarking control
+                arm: BENCH's flash_vs_xla delta toggles exactly this)."""
+    if attention == "xla":
+        return False
+    if attention not in ("auto", "flash"):
+        raise ValueError(
+            f"attention={attention!r} not supported (auto | flash | xla)"
+        )
+    multi = mesh is not None and mesh.size > 1
+    from seldon_core_tpu.ops.fused_mlp import pallas_supported
+
+    return pallas_supported() and not multi
+
+
 @register_unit("TransformerLM")
 class TransformerLM(Unit):
     """Serving unit: next-token logits for a token batch.  For multi-chip
@@ -374,16 +428,19 @@ class TransformerLM(Unit):
         moe_every: int = 0,
         n_experts: int = 8,
         moe_k: int = 2,
+        quant: str = "none",
+        attention: str = "auto",
     ):
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff),
             dtype=jnp.dtype(dtype).type,
             moe_every=int(moe_every), n_experts=int(n_experts),
-            moe_k=int(moe_k),
+            moe_k=int(moe_k), quant=str(quant),
         )
         self.seed = int(seed)
         self.mesh = mesh
+        self.use_flash = resolve_flash(str(attention), mesh)
         # MoE capacity routing flattens the stacked batch into one token
         # stream (shared capacity, cumsum slot order), so co-batched rows
         # change each other's overflow — no cross-request coalescing
@@ -394,15 +451,17 @@ class TransformerLM(Unit):
             rng = jax.random.key(self.seed)
         rng = jax.random.fold_in(rng, self.seed)
         params = lm_init(rng, self.cfg)
+        if self.cfg.quant == "int8":
+            from seldon_core_tpu.ops.quant import quantize_lm_params
+
+            params = quantize_lm_params(params)
         if self.mesh is not None:
             params = jax.device_put(params, param_shardings(self.mesh, params))
         return params
 
     def predict(self, state, X):
-        from seldon_core_tpu.ops.fused_mlp import pallas_supported
-
         tokens = X.astype(jnp.int32)
         return lm_apply(
             state, tokens, self.cfg, self.mesh,
-            use_flash=pallas_supported(),
+            use_flash=self.use_flash,
         )

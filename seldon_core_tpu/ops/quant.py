@@ -23,26 +23,37 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["quantize_weight", "quant_matmul", "quantize_mlp_params",
-           "QuantizedMLP"]
+           "QuantizedMLP", "quantize_lm_params", "lm_matmul",
+           "LM_QUANT_NAMES"]
 
 
 def quantize_weight(w) -> Tuple[jax.Array, jax.Array]:
     """w [in, out] -> (w_q int8 [in, out], scales f32 [out]).
 
-    Symmetric per-output-channel: scale = absmax / 127."""
-    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)  # [out]
-    scales = jnp.maximum(absmax, 1e-12) / 127.0
-    w_q = jnp.clip(jnp.round(w.astype(jnp.float32) / scales), -127, 127)
-    return w_q.astype(jnp.int8), scales
+    Symmetric per-output-channel: scale = absmax / 127.
+
+    Computed in host numpy deliberately: quantization is a one-time LOAD
+    transform, and doing it eagerly on-device fires a burst of tiny XLA
+    compiles per layer (slow everywhere, and abusive to remote-compile
+    services); the serving-path dots (quant_matmul) stay on-device."""
+    import numpy as np
+
+    w_np = np.asarray(w, dtype=np.float32)  # device -> host once
+    absmax = np.abs(w_np).max(axis=0)  # [out]
+    scales = np.maximum(absmax, 1e-12) / 127.0
+    w_q = np.clip(np.round(w_np / scales), -127, 127).astype(np.int8)
+    return jnp.asarray(w_q), jnp.asarray(scales.astype(np.float32))
 
 
 def quant_matmul(x, w_q, w_scales):
-    """x [B, in] (float) @ int8 weights -> f32 [B, out].
+    """x [..., in] (float) @ int8 weights -> f32 [..., out].
 
-    Activations quantize dynamically per row (symmetric absmax); the dot
-    runs int8 x int8 -> int32 on the MXU; dequantization multiplies the
-    row scale back with the per-channel weight scale."""
-    x32 = x.astype(jnp.float32)
+    Activations quantize dynamically per row (symmetric absmax over the
+    contracted axis); the dot runs int8 x int8 -> int32 on the MXU;
+    dequantization multiplies the row scale back with the per-channel
+    weight scale.  Leading dims are flattened for the dot and restored."""
+    lead = x.shape[:-1]
+    x32 = x.reshape((-1, x.shape[-1])).astype(jnp.float32)
     row_absmax = jnp.max(jnp.abs(x32), axis=1, keepdims=True)  # [B, 1]
     row_scales = jnp.maximum(row_absmax, 1e-12) / 127.0
     x_q = jnp.clip(jnp.round(x32 / row_scales), -127, 127).astype(jnp.int8)
@@ -50,7 +61,8 @@ def quant_matmul(x, w_q, w_scales):
         x_q, w_q, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
     )  # [B, out] int32
-    return acc.astype(jnp.float32) * row_scales * w_scales[None, :]
+    y = acc.astype(jnp.float32) * row_scales * w_scales[None, :]
+    return y.reshape(lead + (w_q.shape[1],))
 
 
 def quantize_mlp_params(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -64,6 +76,53 @@ def quantize_mlp_params(params: Dict[str, Any]) -> Dict[str, Any]:
         out[f"w{i}_s"] = s
         out[f"b{i}"] = params[f"b{i}"].astype(jnp.float32)
     return out
+
+
+# transformer-layer weights that quantize (models/transformer.py layout);
+# embed/unembed and norm scales stay in the model dtype — the embedding
+# gather has no matmul to win back, and the tied unembed head is the
+# quality-critical projection
+LM_QUANT_NAMES = ("wqkv", "wo", "w1", "w2")
+
+
+def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """models/transformer.py ``lm_init`` tree -> int8 serving variant.
+
+    Each layer weight ``w`` in LM_QUANT_NAMES becomes ``w_q`` (int8) +
+    ``w_s`` (f32 per-output-channel scales); everything else passes
+    through.  Decode is HBM-bandwidth-bound (the whole weight set streams
+    per step), so halving weight bytes is a near-linear decode speedup on
+    top of the MXU's 2x int8 rate.  Serving-only: int8 weights are not
+    differentiable — training stays bf16."""
+    out: Dict[str, Any] = {}
+    for key, val in params.items():
+        if not (isinstance(val, dict) and "wqkv" in val):
+            out[key] = val
+            continue
+        lp: Dict[str, Any] = {}
+        for name, w in val.items():
+            if name in LM_QUANT_NAMES:
+                w_q, s = quantize_weight(w)
+                lp[f"{name}_q"] = w_q
+                lp[f"{name}_s"] = s
+            else:
+                lp[name] = w
+        out[key] = lp
+    return out
+
+
+def lm_matmul(lp: Dict[str, Any], name: str, h, out_dtype=None):
+    """``h @ lp[name]`` dispatching on quantization: uses the int8 path
+    when the layer carries ``{name}_q``/``{name}_s`` (quantize_lm_params),
+    else the plain dense matmul.  ``out_dtype`` casts the result (the int8
+    path accumulates f32; attention wants the model dtype back)."""
+    if f"{name}_q" in lp:
+        y = quant_matmul(h, lp[f"{name}_q"], lp[f"{name}_s"])
+    else:
+        y = h @ lp[name]
+    if out_dtype is not None and y.dtype != out_dtype:
+        y = y.astype(out_dtype)
+    return y
 
 
 class QuantizedMLP:
