@@ -204,6 +204,257 @@ def probe_device(smoke: bool) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def probe_mfu(smoke: bool) -> dict:
+    """Compute-bound single-chip evidence: real-size LM prefill/decode MFU,
+    flash-vs-XLA and int8-vs-bf16 deltas — subprocess owning the TPU."""
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_probe_mfu"]
+        + (["--smoke"] if smoke else []),
+        capture_output=True, text=True, cwd=REPO, timeout=2400,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"mfu probe failed: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+# advertised peak dense bf16 matmul throughput per chip, TFLOP/s (public
+# spec sheets; device_kind substring -> peak).  MFU here divides by the
+# bf16 peak even for the int8 path, so int8 "MFU" can legitimately exceed
+# the bf16-normalized number — the ratio key is the honest comparison.
+_PEAK_BF16_TFLOPS = (
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0), ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0), ("v2", 46.0),
+)
+
+
+def _chip_peak_tflops(device_kind: str):
+    dk = device_kind.lower()
+    for frag, peak in _PEAK_BF16_TFLOPS:
+        if frag in dk:
+            return peak, False
+    return 197.0, True  # conservative default, flagged as assumed
+
+
+def _probe_mfu_main(smoke: bool) -> None:
+    """Measured on-device: a ~185M-param bf16 decoder LM through the
+    serving compute path (models/generate.py prefill + cached decode
+    scan — exactly what TransformerGenerator.predict jits).
+
+    Methodology notes, reflected in the emitted keys:
+      * every timed figure subtracts the measured relay round-trip floor
+        (~100 ms fixed cost of this environment's host<->TPU tunnel) and
+        amortizes it over a chained multi-rep scan in ONE dispatch, so the
+        numbers are device-time, not relay-time;
+      * FLOP accounting is exact for the matmuls (params term counts only
+        matmul'd weights, embed gather excluded; unembed counted) and
+        counts causal attention at S^2/2 — flash skips the fully-masked
+        blocks, so full-S^2 accounting would inflate its MFU;
+      * MFU divides by the chip's advertised dense bf16 peak
+        (`peak_bf16_tflops`, device_kind-matched).
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models.generate import (
+        _chunk_step,
+        init_cache,
+        generate,
+        prefill,
+    )
+    from seldon_core_tpu.models.transformer import LMConfig, lm_apply, lm_init
+    from seldon_core_tpu.ops.quant import quantize_lm_params
+    from seldon_core_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()
+
+    # relay floor (same probe as --_probe): subtracted from chained timings
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.zeros((1, 8), jnp.float32)
+    np.asarray(f(x))
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        lat.append(time.perf_counter() - t0)
+    relay_s = float(np.percentile(lat, 50))
+
+    if smoke:
+        cfg = LMConfig(vocab=1024, d_model=256, n_heads=8, n_layers=2,
+                       d_ff=1024)
+        B, S, NEW = 4, 128, 16
+        flash_Ss = [256]
+        n_prefill, n_flash = 2, 2
+    else:
+        cfg = LMConfig(vocab=32768, d_model=1024, n_heads=16, n_layers=12,
+                       d_ff=4096)
+        B, S, NEW = 32, 512, 64
+        flash_Ss = [2048, 8192]
+        n_prefill, n_flash = 8, 3
+
+    params = lm_init(jax.random.key(0), cfg)
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+    )
+    # matmul'd params (embed gather is not a matmul; tied unembed is)
+    d, ff, v, L = cfg.d_model, cfg.d_ff, cfg.vocab, cfg.n_layers
+    matmul_per_tok = L * 2 * (d * 3 * d + d * d + 2 * d * ff) + 2 * d * v
+    device = jax.devices()[0]
+    peak_tflops, peak_assumed = _chip_peak_tflops(
+        getattr(device, "device_kind", str(device))
+    )
+    peak = peak_tflops * 1e12
+
+    # ---- prefill: n chained reps in one dispatch --------------------------
+    total_len = S + NEW
+
+    # params MUST be explicit jit arguments: a closure over device arrays
+    # embeds them as HLO constants, and a 370 MB constant blob overflows
+    # the relay's compile-request limit (HTTP 413)
+    def prefill_once(ps, toks):
+        cache = init_cache(cfg, B, total_len)
+        logits, cache = prefill(ps, toks, cache, cfg, use_flash=True)
+        # chain the data dependency so XLA cannot overlap/elide reps
+        nxt = (toks + jnp.argmax(logits, -1)[:, None].astype(jnp.int32)) % v
+        return nxt, logits, cache
+
+    @jax.jit
+    def prefill_reps(ps, toks):
+        def body(t, _):
+            nxt, logits, _cache = prefill_once(ps, t)
+            return nxt, jnp.sum(logits) * 0
+        out, acc = jax.lax.scan(body, toks, None, length=n_prefill)
+        return out, acc
+
+    toks0 = jnp.asarray(
+        np.random.default_rng(0).integers(0, v, size=(B, S)), jnp.int32
+    )
+    jax.block_until_ready(prefill_reps(params, toks0))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(prefill_reps(params, toks0))
+    raw = time.perf_counter() - t0
+    # relay variance (~±15 ms) can exceed tiny smoke-shape compute; never
+    # let the subtraction go negative (real configs are >> the floor)
+    t_prefill = max(raw - relay_s, 0.05 * raw) / n_prefill
+    prefill_tok_s = B * S / t_prefill
+    prefill_flops = (
+        B * S * matmul_per_tok + L * 2 * B * S * S * d  # causal: S^2/2 x 4BSSD
+    )
+    prefill_mfu = prefill_flops / t_prefill / peak
+
+    # ---- decode: one scan over NEW cached steps ---------------------------
+    def decode_measure(ps, qcfg):
+        cache = init_cache(qcfg, B, total_len)
+        logits, cache = jax.jit(
+            lambda p, t, c: prefill(p, t, c, qcfg, use_flash=True)
+        )(ps, toks0, cache)
+        first = jnp.argmax(logits, -1).astype(jnp.int32)
+        carry = (first, cache, jnp.int32(S), jax.random.key(0))
+        step = jax.jit(
+            lambda p, tok, c, pos, key: _chunk_step(
+                p, tok, c, pos, key, qcfg, NEW, 0.0
+            )
+        )
+        jax.block_until_ready(step(ps, *carry))  # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(step(ps, *carry))
+        raw = time.perf_counter() - t0
+        return max(raw - relay_s, 0.05 * raw) / NEW
+
+    t_step = decode_measure(params, cfg)
+    decode_tok_s = B / t_step
+    # per decode step: every matmul'd weight streams once; attention reads
+    # the whole preallocated cache (masked) — that compute happens, count it
+    decode_flops = B * matmul_per_tok + L * 4 * B * total_len * d
+    decode_mfu = decode_flops / t_step / peak
+
+    # ---- int8 serving path ------------------------------------------------
+    cfg_q = LMConfig(vocab=cfg.vocab, d_model=cfg.d_model,
+                     n_heads=cfg.n_heads, n_layers=cfg.n_layers,
+                     d_ff=cfg.d_ff, quant="int8")
+    qparams = quantize_lm_params(params)
+    t_step_q = decode_measure(qparams, cfg_q)
+    decode_tok_s_q = B / t_step_q
+
+    # ---- end-to-end generate (the TransformerGenerator.predict body):
+    # one dispatch = prefill + NEW cached steps, relay INCLUDED — what a
+    # serving caller actually observes per batched request
+    gen = jax.jit(
+        lambda p, t: generate(p, t, cfg, max_new_tokens=NEW)
+    )
+    jax.block_until_ready(gen(params, toks0))
+    t0 = time.perf_counter()
+    jax.block_until_ready(gen(params, toks0))
+    t_e2e = time.perf_counter() - t0
+    e2e_tok_s = B * NEW / t_e2e
+
+    # ---- flash vs XLA attention through the LM forward (TransformerLM
+    # predict path), attention-dominated config ----------------------------
+    acfg = LMConfig(vocab=1024, d_model=1024, n_heads=8, n_layers=2,
+                    d_ff=2048)
+    aparams = lm_init(jax.random.key(1), acfg)
+    flash_vs_xla = {}
+    for s_len in flash_Ss:
+        at = jnp.asarray(
+            np.random.default_rng(1).integers(0, 1024, size=(1, s_len)),
+            jnp.int32,
+        )
+        times = {}
+        for mode, uf in (("flash", True), ("xla", False)):
+            @jax.jit
+            def reps(ps, t, _uf=uf):
+                def body(tk, _):
+                    logits = lm_apply(ps, tk, acfg, use_flash=_uf)
+                    nxt = (tk + jnp.argmax(
+                        logits, -1
+                    ).astype(jnp.int32)) % 1024
+                    return nxt, ()
+                out, _ = jax.lax.scan(body, t, None, length=n_flash)
+                return out
+            jax.block_until_ready(reps(aparams, at))
+            t0 = time.perf_counter()
+            jax.block_until_ready(reps(aparams, at))
+            raw = time.perf_counter() - t0
+            times[mode] = max(raw - relay_s, 0.05 * raw) / n_flash
+        flash_vs_xla[str(s_len)] = round(times["xla"] / times["flash"], 2)
+
+    doc = {
+        "model_params": n_params,
+        "model_params_m": round(n_params / 1e6, 1),
+        "lm_config": (
+            f"d{cfg.d_model} L{cfg.n_layers} H{cfg.n_heads} "
+            f"ff{cfg.d_ff} v{cfg.vocab} bf16"
+        ),
+        "lm_batch": B,
+        "lm_prompt_len": S,
+        "lm_max_new": NEW,
+        "prefill_tok_s": round(prefill_tok_s, 1),
+        "prefill_mfu_pct": round(100 * prefill_mfu, 2),
+        "decode_tok_s": round(decode_tok_s, 1),
+        "decode_mfu_pct": round(100 * decode_mfu, 2),
+        "mfu_pct": round(100 * prefill_mfu, 2),
+        "decode_tok_s_int8": round(decode_tok_s_q, 1),
+        "int8_vs_bf16_x": round(t_step / t_step_q, 2),
+        "e2e_gen_tok_s": round(e2e_tok_s, 1),
+        "e2e_gen_latency_ms": round(t_e2e * 1e3, 1),
+        "flash_vs_xla_x": flash_vs_xla,
+        "peak_bf16_tflops": peak_tflops,
+        "peak_assumed": peak_assumed,
+        "mfu_relay_floor_ms": round(relay_s * 1e3, 2),
+        "mfu_methodology": (
+            "chained multi-rep scans in one dispatch minus measured relay "
+            "floor; exact matmul FLOPs (embed gather excluded, unembed "
+            "counted), causal attention at S^2/2; MFU vs advertised dense "
+            "bf16 peak"
+        ),
+    }
+    print(json.dumps(doc))
+
+
 def _probe_main(smoke: bool) -> None:
     import asyncio
 
@@ -307,19 +558,115 @@ def _probe_main(smoke: bool) -> None:
     print(json.dumps(doc))
 
 
+def gen_lm_deployment(smoke: bool, quant: str = "none") -> dict:
+    """Real-size TransformerGenerator deployment (the MFU-probe config),
+    served through the standard data plane."""
+    if smoke:
+        dims = {"vocab": 1024, "d_model": 256, "n_heads": 8, "n_layers": 2,
+                "d_ff": 1024, "max_new_tokens": 16}
+    else:
+        dims = {"vocab": 32768, "d_model": 1024, "n_heads": 16,
+                "n_layers": 12, "d_ff": 4096, "max_new_tokens": 64}
+    parameters = [
+        {"name": k, "value": str(val), "type": "INT"}
+        for k, val in dims.items()
+    ] + [{"name": "quant", "value": quant, "type": "STRING"}]
+    return {
+        "spec": {
+            "name": "bench-genlm",
+            "predictors": [{
+                "name": "main",
+                "graph": {"name": "gen", "type": "MODEL"},
+                "components": [{
+                    "name": "gen", "runtime": "inprocess",
+                    "class_path": "TransformerGenerator",
+                    "parameters": parameters,
+                }],
+            }],
+        }
+    }
+
+
+def served_gen_phase(smoke: bool) -> dict:
+    """Serve the MFU-probe LM end-to-end: engine process + native C++ data
+    plane, one batched REST request per measurement.  This is the literal
+    'user POSTs prompts, tokens come back' number with every layer of the
+    stack (HTTP parse, batching, dispatch, relay, decode scan, JSON
+    format) in the loop."""
+    import urllib.request
+
+    B, S = (4, 128) if smoke else (32, 512)
+    new = 16 if smoke else 64
+    import numpy as np
+
+    rows = np.random.default_rng(0).integers(
+        0, 1024 if smoke else 32768, size=(B, S)
+    ).astype(float).tolist()
+    payload = json.dumps({"data": {"ndarray": rows}}).encode()
+    url = f"http://127.0.0.1:{Engine.REST_PORT}/api/v0.1/predictions"
+
+    def request(timeout):
+        req = urllib.request.Request(
+            url, data=payload, headers={"Content-Type": "application/json"}
+        )
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = json.loads(r.read())
+        dt = time.perf_counter() - t0
+        shape = np.asarray(body["data"].get("ndarray", [])).shape
+        if shape != (B, new):
+            raise RuntimeError(f"served gen returned shape {shape}: "
+                               f"{str(body)[:300]}")
+        return dt
+
+    eng = Engine(
+        gen_lm_deployment(smoke), prewarm_widths="",
+        env_overrides={
+            "ENGINE_MAX_BATCH": str(B),
+            # first request compiles prefill+decode for this batch bucket
+            "ENGINE_DISPATCH_TIMEOUT_S": "900",
+        },
+    )
+    try:
+        request(timeout=900)  # compile + warm
+        lats = [request(timeout=120) for _ in range(2 if smoke else 4)]
+    finally:
+        eng.stop()
+    import statistics
+
+    med = statistics.median(lats)
+    return {
+        "served_gen_tok_s": round(B * new / med, 1),
+        "served_gen_latency_ms": round(med * 1e3, 1),
+        "served_gen_batch": B,
+        "served_gen_prompt_len": S,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true")
     parser.add_argument("--_probe", action="store_true")
+    parser.add_argument("--_probe_mfu", action="store_true")
     parser.add_argument("--duration", type=float, default=None)
     args = parser.parse_args()
     if args._probe:
         _probe_main(args.smoke)
         return
+    if args._probe_mfu:
+        _probe_mfu_main(args.smoke)
+        return
     duration = args.duration or (3.0 if args.smoke else 8.0)
 
     # ---- device probe (owns the TPU before any engine boots) -------------
     probe = probe_device(args.smoke)
+
+    # ---- compute-bound evidence: real-size LM MFU + kernel deltas --------
+    mfu = probe_mfu(args.smoke)
+
+    # ---- the same LM served end-to-end through the engine ----------------
+    time.sleep(8.0)  # let the relay release the chip after the probe
+    served_gen = served_gen_phase(args.smoke)
 
     # ---- stub graph: the reference's own max-throughput methodology ------
     # 4096-row buckets amortize the per-batch Python cost further than the
@@ -390,6 +737,11 @@ def main() -> None:
         "rest_256_qps": stub_rest[256]["qps"],
         "rest_256_p50_ms": stub_rest[256]["p50_ms"],
         "rest_256_p99_ms": stub_rest[256].get("p99_ms"),
+        # 256 closed-loop clients against a ~105 ms relay floor cap out at
+        # 256/0.105 ~= 2.4k req/s REGARDLESS of server speed — this row is
+        # the reference-matched client count, not a server limit; the
+        # saturation row above is the server capacity figure
+        "rest_256_relay_cap_qps": round(256 / (probe["relay_floor_ms"] / 1e3), 0),
         "grpc_max_qps": round(grpc_peak["qps"], 1),
         "grpc_vs_baseline": round(grpc_peak["qps"] / REFERENCE_GRPC_QPS, 4),
         "grpc_max_qps_clients": grpc_peak_c,
@@ -419,6 +771,8 @@ def main() -> None:
                       *mnist.values(), *ensemble.values()]
         ),
         **probe,
+        **mfu,
+        **served_gen,
         "duration_s": duration,
     }
     print(json.dumps(result))

@@ -88,6 +88,12 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         max_batch=int(os.environ.get("ENGINE_MAX_BATCH", "1024")),
         max_wait_ms=float(os.environ.get("ENGINE_BATCH_WAIT_MS", "2.0")),
         pipeline_depth=int(os.environ.get("ENGINE_PIPELINE_DEPTH", "8")),
+        # large models (100M+-param generators) compile for minutes on a
+        # cold cache; the per-dispatch 504 budget must cover that first
+        # trace when prewarm is skipped
+        dispatch_timeout_s=float(
+            os.environ.get("ENGINE_DISPATCH_TIMEOUT_S", "30")
+        ),
     )
     # boot-time shape compilation: ENGINE_PREWARM_WIDTHS="784,16" compiles
     # every batch bucket of those feature widths before the server binds,
@@ -224,6 +230,14 @@ def main(argv=None) -> None:
     parser.add_argument("--rest-port", type=int, default=None)
     parser.add_argument("--grpc-port", type=int, default=None)
     args = parser.parse_args(argv)
+    if os.environ.get("SELDON_FORCE_CPU") == "1":
+        # host-CPU serving for control-plane demos/tests: several engines
+        # can then coexist on a box whose accelerator admits one process
+        # (JAX_PLATFORMS env is not honored by every plugin backend; the
+        # config call before first backend use is)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     from seldon_core_tpu.runtime.compilecache import enable_compile_cache
 
     enable_compile_cache()
