@@ -41,7 +41,9 @@ from seldon_core_tpu.operator.manifests import generate_manifests
 
 __all__ = [
     "KubeClient",
+    "KubeConflict",
     "FakeKubeApi",
+    "HostileKubeApi",
     "KubectlClient",
     "Reconciler",
     "SELDON_CRD",
@@ -100,10 +102,20 @@ SELDON_CRD = {
 }
 
 
+class KubeConflict(Exception):
+    """HTTP 409 — optimistic-concurrency conflict (stale resourceVersion)
+    or a write colliding with another actor's.  The real API server
+    returns these routinely under controller races; the reconcile loop
+    resolves them by re-reading and retrying
+    (SeldonDeploymentControllerImpl.java:69-111 takes the same
+    LIST -> CREATE(404)/UPDATE shape for the same reason)."""
+
+
 class KubeClient:
     """The API-server verbs the reconcile loop needs.  Implementations must
     be idempotent-friendly: create on an existing object raises KeyError,
-    replace/delete on a missing one raises KeyError."""
+    replace/delete on a missing one raises KeyError; optimistic-concurrency
+    failures raise KubeConflict."""
 
     def list(self, kind: str, namespace: str,
              label_selector: Optional[Dict[str, str]] = None) -> List[dict]:
@@ -142,6 +154,11 @@ class FakeKubeApi(KubeClient):
 
     objects: Dict[Tuple[str, str, str], dict] = field(default_factory=dict)
     ops: List[Tuple[str, str]] = field(default_factory=list)
+    _rv: int = 0
+
+    def _bump_rv(self, obj: dict) -> None:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
 
     def list(self, kind, namespace, label_selector=None):
         out = []
@@ -164,15 +181,30 @@ class FakeKubeApi(KubeClient):
         key = _meta(obj)
         if key in self.objects:
             raise KeyError(f"already exists: {key}")
-        self.objects[key] = copy.deepcopy(obj)
+        stored = copy.deepcopy(obj)
+        self._bump_rv(stored)
+        self.objects[key] = stored
         self.ops.append(("create", f"{key[0]}/{key[2]}"))
 
     def replace(self, obj):
         key = _meta(obj)
         if key not in self.objects:
             raise KeyError(f"not found: {key}")
-        prior_status = self.objects[key].get("status")
-        self.objects[key] = copy.deepcopy(obj)
+        live = self.objects[key]
+        # optimistic concurrency, real-API-server semantics: a caller that
+        # echoes a resourceVersion must echo the CURRENT one; objects
+        # rendered fresh (no resourceVersion) behave like server-side
+        # apply and win (KubectlClient.replace uses exactly that)
+        sent_rv = obj.get("metadata", {}).get("resourceVersion")
+        live_rv = live.get("metadata", {}).get("resourceVersion")
+        if sent_rv is not None and live_rv is not None and sent_rv != live_rv:
+            raise KubeConflict(
+                f"conflict: {key} resourceVersion {sent_rv} != {live_rv}"
+            )
+        prior_status = live.get("status")
+        stored = copy.deepcopy(obj)
+        self._bump_rv(stored)
+        self.objects[key] = stored
         if prior_status is not None and "status" not in obj:
             self.objects[key]["status"] = prior_status  # replace keeps status
         self.ops.append(("replace", f"{key[0]}/{key[2]}"))
@@ -191,6 +223,7 @@ class FakeKubeApi(KubeClient):
         self.objects[key].setdefault("status", {}).update(
             copy.deepcopy(status)
         )
+        self._bump_rv(self.objects[key])
         self.ops.append(("patch_status", f"{kind}/{name}"))
 
     # -- test conveniences ---------------------------------------------
@@ -205,6 +238,86 @@ class FakeKubeApi(KubeClient):
 
     def clear_ops(self) -> None:
         self.ops.clear()
+
+
+@dataclass
+class HostileKubeApi(FakeKubeApi):
+    """FakeKubeApi with the real API server's failure modes, injectable —
+    the semantics the reference controller hardens against
+    (SeldonDeploymentControllerImpl.java:69-111 create-vs-update races,
+    SeldonDeploymentWatcher.java:89-153 stale resourceVersions).
+
+    Knobs:
+      * ``fail_queue`` — list of (verb, kind_or_name_substring, exception);
+        the next matching call consumes the entry and raises.  Use for
+        transient 500s (RuntimeError) and injected 409s (KubeConflict).
+      * ``race_on_get_miss`` — when get() misses for a (kind, name) listed
+        here, a phantom controller creates the object BEFORE returning, so
+        the caller's get->create window always loses the race.
+      * ``delete_crs_after_writes`` — once this many mutating verbs have
+        landed, every SeldonDeployment CR vanishes (mid-reconcile CR
+        deletion)."""
+
+    fail_queue: List[Tuple[str, str, Exception]] = field(default_factory=list)
+    race_on_get_miss: List[Tuple[str, str]] = field(default_factory=list)
+    delete_crs_after_writes: Optional[int] = None
+    _writes: int = 0
+
+    def _maybe_fail(self, verb: str, ident: str) -> None:
+        for i, (v, frag, exc) in enumerate(self.fail_queue):
+            if v == verb and frag in ident:
+                del self.fail_queue[i]
+                raise exc
+
+    def _count_write(self) -> None:
+        self._writes += 1
+        if (self.delete_crs_after_writes is not None
+                and self._writes >= self.delete_crs_after_writes):
+            self.delete_crs_after_writes = None
+            for key in [k for k in self.objects
+                        if k[0] == "SeldonDeployment"]:
+                del self.objects[key]
+                self.ops.append(("hostile_delete", f"{key[0]}/{key[2]}"))
+
+    def list(self, kind, namespace, label_selector=None):
+        self._maybe_fail("list", kind)
+        return super().list(kind, namespace, label_selector)
+
+    def get(self, kind, namespace, name):
+        self._maybe_fail("get", f"{kind}/{name}")
+        obj = super().get(kind, namespace, name)
+        if obj is None and (kind, name) in self.race_on_get_miss:
+            self.race_on_get_miss.remove((kind, name))
+            phantom = {
+                "kind": kind,
+                "metadata": {"namespace": namespace, "name": name,
+                             "annotations": {HASH_ANNOTATION: "phantom"},
+                             "labels": {}},
+            }
+            super().create(phantom)
+            self.ops.append(("hostile_create", f"{kind}/{name}"))
+        return obj
+
+    def create(self, obj):
+        key = _meta(obj)
+        self._maybe_fail("create", f"{key[0]}/{key[2]}")
+        super().create(obj)
+        self._count_write()
+
+    def replace(self, obj):
+        key = _meta(obj)
+        self._maybe_fail("replace", f"{key[0]}/{key[2]}")
+        super().replace(obj)
+        self._count_write()
+
+    def delete(self, kind, namespace, name):
+        self._maybe_fail("delete", f"{kind}/{name}")
+        super().delete(kind, namespace, name)
+        self._count_write()
+
+    def patch_status(self, kind, namespace, name, status):
+        self._maybe_fail("patch_status", f"{kind}/{name}")
+        super().patch_status(kind, namespace, name, status)
 
 
 class KubectlClient(KubeClient):
@@ -225,6 +338,8 @@ class KubectlClient(KubeClient):
         if proc.returncode != 0:
             if "NotFound" in proc.stderr or "AlreadyExists" in proc.stderr:
                 raise KeyError(proc.stderr.strip())
+            if "Conflict" in proc.stderr or "conflict" in proc.stderr:
+                raise KubeConflict(proc.stderr.strip())
             raise RuntimeError(proc.stderr.strip())
         return proc.stdout
 
@@ -363,16 +478,36 @@ class Reconciler:
             desired_keys.add((kind, res_name))
             live = self.client.get(kind, self.namespace, res_name)
             if live is None:
-                self.client.create(m)
-                counts["creates"] += 1
+                try:
+                    self.client.create(m)
+                    counts["creates"] += 1
+                except KeyError:
+                    # lost a create race (another controller/kubelet actor
+                    # landed it between our GET miss and the POST) —
+                    # converge onto the racer's object in the same pass
+                    # (the reference's CREATE(404)-vs-UPDATE split,
+                    # SeldonDeploymentControllerImpl.java:69-111)
+                    try:
+                        self._replace_converged(m)
+                        counts["updates"] += 1
+                    except KeyError:
+                        # racer's object vanished again before our replace
+                        # (create-then-delete churn): take the create path
+                        self.client.create(m)
+                        counts["creates"] += 1
                 continue
             live_hash = (
                 live.get("metadata", {}).get("annotations", {})
                 .get(HASH_ANNOTATION)
             )
             if live_hash != m["metadata"]["annotations"][HASH_ANNOTATION]:
-                self.client.replace(m)
-                counts["updates"] += 1
+                try:
+                    self._replace_converged(m)
+                    counts["updates"] += 1
+                except KeyError:
+                    # deleted under us mid-pass: recreate
+                    self.client.create(m)
+                    counts["creates"] += 1
         # prune: owned resources no longer rendered (removed predictors /
         # components) — SeldonDeploymentControllerImpl's removeDeployments
         for kind in ("Deployment", "Service"):
@@ -385,6 +520,24 @@ class Reconciler:
                     counts["deletes"] += 1
         self._update_status(name)
         return counts
+
+    def _replace_converged(self, m: dict, retries: int = 2) -> None:
+        """Replace with 409 resolution: our rendering is authoritative for
+        owned resources, so a conflict just means the live resourceVersion
+        moved — re-issue the (version-less, server-side-apply-like) write.
+        Bounded retries: a persistently conflicting object surfaces as an
+        error rather than a livelock."""
+        for attempt in range(retries + 1):
+            try:
+                self.client.replace(m)
+                return
+            except KubeConflict:
+                if attempt == retries:
+                    raise
+                # refresh our view; the next write supersedes the racer's
+                kind, _, res_name = _meta(m)
+                if self.client.get(kind, self.namespace, res_name) is None:
+                    raise KeyError(f"not found: {res_name}")
 
     def reconcile_deleted(self, name: str) -> int:
         """CR removed: prune everything it owned."""
@@ -426,12 +579,31 @@ class Reconciler:
         })
 
     def _patch_cr_status(self, name: str, status: dict) -> None:
+        # write-suppression: a status patch bumps the CR's resourceVersion,
+        # so patching an unchanged status every tick turns the steady state
+        # into a write loop (and retriggers level-based watchers cluster-
+        # wide).  Compare against the live status first.
+        live = self.client.get("SeldonDeployment", self.namespace, name)
+        if live is None:
+            return  # CR deleted mid-reconcile: nothing to write back to
+        live_status = live.get("status", {})
+        if all(live_status.get(k) == v for k, v in status.items()):
+            return
         try:
             self.client.patch_status(
                 "SeldonDeployment", self.namespace, name, status
             )
         except KeyError:
-            pass  # CR deleted mid-reconcile: nothing to write back to
+            pass  # CR deleted between the read and the patch
+        except KubeConflict:
+            # another writer bumped the CR between read and patch; one
+            # retry — status is derived state, next tick rewrites it anyway
+            try:
+                self.client.patch_status(
+                    "SeldonDeployment", self.namespace, name, status
+                )
+            except (KeyError, KubeConflict):
+                pass
 
     # -- control loop --------------------------------------------------------
 

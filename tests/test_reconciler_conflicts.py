@@ -1,0 +1,214 @@
+"""Reconciler vs a HOSTILE API server — the real k8s API's failure modes
+the reference controller hardens against: create races and 404-create vs
+conflict-update (SeldonDeploymentControllerImpl.java:69-111), stale
+resourceVersions (SeldonDeploymentWatcher.java:89-153), mid-reconcile CR
+deletion, status-patch conflicts, and transient API errors.
+
+Every scenario asserts two properties: the loop never crashes, and the
+cluster CONVERGES (possibly on the next tick) with no orphaned resources.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from seldon_core_tpu.operator.reconciler import (
+    HASH_ANNOTATION,
+    HostileKubeApi,
+    KubeConflict,
+    OWNER_LABEL,
+    Reconciler,
+)
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def load_cr(name="iris", example="iris_deployment.json"):
+    with open(os.path.join(EXAMPLES, example)) as f:
+        cr = json.load(f)
+    md = cr.setdefault("metadata", {})
+    md["name"] = name
+    md.setdefault("namespace", "default")
+    cr.setdefault("kind", "SeldonDeployment")
+    return cr
+
+
+@pytest.fixture()
+def api():
+    return HostileKubeApi()
+
+
+@pytest.fixture()
+def rec(api):
+    return Reconciler(api)
+
+
+def converged(api, rec, name):
+    """Steady-state check: a reconcile tick issues zero resource writes and
+    every desired resource exists with a non-phantom hash."""
+    api.clear_ops()
+    rec.run_once()
+    writes = [op for op in api.ops
+              if op[0] in ("create", "replace", "delete")]
+    assert writes == [], f"not converged: {writes}"
+    for obj in api.list("Deployment", "default", {OWNER_LABEL: name}):
+        h = obj["metadata"]["annotations"].get(HASH_ANNOTATION)
+        assert h and h != "phantom"
+
+
+# -- failure mode 1: create race -------------------------------------------
+
+def test_create_race_converges_same_pass(api, rec):
+    """Another actor creates the engine Deployment between the reconciler's
+    GET miss and its POST: the create's AlreadyExists must fall back to
+    replace, converging in the SAME pass (no failed tick)."""
+    api.create(load_cr())
+    # discover the first rendered names with a throwaway reconcile on a
+    # pristine clone, then arm the race for every one of them
+    probe_api = HostileKubeApi()
+    probe_api.create(load_cr())
+    Reconciler(probe_api).run_once()
+    for (kind, _, name) in list(probe_api.objects):
+        if kind in ("Deployment", "Service"):
+            api.race_on_get_miss.append((kind, name))
+
+    results = rec.run_once()
+    assert results["iris"].get("failed", 0) == 0
+    # every racer's phantom got replaced by the real rendering
+    assert results["iris"]["updates"] >= 1
+    converged(api, rec, "iris")
+
+
+# -- failure mode 2: stale-resourceVersion conflict on replace -------------
+
+def test_stale_rv_conflict_retried(api, rec):
+    api.create(load_cr())
+    rec.run_once()
+    # change the spec so the next tick must replace, and inject a 409 on
+    # the engine Deployment write
+    cr = api.get("SeldonDeployment", "default", "iris")
+    cr["spec"]["predictors"][0]["replicas"] = 3
+    api.replace(cr)
+    api.fail_queue.append(("replace", "Deployment/", KubeConflict("409")))
+    results = rec.run_once()
+    assert results["iris"].get("failed", 0) == 0
+    dep = api.list("Deployment", "default", {OWNER_LABEL: "iris"})[0]
+    assert dep["spec"]["replicas"] == 3
+    converged(api, rec, "iris")
+
+
+def test_persistent_conflict_isolates_cr_then_recovers(api, rec):
+    """A conflict that outlives the retry budget fails the CR's tick
+    without crashing the loop; the next clean tick converges."""
+    api.create(load_cr())
+    rec.run_once()
+    cr = api.get("SeldonDeployment", "default", "iris")
+    cr["spec"]["predictors"][0]["replicas"] = 5
+    api.replace(cr)
+    for _ in range(4):  # more than the retry budget
+        api.fail_queue.append(("replace", "Deployment/", KubeConflict("409")))
+    results = rec.run_once()
+    assert results["iris"].get("failed", 0) == 1
+    api.fail_queue.clear()
+    results = rec.run_once()
+    assert results["iris"].get("failed", 0) == 0
+    dep = api.list("Deployment", "default", {OWNER_LABEL: "iris"})[0]
+    assert dep["spec"]["replicas"] == 5
+    converged(api, rec, "iris")
+
+
+# -- failure mode 3: resource deleted under the reconciler -----------------
+
+def test_resource_deleted_mid_pass_recreated(api, rec):
+    """replace() hits NotFound because a hostile actor deleted the live
+    object after the GET: the reconciler must fall back to create."""
+    api.create(load_cr())
+    rec.run_once()
+    cr = api.get("SeldonDeployment", "default", "iris")
+    cr["spec"]["predictors"][0]["replicas"] = 2
+    api.replace(cr)
+    dep_name = api.list(
+        "Deployment", "default", {OWNER_LABEL: "iris"}
+    )[0]["metadata"]["name"]
+
+    # delete the Deployment between the reconciler's GET and its replace:
+    # emulate by a fail hook that deletes then raises KeyError (NotFound)
+    class Vanish(KeyError):
+        pass
+
+    del api.objects[("Deployment", "default", dep_name)]
+    api.fail_queue.append(("replace", f"Deployment/{dep_name}",
+                           Vanish("not found")))
+    results = rec.run_once()
+    assert results["iris"].get("failed", 0) == 0
+    dep = api.get("Deployment", "default", dep_name)
+    assert dep is not None and dep["spec"]["replicas"] == 2
+    converged(api, rec, "iris")
+
+
+# -- failure mode 4: CR deleted mid-reconcile ------------------------------
+
+def test_cr_deleted_mid_reconcile_no_crash_then_prune(api, rec):
+    """The CR vanishes after rendering but before the status write-back:
+    the tick must not crash, and the NEXT tick prunes every orphan."""
+    api.create(load_cr())
+    api.delete_crs_after_writes = 1  # vanish after the first created child
+    results = rec.run_once()
+    assert "iris" in results  # tick completed
+    # owned resources exist but the CR is gone
+    assert api.get("SeldonDeployment", "default", "iris") is None
+    results = rec.run_once()
+    assert results["iris"]["deletes"] >= 1
+    assert api.list("Deployment", "default", {OWNER_LABEL: "iris"}) == []
+    assert api.list("Service", "default", {OWNER_LABEL: "iris"}) == []
+
+
+# -- failure mode 5: status-patch conflict ---------------------------------
+
+def test_status_patch_conflict_retried(api, rec):
+    api.create(load_cr())
+    api.fail_queue.append(
+        ("patch_status", "SeldonDeployment/iris", KubeConflict("409"))
+    )
+    results = rec.run_once()
+    assert results["iris"].get("failed", 0) == 0
+    status = api.get("SeldonDeployment", "default", "iris").get("status")
+    assert status and status["state"] in ("Creating", "Available")
+
+
+# -- failure mode 6: transient API 500s ------------------------------------
+
+def test_transient_api_error_isolates_cr_and_recovers(api, rec):
+    """An API flake mid-reconcile fails only that CR's tick (run_once's
+    isolation contract) and the next tick converges with no orphans."""
+    api.create(load_cr("a"))
+    api.create(load_cr("b", "mnist_deployment.json"))
+    api.fail_queue.append(("create", "Deployment/", RuntimeError("API 500")))
+    results = rec.run_once()
+    failed = [n for n, r in results.items() if r.get("failed")]
+    ok = [n for n, r in results.items() if not r.get("failed")]
+    assert len(failed) == 1 and len(ok) == 1  # isolation
+    results = rec.run_once()
+    assert all(not r.get("failed") for r in results.values())
+    for name in ("a", "b"):
+        assert api.list("Deployment", "default", {OWNER_LABEL: name})
+        converged(api, rec, name)
+
+
+# -- steady state stays zero-write under RV bookkeeping --------------------
+
+def test_steady_state_zero_writes_including_status(api, rec):
+    """With resourceVersions live, an unchanged status must NOT be patched
+    every tick (each patch bumps the CR RV and would retrigger watchers) —
+    total write silence at steady state."""
+    api.create(load_cr())
+    rec.run_once()
+    api.mark_deployments_ready()
+    rec.run_once()
+    api.clear_ops()
+    rec.run_once()
+    writes = [op for op in api.ops
+              if op[0] in ("create", "replace", "delete", "patch_status")]
+    assert writes == []
