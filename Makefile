@@ -15,6 +15,14 @@ test:
 bench:
 	python bench.py
 
+# guided end-to-end walkthroughs (the reference's notebooks role):
+# canary shift, 8-member ensemble, epsilon-greedy feedback, SSE streaming
+demos:
+	python examples/demos.py all
+
+stack:
+	python examples/local_stack.py
+
 bundle:
 	python -m seldon_core_tpu.operator.bundle
 
@@ -36,4 +44,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test bench bundle images publish release-dryrun
+.PHONY: proto native test bench demos stack bundle images publish release-dryrun

@@ -200,6 +200,16 @@ class ApiGateway:
             return await engine.send_feedback(fb)
         return await self._http_post(str(engine) + "/api/v0.1/feedback", fb.to_json())
 
+    def _get_session(self):
+        """Shared pooled session; timeouts are PER REQUEST (a session-level
+        total would make unary calls and long-lived SSE proxies poison each
+        other's deadline)."""
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
     async def _http_post(self, url: str, payload: str) -> SeldonMessage:
         import aiohttp
 
@@ -208,14 +218,14 @@ class ApiGateway:
         # java:60-72, HttpRetryHandler.java:34-45).  Retries fire only on
         # connection-establishment failures — once bytes may have reached the
         # engine, re-POSTing could double-apply feedback training
-        if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=20)
-            )
+        session = self._get_session()
+        timeout = aiohttp.ClientTimeout(total=20)
         last = "unreachable"
         for _ in range(3):
             try:
-                async with self._session.post(url, data=payload) as r:
+                async with session.post(
+                    url, data=payload, timeout=timeout
+                ) as r:
                     return SeldonMessage.from_json(await r.text())
             except aiohttp.ClientConnectorError as e:
                 last = str(e)
@@ -296,6 +306,72 @@ def make_gateway_app(gateway: ApiGateway):
             return _error_response(str(e), code=401)
         return _msg_response(ack)
 
+    async def generate_stream(request):
+        """SSE token streaming through the ingress: auth + canary pick,
+        then relay the engine's event stream (in-process engines stream
+        directly; remote engines are proxied chunk-for-chunk)."""
+        try:
+            payload = await _payload_text(request)
+            reg = gateway._resolve(_bearer(request))
+        except AuthError as e:
+            return _error_response(str(e), code=401)
+        except SeldonMessageError as e:
+            return _error_response(str(e))
+        _, engine = gateway._pick_engine(reg)
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"},
+        )
+        import json as _json
+
+        if hasattr(engine, "generate_stream"):  # in-process EngineService
+            try:
+                text, chunk = engine.prepare_stream_request(payload)
+            except SeldonMessageError as e:
+                return _error_response(str(e))
+            await resp.prepare(request)
+            agen = engine.generate_stream(text, chunk=chunk)
+            try:
+                async for event in agen:
+                    await resp.write(b"data: " + event.encode() + b"\n\n")
+            except Exception as e:  # mid-stream: in-band terminal event,
+                # same SSE failure contract as the engine lane (rest.py)
+                await resp.write(
+                    b'data: {"done": true, "error": %s}\n\n'
+                    % _json.dumps(str(e)).encode()
+                )
+            finally:
+                await agen.aclose()
+            await resp.write_eof()
+            return resp
+        # remote engine: stream the upstream SSE bytes through unchanged
+        import aiohttp
+
+        try:
+            async with gateway._get_session().post(
+                str(engine) + "/api/v0.1/generate/stream", data=payload,
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=20),
+            ) as upstream:
+                if upstream.status != 200:
+                    return _error_response(
+                        await upstream.text(), code=upstream.status
+                    )
+                await resp.prepare(request)
+                async for chunk_bytes in upstream.content.iter_any():
+                    await resp.write(chunk_bytes)
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            if not resp.prepared:
+                return _error_response(f"engine unreachable: {e}", code=503)
+            # upstream broke mid-stream: emit a terminal error event — the
+            # SSE contract's in-band failure channel (headers already sent)
+            await resp.write(
+                b'data: {"done": true, "error": %s}\n\n'
+                % _json.dumps(str(e)).encode()
+            )
+        await resp.write_eof()
+        return resp
+
     async def ping(_):
         return web.Response(text="pong")
 
@@ -317,6 +393,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_post("/oauth/token", token)
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
+    app.router.add_post("/api/v0.1/generate/stream", generate_stream)
     app.router.add_get("/ping", ping)
     app.router.add_get("/ready", ready)
     app.router.add_get("/prometheus", prometheus)

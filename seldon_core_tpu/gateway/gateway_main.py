@@ -45,7 +45,25 @@ def _build_store():
     return DeploymentStore()
 
 
-def _register_specs(store, spec_dir: str, seen: dict) -> None:
+def _engine_url_map() -> dict:
+    """Explicit per-predictor overrides: '{"<deployment>/<predictor>": url}'
+    — topologies where predictor engines don't follow one URL pattern
+    (canary pairs on distinct ports, split-cluster serving).  Parsed once
+    at boot; a malformed value is a fatal config error with a clear
+    message, not a crash-loop in the poll tick."""
+    raw_map = os.environ.get("GATEWAY_ENGINE_URL_MAP", "").strip()
+    if not raw_map:
+        return {}
+    try:
+        return {str(k): str(v) for k, v in json.loads(raw_map).items()}
+    except (json.JSONDecodeError, AttributeError) as e:
+        raise SystemExit(
+            f"GATEWAY_ENGINE_URL_MAP is not a JSON object of "
+            f"'deployment/predictor' -> url: {e}"
+        ) from e
+
+
+def _register_specs(store, spec_dir: str, seen: dict, url_map: dict) -> None:
     template = os.environ.get(
         "GATEWAY_ENGINE_URL_TEMPLATE", "http://{name}:8000"
     )
@@ -56,12 +74,20 @@ def _register_specs(store, spec_dir: str, seen: dict) -> None:
         try:
             with open(path) as f:
                 spec = SeldonDeploymentSpec.from_json_dict(json.load(f))
-            url = template.format(name=spec.name)
-            store.register(
-                spec, {p.name: url for p in spec.predictors}
-            )
+            # {predictor} in the template routes each predictor to its own
+            # engine Service — the canary topology (one engine pod per
+            # predictor, replica-weighted split in ApiGateway._pick_engine)
+            engines = {
+                p.name: url_map.get(
+                    f"{spec.name}/{p.name}",
+                    template.format(name=spec.name, predictor=p.name),
+                )
+                for p in spec.predictors
+            }
+            store.register(spec, engines)
             seen[path] = mtime
-            print(f"registered {spec.name} -> {url}", flush=True)
+            print(f"registered {spec.name} -> {sorted(engines.values())}",
+                  flush=True)
         except (GraphSpecError, ValueError, OSError,
                 json.JSONDecodeError) as e:
             print(f"skipping {path}: {e}", flush=True)
@@ -85,8 +111,9 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
     if gateway.firehose is not None:
         gateway.firehose.start()  # drain task needs the running loop
     seen: dict = {}
+    url_map = _engine_url_map()
     if spec_dir:
-        _register_specs(store, spec_dir, seen)
+        _register_specs(store, spec_dir, seen, url_map)
     runner = await serve_app(make_gateway_app(gateway), host, rest_port)
     grpc_server = make_gateway_grpc_server(gateway, host, grpc_port)
     await grpc_server.start()
@@ -110,7 +137,7 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
             await asyncio.wait_for(stop.wait(), timeout=5.0)
         except asyncio.TimeoutError:
             if spec_dir:  # poll for new/changed deployment specs
-                _register_specs(store, spec_dir, seen)
+                _register_specs(store, spec_dir, seen, url_map)
     await grpc_server.stop(grace=5.0)
     await runner.cleanup()
     if gateway.firehose is not None:
