@@ -1,8 +1,16 @@
-"""Cross-language conformance: a zero-dependency C++ microservice
-(examples/cpp_model/model_server.cpp) served as a graph node through the
-engine's remote REST runtime — the guarantee that the internal API
-(docs/internal-api.md) admits any language, the way the reference's R and
-Java wrappers did (wrappers/s2i/R/microservice.R)."""
+"""Cross-language conformance: the SAME suite drives every non-Python
+model-server lane through the engine's remote REST runtime — the
+guarantee that the internal API (docs/internal-api.md) admits any
+language, the way the reference's R and Java wrappers did
+(wrappers/s2i/R/microservice.R).
+
+Lanes (each skipped when its toolchain is absent):
+  * cpp — zero-dependency C++ server (examples/cpp_model/model_server.cpp)
+  * r   — zero-package base-R server (wrappers/R/microservice.R)
+
+Both implement the conformance semantics: scale features by the `scale`
+FLOAT parameter, output name "scaled", kind preservation, /send-feedback.
+"""
 
 import asyncio
 import json
@@ -18,14 +26,14 @@ import pytest
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.runtime.engine import EngineService
 
-SRC = os.path.join(
-    os.path.dirname(__file__), "..", "examples", "cpp_model",
-    "model_server.cpp",
-)
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+SRC = os.path.join(ROOT, "examples", "cpp_model", "model_server.cpp")
+R_SERVER = os.path.join(ROOT, "wrappers", "R", "microservice.R")
+R_MODEL = os.path.join(ROOT, "wrappers", "R", "example_model.R")
 
-pytestmark = pytest.mark.skipif(
-    shutil.which("g++") is None, reason="no C++ toolchain"
-)
+PARAMS = json.dumps([{"name": "scale", "value": "2.0", "type": "FLOAT"}])
+
+LANES = ["cpp", "r"]
 
 
 def free_port():
@@ -36,32 +44,50 @@ def free_port():
     return port
 
 
-@pytest.fixture(scope="module")
-def server(tmp_path_factory):
-    binary = str(tmp_path_factory.mktemp("cpp") / "model_server")
-    subprocess.run(
-        ["g++", "-O2", "-std=c++17", "-pthread", "-o", binary, SRC],
-        check=True,
-    )
+def _spawn_lane(lane, tmp_path_factory):
     port = free_port()
     env = dict(
         os.environ,
         PREDICTIVE_UNIT_SERVICE_PORT=str(port),
-        PREDICTIVE_UNIT_PARAMETERS=json.dumps(
-            [{"name": "scale", "value": "2.0", "type": "FLOAT"}]
-        ),
+        PREDICTIVE_UNIT_PARAMETERS=PARAMS,
     )
-    proc = subprocess.Popen([binary], env=env, stderr=subprocess.PIPE)
-    deadline = time.monotonic() + 10
+    if lane == "cpp":
+        if shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain")
+        binary = str(tmp_path_factory.mktemp("cpp") / "model_server")
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-pthread", "-o", binary, SRC],
+            check=True,
+        )
+        cmd = [binary]
+    elif lane == "r":
+        if shutil.which("Rscript") is None:
+            pytest.skip("no R toolchain")
+        cmd = ["Rscript", R_SERVER, "--model", R_MODEL, "--service", "MODEL"]
+    else:  # pragma: no cover
+        raise ValueError(lane)
+    proc = subprocess.Popen(cmd, env=env, stderr=subprocess.PIPE)
+    deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         try:
             socket.create_connection(("127.0.0.1", port), 0.2).close()
             break
         except OSError:
+            if proc.poll() is not None:
+                pytest.fail(
+                    f"{lane} model server exited: "
+                    f"{proc.stderr.read().decode()[-2000:]}"
+                )
             time.sleep(0.1)
     else:
         proc.kill()
-        pytest.fail("cpp model server did not come up")
+        pytest.fail(f"{lane} model server did not come up")
+    return proc, port
+
+
+@pytest.fixture(scope="module", params=LANES)
+def server(request, tmp_path_factory):
+    proc, port = _spawn_lane(request.param, tmp_path_factory)
     yield port
     proc.kill()
     proc.wait()

@@ -1,0 +1,19 @@
+# Template R user model for the seldon_core_tpu R microservice lane —
+# the role MyModel.py plays for the Python wrapper (and the reference's
+# wrappers/s2i/R test model).  Semantics match the C++ conformance server
+# (examples/cpp_model/model_server.cpp): multiply features by the `scale`
+# parameter, one output name "scaled" — so the cross-language conformance
+# suite (tests/test_conformance.py) can drive both lanes identically.
+
+initialise_seldon <- function(params) {
+  scale <- if (!is.null(params$scale)) as.numeric(params$scale) else 1.0
+  structure(list(scale = scale), class = "scaler")
+}
+
+predict.scaler <- function(object, X, ...) {
+  as.matrix(X) * object$scale
+}
+
+class_names <- function(model) {
+  "scaled"
+}

@@ -1,0 +1,458 @@
+# seldon_core_tpu R microservice — the R model wrapper lane.
+#
+# Role parity: the reference ships an R wrapper runtime
+# (wrappers/s2i/R/microservice.R in seldon-core) built on plumber+jsonlite+
+# optparse+urltools+stringi.  This implementation serves the SAME internal
+# API (docs/internal-api.md) with ZERO package dependencies — base R only
+# (serverSocket/socketAccept, R >= 4.0) — so it runs on any Rocker/r-base
+# image without an install step, the same zero-dependency stance as the
+# C++ conformance server (examples/cpp_model/model_server.cpp).
+#
+# CLI (reference-compatible):
+#   Rscript microservice.R --model MyModel.R [--service MODEL|ROUTER|
+#       TRANSFORMER|COMBINER] [--api REST] [--parameters '<json>']
+#       [--persistence 0|1]
+# Env (operator contract, graph/defaulting.py):
+#   PREDICTIVE_UNIT_SERVICE_PORT (default 5000)
+#   PREDICTIVE_UNIT_PARAMETERS   (JSON [{name,value,type},...])
+#   PREDICTIVE_UNIT_ID           (persistence snapshot key)
+#
+# User-model contract (sourced from --model):
+#   initialise_seldon(params)            -> model object        (required)
+#   predict(model, X)                    -> numeric matrix      (MODEL)
+#   route(model, X)                      -> integer branch      (ROUTER)
+#   send_feedback(model, X, reward, truth) -> model object      (ROUTER)
+#   transform_input(model, X)            -> numeric matrix      (TRANSFORMER)
+#   transform_output(model, X)           -> numeric matrix      (TRANSFORMER)
+#   aggregate(model, Xs)                 -> numeric matrix      (COMBINER)
+#   class_names(model)                   -> character vector    (optional)
+# X is a numeric matrix (rows = samples); params a named list with INT/
+# FLOAT/BOOL/STRING types already converted.
+
+# -- minimal JSON ------------------------------------------------------------
+# Restricted-grammar parser for the prediction data plane (objects, arrays,
+# strings, numbers, true/false/null).  Small payload sizes make the simple
+# recursive descent fine.
+
+json_parse <- function(txt) {
+  st <- new.env(parent = emptyenv())
+  st$s <- txt
+  st$i <- 1L
+  st$n <- nchar(txt)
+
+  peek <- function() substr(st$s, st$i, st$i)
+  advance <- function() st$i <- st$i + 1L
+  skip_ws <- function() {
+    while (st$i <= st$n && peek() %in% c(" ", "\t", "\n", "\r")) advance()
+  }
+  fail <- function(what) stop(sprintf("JSON parse error at %d: %s", st$i, what))
+
+  parse_string <- function() {
+    if (peek() != '"') fail("expected string")
+    advance()
+    out <- character(0)
+    repeat {
+      if (st$i > st$n) fail("unterminated string")
+      ch <- peek()
+      if (ch == '"') { advance(); break }
+      if (ch == "\\") {
+        advance()
+        esc <- peek()
+        advance()
+        out <- c(out, switch(
+          esc,
+          '"' = '"', "\\" = "\\", "/" = "/", b = "\b", f = "\f",
+          n = "\n", r = "\r", t = "\t",
+          u = {
+            hex <- substr(st$s, st$i, st$i + 3L)
+            st$i <- st$i + 4L
+            intToUtf8(strtoi(hex, 16L))
+          },
+          fail(paste0("bad escape \\", esc))
+        ))
+      } else {
+        advance()
+        out <- c(out, ch)
+      }
+    }
+    paste0(out, collapse = "")
+  }
+
+  parse_number <- function() {
+    m <- regexpr("^-?[0-9]+(\\.[0-9]+)?([eE][+-]?[0-9]+)?",
+                 substring(st$s, st$i))
+    if (m == -1L) fail("expected number")
+    len <- attr(m, "match.length")
+    val <- as.numeric(substr(st$s, st$i, st$i + len - 1L))
+    st$i <- st$i + len
+    val
+  }
+
+  parse_value <- function() {
+    skip_ws()
+    ch <- peek()
+    if (ch == '"') return(parse_string())
+    if (ch == "{") return(parse_object())
+    if (ch == "[") return(parse_array())
+    if (substr(st$s, st$i, st$i + 3L) == "true") { st$i <- st$i + 4L; return(TRUE) }
+    if (substr(st$s, st$i, st$i + 4L) == "false") { st$i <- st$i + 5L; return(FALSE) }
+    if (substr(st$s, st$i, st$i + 3L) == "null") { st$i <- st$i + 4L; return(NULL) }
+    parse_number()
+  }
+
+  parse_object <- function() {
+    advance()  # {
+    out <- list()
+    skip_ws()
+    if (peek() == "}") { advance(); return(out) }
+    repeat {
+      skip_ws()
+      key <- parse_string()
+      skip_ws()
+      if (peek() != ":") fail("expected ':'")
+      advance()
+      val <- parse_value()
+      out[[key]] <- val
+      skip_ws()
+      ch <- peek()
+      advance()
+      if (ch == "}") break
+      if (ch != ",") fail("expected ',' or '}'")
+    }
+    out
+  }
+
+  parse_array <- function() {
+    advance()  # [
+    out <- list()
+    skip_ws()
+    if (peek() == "]") { advance(); return(out) }
+    repeat {
+      val <- parse_value()
+      out[[length(out) + 1L]] <- if (is.null(val)) NA else val
+      skip_ws()
+      ch <- peek()
+      advance()
+      if (ch == "]") break
+      if (ch != ",") fail("expected ',' or ']'")
+    }
+    out
+  }
+
+  val <- parse_value()
+  skip_ws()
+  val
+}
+
+json_escape <- function(s) {
+  s <- gsub("\\\\", "\\\\\\\\", s)
+  s <- gsub('"', '\\\\"', s)
+  s <- gsub("\n", "\\\\n", s)
+  s <- gsub("\r", "\\\\r", s)
+  s <- gsub("\t", "\\\\t", s)
+  s
+}
+
+json_num <- function(x) {
+  # finite doubles with enough digits to round-trip; the wire contract is
+  # double-precision (proto Tensor.values)
+  vapply(x, function(v) {
+    if (!is.finite(v)) return("0")
+    format(v, digits = 17, scientific = FALSE, trim = TRUE)
+  }, character(1))
+}
+
+json_str_array <- function(xs) {
+  if (length(xs) == 0) return("[]")
+  paste0("[", paste0('"', json_escape(xs), '"', collapse = ","), "]")
+}
+
+# -- SeldonMessage data helpers ---------------------------------------------
+
+extract_matrix <- function(doc) {
+  # doc: parsed SeldonMessage; returns list(X=matrix, kind="ndarray"|"tensor")
+  data <- doc[["data"]]
+  if (is.null(data)) stop("data field is missing")
+  if (!is.null(data[["ndarray"]])) {
+    rows <- data[["ndarray"]]
+    X <- do.call(rbind, lapply(rows, function(r) as.numeric(unlist(r))))
+    if (is.null(X)) X <- matrix(numeric(0), nrow = 0, ncol = 0)
+    return(list(X = X, kind = "ndarray"))
+  }
+  if (!is.null(data[["tensor"]])) {
+    shape <- as.integer(unlist(data[["tensor"]][["shape"]]))
+    values <- as.numeric(unlist(data[["tensor"]][["values"]]))
+    if (length(shape) == 1) shape <- c(1L, shape)
+    X <- matrix(values, nrow = shape[1], ncol = prod(shape[-1]), byrow = TRUE)
+    return(list(X = X, kind = "tensor"))
+  }
+  stop("data field must contain ndarray or tensor field")
+}
+
+format_response <- function(Y, kind, names) {
+  # numeric matrix -> SeldonMessage JSON preserving the request's data kind
+  # (tensor in -> tensor out; PredictorUtils.java:127 semantics)
+  Y <- as.matrix(Y)
+  names_json <- json_str_array(names)
+  if (kind == "tensor") {
+    vals <- paste0(json_num(as.numeric(t(Y))), collapse = ",")
+    sprintf(
+      '{"data":{"names":%s,"tensor":{"shape":[%d,%d],"values":[%s]}}}',
+      names_json, nrow(Y), ncol(Y), vals
+    )
+  } else {
+    rows <- apply(Y, 1, function(r) paste0("[", paste0(json_num(r), collapse = ","), "]"))
+    sprintf('{"data":{"names":%s,"ndarray":[%s]}}',
+            names_json, paste0(rows, collapse = ","))
+  }
+}
+
+failure_response <- function(reason, code = 400L) {
+  sprintf(
+    '{"status":{"code":%d,"status":"FAILURE","reason":"%s"}}',
+    code, json_escape(reason)
+  )
+}
+
+# -- CLI / env ---------------------------------------------------------------
+
+parse_args <- function(argv) {
+  args <- list(model = NULL, service = "MODEL", api = "REST",
+               parameters = NULL, persistence = 0L)
+  i <- 1L
+  while (i <= length(argv)) {
+    a <- argv[[i]]
+    take <- function() { i <<- i + 1L; argv[[i]] }
+    if (a %in% c("--model", "-m")) args$model <- take()
+    else if (a %in% c("--service", "-s")) args$service <- take()
+    else if (a %in% c("--api", "-a")) args$api <- take()
+    else if (a %in% c("--parameters", "-p")) args$parameters <- take()
+    else if (a %in% c("--persistence", "-e")) args$persistence <- as.integer(take())
+    else if (is.null(args$model)) args$model <- a  # positional model file
+    i <- i + 1L
+  }
+  args
+}
+
+typed_parameters <- function(raw) {
+  # [{name,value,type}] -> named list with INT/FLOAT/BOOL conversion
+  # (microservice.py:122-136 / graph/spec.py typed Parameter semantics)
+  if (is.null(raw) || !nzchar(raw)) return(list())
+  entries <- json_parse(raw)
+  out <- list()
+  for (e in entries) {
+    value <- e[["value"]]
+    type <- if (is.null(e[["type"]])) "STRING" else e[["type"]]
+    out[[e[["name"]]]] <- switch(
+      type,
+      INT = as.integer(value),
+      FLOAT = as.numeric(value),
+      DOUBLE = as.numeric(value),
+      BOOL = toupper(as.character(value)) %in% c("TRUE", "1"),
+      as.character(value)
+    )
+  }
+  out
+}
+
+# -- HTTP server (base R, serverSocket/socketAccept) -------------------------
+
+read_request <- function(con) {
+  # byte-wise header read until CRLFCRLF, then Content-Length body bytes
+  header <- raw(0)
+  repeat {
+    b <- readBin(con, "raw", n = 1L)
+    if (length(b) == 0) return(NULL)  # peer closed
+    header <- c(header, b)
+    n <- length(header)
+    if (n >= 4 && identical(header[(n - 3):n],
+                            as.raw(c(0x0d, 0x0a, 0x0d, 0x0a)))) break
+    if (n > 65536) stop("header too large")
+  }
+  text <- rawToChar(header)
+  lines <- strsplit(text, "\r\n", fixed = TRUE)[[1]]
+  request_line <- strsplit(lines[[1]], " ", fixed = TRUE)[[1]]
+  method <- request_line[[1]]
+  target <- request_line[[2]]
+  clen <- 0L
+  ctype <- ""
+  for (h in lines[-1]) {
+    kv <- regmatches(h, regexec("^([^:]+):[ \t]*(.*)$", h))[[1]]
+    if (length(kv) == 3) {
+      key <- tolower(kv[[2]])
+      if (key == "content-length") clen <- as.integer(kv[[3]])
+      if (key == "content-type") ctype <- tolower(kv[[3]])
+    }
+  }
+  body <- raw(0)
+  while (length(body) < clen) {
+    chunk <- readBin(con, "raw", n = clen - length(body))
+    if (length(chunk) == 0) break
+    body <- c(body, chunk)
+  }
+  path <- strsplit(target, "?", fixed = TRUE)[[1]][[1]]
+  list(method = method, path = path, ctype = ctype,
+       body = rawToChar(body), query = if (grepl("?", target, fixed = TRUE))
+         sub("^[^?]*\\?", "", target) else "")
+}
+
+payload_json <- function(req) {
+  # raw JSON body, or the reference's form/query convention json=<urlenc>
+  # (engine InternalPredictionService.java:240-242)
+  text <- req$body
+  source_qs <- NULL
+  if (grepl("form", req$ctype, fixed = TRUE)) source_qs <- text
+  else if (!nzchar(text) && nzchar(req$query)) source_qs <- req$query
+  if (!is.null(source_qs)) {
+    for (pair in strsplit(source_qs, "&", fixed = TRUE)[[1]]) {
+      kv <- strsplit(pair, "=", fixed = TRUE)[[1]]
+      if (length(kv) == 2 && kv[[1]] == "json") {
+        return(URLdecode(chartr("+", " ", kv[[2]])))
+      }
+    }
+  }
+  text
+}
+
+respond <- function(con, code, body, ctype = "application/json") {
+  body_raw <- charToRaw(body)
+  head <- sprintf(
+    paste0("HTTP/1.1 %d %s\r\nContent-Type: %s\r\n",
+           "Content-Length: %d\r\nConnection: close\r\n\r\n"),
+    code, if (code == 200) "OK" else "Error", ctype, length(body_raw)
+  )
+  writeBin(c(charToRaw(head), body_raw), con)
+  flush(con)
+}
+
+# -- endpoint logic ----------------------------------------------------------
+
+model_names <- function(model, Y) {
+  if (exists("class_names", mode = "function")) {
+    out <- class_names(model)
+    if (!is.null(out)) return(as.character(out))
+  }
+  cn <- colnames(as.matrix(Y))
+  if (!is.null(cn)) return(cn)
+  character(0)
+}
+
+make_handlers <- function(service, state) {
+  transform_like <- function(fn) {
+    function(doc) {
+      parsed <- extract_matrix(doc)
+      Y <- fn(state$model, parsed$X)
+      format_response(Y, parsed$kind, model_names(state$model, Y))
+    }
+  }
+  handlers <- new.env(parent = emptyenv())
+  if (service == "MODEL") {
+    handlers[["/predict"]] <- transform_like(function(m, X) predict(m, X))
+    handlers[["/send-feedback"]] <- function(doc) "{}"
+  } else if (service == "ROUTER") {
+    handlers[["/route"]] <- function(doc) {
+      parsed <- extract_matrix(doc)
+      branch <- route(state$model, parsed$X)
+      format_response(matrix(as.numeric(branch), 1, 1), parsed$kind,
+                      character(0))
+    }
+    handlers[["/send-feedback"]] <- function(doc) {
+      reward <- if (is.null(doc[["reward"]])) 0 else as.numeric(doc[["reward"]])
+      request <- extract_matrix(doc[["request"]])
+      truth <- if (!is.null(doc[["truth"]])) extract_matrix(doc[["truth"]])$X
+               else NULL
+      updated <- send_feedback(state$model, request$X, reward, truth)
+      if (!is.null(updated)) state$model <- updated
+      persist_maybe(state)
+      "{}"
+    }
+  } else if (service == "TRANSFORMER") {
+    handlers[["/transform-input"]] <- transform_like(
+      function(m, X) transform_input(m, X))
+    handlers[["/transform-output"]] <- transform_like(
+      function(m, X) transform_output(m, X))
+  } else if (service == "COMBINER") {
+    handlers[["/aggregate"]] <- function(doc) {
+      # SeldonMessageList {seldonMessages: [...]} -> list of matrices
+      msgs <- doc[["seldonMessages"]]
+      if (is.null(msgs)) stop("seldonMessages field is missing")
+      parsed <- lapply(msgs, extract_matrix)
+      Y <- aggregate(state$model, lapply(parsed, function(p) p$X))
+      format_response(Y, parsed[[1]]$kind, model_names(state$model, Y))
+    }
+  } else {
+    stop(sprintf("unknown service type [%s]", service))
+  }
+  handlers
+}
+
+persist_maybe <- function(state) {
+  if (state$persistence) saveRDS(state$model, state$snapshot)
+}
+
+# -- main --------------------------------------------------------------------
+
+run_microservice <- function(argv = commandArgs(trailingOnly = TRUE)) {
+  args <- parse_args(argv)
+  if (args$api != "REST") {
+    cat(sprintf("Invalid API type [%s]\n", args$api)); quit(status = 1)
+  }
+  if (is.null(args$model) || !file.exists(args$model)) {
+    cat(sprintf("Model file does not exist [%s]\n", args$model))
+    quit(status = 1)
+  }
+  raw_params <- args$parameters
+  if (is.null(raw_params)) raw_params <- Sys.getenv("PREDICTIVE_UNIT_PARAMETERS")
+  params <- typed_parameters(raw_params)
+
+  sys.source(args$model, envir = globalenv())
+  if (!exists("initialise_seldon", mode = "function")) {
+    cat("model file must define initialise_seldon(params)\n"); quit(status = 1)
+  }
+
+  state <- new.env(parent = emptyenv())
+  state$persistence <- isTRUE(args$persistence == 1L)
+  state$snapshot <- sprintf(
+    "seldon-r-%s.rds", Sys.getenv("PREDICTIVE_UNIT_ID", "model"))
+  if (state$persistence && file.exists(state$snapshot)) {
+    state$model <- readRDS(state$snapshot)   # restore-on-boot
+  } else {
+    state$model <- initialise_seldon(params)
+  }
+
+  handlers <- make_handlers(args$service, state)
+  port <- as.integer(Sys.getenv("PREDICTIVE_UNIT_SERVICE_PORT", "5000"))
+  srv <- serverSocket(port)
+  cat(sprintf("R microservice: service=%s port=%d\n", args$service, port))
+
+  repeat {
+    con <- socketAccept(srv, blocking = TRUE, open = "r+b")
+    tryCatch({
+      req <- read_request(con)
+      if (is.null(req)) { close(con); next }
+      if (req$path == "/ping") {
+        respond(con, 200L, "pong", "text/plain")
+      } else if (req$path %in% c("/ready", "/health")) {
+        respond(con, 200L, "ready", "text/plain")
+      } else {
+        handler <- handlers[[req$path]]
+        if (is.null(handler)) {
+          respond(con, 404L, failure_response("not found", 404L))
+        } else {
+          result <- tryCatch(
+            list(ok = TRUE, body = handler(json_parse(payload_json(req)))),
+            error = function(e) list(ok = FALSE, body = failure_response(
+              conditionMessage(e)))
+          )
+          respond(con, if (result$ok) 200L else 400L, result$body)
+        }
+      }
+    }, error = function(e) {
+      cat(sprintf("request error: %s\n", conditionMessage(e)))
+    }, finally = tryCatch(close(con), error = function(e) NULL))
+  }
+}
+
+if (sys.nframe() == 0L || identical(environment(), globalenv())) {
+  if (!interactive()) run_microservice()
+}
