@@ -1416,7 +1416,10 @@ void h2_handle_message(Plane* pl, int ci, uint32_t sid,
   Conn& c = *pl->conns[ci];
   H2State& h = *c.h2s;
   want_flush = true;
-  if (path == "/seldon.protos.Seldon/Predict") {
+  // Model alias == Seldon service: an engine composes as a MODEL leaf of
+  // a larger cross-process graph (grpc_server.make_engine_grpc_server)
+  if (path == "/seldon.protos.Seldon/Predict" ||
+      path == "/seldon.protos.Model/Predict") {
     PwTensor t;
     if (pw_parse_request(msg, mlen, t)) {
       long long rows = t.shape.size() >= 2 ? t.shape[0] : 1;

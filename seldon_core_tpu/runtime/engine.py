@@ -329,7 +329,6 @@ class EngineService:
             # serve() — reconcile-time validation can only check integer
             # syntax, not width compatibility.  Prewarm is an optimization;
             # a rejected width is logged and skipped.
-            rejected = False
             for b in sizes:
                 x = _np.zeros((b,) + shape, dtype=_np.float64)
                 try:
@@ -340,12 +339,9 @@ class EngineService:
                         "%d (%s: %s); skipping this width",
                         shape, b, type(e).__name__, e,
                     )
-                    rejected = True
                     break
                 self._known_good_widths.add(x.shape[1:])
                 compiled += 1
-            if rejected:
-                continue
         return compiled
 
     async def _submit(self, rows):
@@ -530,8 +526,10 @@ class EngineService:
             if parsed is not None:
                 puid, rows = parsed
                 puid = puid or new_puid()
+                # method=GRPC: the gRPC surface records its own metric
+                # children (native h2 lane matches — nativeplane merge)
                 with self.metrics.time_server(
-                    "predictions", "POST"
+                    "predictions", "GRPC"
                 ) as code, self.tracer.span(
                     puid, "request", kind="request", method="predict",
                     mode=self.mode,
@@ -589,7 +587,7 @@ class EngineService:
                     rows = rows.reshape(1, -1)
                 puid = req.meta.puid or new_puid()
                 with self.metrics.time_server(
-                    "predictions", "POST"
+                    "predictions", "GRPC"
                 ) as code, self.tracer.span(
                     puid, "request", kind="request", method="predict",
                     mode=self.mode,

@@ -103,6 +103,28 @@ def make_engine_grpc_server(engine, host: str, port: int) -> grpc.aio.Server:
                     "SendFeedback": _unary(send_feedback, pb.Feedback),
                 },
             ),
+            # Node-service aliases: an engine IS a model from a parent
+            # graph's perspective, so engines compose as MODEL leaves of
+            # larger cross-process graphs.  The parent's node client dials
+            # Model/Predict, and feedback arrives as Router/SendFeedback
+            # (typed nodes) or Generic/SendFeedback (untyped) —
+            # runtime/client.py GrpcNodeRuntime:198-209
+            grpc.method_handlers_generic_handler(
+                "seldon.protos.Model",
+                {
+                    "Predict": grpc.unary_unary_rpc_method_handler(
+                        predict_wire
+                    ),
+                },
+            ),
+            grpc.method_handlers_generic_handler(
+                "seldon.protos.Router",
+                {"SendFeedback": _unary(send_feedback, pb.Feedback)},
+            ),
+            grpc.method_handlers_generic_handler(
+                "seldon.protos.Generic",
+                {"SendFeedback": _unary(send_feedback, pb.Feedback)},
+            ),
         )
     )
     server.add_insecure_port(f"{host}:{port}")

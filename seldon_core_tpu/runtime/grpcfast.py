@@ -501,6 +501,13 @@ class FastGrpcServer:
         return cls({
             b"/seldon.protos.Seldon/Predict": predict,
             b"/seldon.protos.Seldon/SendFeedback": send_feedback,
+            # node-service aliases: engines compose as MODEL leaves of
+            # larger cross-process graphs; feedback arrives on the Router/
+            # Generic services (grpc_server.make_engine_grpc_server,
+            # runtime/client.py GrpcNodeRuntime:198-209)
+            b"/seldon.protos.Model/Predict": predict,
+            b"/seldon.protos.Router/SendFeedback": send_feedback,
+            b"/seldon.protos.Generic/SendFeedback": send_feedback,
         })
 
     async def start(self, host: str, port: int) -> None:
