@@ -97,6 +97,7 @@ class _EngineRoutes:
             b"/api/v0.1/predictions": self._predictions,
             b"/api/v0.1/feedback": self._feedback,
             b"/api/v0.1/generate/stream": self._generate_stream,
+            b"/api/v0.1/events": self._events,
         }
         self.get: Dict[bytes, Handler] = {
             b"/ping": self._ping,
@@ -107,7 +108,13 @@ class _EngineRoutes:
             b"/trace": self._trace,
             b"/trace/enable": self._trace_enable,
             b"/trace/disable": self._trace_disable,
+            b"/api/v0.1/events": self._events,
         }
+
+    async def _events(self, body, ctype, query) -> Result:
+        # stubbed external surface, reference-exact
+        # (engine RestClientController.java:177-180)
+        return 200, b"Not Implemented", "text/plain"
 
     async def _predictions(self, body, ctype, query) -> Result:
         try:

@@ -164,9 +164,16 @@ def make_engine_app(engine: EngineService) -> web.Application:
         await resp.write_eof()
         return resp
 
+    async def events(_):
+        # documented external surface, stubbed exactly like the reference
+        # (engine RestClientController.java:177-180 returns "Not
+        # Implemented" with 200 on any method)
+        return web.Response(text="Not Implemented")
+
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
     app.router.add_post("/api/v0.1/generate/stream", generate_stream)
+    app.router.add_route("*", "/api/v0.1/events", events)
     app.router.add_get("/ping", ping)
     app.router.add_get("/ready", ready)
     app.router.add_get("/pause", pause)

@@ -89,6 +89,14 @@ def test_engine_rest_predict_roundtrip():
                     async with s.get(f"http://127.0.0.1:{port}{path}") as r:
                         assert r.status == expect, path
 
+                # events stub, reference-exact
+                # (engine RestClientController.java:177-180)
+                async with s.get(
+                    f"http://127.0.0.1:{port}/api/v0.1/events"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.text() == "Not Implemented"
+
                 # prometheus exposition carries reference metric families
                 async with s.get(f"http://127.0.0.1:{port}/prometheus") as r:
                     text = await r.text()
