@@ -43,11 +43,15 @@ __all__ = ["init_cache", "prefill", "decode_step", "generate",
 
 
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
+    # K/V stored at the GROUPED head count (cfg.kv_heads): with GQA the
+    # cache — the HBM stream every decode step pays for — shrinks by
+    # n_heads/n_kv_heads
     hd = cfg.d_model // cfg.n_heads
+    kv = cfg.kv_heads
     return {
         f"l{i}": {
-            "k": jnp.zeros((batch, cfg.n_heads, max_len, hd), cfg.dtype),
-            "v": jnp.zeros((batch, cfg.n_heads, max_len, hd), cfg.dtype),
+            "k": jnp.zeros((batch, kv, max_len, hd), cfg.dtype),
+            "v": jnp.zeros((batch, kv, max_len, hd), cfg.dtype),
         }
         for i in range(cfg.n_layers)
     }
@@ -67,30 +71,59 @@ def sanitize_prompt(X, vocab: int):
     return jnp.clip(jnp.nan_to_num(X), 0, vocab - 1).astype(jnp.int32)
 
 
+def _grouped_qk(q, cache_k):
+    """q [B,H,S,hd] x cache_k [B,KV,L,hd] -> scores [B,KV,g,S,L] f32.
+
+    The group axis folds into the dot_general row axis so K streams from
+    HBM once at its stored (grouped) size — decode is HBM-bound on exactly
+    this stream, and with GQA it is n_heads/n_kv_heads smaller.  Reads use
+    the stored dtype (bf16) with f32 accumulation via
+    ``preferred_element_type``; an explicit .astype(f32) would materialise
+    a second, twice-as-large copy of the cache every step."""
+    B, H, S, hd = q.shape
+    KV, L = cache_k.shape[1], cache_k.shape[2]
+    g = H // KV
+    scale = jnp.float32(1.0 / (hd ** 0.5))
+    s = jax.lax.dot_general(
+        q.reshape(B, KV, g * S, hd), cache_k,
+        (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    return s.reshape(B, KV, g, S, L)
+
+
+def _grouped_pv(p, cache_v, out_shape):
+    """p [B,KV,g,S,L] x cache_v [B,KV,L,hd] -> [B,H,S,hd] (stored dtype)."""
+    B, KV, g, S, L = p.shape
+    out = jax.lax.dot_general(
+        p.astype(cache_v.dtype).reshape(B, KV, g * S, L), cache_v,
+        (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ).astype(cache_v.dtype)
+    return out.reshape(out_shape)
+
+
 def _attend_cached(q, cache_k, cache_v, n_valid):
-    """q [B,H,1,hd] against the cache; positions >= n_valid (scalar) masked."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   cache_k.astype(jnp.float32)) * scale
-    valid = jnp.arange(cache_k.shape[2]) < n_valid  # [max_len]
-    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    """q [B,H,1,hd] against the (possibly grouped) cache; positions >=
+    n_valid (scalar) masked."""
+    s = _grouped_qk(q, cache_k)  # [B,KV,g,1,L]
+    valid = jnp.arange(cache_k.shape[2]) < n_valid  # [L]
+    s = jnp.where(valid[None, None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cache_v.dtype), cache_v)
+    return _grouped_pv(p, cache_v, q.shape)
 
 
 def _attend_cached_causal(q, cache_k, cache_v, start):
     """q [B,H,S,hd] for global positions start..start+S-1 over the cache:
     query i may see cache positions <= start + i (speculative segments)."""
     S = q.shape[2]
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   cache_k.astype(jnp.float32)) * scale
+    s = _grouped_qk(q, cache_k)  # [B,KV,g,S,L]
     qpos = start + jnp.arange(S)[:, None]
     kpos = jnp.arange(cache_k.shape[2])[None, :]
-    mask = kpos <= qpos  # [S, max_len]
-    s = jnp.where(mask[None, None, :, :], s, -1e30)
+    mask = kpos <= qpos  # [S, L]
+    s = jnp.where(mask[None, None, None, :, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(cache_v.dtype), cache_v)
+    return _grouped_pv(p, cache_v, q.shape)
 
 
 def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
@@ -104,10 +137,13 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
 
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
+    kv_h = cfg.kv_heads
     h = _rmsnorm(x, lp["ln1"])
     qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q, k, v = (_heads(t, B, S, cfg.n_heads, hd) for t in (q, k, v))
+    q, k, v = jnp.split(qkv, [D, D + kv_h * hd], axis=-1)
+    q = _heads(q, B, S, cfg.n_heads, hd)
+    k = _heads(k, B, S, kv_h, hd)
+    v = _heads(v, B, S, kv_h, hd)
     cache_k = jax.lax.dynamic_update_slice(
         cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, 0, start, 0)
     )
@@ -313,7 +349,8 @@ class TransformerGenerator(Unit):
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  dtype: str = "bfloat16", moe_every: int = 0,
                  n_experts: int = 8, moe_k: int = 2, mesh=None,
-                 quant: str = "none", attention: str = "auto"):
+                 quant: str = "none", attention: str = "auto",
+                 n_kv_heads: int = 0):
         # mesh (from the binding's mesh_axes, e.g. {"tp": 4}): params are
         # laid out with the LM's tp shardings and GSPMD partitions the
         # whole prefill+decode program across the mesh — one generator
@@ -325,6 +362,7 @@ class TransformerGenerator(Unit):
             dtype=jnp.dtype(dtype).type,
             moe_every=int(moe_every), n_experts=int(n_experts),
             moe_k=int(moe_k), quant=str(quant),
+            n_kv_heads=int(n_kv_heads),
         )
         from seldon_core_tpu.models.transformer import resolve_flash
 

@@ -55,6 +55,12 @@ class LMConfig:
     n_heads: int = 4
     n_layers: int = 2
     d_ff: int = 512
+    # grouped-query attention (LLaMA-2/Mistral-style): n_kv_heads < n_heads
+    # shares each K/V head across n_heads/n_kv_heads query heads.  On TPU
+    # this is a SERVING lever first: the KV cache shrinks by the group
+    # factor, and cached decode is HBM-bound on exactly that stream.
+    # 0 = multi-head attention (n_kv_heads == n_heads).
+    n_kv_heads: int = 0
     dtype: Any = jnp.bfloat16
     # MoE: every ``moe_every``-th block (1-indexed) swaps its dense FFN for
     # a mixture of ``n_experts`` experts, top-``moe_k`` routed, sharded over
@@ -82,6 +88,16 @@ class LMConfig:
             raise ValueError(
                 f"quant={self.quant!r} not supported (none | int8)"
             )
+        kv = self.kv_heads
+        if self.n_heads % kv != 0:
+            raise ValueError(
+                f"n_heads={self.n_heads} not divisible by "
+                f"n_kv_heads={kv}"
+            )
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 def _rmsnorm(x, w, eps=1e-6):
@@ -102,11 +118,13 @@ def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
     params: Dict[str, Any] = {
         "embed": dense(keys[0], (cfg.vocab, cfg.d_model), cfg.d_model),
     }
+    hd = cfg.d_model // cfg.n_heads
+    qkv_out = cfg.d_model + 2 * cfg.kv_heads * hd  # q | k | v segments
     for i in range(cfg.n_layers):
         k = keys[1 + 4 * i : 1 + 4 * (i + 1)]
         lp = {
             "ln1": jnp.ones((cfg.d_model,), dt),
-            "wqkv": dense(k[0], (cfg.d_model, 3 * cfg.d_model), cfg.d_model),
+            "wqkv": dense(k[0], (cfg.d_model, qkv_out), cfg.d_model),
             "wo": dense(k[1], (cfg.d_model, cfg.d_model), cfg.d_model),
             "ln2": jnp.ones((cfg.d_model,), dt),
         }
@@ -160,13 +178,60 @@ def param_shardings(mesh: Mesh, params) -> Any:
     return jax.tree_util.tree_unflatten(treedef, shardings)
 
 
+def gqa_attention(q, k, v, causal: bool):
+    """Grouped-query attention without materialising repeated K/V.
+
+    q [B, H, S, hd]; k/v [B, KV, S_k, hd] with H = KV * g.  The group axis
+    rides the dot_general batch dims, so K/V stream from HBM ONCE at their
+    stored (grouped) size — an explicit head-repeat would rebuild the full
+    MHA-sized tensors and erase GQA's bandwidth win."""
+    B, H, S, hd = q.shape
+    KV = k.shape[1]
+    g = H // KV
+    qg = q.reshape(B, KV, g * S, hd)  # group heads fold into the row axis
+    scale = jnp.float32(1.0 / (hd ** 0.5))
+    s = jax.lax.dot_general(
+        qg, k, (((3,), (3,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [B, KV, g*S, S_k]
+    s = s.reshape(B, KV, g, S, k.shape[2])
+    if causal:
+        qpos = jnp.arange(S)[:, None]
+        kpos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where((qpos >= kpos)[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    out = jax.lax.dot_general(
+        p.reshape(B, KV, g * S, k.shape[2]), v,
+        (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)  # [B, KV, g*S, hd]
+    return out.reshape(B, H, S, hd)
+
+
 def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
                use_flash: bool = False):
-    """[B, H, S, hd] -> [B, H, S, hd]; ring over sp when the mesh shards S.
+    """q [B, H, S, hd], k/v [B, KV, S, hd] -> [B, H, S, hd]; ring over sp
+    when the mesh shards S.
 
     ``use_flash`` opts the single-chip path into the Pallas flash kernel
     (differentiable — custom flash VJP); constraint violations fall back
-    to the plain XLA path silently."""
+    to the plain XLA path silently.  Grouped K/V (KV < H) takes the GQA
+    formulation; the ring path requires full MHA heads."""
+    if k.shape[1] != q.shape[1]:
+        if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+            raise ValueError(
+                "sequence-parallel ring attention requires "
+                "n_kv_heads == n_heads"
+            )
+        if use_flash and (mesh is None or mesh.size == 1):
+            # the flash kernel is GQA-native (grouped K/V block indexing)
+            from seldon_core_tpu.ops.flash_attention import flash_attention
+
+            try:
+                return flash_attention(q, k, v, causal=causal)
+            except ValueError:
+                pass  # shape constraints unmet -> grouped XLA path
+        return gqa_attention(q, k, v, causal)
     if use_flash and (mesh is None or mesh.size == 1):
         # single-chip only: pallas_call is not auto-partitionable under
         # GSPMD, so any multi-device mesh (tp/dp/sp) keeps the XLA path
@@ -209,14 +274,18 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
 
     B, S, D = x.shape
     hd = cfg.d_model // cfg.n_heads
+    kv = cfg.kv_heads
     h = _rmsnorm(x, lp["ln1"])
-    qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)  # [B,S,3D]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)  # [B,S,D+2*kv*hd]
+    q, k, v = jnp.split(qkv, [D, D + kv * hd], axis=-1)
 
-    def heads(t):
-        return t.reshape(B, S, cfg.n_heads, hd).transpose(0, 2, 1, 3)
+    def heads(t, n):
+        return t.reshape(B, S, n, hd).transpose(0, 2, 1, 3)
 
-    a = _attention(heads(q), heads(k), heads(v), mesh, causal, use_flash)
+    a = _attention(
+        heads(q, cfg.n_heads), heads(k, kv), heads(v, kv),
+        mesh, causal, use_flash,
+    )
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
     h = _rmsnorm(x, lp["ln2"])
@@ -430,6 +499,7 @@ class TransformerLM(Unit):
         moe_k: int = 2,
         quant: str = "none",
         attention: str = "auto",
+        n_kv_heads: int = 0,
     ):
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
@@ -437,6 +507,7 @@ class TransformerLM(Unit):
             dtype=jnp.dtype(dtype).type,
             moe_every=int(moe_every), n_experts=int(n_experts),
             moe_k=int(moe_k), quant=str(quant),
+            n_kv_heads=int(n_kv_heads),
         )
         self.seed = int(seed)
         self.mesh = mesh

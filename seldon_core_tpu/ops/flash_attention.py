@@ -102,11 +102,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
 
 
 def _validate(q, k, v):
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
-    if q.ndim != 4:
-        raise ValueError(f"expected [B, H, S, D], got {q.shape}")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shapes differ: {k.shape} {v.shape}")
+    if q.ndim != 4 or k.ndim != 4:
+        raise ValueError(f"expected [B, H, S, D], got {q.shape} {k.shape}")
     B, H, S, D = q.shape
+    KV = k.shape[1]
+    if k.shape[0] != B or k.shape[2] != S or k.shape[3] != D:
+        raise ValueError(f"q/k shapes differ: {q.shape} {k.shape}")
+    if KV == 0 or H % KV != 0:
+        raise ValueError(f"query heads {H} not a multiple of kv heads {KV}")
     if S % _BLOCK != 0:
         raise ValueError(f"seq len {S} not divisible by {_BLOCK}")
     if D > 256:
@@ -115,21 +120,29 @@ def _validate(q, k, v):
 
 
 def _fwd_impl(q, k, v, causal: bool, interpret: bool):
-    """Returns (out [B,H,S,D], lse [B*H,S,1] f32)."""
+    """Returns (out [B,H,S,D], lse [B*H,S,1] f32).
+
+    Grouped K/V (GQA, k/v [B, KV, S, D] with KV < H) is native: the K/V
+    BlockSpec index maps fold the query-head -> kv-head mapping, so K/V
+    stream from HBM at their stored (grouped) size — no head repeat."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, S, D = _validate(q, k, v)
+    KV = k.shape[1]
+    g = H // KV
     n_k = S // _BLOCK
     scale = float(1.0 / (D ** 0.5))
-
-    def merge(t):
-        return t.reshape(B * H, S, D)
 
     grid = (B * H, S // _BLOCK, n_k)
     blk = lambda idx: pl.BlockSpec(  # noqa: E731
         (1, _BLOCK, D), idx, memory_space=pltpu.VMEM
     )
+
+    def kv_index(b):
+        # merged q row b = bi * H + h; its kv row = bi * KV + h // g
+        return (b // H) * KV + (b % H) // g
+
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel, causal=causal, scale=scale, n_k=n_k
@@ -141,8 +154,8 @@ def _fwd_impl(q, k, v, causal: bool, interpret: bool):
         grid=grid,
         in_specs=[
             blk(lambda b, i, j: (b, i, 0)),   # Q: follows the q-block axis
-            blk(lambda b, i, j: (b, j, 0)),   # K: follows the k-block axis
-            blk(lambda b, i, j: (b, j, 0)),   # V
+            blk(lambda b, i, j: (kv_index(b), j, 0)),   # K (grouped)
+            blk(lambda b, i, j: (kv_index(b), j, 0)),   # V
         ],
         out_specs=(
             blk(lambda b, i, j: (b, i, 0)),
@@ -155,7 +168,8 @@ def _fwd_impl(q, k, v, causal: bool, interpret: bool):
             pltpu.VMEM((_BLOCK, D), jnp.float32),       # acc
         ],
         interpret=interpret,
-    )(merge(q), merge(k), merge(v))
+    )(q.reshape(B * H, S, D), k.reshape(B * KV, S, D),
+      v.reshape(B * KV, S, D))
     return out.reshape(B, H, S, D), lse
 
 
@@ -333,11 +347,12 @@ def flash_attention(
     causal: bool = True,
     interpret: bool = False,
 ) -> jax.Array:
-    """[B, H, S, D] q/k/v -> [B, H, S, D] attention output.
+    """q [B, H, S, D], k/v [B, KV, S, D] (KV divides H; KV < H = grouped-
+    query attention) -> [B, H, S, D] attention output.
 
-    Differentiable (custom flash VJP).  Constraints (ValueError otherwise,
-    caller falls back to XLA): S divisible by 128, D <= 256, q/k/v same
-    shape."""
+    Differentiable (custom flash VJP; the GQA backward group-sums the
+    repeated-head dK/dV).  Constraints (ValueError otherwise, caller falls
+    back to XLA): S divisible by 128, D <= 256, H a multiple of KV."""
     out, _ = _fwd_impl(q, k, v, causal, interpret)
     return out
 
@@ -349,7 +364,21 @@ def _flash_fwd(q, k, v, causal, interpret):
 
 def _flash_bwd(causal, interpret, res, do):
     q, k, v, o, lse = res
-    return _bwd_impl(q, k, v, o, lse, do, causal, interpret)
+    H, KV = q.shape[1], k.shape[1]
+    if KV == H:
+        return _bwd_impl(q, k, v, o, lse, do, causal, interpret)
+    # GQA backward: run the MHA kernels over head-repeated K/V, then sum
+    # each group's dK/dV (the adjoint of the head-share).  Training-only
+    # cost — the forward serving path never materialises repeated K/V.
+    g = H // KV
+    krep = jnp.repeat(k, g, axis=1)
+    vrep = jnp.repeat(v, g, axis=1)
+    dq, dk_rep, dv_rep = _bwd_impl(q, krep, vrep, o, lse, do, causal,
+                                   interpret)
+    B, _, S, D = k.shape
+    dk = dk_rep.reshape(B, KV, g, S, D).sum(axis=2).astype(k.dtype)
+    dv = dv_rep.reshape(B, KV, g, S, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,107 @@
+"""Grouped-query attention: cache shapes shrink by the group factor, the
+GQA formulation matches head-repeated MHA numerics exactly, generation and
+training run end-to-end, and invalid head configs fail at config time."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.models.generate import (
+    TransformerGenerator,
+    generate,
+    init_cache,
+)
+from seldon_core_tpu.models.transformer import (
+    LMConfig,
+    gqa_attention,
+    lm_apply,
+    lm_init,
+    lm_train_step,
+)
+
+
+def test_gqa_matches_repeated_mha_numerics():
+    """gqa_attention == plain attention with K/V heads explicitly
+    repeated — the formulation only changes the dataflow, not the math."""
+    rng = np.random.default_rng(0)
+    B, H, KV, S, hd = 2, 8, 2, 16, 32
+    q = jnp.asarray(rng.normal(size=(B, H, S, hd)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, KV, S, hd)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, KV, S, hd)), jnp.float32)
+    got = np.asarray(gqa_attention(q, k, v, causal=True))
+
+    krep = jnp.repeat(k, H // KV, axis=1)
+    vrep = jnp.repeat(v, H // KV, axis=1)
+    scale = 1.0 / np.sqrt(hd)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, krep) * scale
+    qpos = jnp.arange(S)[:, None]
+    kpos = jnp.arange(S)[None, :]
+    s = jnp.where(qpos >= kpos, s, -1e30)
+    want = np.asarray(jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), vrep
+    ))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_cache_shrinks_by_group_factor():
+    cfg_mha = LMConfig(vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128)
+    cfg_gqa = LMConfig(vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+                       n_kv_heads=2)
+    c_mha = init_cache(cfg_mha, batch=4, max_len=32)
+    c_gqa = init_cache(cfg_gqa, batch=4, max_len=32)
+    assert c_mha["l0"]["k"].shape == (4, 8, 32, 8)
+    assert c_gqa["l0"]["k"].shape == (4, 2, 32, 8)
+    # wqkv output shrinks too: q (64) + k/v (2 heads x 8 dim each)
+    p = lm_init(jax.random.key(0), cfg_gqa)
+    assert p["l0"]["wqkv"].shape == (64, 64 + 2 * 2 * 8)
+
+
+def test_gqa_generate_prefill_decode_consistency():
+    """generate() (prefill + cached decode scan) must agree with teacher
+    forcing through lm_apply: greedy tokens re-fed through the full forward
+    reproduce the same argmax chain."""
+    cfg = LMConfig(vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+                   n_kv_heads=2, dtype=jnp.float32)
+    params = lm_init(jax.random.key(0), cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, 64, size=(2, 8)), jnp.int32
+    )
+    toks = np.asarray(generate(params, prompt, cfg, max_new_tokens=6))
+    full = np.asarray(prompt)
+    for i in range(6):
+        logits = np.asarray(lm_apply(params, jnp.asarray(full), cfg))
+        nxt = logits[:, -1, :].argmax(-1)
+        np.testing.assert_array_equal(nxt, toks[:, i])
+        full = np.concatenate([full, nxt[:, None].astype(np.int32)], axis=1)
+
+
+def test_gqa_unit_serves_and_int8_composes():
+    gen = TransformerGenerator(vocab=64, d_model=64, n_heads=8, n_kv_heads=2,
+                               n_layers=2, d_ff=128, max_new_tokens=8,
+                               dtype="float32", quant="int8")
+    state = gen.init_state(jax.random.key(0))
+    y = np.asarray(gen.predict(state, jnp.zeros((2, 4), jnp.float32)))
+    assert y.shape == (2, 8)
+    assert ((y >= 0) & (y < 64)).all()
+
+
+def test_gqa_trains():
+    import optax
+
+    cfg = LMConfig(vocab=64, d_model=64, n_heads=8, n_layers=2, d_ff=128,
+                   n_kv_heads=4, dtype=jnp.float32)
+    params = lm_init(jax.random.key(0), cfg)
+    opt = optax.adam(1e-3)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(4, 17)), jnp.int32
+    )}
+    params, _, loss = lm_train_step(
+        params, opt.init(params), batch, opt, cfg, use_flash=False
+    )
+    assert np.isfinite(float(loss))
+
+
+def test_gqa_invalid_heads_rejected():
+    with pytest.raises(ValueError, match="not divisible"):
+        LMConfig(n_heads=4, n_kv_heads=3)
