@@ -286,13 +286,17 @@ def _probe_mfu_main(smoke: bool) -> None:
     if smoke:
         cfg = LMConfig(vocab=1024, d_model=256, n_heads=8, n_layers=2,
                        d_ff=1024)
-        B, S, NEW = 4, 128, 16
+        B, B_MAX, S, NEW = 4, 8, 128, 16
         flash_Ss = [256]
         n_prefill, n_flash = 2, 2
     else:
+        # flagship serving LM: GQA-4 (n_kv_heads=4) — the modern
+        # architecture choice AND the decode lever (the KV cache, the HBM
+        # stream every cached step pays for, shrinks by the group factor;
+        # measured +~60% decode tok/s at B=32 vs MHA on v5e)
         cfg = LMConfig(vocab=32768, d_model=1024, n_heads=16, n_layers=12,
-                       d_ff=4096)
-        B, S, NEW = 32, 512, 64
+                       d_ff=4096, n_kv_heads=4)
+        B, B_MAX, S, NEW = 32, 256, 512, 64
         flash_Ss = [2048, 8192]
         n_prefill, n_flash = 8, 3
 
@@ -300,9 +304,11 @@ def _probe_mfu_main(smoke: bool) -> None:
     n_params = sum(
         int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
     )
-    # matmul'd params (embed gather is not a matmul; tied unembed is)
+    # matmul'd params (embed gather is not a matmul; tied unembed is);
+    # GQA shrinks the qkv projection to d + 2*kv*hd output columns
     d, ff, v, L = cfg.d_model, cfg.d_ff, cfg.vocab, cfg.n_layers
-    matmul_per_tok = L * 2 * (d * 3 * d + d * d + 2 * d * ff) + 2 * d * v
+    qkv_out = d + 2 * cfg.kv_heads * (d // cfg.n_heads)
+    matmul_per_tok = L * 2 * (d * qkv_out + d * d + 2 * d * ff) + 2 * d * v
     device = jax.devices()[0]
     peak_tflops, peak_assumed = _chip_peak_tflops(
         getattr(device, "device_kind", str(device))
@@ -347,11 +353,12 @@ def _probe_mfu_main(smoke: bool) -> None:
     prefill_mfu = prefill_flops / t_prefill / peak
 
     # ---- decode: one scan over NEW cached steps ---------------------------
-    def decode_measure(ps, qcfg):
-        cache = init_cache(qcfg, B, total_len)
+    def decode_measure(ps, qcfg, b):
+        btoks = toks0[:1].repeat(b, axis=0) if b != B else toks0
+        cache = init_cache(qcfg, b, total_len)
         logits, cache = jax.jit(
             lambda p, t, c: prefill(p, t, c, qcfg, use_flash=True)
-        )(ps, toks0, cache)
+        )(ps, btoks, cache)
         first = jnp.argmax(logits, -1).astype(jnp.int32)
         carry = (first, cache, jnp.int32(S), jax.random.key(0))
         step = jax.jit(
@@ -365,19 +372,23 @@ def _probe_mfu_main(smoke: bool) -> None:
         raw = time.perf_counter() - t0
         return max(raw - relay_s, 0.05 * raw) / NEW
 
-    t_step = decode_measure(params, cfg)
+    t_step = decode_measure(params, cfg, B)
     decode_tok_s = B / t_step
+    # throughput-optimal batch: per-step fixed costs amortize with B (the
+    # serving engine's continuous batcher runs exactly this regime)
+    t_step_max = decode_measure(params, cfg, B_MAX)
+    decode_tok_s_maxb = B_MAX / t_step_max
     # per decode step: every matmul'd weight streams once; attention reads
     # the whole preallocated cache (masked) — that compute happens, count it
     decode_flops = B * matmul_per_tok + L * 4 * B * total_len * d
     decode_mfu = decode_flops / t_step / peak
 
     # ---- int8 serving path ------------------------------------------------
-    cfg_q = LMConfig(vocab=cfg.vocab, d_model=cfg.d_model,
-                     n_heads=cfg.n_heads, n_layers=cfg.n_layers,
-                     d_ff=cfg.d_ff, quant="int8")
+    import dataclasses
+
+    cfg_q = dataclasses.replace(cfg, quant="int8")
     qparams = quantize_lm_params(params)
-    t_step_q = decode_measure(qparams, cfg_q)
+    t_step_q = decode_measure(qparams, cfg_q, B)
     decode_tok_s_q = B / t_step_q
 
     # ---- end-to-end generate (the TransformerGenerator.predict body):
@@ -427,7 +438,7 @@ def _probe_mfu_main(smoke: bool) -> None:
         "model_params_m": round(n_params / 1e6, 1),
         "lm_config": (
             f"d{cfg.d_model} L{cfg.n_layers} H{cfg.n_heads} "
-            f"ff{cfg.d_ff} v{cfg.vocab} bf16"
+            f"kv{cfg.kv_heads} ff{cfg.d_ff} v{cfg.vocab} bf16"
         ),
         "lm_batch": B,
         "lm_prompt_len": S,
@@ -436,6 +447,8 @@ def _probe_mfu_main(smoke: bool) -> None:
         "prefill_mfu_pct": round(100 * prefill_mfu, 2),
         "decode_tok_s": round(decode_tok_s, 1),
         "decode_mfu_pct": round(100 * decode_mfu, 2),
+        "decode_tok_s_maxbatch": round(decode_tok_s_maxb, 1),
+        "decode_maxbatch": B_MAX,
         "mfu_pct": round(100 * prefill_mfu, 2),
         "decode_tok_s_int8": round(decode_tok_s_q, 1),
         "int8_vs_bf16_x": round(t_step / t_step_q, 2),
@@ -566,7 +579,8 @@ def gen_lm_deployment(smoke: bool, quant: str = "none") -> dict:
                 "d_ff": 1024, "max_new_tokens": 16}
     else:
         dims = {"vocab": 32768, "d_model": 1024, "n_heads": 16,
-                "n_layers": 12, "d_ff": 4096, "max_new_tokens": 64}
+                "n_kv_heads": 4, "n_layers": 12, "d_ff": 4096,
+                "max_new_tokens": 64}
     parameters = [
         {"name": k, "value": str(val), "type": "INT"}
         for k, val in dims.items()
@@ -697,6 +711,26 @@ def main() -> None:
     )
 
     # ---- real model: MNIST MLP ------------------------------------------
+    # plus two attribution controls that isolate the stub-vs-mnist gap:
+    #   names removed (bare 784-double payload, SAME TPU engine)
+    #   relay removed (CPU-pinned engine, names payload)
+    # Measured: all configs land within ~5%, so the gap is per-request
+    # payload BYTES (784 doubles through client-compose + loopback + parse
+    # on the one shared host core) — not names parsing (the C++ lane
+    # fast-paths names-bearing contract payloads) and not the relay.
+    bare_contract = tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    )
+    json.dump(
+        {"features": [{"name": "x", "dtype": "FLOAT",
+                       "ftype": "continuous", "range": [0, 1],
+                       "repeat": 784}],
+         "targets": [{"name": "class", "dtype": "FLOAT",
+                      "ftype": "continuous", "range": [0, 1],
+                      "repeat": 10}]},
+        bare_contract,
+    )
+    bare_contract.flush()
     mnist_cfgs = [256] + ([512] if args.smoke else [1024, 2048])
     eng = Engine(mnist_deployment(1), prewarm_widths="784")
     try:
@@ -704,9 +738,25 @@ def main() -> None:
             c: run_load(MNIST_CONTRACT, Engine.REST_PORT, "rest", c, duration)
             for c in mnist_cfgs
         }
+        mnist_peak_c = max(mnist, key=lambda c: mnist[c]["qps"])
+        attr_bare = run_load(
+            bare_contract.name, Engine.REST_PORT, "rest", mnist_peak_c,
+            duration,
+        )
     finally:
         eng.stop()
-    mnist_peak_c, mnist_peak = max(mnist.items(), key=lambda kv: kv[1]["qps"])
+    mnist_peak = mnist[mnist_peak_c]
+    eng = Engine(
+        mnist_deployment(1), prewarm_widths="784",
+        env_overrides={"SELDON_FORCE_CPU": "1"},
+    )
+    try:
+        attr_cpu = run_load(
+            MNIST_CONTRACT, Engine.REST_PORT, "rest", mnist_peak_c, duration
+        )
+    finally:
+        eng.stop()
+        os.unlink(bare_contract.name)
 
     # ---- ensemble series: on-device fan-out should hold QPS flat ---------
     # (BASELINE.md north star: linear total QPS out to 8 members; probed at
@@ -752,6 +802,11 @@ def main() -> None:
         "mnist_max_qps_clients": mnist_peak_c,
         "mnist_256_qps": mnist[256]["qps"],
         "mnist_256_p50_ms": mnist[256]["p50_ms"],
+        # controls: ~equal qps with relay removed (CPU engine) and with
+        # names removed (bare payload) => the stub-vs-mnist gap is
+        # per-request payload bytes on the one shared host core
+        "mnist_attr_cpu_engine_qps": round(attr_cpu["qps"], 1),
+        "mnist_attr_bare_payload_qps": round(attr_bare["qps"], 1),
         "ensemble_members_qps": {
             str(m): r["qps"] for m, r in sorted(ensemble.items())
         },

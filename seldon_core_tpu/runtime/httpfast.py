@@ -441,6 +441,15 @@ class _FastHttpProtocol(asyncio.Protocol):
             else None
         )
         if table is None:
+            if path == b"/api/v0.1/events":
+                # reference-exact: the stub answers 200 on ANY method
+                # (engine RestClientController.java:177-180)
+                handler = self.routes.get[b"/api/v0.1/events"]
+                task = asyncio.get_running_loop().create_task(
+                    handler(body, "", query.decode("latin-1"))
+                )
+                self.queue.put_nowait((task, close))
+                return
             self._reject(405, b"method not allowed")
             return
         handler = table.get(path)

@@ -50,14 +50,14 @@ def pytest_collection_modifyitems(config, items):
     i, n = int(idx), int(total)
     if not (1 <= i <= n):
         raise pytest.UsageError(f"TEST_SHARD={shard!r}: need 1<=i<=n")
-    keep, dropped = [], 0
+    keep, dropped = [], []
     for item in items:
         bucket = zlib.crc32(os.path.basename(str(item.fspath)).encode()) % n
         if bucket == i - 1:
             keep.append(item)
         else:
-            dropped += 1
+            dropped.append(item)
     items[:] = keep
-    config.hook.pytest_deselected(items=[])  # counts shown via summary
+    config.hook.pytest_deselected(items=dropped)  # 'N deselected' summary
     print(f"[TEST_SHARD {shard}] running {len(keep)} tests, "
-          f"{dropped} in other shards")
+          f"{len(dropped)} in other shards")
