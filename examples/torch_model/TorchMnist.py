@@ -1,0 +1,50 @@
+"""PyTorch user model served through the standard wrapper runtime — the
+role the reference's TF example played (examples/models/deep_mnist/
+DeepMnist.py: load a TF session in __init__, sess.run in predict).
+
+The framework is model-library-agnostic at the wrapper boundary: any
+object with ``predict(X, feature_names) -> array`` serves (reference
+style 1 in examples/custom_model/MyModel.py).  This one runs a torch CPU
+module; a JAX graph node and a torch microservice node can share one
+inference graph.
+
+Serve it:
+
+    python -m seldon_core_tpu.runtime.microservice \
+        examples.torch_model.TorchMnist:TorchMnist REST --port 9005
+
+or bind it as a remote component in a deployment JSON.  Weights load from
+``weights_path`` (torch.save state_dict) when given; otherwise the net
+initialises randomly (demo/contract-testing mode — this example ships no
+trained weights, same as the reference's template models)."""
+
+import numpy as np
+
+
+class TorchMnist:
+    class_names = [f"class:{i}" for i in range(10)]
+
+    def __init__(self, hidden: int = 128, weights_path: str = "",
+                 seed: int = 0):
+        import torch
+
+        torch.manual_seed(int(seed))
+        self.torch = torch
+        self.net = torch.nn.Sequential(
+            torch.nn.Linear(784, int(hidden)),
+            torch.nn.ReLU(),
+            torch.nn.Linear(int(hidden), 10),
+        )
+        if weights_path:
+            self.net.load_state_dict(
+                torch.load(weights_path, map_location="cpu")
+            )
+        self.net.eval()
+
+    def predict(self, X, feature_names=None):
+        with self.torch.no_grad():
+            x = self.torch.as_tensor(
+                np.asarray(X, dtype=np.float32).reshape(-1, 784)
+            )
+            probs = self.torch.softmax(self.net(x), dim=1)
+        return probs.numpy().astype(np.float64)

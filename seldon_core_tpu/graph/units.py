@@ -194,7 +194,17 @@ def instantiate_bound_unit(binding, node) -> Unit:
                 f"mesh; drop mesh_axes or use a mesh-capable unit"
             )
         kwargs["mesh"] = build_mesh(dict(binding.mesh_axes))
-    return cls(**kwargs)
+    # reference-style plain user objects (predict(X, names) / route / ...)
+    # get the SAME adapter the microservice wrapper applies (as_unit), so
+    # ANY model-library class (torch/sklearn-style) binds inprocess too —
+    # the engine serves it host-mode (UserObjectUnit.pure = False keeps it
+    # out of the compiled XLA program, exactly like a remote wrapper node)
+    from seldon_core_tpu.runtime.microservice import as_unit
+
+    service_type = (
+        node.type.name if getattr(node, "type", None) is not None else "MODEL"
+    )
+    return as_unit(cls(**kwargs), service_type)
 
 
 # ---------------------------------------------------------------------------

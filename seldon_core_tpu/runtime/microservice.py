@@ -70,8 +70,14 @@ class UserObjectUnit(Unit):
         return np.asarray(self.user.predict(np.asarray(X), names))
 
     def transform_input(self, state, X, names):
-        if self.service_type == "OUTLIER_DETECTOR":
-            # score + tag, pass data through (outlier_detector_microservice.py:36-56)
+        if self.service_type == "OUTLIER_DETECTOR" or (
+            not hasattr(self.user, "transform_input")
+            and hasattr(self.user, "score")
+        ):
+            # score + tag, pass data through (outlier_detector_microservice.
+            # py:36-56).  The score-only duck check keeps the lane reachable
+            # for inprocess bindings too, where the graph type system has
+            # no OUTLIER_DETECTOR member (outliers are TRANSFORMER nodes)
             scores = np.asarray(self.user.score(np.asarray(X), names))
             return np.asarray(X), UnitAux(tags={"outlierScore": scores})
         if hasattr(self.user, "transform_input"):
@@ -102,12 +108,23 @@ class UserObjectUnit(Unit):
         return state
 
 
-def build_unit(user_class, parameters: List[Parameter], service_type: str) -> Unit:
-    kwargs = params_to_kwargs(parameters)
-    obj = user_class(**kwargs)
-    if isinstance(obj, Unit):
+def as_unit(obj: Any, service_type: str = "MODEL") -> Unit:
+    """Give any instantiated model object the Unit protocol.
+
+    Unit subclasses AND duck-typed units (anything already exposing the
+    protocol's ``pure``/``init_state`` surface) pass through untouched;
+    reference-style plain objects (``predict(X, names)``) get the
+    UserObjectUnit adapter.  Single wrap policy shared by the microservice
+    wrapper and inprocess graph bindings."""
+    if isinstance(obj, Unit) or hasattr(obj, "pure") \
+            or hasattr(obj, "init_state"):
         return obj
     return UserObjectUnit(obj, service_type)
+
+
+def build_unit(user_class, parameters: List[Parameter], service_type: str) -> Unit:
+    kwargs = params_to_kwargs(parameters)
+    return as_unit(user_class(**kwargs), service_type)
 
 
 def build_runtime(

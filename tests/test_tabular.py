@@ -114,6 +114,8 @@ _EXAMPLE_FEATURES = {
     "generator_deployment.json": 5,  # 5-token prompts -> generated tokens
     "stub_deployment.json": 1,  # the reference's max-throughput stub graph
     "generator_tp_deployment.json": 5,  # tp=4 mesh-sharded LM generator
+    "generator_ep_deployment.json": 5,  # ep=4 MoE expert-parallel generator
+    "generator_int8_deployment.json": 4,  # int8 + GQA + flash opt-ins
 }
 
 

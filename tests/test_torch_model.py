@@ -1,0 +1,52 @@
+"""Framework-agnostic model serving: a PyTorch module through the SAME
+wrapper/engine surfaces the JAX units use — the reference's external-
+framework examples role (examples/models/deep_mnist/DeepMnist.py TF
+session; sklearn iris)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+def test_torch_model_plain_class_contract():
+    from examples.torch_model.TorchMnist import TorchMnist
+    from seldon_core_tpu.testing.contract import (
+        Contract,
+        generate_batch,
+        validate_response,
+    )
+
+    m = TorchMnist(hidden=32)
+    contract = Contract.from_file("examples/torch_model/contract.json")
+    msg = generate_batch(contract, 4, seed=0)
+    X, names = msg.data.numpy(), msg.data.names
+    probs = m.predict(X, names)
+    assert probs.shape == (4, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+    resp = msg.with_array(probs, names=m.class_names)
+    assert validate_response(contract, resp) == []
+
+
+def test_torch_model_through_engine():
+    """The deployment JSON serves the torch model via the host-mode
+    engine — one graph can mix JAX compiled nodes and torch host nodes."""
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    doc = json.load(open("examples/torch_model/torch_mnist_deployment.json"))
+    engine = EngineService(SeldonDeploymentSpec.from_json_dict(doc))
+
+    async def run():
+        text, status = await engine.predict_json(
+            json.dumps({"data": {"ndarray": np.zeros((2, 784)).tolist()}})
+        )
+        assert status == 200, text
+        probs = np.asarray(json.loads(text)["data"]["ndarray"])
+        assert probs.shape == (2, 10)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    asyncio.run(run())
