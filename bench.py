@@ -415,7 +415,9 @@ def _probe_mfu_main(smoke: bool) -> None:
             jnp.int32,
         )
         times = {}
-        for mode, uf in (("flash", True), ("xla", False)):
+        # "force" pins the kernel arm regardless of the auto-mode length
+        # threshold — this ratio is the kernel-vs-XLA measurement itself
+        for mode, uf in (("flash", "force"), ("xla", False)):
             @jax.jit
             def reps(ps, t, _uf=uf):
                 def body(tk, _):

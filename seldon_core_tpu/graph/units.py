@@ -199,11 +199,13 @@ def instantiate_bound_unit(binding, node) -> Unit:
     # ANY model-library class (torch/sklearn-style) binds inprocess too —
     # the engine serves it host-mode (UserObjectUnit.pure = False keeps it
     # out of the compiled XLA program, exactly like a remote wrapper node)
+    from seldon_core_tpu.graph.interpreter import effective_type
     from seldon_core_tpu.runtime.microservice import as_unit
 
-    service_type = (
-        node.type.name if getattr(node, "type", None) is not None else "MODEL"
-    )
+    # effective_type resolves implementation-implied types the same way
+    # the interpreter's method dispatch does (a node may omit `type`)
+    etype = effective_type(node)
+    service_type = etype.name if etype is not None else "MODEL"
     return as_unit(cls(**kwargs), service_type)
 
 

@@ -45,7 +45,9 @@ __all__ = ["init_cache", "prefill", "decode_step", "generate",
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
     # K/V stored at the GROUPED head count (cfg.kv_heads): with GQA the
     # cache — the HBM stream every decode step pays for — shrinks by
-    # n_heads/n_kv_heads
+    # n_heads/n_kv_heads.  NOT rounded up to the flash-decode block: that
+    # kernel is unwired (measured slower, see ops/flash_decode.py), and
+    # padding would bill every decode step for masked slots
     hd = cfg.d_model // cfg.n_heads
     kv = cfg.kv_heads
     return {
@@ -105,7 +107,14 @@ def _grouped_pv(p, cache_v, out_shape):
 
 def _attend_cached(q, cache_k, cache_v, n_valid):
     """q [B,H,1,hd] against the (possibly grouped) cache; positions >=
-    n_valid (scalar) masked."""
+    n_valid (scalar) masked.
+
+    Deliberately the grouped-XLA formulation: the fused Pallas
+    flash-decode kernel (ops/flash_decode.py) was measured SLOWER here —
+    a (B*KV, L/128) grid serializes tiny per-step dots where XLA runs
+    the whole batch as a few large batched dots (see that module's
+    docstring for numbers).  Keep the dots batched; revisit only with a
+    batch-blocked kernel design."""
     s = _grouped_qk(q, cache_k)  # [B,KV,g,1,L]
     valid = jnp.arange(cache_k.shape[2]) < n_valid  # [L]
     s = jnp.where(valid[None, None, None, None, :], s, -1e30)

@@ -217,13 +217,19 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
     (differentiable — custom flash VJP); constraint violations fall back
     to the plain XLA path silently.  Grouped K/V (KV < H) takes the GQA
     formulation; the ring path requires full MHA heads."""
+    # auto mode only takes the kernel where it measures faster than XLA's
+    # fused attention (long sequences); "force" overrides (explicit opt-in
+    # / the benchmarking arm)
+    flash_eligible = use_flash == "force" or (
+        use_flash and q.shape[2] >= FLASH_AUTO_MIN_S
+    )
     if k.shape[1] != q.shape[1]:
         if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
             raise ValueError(
                 "sequence-parallel ring attention requires "
                 "n_kv_heads == n_heads"
             )
-        if use_flash and (mesh is None or mesh.size == 1):
+        if flash_eligible and (mesh is None or mesh.size == 1):
             # the flash kernel is GQA-native (grouped K/V block indexing)
             from seldon_core_tpu.ops.flash_attention import flash_attention
 
@@ -232,7 +238,7 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
             except ValueError:
                 pass  # shape constraints unmet -> grouped XLA path
         return gqa_attention(q, k, v, causal)
-    if use_flash and (mesh is None or mesh.size == 1):
+    if flash_eligible and (mesh is None or mesh.size == 1):
         # single-chip only: pallas_call is not auto-partitionable under
         # GSPMD, so any multi-device mesh (tp/dp/sp) keeps the XLA path
         from seldon_core_tpu.ops.flash_attention import flash_attention
@@ -455,18 +461,27 @@ def lm_pipeline_train_step(pp_params, opt_state, batch, optimizer,
     )
 
 
-def resolve_flash(attention: str, mesh: Optional[Mesh]) -> bool:
-    """Deployment-parameter attention mode -> static use_flash decision.
+#: ``auto`` mode takes the Pallas flash kernel only at-or-past this
+#: sequence length: interleaved A/B through the LM forward on v5e
+#: measured the kernel 1.4x FASTER than XLA's fused attention at S=8192
+#: but 1.7x SLOWER at S=2048 (XLA's fusion is strong at moderate S; the
+#: kernel's block-skip + O(S*D) HBM traffic win out as S^2 grows).
+FLASH_AUTO_MIN_S = 4096
 
-    ``auto``  — Pallas flash kernel when the runtime supports it and the
+
+def resolve_flash(attention: str, mesh: Optional[Mesh]):
+    """Deployment-parameter attention mode -> static flash decision.
+
+    ``auto``  — Pallas flash kernel when the runtime supports it, the
                 mesh is single-chip (pallas_call is not auto-partitionable
-                under GSPMD);
-    ``flash`` — prefer the kernel; a runtime without Pallas support or a
-                multi-chip mesh still falls back to XLA (degrade, don't
-                crash-loop the pod — shape constraints additionally fall
-                back per call inside ``_attention``);
-    ``xla``   — force the plain XLA attention (the benchmarking control
-                arm: BENCH's flash_vs_xla delta toggles exactly this)."""
+                under GSPMD), AND the sequence is long enough to win
+                (``FLASH_AUTO_MIN_S``, checked per call in
+                ``_attention``);  returns True/False;
+    ``flash`` — force the kernel at ANY length (returns ``"force"``, the
+                benchmarking arm / explicit opt-in); a runtime without
+                Pallas support or a multi-chip mesh still falls back to
+                XLA (degrade, don't crash-loop the pod);
+    ``xla``   — force the plain XLA attention (the control arm)."""
     if attention == "xla":
         return False
     if attention not in ("auto", "flash"):
@@ -476,7 +491,10 @@ def resolve_flash(attention: str, mesh: Optional[Mesh]) -> bool:
     multi = mesh is not None and mesh.size > 1
     from seldon_core_tpu.ops.fused_mlp import pallas_supported
 
-    return pallas_supported() and not multi
+    supported = pallas_supported() and not multi
+    if attention == "flash":
+        return "force" if supported else False
+    return supported
 
 
 @register_unit("TransformerLM")

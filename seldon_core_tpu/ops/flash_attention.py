@@ -22,10 +22,15 @@ dK/dV run on a (heads, k-block, q-block) grid accumulating over Q blocks —
 two passes instead of atomics, the standard TPU formulation.  Gradients
 match the XLA attention VJP to ~1e-5 in f32 (tests/test_flash_attention.py).
 
-Measured on v5e (chained-dependency timing, bf16, causal): 8.8x faster
-than the XLA einsum+softmax attention at S=2048/H=8/D=128, 2.5x at
-S=8192, 3.3x at S=16384 — the [S, S] HBM materialisation XLA pays grows
-quadratically while this kernel's HBM traffic stays O(S*D).
+Measured on v5e THROUGH the full LM forward (interleaved A/B, chained
+100-rep dispatches, bf16, causal, H=8/D=128): **1.4x faster than XLA's
+fused attention at S=8192** and 1.7x slower at S=2048 — XLA's own fusion
+is strong at moderate lengths; this kernel's causal block-skip and
+O(S*D) HBM traffic win as S^2 grows.  ``models/transformer.py``'s auto
+mode therefore takes the kernel only from ``FLASH_AUTO_MIN_S`` up, and
+``attention="flash"`` forces it.  K-block size auto-selects up to 512
+(grid-step overhead amortization — the bk=128 variant measured 0.6x XLA
+at S=8192; bk=512 flipped it to 1.4x).
 """
 
 from __future__ import annotations
@@ -42,7 +47,8 @@ _NEG_INF = -1e30
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                  *, causal: bool, scale: float, n_k: int):
+                  *, causal: bool, scale: float, n_k: int,
+                  bq: int = _BLOCK, bk: int = _BLOCK):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -57,7 +63,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     # causal: blocks strictly above the diagonal are fully masked — skip
     # their dots entirely (halves the causal FLOPs; XLA's fused attention
     # cannot skip, it masks after materialising the scores)
-    @pl.when(jnp.logical_or(not causal, ik <= iq))
+    @pl.when(jnp.logical_or(not causal, ik * bk <= iq * bq + (bq - 1)))
     def _compute():
         q = q_ref[0]  # [bq, D]
         k = k_ref[0]  # [bk, D]
@@ -66,11 +72,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
         if causal:
-            qpos = iq * _BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (_BLOCK, _BLOCK), 0
+            qpos = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
             )
-            kpos = ik * _BLOCK + jax.lax.broadcasted_iota(
-                jnp.int32, (_BLOCK, _BLOCK), 1
+            kpos = ik * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
 
@@ -131,21 +137,37 @@ def _fwd_impl(q, k, v, causal: bool, interpret: bool):
     B, H, S, D = _validate(q, k, v)
     KV = k.shape[1]
     g = H // KV
-    n_k = S // _BLOCK
+    # larger K blocks amortize the per-grid-step overhead at long S (the
+    # VMEM budget easily holds [bk, D] K/V tiles at bk=512); bq stays at
+    # the native 128 so the stats tiles keep the lane-broadcast layout
+    bq = _BLOCK
+    bk = max(b for b in (512, 256, _BLOCK) if S % b == 0)
+    n_k = S // bk
     scale = float(1.0 / (D ** 0.5))
 
-    grid = (B * H, S // _BLOCK, n_k)
-    blk = lambda idx: pl.BlockSpec(  # noqa: E731
-        (1, _BLOCK, D), idx, memory_space=pltpu.VMEM
+    grid = (B * H, S // bq, n_k)
+    qblk = lambda idx: pl.BlockSpec(  # noqa: E731
+        (1, bq, D), idx, memory_space=pltpu.VMEM
+    )
+    kblk = lambda idx: pl.BlockSpec(  # noqa: E731
+        (1, bk, D), idx, memory_space=pltpu.VMEM
     )
 
-    def kv_index(b):
-        # merged q row b = bi * H + h; its kv row = bi * KV + h // g
-        return (b // H) * KV + (b % H) // g
+    if KV == H:
+        # MHA: keep the identity map LITERAL — the computed form below is
+        # algebraically b but defeats the pipeliner's sequential-block
+        # prefetch (measured: up to 4x slower at S=8192)
+        def kv_index(b):
+            return b
+    else:
+        def kv_index(b):
+            # merged q row b = bi * H + h; its kv row = bi * KV + h // g
+            return (b // H) * KV + (b % H) // g
 
     out, lse = pl.pallas_call(
         functools.partial(
-            _flash_kernel, causal=causal, scale=scale, n_k=n_k
+            _flash_kernel, causal=causal, scale=scale, n_k=n_k,
+            bq=bq, bk=bk,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
@@ -153,19 +175,19 @@ def _fwd_impl(q, k, v, causal: bool, interpret: bool):
         ),
         grid=grid,
         in_specs=[
-            blk(lambda b, i, j: (b, i, 0)),   # Q: follows the q-block axis
-            blk(lambda b, i, j: (kv_index(b), j, 0)),   # K (grouped)
-            blk(lambda b, i, j: (kv_index(b), j, 0)),   # V
+            qblk(lambda b, i, j: (b, i, 0)),  # Q: follows the q-block axis
+            kblk(lambda b, i, j: (kv_index(b), j, 0)),   # K (grouped)
+            kblk(lambda b, i, j: (kv_index(b), j, 0)),   # V
         ],
         out_specs=(
-            blk(lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, _BLOCK, 1), lambda b, i, j: (b, i, 0),
+            qblk(lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((_BLOCK, _BLOCK), jnp.float32),  # m (lane-broadcast)
-            pltpu.VMEM((_BLOCK, _BLOCK), jnp.float32),  # l
-            pltpu.VMEM((_BLOCK, D), jnp.float32),       # acc
+            pltpu.VMEM((bq, bk), jnp.float32),  # m (lane-broadcast)
+            pltpu.VMEM((bq, bk), jnp.float32),  # l
+            pltpu.VMEM((bq, D), jnp.float32),   # acc
         ],
         interpret=interpret,
     )(q.reshape(B * H, S, D), k.reshape(B * KV, S, D),

@@ -1,0 +1,150 @@
+"""Flash-decode Pallas kernel — single-token cached attention in ONE pass.
+
+The cached decode step's attention (models/generate.py:_attend_cached) is
+the serving hot loop: every generated token streams the whole KV cache
+from HBM.  This kernel fuses the dot -> mask/softmax -> dot chain
+(classic flash-decoding): K/V blocks stream through VMEM once, the
+softmax runs online (running max ``m``, normaliser ``l``, weighted
+accumulator ``acc``), and the [*, L] score row never exists in HBM.
+
+GQA-native like ops/flash_attention.py: q arrives as [B, KV, G, hd]
+(the group's query heads folded onto the sublane axis), K/V at their
+stored grouped size [B, KV, L, hd].  The valid-length mask (positions >=
+n_valid are preallocated-but-unwritten cache slots) rides a prefetched
+scalar.
+
+STATUS — correct but NOT wired into serving: measured on v5e (166M-param
+GQA-4 LM, L=576, B=32/256) the kernel is ~1.6-2.3x SLOWER per decode
+step than the grouped-XLA formulation.  The (B*KV, L/128) grid runs
+sequentially with a tiny [G, 128] dot per step, while XLA executes the
+whole batch as a few large batched dots — at decode's short L the
+per-grid-step overhead dominates anything saved on the score row.  A win
+here needs a batch-blocked design (fold B onto the sublane axis, grid
+over L only); until someone builds and MEASURES that, serving keeps the
+XLA path (models/generate.py:_attend_cached).  The op stays for the
+kernel-correctness suite and as the starting point for that redesign.
+
+Constraints (ValueError): L divisible by 128, hd <= 256.
+``models/generate.py:init_cache`` rounds cache lengths up to 128 so
+caches stay eligible.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_decode", "flash_decode_supported"]
+
+_BLOCK = 128
+_NEG_INF = -1e30
+
+
+def _decode_kernel(nv_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, n_k: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [G, hd]
+    k = k_ref[0]  # [BLK, hd]
+    v = v_ref[0]  # [BLK, hd]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, BLK]
+    pos = j * _BLOCK + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < nv_ref[0], s, _NEG_INF)
+
+    m_prev = m_ref[:]  # [G, BLK] lane-broadcast stats
+    l_prev = l_ref[:]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur)
+    l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = m_cur
+    acc_ref[:] = acc_ref[:] * alpha[:, :1] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_k - 1)
+    def _done():
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def flash_decode(q, k, v, n_valid, interpret: bool = False) -> jax.Array:
+    """q [B, KV, G, hd] x cache k/v [B, KV, L, hd] -> [B, KV, G, hd].
+
+    ``n_valid``: scalar int — cache positions >= n_valid are masked.
+    Numerics match models/generate.py:_attend_cached (f32 online softmax
+    over stored-dtype K/V reads)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if q.ndim != 4 or k.ndim != 4 or k.shape != v.shape:
+        raise ValueError(f"bad shapes: q{q.shape} k{k.shape} v{v.shape}")
+    B, KV, G, hd = q.shape
+    L = k.shape[2]
+    if k.shape[0] != B or k.shape[1] != KV or k.shape[3] != hd:
+        raise ValueError(f"q/k mismatch: q{q.shape} k{k.shape}")
+    if L % _BLOCK != 0:
+        raise ValueError(f"cache len {L} not divisible by {_BLOCK}")
+    if hd > 256:
+        raise ValueError(f"head dim {hd} > 256")
+    n_k = L // _BLOCK
+    scale = float(1.0 / (hd ** 0.5))
+    nv = jnp.asarray(n_valid, jnp.int32).reshape((1,))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * KV, n_k),
+        in_specs=[
+            pl.BlockSpec((1, G, hd), lambda b, j, nv_ref: (b, 0, 0)),
+            pl.BlockSpec((1, _BLOCK, hd), lambda b, j, nv_ref: (b, j, 0)),
+            pl.BlockSpec((1, _BLOCK, hd), lambda b, j, nv_ref: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, hd), lambda b, j, nv_ref: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, _BLOCK), jnp.float32),  # m (lane-broadcast)
+            pltpu.VMEM((G, _BLOCK), jnp.float32),  # l
+            pltpu.VMEM((G, hd), jnp.float32),      # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, n_k=n_k, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * KV, G, hd), k.dtype),
+        interpret=interpret,
+    )(nv, q.reshape(B * KV, G, hd), k.reshape(B * KV, L, hd),
+      v.reshape(B * KV, L, hd))
+    return out.reshape(B, KV, G, hd)
+
+
+@functools.lru_cache(maxsize=1)
+def flash_decode_supported() -> bool:
+    """One-time runtime probe (static under jit) — mirrors
+    ops/fused_mlp.pallas_supported for the decode kernel.
+
+    Probes via an explicit AOT lower+compile: the first call often happens
+    INSIDE another function's trace, where an eager pallas_call only
+    records a jaxpr and the backend's can't-lower error would surface
+    later, from the caller's compile — AOT compilation forces it here."""
+    try:
+        q = jax.ShapeDtypeStruct((1, 1, 1, 128), jnp.float32)
+        kv = jax.ShapeDtypeStruct((1, 1, 128, 128), jnp.float32)
+        jax.jit(
+            lambda q_, k_, nv: flash_decode(q_, k_, k_, nv)
+        ).lower(q, kv, jax.ShapeDtypeStruct((), jnp.int32)).compile()
+        return True
+    except Exception:  # noqa: BLE001 - any backend/lowering failure
+        return False

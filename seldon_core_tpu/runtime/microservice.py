@@ -71,13 +71,16 @@ class UserObjectUnit(Unit):
 
     def transform_input(self, state, X, names):
         if self.service_type == "OUTLIER_DETECTOR" or (
-            not hasattr(self.user, "transform_input")
-            and hasattr(self.user, "score")
+            hasattr(self.user, "score")
+            and not hasattr(self.user, "transform_input")
+            and not hasattr(self.user, "predict")
         ):
             # score + tag, pass data through (outlier_detector_microservice.
-            # py:36-56).  The score-only duck check keeps the lane reachable
-            # for inprocess bindings too, where the graph type system has
-            # no OUTLIER_DETECTOR member (outliers are TRANSFORMER nodes)
+            # py:36-56).  The duck check fires ONLY for pure scorers
+            # (score and nothing else) so the lane stays reachable for
+            # inprocess bindings — where the graph type system has no
+            # OUTLIER_DETECTOR member — without hijacking sklearn-style
+            # objects whose score(X, y) is a metric, not an outlier score
             scores = np.asarray(self.user.score(np.asarray(X), names))
             return np.asarray(X), UnitAux(tags={"outlierScore": scores})
         if hasattr(self.user, "transform_input"):
@@ -111,13 +114,14 @@ class UserObjectUnit(Unit):
 def as_unit(obj: Any, service_type: str = "MODEL") -> Unit:
     """Give any instantiated model object the Unit protocol.
 
-    Unit subclasses AND duck-typed units (anything already exposing the
-    protocol's ``pure``/``init_state`` surface) pass through untouched;
-    reference-style plain objects (``predict(X, names)``) get the
-    UserObjectUnit adapter.  Single wrap policy shared by the microservice
-    wrapper and inprocess graph bindings."""
-    if isinstance(obj, Unit) or hasattr(obj, "pure") \
-            or hasattr(obj, "init_state"):
+    Unit subclasses AND duck-typed units (anything declaring the
+    protocol's ``pure`` marker) pass through untouched; reference-style
+    plain objects (``predict(X, names)``) get the UserObjectUnit adapter.
+    ``pure`` alone is the duck signal — method names like ``init_state``
+    or ``predict`` occur naturally on user models and must not change
+    their calling convention.  Single wrap policy shared by the
+    microservice wrapper and inprocess graph bindings."""
+    if isinstance(obj, Unit) or hasattr(obj, "pure"):
         return obj
     return UserObjectUnit(obj, service_type)
 
