@@ -1,0 +1,185 @@
+"""Train -> checkpoint -> serve, end to end — the framework's full model
+lifecycle in one script (the reference had no training story at all; its
+models arrived pre-trained via s2i images).
+
+  1. trains a small decoder LM (models/transformer.py lm_train_step —
+     the same dp/tp/sp-shardable step the multichip dryrun exercises) on
+     a synthetic copy task until it learns it;
+  2. checkpoints the params with save_lm_weights (one .npz, the
+     persistence pytree format);
+  3. serves the checkpoint through a REAL engine process: a deployment
+     JSON whose TransformerGenerator carries ``weights_path``;
+  4. proves over REST that the SERVED model reproduces the learned
+     behavior (continues the pattern), which random weights cannot.
+
+Run from the repo root:  python examples/train_then_serve.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # runnable from anywhere, like local_stack.py
+    sys.path.insert(0, REPO)
+PORT = 18890
+
+VOCAB = 32
+SEQ = 16
+PERIOD = 4  # the task: sequences repeat with this period
+
+
+def batches(rng, batch=64):
+    """Synthetic copy task: token t equals token t-PERIOD, so a trained
+    model continues any periodic prompt exactly."""
+    while True:
+        head = rng.integers(0, VOCAB, size=(batch, PERIOD))
+        reps = -(-(SEQ + 1) // PERIOD)
+        yield np.tile(head, (1, reps))[:, : SEQ + 1].astype(np.int32)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from seldon_core_tpu.models.transformer import (
+        LMConfig,
+        lm_init,
+        lm_train_step,
+        save_lm_weights,
+    )
+
+    cfg = LMConfig(vocab=VOCAB, d_model=64, n_heads=4, n_kv_heads=2,
+                   n_layers=2, d_ff=256, dtype=jnp.float32)
+    params = lm_init(jax.random.key(0), cfg)
+    opt = optax.adam(5e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(
+        lambda p, o, b: lm_train_step(p, o, b, opt, cfg, use_flash=False)
+    )
+
+    print("[1/4] training the copy task")
+    gen = batches(np.random.default_rng(0))
+    loss = None
+    for i in range(800):
+        batch = {"tokens": jnp.asarray(next(gen))}
+        params, opt_state, loss = step(params, opt_state, batch)
+        if i % 100 == 0:
+            print(f"      step {i:4d} loss {float(loss):.4f}", flush=True)
+    final_loss = float(loss)
+    # loss floor: the first PERIOD-1 predicted tokens of each sequence
+    # are irreducibly random (((PERIOD-1)/SEQ) * ln(VOCAB) ~= 0.65);
+    # converged = near-floor, far below the untrained ln(VOCAB) ~= 3.47
+    print(f"      final loss {final_loss:.4f} (floor ~0.65, untrained ~3.47)")
+    assert final_loss < 1.2, f"copy task did not converge: {final_loss}"
+
+    # the continuation the TRAINED model itself produces for the probe
+    # prompt — the serving fidelity reference (the served model must
+    # reproduce it token-for-token; idealized copy accuracy is reported
+    # but the model may make occasional in-distribution errors)
+    from seldon_core_tpu.models.generate import generate
+
+    head = [3, 14, 7, 29]
+    probe = (head * (SEQ // PERIOD))[:SEQ]
+    local = np.asarray(generate(
+        params, jnp.asarray([probe], jnp.int32), cfg, max_new_tokens=8
+    ))[0].astype(float).tolist()
+
+    tmp = tempfile.mkdtemp(prefix="seldon-train-")
+    ckpt = os.path.join(tmp, "copy_lm.npz")
+    print(f"[2/4] checkpoint -> {ckpt}")
+    save_lm_weights(params, ckpt)
+
+    print("[3/4] serving the checkpoint through an engine process")
+    deployment = {
+        "spec": {
+            "name": "trained-lm",
+            "predictors": [{
+                "name": "main",
+                "graph": {"name": "gen", "type": "MODEL"},
+                "components": [{
+                    "name": "gen", "runtime": "inprocess",
+                    "class_path": "TransformerGenerator",
+                    "parameters": [
+                        {"name": "vocab", "value": str(VOCAB), "type": "INT"},
+                        {"name": "d_model", "value": "64", "type": "INT"},
+                        {"name": "n_heads", "value": "4", "type": "INT"},
+                        {"name": "n_kv_heads", "value": "2", "type": "INT"},
+                        {"name": "n_layers", "value": "2", "type": "INT"},
+                        {"name": "d_ff", "value": "256", "type": "INT"},
+                        {"name": "dtype", "value": "float32",
+                         "type": "STRING"},
+                        {"name": "max_new_tokens", "value": "8",
+                         "type": "INT"},
+                        {"name": "weights_path", "value": ckpt,
+                         "type": "STRING"},
+                    ],
+                }],
+            }],
+        }
+    }
+    dep_path = os.path.join(tmp, "deployment.json")
+    with open(dep_path, "w") as f:
+        json.dump(deployment, f)
+    env = dict(os.environ, SELDON_FORCE_CPU="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seldon_core_tpu.runtime.engine_main",
+         "--file", dep_path, "--host", "127.0.0.1",
+         "--rest-port", str(PORT), "--grpc-port", str(PORT + 1)],
+        env=env, cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError("engine died at boot")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{PORT}/ready", timeout=2
+                )
+                break
+            except OSError:
+                time.sleep(1)
+
+        print("[4/4] served output == the trained model's own continuation")
+        prompt = [float(t) for t in probe]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{PORT}/api/v0.1/predictions",
+            data=json.dumps({"data": {"ndarray": [prompt]}}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())["data"]["ndarray"][0]
+        ideal = [float(t) for t in (head * 4)[: len(out)]]
+        acc = sum(a == b for a, b in zip(out, ideal)) / len(out)
+        print(f"      prompt tail {prompt[-4:]} -> served {out}")
+        print(f"      local generate() -> {local}")
+        print(f"      copy accuracy vs ideal: {acc:.0%} (random ~3%)")
+        # serving fidelity: the engine serves EXACTLY the checkpoint
+        assert out == local, f"served {out} != local model {local}"
+        # and the checkpoint clearly learned the task (vs 1/32 random)
+        assert acc >= 0.5, f"copy accuracy {acc:.0%}"
+        print("OK — trained weights served end to end")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,7 @@ from seldon_core_tpu.models.transformer import (
     _attention,
     _ffn,
     _rmsnorm,
+    apply_rope,
     lm_init,
 )
 
@@ -153,6 +154,12 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
     q = _heads(q, B, S, cfg.n_heads, hd)
     k = _heads(k, B, S, kv_h, hd)
     v = _heads(v, B, S, kv_h, hd)
+    if cfg.rope:
+        # rotate with GLOBAL positions before the cache write, so stored
+        # keys are final and cached attention needs no re-rotation
+        positions = start + jnp.arange(S)
+        q = apply_rope(q, positions, cfg.rope_base)
+        k = apply_rope(k, positions, cfg.rope_base)
     cache_k = jax.lax.dynamic_update_slice(
         cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, 0, start, 0)
     )
@@ -359,7 +366,8 @@ class TransformerGenerator(Unit):
                  dtype: str = "bfloat16", moe_every: int = 0,
                  n_experts: int = 8, moe_k: int = 2, mesh=None,
                  quant: str = "none", attention: str = "auto",
-                 n_kv_heads: int = 0):
+                 n_kv_heads: int = 0, weights_path: str = "",
+                 rope: bool = True, rope_base: float = 10000.0):
         # mesh (from the binding's mesh_axes, e.g. {"tp": 4}): params are
         # laid out with the LM's tp shardings and GSPMD partitions the
         # whole prefill+decode program across the mesh — one generator
@@ -372,10 +380,12 @@ class TransformerGenerator(Unit):
             moe_every=int(moe_every), n_experts=int(n_experts),
             moe_k=int(moe_k), quant=str(quant),
             n_kv_heads=int(n_kv_heads),
+            rope=bool(rope), rope_base=float(rope_base),
         )
         from seldon_core_tpu.models.transformer import resolve_flash
 
         self.use_flash = resolve_flash(str(attention), mesh)
+        self.weights_path = str(weights_path)
         self.seed = int(seed)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -391,9 +401,12 @@ class TransformerGenerator(Unit):
         self.updates_state_on_predict = self.temperature > 0.0
 
     def init_state(self, rng):
+        from seldon_core_tpu.models.transformer import load_lm_weights
+
         if rng is None:
             rng = jax.random.key(self.seed)
         params = lm_init(jax.random.fold_in(rng, self.seed), self.cfg)
+        params = load_lm_weights(params, self.weights_path)
         if self.cfg.quant == "int8":
             from seldon_core_tpu.ops.quant import quantize_lm_params
 

@@ -170,21 +170,31 @@ class SpeculativeGenerator(Unit):
                  draft_d_model: int = 0, draft_n_heads: int = 0,
                  draft_n_layers: int = 0, draft_d_ff: int = 0,
                  seed: int = 0, max_new_tokens: int = 32, k: int = 4,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", rope: bool = True,
+                 rope_base: float = 10000.0):
         dt = jnp.dtype(dtype).type
+        rope = bool(rope)
         self.target_cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff), dtype=dt,
+            rope=rope, rope_base=float(rope_base),
         )
         dd = int(draft_d_model) or max(16, int(d_model) // 4)
         dh = int(draft_n_heads) or max(2, int(n_heads) // 2)
-        while dd % dh != 0:  # derived defaults must keep hd integral
+        # derived defaults must keep hd integral — and EVEN when RoPE is
+        # on (rotation pairs dimensions)
+        while dd % dh != 0 or (rope and (dd // dh) % 2 != 0):
+            if dh <= 1:
+                raise ValueError(
+                    f"cannot derive a draft head count for d_model={dd} "
+                    f"with rope={rope}; set draft_n_heads explicitly"
+                )
             dh -= 1
         self.draft_cfg = LMConfig(
             vocab=int(vocab), d_model=dd, n_heads=dh,
             n_layers=int(draft_n_layers) or max(1, int(n_layers) // 2),
             d_ff=int(draft_d_ff) or max(32, int(d_ff) // 4),
-            dtype=dt,
+            dtype=dt, rope=rope, rope_base=float(rope_base),
         )
         self.seed = int(seed)
         self.max_new_tokens = int(max_new_tokens)

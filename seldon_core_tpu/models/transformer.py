@@ -44,6 +44,7 @@ from seldon_core_tpu.parallel.ring_attention import ring_attention
 
 __all__ = ["LMConfig", "lm_init", "lm_apply", "lm_loss", "lm_train_step",
            "param_shardings", "TransformerLM", "resolve_flash",
+           "save_lm_weights", "load_lm_weights",
            "lm_pipeline_params", "lm_pipeline_apply", "lm_pipeline_loss",
            "lm_pipeline_train_step"]
 
@@ -72,6 +73,13 @@ class LMConfig:
     # (ops/quant.py) — halves HBM weight traffic (decode is bandwidth-
     # bound) and runs the dots at the MXU's 2x int8 rate.  Serving-only.
     quant: str = "none"
+    # rotary position embeddings (RoPE, the modern standard).  Without ANY
+    # positional signal a causal transformer cannot express
+    # position-relative behavior (it must fall back to content-based
+    # induction); rotation is applied to q/k after the head split, so the
+    # KV cache stores rotated keys and cached decode needs no extra state.
+    rope: bool = True
+    rope_base: float = 10000.0
 
     def is_moe_layer(self, i: int) -> bool:
         return self.moe_every > 0 and (i + 1) % self.moe_every == 0
@@ -94,6 +102,11 @@ class LMConfig:
                 f"n_heads={self.n_heads} not divisible by "
                 f"n_kv_heads={kv}"
             )
+        if self.rope and (self.d_model // self.n_heads) % 2 != 0:
+            raise ValueError(
+                f"RoPE needs an even head dim, got "
+                f"{self.d_model // self.n_heads}"
+            )
 
     @property
     def kv_heads(self) -> int:
@@ -104,6 +117,25 @@ def _rmsnorm(x, w, eps=1e-6):
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
     return (x32 * scale).astype(x.dtype) * w
+
+
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotate [B, H, S, hd] by per-position angles; positions [S] (may be
+    traced — cached decode passes start+arange).  Half-split convention;
+    f32 trig, output in the input dtype."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = base ** (
+        -jnp.arange(0, half, dtype=jnp.float32) / half
+    )  # [half]
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S,half]
+    cos = jnp.cos(angles)[None, None]  # [1,1,S,half]
+    sin = jnp.sin(angles)[None, None]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
 
 
 def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
@@ -288,10 +320,14 @@ def _block(lp, x, cfg: LMConfig, mesh: Optional[Mesh], causal: bool,
     def heads(t, n):
         return t.reshape(B, S, n, hd).transpose(0, 2, 1, 3)
 
-    a = _attention(
-        heads(q, cfg.n_heads), heads(k, kv), heads(v, kv),
-        mesh, causal, use_flash,
-    )
+    q, k, v = heads(q, cfg.n_heads), heads(k, kv), heads(v, kv)
+    if cfg.rope:
+        # rotation BEFORE any sharded attention: positions are global, so
+        # the sp ring path needs no per-shard offsets
+        positions = jnp.arange(S)
+        q = apply_rope(q, positions, cfg.rope_base)
+        k = apply_rope(k, positions, cfg.rope_base)
+    a = _attention(q, k, v, mesh, causal, use_flash)
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
     h = _rmsnorm(x, lp["ln2"])
@@ -497,6 +533,66 @@ def resolve_flash(attention: str, mesh: Optional[Mesh]):
     return supported
 
 
+def save_lm_weights(params, path: str) -> str:
+    """Checkpoint an lm_init-shaped params tree to one .npz — the
+    train->serve hand-off (runtime/persistence.py flat-pytree format, so
+    the same file also restores through the persistence machinery)."""
+    from seldon_core_tpu.runtime.persistence import save_state_to_path
+
+    return save_state_to_path(path, params)
+
+
+def load_lm_weights(params, path: str):
+    """Load trained weights onto a freshly-initialised params tree (the
+    ``weights_path`` unit parameter).  Structure/dtype follow the serving
+    config — an f32 training checkpoint serves as bf16, and quantization
+    applies AFTER loading.
+
+    STRICT: a missing file, a checkpoint whose keys don't cover the
+    serving config's tree (layer-count mismatch, a state checkpoint
+    rather than a params checkpoint), or a shape mismatch (wrong
+    d_model/vocab/...) all raise a one-line config error at LOAD time —
+    a generator pod silently serving random or misshapen weights is the
+    worst failure mode."""
+    if not path:
+        return params
+    import os as _os
+
+    if not _os.path.exists(path):
+        raise FileNotFoundError(f"weights_path {path!r} does not exist")
+    import numpy as _np
+
+    import jax as _jax
+
+    from seldon_core_tpu.runtime.persistence import state_from_host
+
+    with _np.load(path) as data:
+        flat = dict(data)
+    want = {
+        _jax.tree_util.keystr(p): _np.asarray(leaf).shape
+        for p, leaf in _jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    missing = sorted(set(want) - set(flat))
+    if missing:
+        raise ValueError(
+            f"weights_path {path!r} does not cover the serving config: "
+            f"{len(missing)} missing leaves (first: {missing[0]}); is the "
+            f"checkpoint from a different architecture, or a unit-STATE "
+            f"snapshot rather than save_lm_weights params?"
+        )
+    bad = [
+        (k, flat[k].shape, want[k])
+        for k in want if tuple(flat[k].shape) != tuple(want[k])
+    ]
+    if bad:
+        k, got, exp = bad[0]
+        raise ValueError(
+            f"weights_path {path!r} shape mismatch at {k}: checkpoint "
+            f"{got} vs serving config {exp} (+{len(bad) - 1} more)"
+        )
+    return state_from_host(flat, params)
+
+
 @register_unit("TransformerLM")
 class TransformerLM(Unit):
     """Serving unit: next-token logits for a token batch.  For multi-chip
@@ -518,7 +614,11 @@ class TransformerLM(Unit):
         quant: str = "none",
         attention: str = "auto",
         n_kv_heads: int = 0,
+        weights_path: str = "",
+        rope: bool = True,
+        rope_base: float = 10000.0,
     ):
+        self.weights_path = str(weights_path)
         self.cfg = LMConfig(
             vocab=int(vocab), d_model=int(d_model), n_heads=int(n_heads),
             n_layers=int(n_layers), d_ff=int(d_ff),
@@ -526,6 +626,7 @@ class TransformerLM(Unit):
             moe_every=int(moe_every), n_experts=int(n_experts),
             moe_k=int(moe_k), quant=str(quant),
             n_kv_heads=int(n_kv_heads),
+            rope=bool(rope), rope_base=float(rope_base),
         )
         self.seed = int(seed)
         self.mesh = mesh
@@ -540,6 +641,7 @@ class TransformerLM(Unit):
             rng = jax.random.key(self.seed)
         rng = jax.random.fold_in(rng, self.seed)
         params = lm_init(rng, self.cfg)
+        params = load_lm_weights(params, self.weights_path)
         if self.cfg.quant == "int8":
             from seldon_core_tpu.ops.quant import quantize_lm_params
 

@@ -25,6 +25,7 @@ __all__ = [
     "state_to_host",
     "state_from_host",
     "save_state",
+    "save_state_to_path",
     "load_state",
     "restore_runtime",
     "persist_loop",
@@ -79,15 +80,19 @@ def checkpoint_path(unit_name: str) -> str:
     return os.path.join(base, f"{dep}_{pred}_{unit_name}.ckpt.npz")
 
 
-def save_state(unit_name: str, state) -> Optional[str]:
-    if state is None:
-        return None
-    path = checkpoint_path(unit_name)
+def save_state_to_path(path: str, state) -> str:
+    """Atomic npz snapshot of a state pytree (tmp-write + rename)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **state_to_host(state))
     os.replace(tmp, path)
     return path
+
+
+def save_state(unit_name: str, state) -> Optional[str]:
+    if state is None:
+        return None
+    return save_state_to_path(checkpoint_path(unit_name), state)
 
 
 def load_state(unit_name: str, like) -> Any:

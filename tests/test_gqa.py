@@ -105,3 +105,74 @@ def test_gqa_trains():
 def test_gqa_invalid_heads_rejected():
     with pytest.raises(ValueError, match="not divisible"):
         LMConfig(n_heads=4, n_kv_heads=3)
+
+
+def test_rope_properties():
+    """RoPE: norm-preserving rotation; relative-position invariance of
+    attention scores (the property that makes position-relative behavior
+    learnable); disabled via cfg.rope=False."""
+    from seldon_core_tpu.models.transformer import LMConfig, apply_rope
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 2, 8, 16)), jnp.float32)
+    pos = jnp.arange(8)
+    r = apply_rope(x, pos)
+    # rotation preserves per-vector norm
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5,
+    )
+    # score depends only on RELATIVE offset: <R(p)q, R(p+d)k> equal for
+    # all p at fixed d
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    scores = []
+    for p in (0, 3, 11):
+        qr = apply_rope(q, jnp.asarray([p]))
+        kr = apply_rope(k, jnp.asarray([p + 5]))
+        scores.append(float(np.asarray(qr * kr).sum()))
+    np.testing.assert_allclose(scores, scores[0], rtol=1e-4)
+    # odd head dim rejected at config time when rope is on
+    with pytest.raises(ValueError, match="even head dim"):
+        LMConfig(d_model=12, n_heads=4)  # hd=3
+    LMConfig(d_model=12, n_heads=4, rope=False)  # fine without rope
+
+
+def test_weights_path_roundtrip_and_validation(tmp_path):
+    """save_lm_weights -> weights_path serves the EXACT checkpoint;
+    wrong-architecture or state-format checkpoints fail at load time."""
+    from seldon_core_tpu.models.generate import TransformerGenerator
+    from seldon_core_tpu.models.transformer import (
+        LMConfig, lm_init, load_lm_weights, save_lm_weights,
+    )
+
+    cfg = LMConfig(vocab=64, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                   dtype=jnp.float32)
+    params = lm_init(jax.random.key(7), cfg)
+    path = str(tmp_path / "w.npz")
+    save_lm_weights(params, path)
+
+    gen = TransformerGenerator(vocab=64, d_model=64, n_heads=4, n_layers=2,
+                               d_ff=128, max_new_tokens=4, dtype="float32",
+                               weights_path=path, seed=123)
+    state = gen.init_state(jax.random.key(99))
+    np.testing.assert_array_equal(
+        np.asarray(state["params"]["l0"]["wqkv"]),
+        np.asarray(params["l0"]["wqkv"]),
+    )
+
+    # missing file
+    with pytest.raises(FileNotFoundError):
+        load_lm_weights(params, str(tmp_path / "nope.npz"))
+    # layer-count mismatch -> missing leaves
+    big = lm_init(jax.random.key(0), LMConfig(
+        vocab=64, d_model=64, n_heads=4, n_layers=4, d_ff=128,
+        dtype=jnp.float32))
+    with pytest.raises(ValueError, match="missing leaves"):
+        load_lm_weights(big, path)
+    # shape mismatch (different d_ff)
+    wide = lm_init(jax.random.key(0), LMConfig(
+        vocab=64, d_model=64, n_heads=4, n_layers=2, d_ff=256,
+        dtype=jnp.float32))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_lm_weights(wide, path)
