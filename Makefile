@@ -20,6 +20,10 @@ bench:
 demos:
 	python examples/demos.py all
 
+# full model lifecycle: train -> checkpoint -> serve -> verify over REST
+train-demo:
+	python examples/train_then_serve.py
+
 stack:
 	python examples/local_stack.py
 
@@ -44,4 +48,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test bench demos stack bundle images publish release-dryrun
+.PHONY: proto native test bench demos train-demo stack bundle images publish release-dryrun
