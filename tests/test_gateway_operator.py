@@ -21,6 +21,16 @@ from seldon_core_tpu.operator.materializer import Materializer
 from seldon_core_tpu.runtime.engine import EngineService
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def two_predictor_spec(name="canary-dep", main_replicas=3, canary_replicas=1):
     """Main + canary predictors — the reference's canary pattern."""
 
@@ -140,12 +150,7 @@ def test_gateway_http_surface():
         store.register(spec, engines)
         gw = ApiGateway(store=store)
 
-        import socket
-
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        port = _free_port()
         runner = await serve_app(make_gateway_app(gw), "127.0.0.1", port)
         try:
             async with aiohttp.ClientSession() as session:
@@ -352,3 +357,82 @@ def test_firehose_consumer_holds_back_partial_lines(tmp_path):
     finally:
         sys.stdout = old
     assert "puid=b" in out2.getvalue()
+
+
+def test_gateway_sse_stream_proxy():
+    """SSE generation THROUGH the gateway (apife generate_stream route):
+    auth enforced, in-process engine branch streams token events with a
+    terminal done frame — the reference's apife never had a streaming
+    surface (pre-LLM)."""
+
+    async def run():
+        import aiohttp
+
+        from seldon_core_tpu.runtime.rest import serve_app
+
+        spec = SeldonDeploymentSpec.from_json_dict({
+            "spec": {
+                "name": "gen-gw", "oauth_key": "gk", "oauth_secret": "gs",
+                "predictors": [{
+                    "name": "main",
+                    "graph": {"name": "g", "type": "MODEL"},
+                    "components": [{
+                        "name": "g", "runtime": "inprocess",
+                        "class_path": "TransformerGenerator",
+                        "parameters": [
+                            {"name": "vocab", "value": "64", "type": "INT"},
+                            {"name": "d_model", "value": "64", "type": "INT"},
+                            {"name": "n_heads", "value": "4", "type": "INT"},
+                            {"name": "n_layers", "value": "2", "type": "INT"},
+                            {"name": "d_ff", "value": "128", "type": "INT"},
+                            {"name": "dtype", "value": "float32",
+                             "type": "STRING"},
+                            {"name": "max_new_tokens", "value": "8",
+                             "type": "INT"},
+                        ],
+                    }],
+                }],
+            }
+        })
+        store = DeploymentStore()
+        store.register(spec, {"main": EngineService(spec)})
+        gw = ApiGateway(store=store)
+        token = store.issue_token("gk", "gs")
+
+        port = _free_port()
+        runner = await serve_app(make_gateway_app(gw), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as session:
+                payload = {"data": {"ndarray": [[1.0, 2.0, 3.0]]},
+                           "chunk": 4}
+                # unauthenticated -> 401, no stream
+                async with session.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/generate/stream",
+                    json=payload,
+                ) as r:
+                    assert r.status == 401
+                # authenticated: SSE events, terminal done frame, 8 tokens
+                async with session.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/generate/stream",
+                    headers={"Authorization": f"Bearer {token}"},
+                    json=payload,
+                ) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith(
+                        "text/event-stream"
+                    )
+                    events = []
+                    async for raw in r.content:
+                        line = raw.decode().strip()
+                        if line.startswith("data: "):
+                            events.append(json.loads(line[len("data: "):]))
+                assert events[-1].get("done") is True
+                toks = sum(
+                    len(e["tokens"][0]) for e in events if "tokens" in e
+                )
+                assert toks == 8, events
+        finally:
+            await runner.cleanup()
+            await gw.close()
+
+    asyncio.run(run())
