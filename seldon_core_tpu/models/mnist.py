@@ -152,9 +152,10 @@ class MnistClassifier(Unit):
 @register_unit("QuantizedMnistClassifier")
 class QuantizedMnistClassifier(MnistClassifier):
     """Int8 serving variant: weights quantize once at init (symmetric
-    per-channel), activations quantize per row at predict, matmuls run
-    int8 x int8 -> int32 on the MXU (ops/quant.py) — ~2x MXU rate and half
-    the weight HBM traffic vs bf16, argmax-stable for classifier heads."""
+    per-channel) and serve weight-only (dequant_matmul: XLA fuses the
+    convert+scale into the dot's weight read, so weights stream at int8
+    size — ops/quant.py records the measured trade-offs).  Activations
+    are never quantized; argmax-stable for classifier heads."""
 
     def init_state(self, rng):
         from seldon_core_tpu.ops.quant import quantize_mlp_params

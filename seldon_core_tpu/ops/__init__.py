@@ -6,6 +6,7 @@ from seldon_core_tpu.ops.fused_mlp import (  # noqa: F401
 )
 from seldon_core_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from seldon_core_tpu.ops.quant import (  # noqa: F401
+    dequant_matmul,
     QuantizedMLP,
     quant_matmul,
     quantize_weight,
