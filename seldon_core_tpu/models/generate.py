@@ -291,8 +291,14 @@ def _chunk_step(params, token, cache, pos, key, cfg: LMConfig, n: int,
     return toks.T, (token, cache, pos, key)  # [B, n]
 
 
+# cache buffers DONATED across chunk dispatches: each SSE chunk would
+# otherwise copy the whole KV cache in and out of the program (stream
+# serving pays that per event; the one-shot generate() runs a single
+# program and never sees the boundary).  Callers must treat the passed
+# carry as consumed — stream_chunks reassigns it every iteration.
 _chunk_step_jit = jax.jit(
-    _chunk_step, static_argnames=("cfg", "n", "temperature")
+    _chunk_step, static_argnames=("cfg", "n", "temperature"),
+    donate_argnums=(2,),
 )
 
 

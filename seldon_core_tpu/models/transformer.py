@@ -69,9 +69,9 @@ class LMConfig:
     moe_every: int = 0
     n_experts: int = 8
     moe_k: int = 2
-    # "int8": serve layer matmuls from symmetric per-channel int8 weights
-    # (ops/quant.py) — halves HBM weight traffic (decode is bandwidth-
-    # bound) and runs the dots at the MXU's 2x int8 rate.  Serving-only.
+    # "int8": serve layer matmuls from symmetric per-channel int8 weights,
+    # weight-only W8A16 (ops/quant.py dequant_matmul) — weights stream at
+    # half the bytes, activations never quantize.  Serving-only.
     quant: str = "none"
     # rotary position embeddings (RoPE, the modern standard).  Without ANY
     # positional signal a causal transformer cannot express
