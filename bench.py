@@ -26,8 +26,13 @@ throughput is meaningful while single-request p50 is floored by the relay
 into parse/dispatch/format so the framework-added latency is visible
 separately from the relay.
 
-Prints ONE JSON line: metric=stub_rest_socketed_max_qps,
-vs_baseline = value / 12088.95.
+Output contract (the driver captures a bounded TAIL of stdout and parses
+the last line): the FULL result dict is written to ``BENCH_FULL.json`` at
+the repo root, and the LAST stdout line is a COMPACT JSON object (headline
+metric + curated keys, no prose) guaranteed to fit the capture window —
+round 3's single fat line outgrew it and truncated the headline value out
+of the judged artifact.  metric=stub_rest_socketed_max_qps, vs_baseline =
+value / 12088.95.
 """
 
 from __future__ import annotations
@@ -832,7 +837,30 @@ def main() -> None:
         **served_gen,
         "duration_s": duration,
     }
-    print(json.dumps(result))
+    # full artifact to disk; compact machine line LAST on stdout
+    full_path = os.path.join(REPO, "BENCH_FULL.json")
+    with open(full_path, "w") as f:
+        json.dump(result, f, indent=1)
+    compact_keys = [
+        "metric", "value", "unit", "vs_baseline",
+        "grpc_max_qps", "grpc_vs_baseline", "rest_qps_per_host_core",
+        "host_cores", "mnist_max_qps", "failures",
+        "prefill_mfu_pct", "mfu_pct",
+        "decode_tok_s", "decode_tok_s_maxbatch", "decode_maxbatch",
+        "decode_hbm_bw_util_pct", "decode_hbm_bw_util_pct_maxbatch",
+        "decode_tok_s_int8kv", "int8kv_vs_bf16_x",
+        "decode_tok_s_int8", "int8_vs_bf16_x",
+        "spec_vs_plain_x", "spec_accept_len",
+        "flash_vs_xla_x",
+        "e2e_gen_tok_s", "served_gen_tok_s",
+        "span_framework_p50_ms", "relay_floor_ms",
+        "model_params_m", "lm_config",
+    ]
+    compact = {k: result[k] for k in compact_keys if k in result}
+    compact["full_artifact"] = "BENCH_FULL.json"
+    line = json.dumps(compact, separators=(",", ":"))
+    assert len(line) < 1500, f"compact bench line too long ({len(line)})"
+    print(line)
 
 
 if __name__ == "__main__":

@@ -173,7 +173,14 @@ def main() -> int:
         return 0
     finally:
         if proc.poll() is None:
+            # two signals on purpose: the first starts engine_main's
+            # graceful drain (20 s readiness-503 window), the second skips
+            # it — a demo teardown has no traffic to drain.  The pause in
+            # between matters: POSIX signals don't queue, so back-to-back
+            # sends can coalesce into one delivery and leave the engine in
+            # its full drain window
             proc.send_signal(signal.SIGTERM)
+            time.sleep(1.0)
             proc.send_signal(signal.SIGTERM)
             try:
                 proc.wait(timeout=20)

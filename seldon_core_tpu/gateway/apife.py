@@ -379,7 +379,10 @@ def make_gateway_app(gateway: ApiGateway):
         # readiness = a registered routing table (an empty gateway serves
         # nothing useful; the bundle's probe gates the Service on this) —
         # regardless of auth mode: an open gateway with no deployments can
-        # still only 404
+        # still only 404.  No startup flap: gateway_main registers spec_dir
+        # files BEFORE binding this server, so a red probe means the spec
+        # source is genuinely empty, and readiness (not liveness) failing
+        # just keeps the Service from routing here — the intended gate
         if gateway.store.deployments():
             return web.Response(text="ready")
         return web.Response(text="no deployments registered", status=503)

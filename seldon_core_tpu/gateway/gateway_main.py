@@ -63,10 +63,25 @@ def _engine_url_map() -> dict:
         ) from e
 
 
-def _register_specs(store, spec_dir: str, seen: dict, url_map: dict) -> None:
+def _engine_url_template() -> str:
+    """Validated once at boot: a template with placeholders other than
+    {name}/{predictor} is a fatal config error with a clear message — NOT
+    a KeyError escaping from the poll loop on the first matching spec."""
     template = os.environ.get(
         "GATEWAY_ENGINE_URL_TEMPLATE", "http://{name}:8000"
     )
+    try:
+        template.format(name="x", predictor="y")
+    except (KeyError, IndexError, ValueError) as e:
+        raise SystemExit(
+            f"GATEWAY_ENGINE_URL_TEMPLATE {template!r} is invalid: only "
+            f"{{name}} and {{predictor}} placeholders are supported ({e})"
+        ) from e
+    return template
+
+
+def _register_specs(store, spec_dir: str, seen: dict, url_map: dict,
+                    template: str) -> None:
     for path in sorted(glob.glob(os.path.join(spec_dir, "*.json"))):
         mtime = os.path.getmtime(path)
         if seen.get(path) == mtime:
@@ -112,8 +127,9 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
         gateway.firehose.start()  # drain task needs the running loop
     seen: dict = {}
     url_map = _engine_url_map()
+    template = _engine_url_template()  # fatal at boot if malformed
     if spec_dir:
-        _register_specs(store, spec_dir, seen, url_map)
+        _register_specs(store, spec_dir, seen, url_map, template)
     runner = await serve_app(make_gateway_app(gateway), host, rest_port)
     grpc_server = make_gateway_grpc_server(gateway, host, grpc_port)
     await grpc_server.start()
@@ -137,7 +153,7 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
             await asyncio.wait_for(stop.wait(), timeout=5.0)
         except asyncio.TimeoutError:
             if spec_dir:  # poll for new/changed deployment specs
-                _register_specs(store, spec_dir, seen, url_map)
+                _register_specs(store, spec_dir, seen, url_map, template)
     await grpc_server.stop(grace=5.0)
     await runner.cleanup()
     if gateway.firehose is not None:

@@ -48,16 +48,41 @@ def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
     # cache — the HBM stream every decode step pays for — shrinks by
     # n_heads/n_kv_heads.  NOT rounded up to the flash-decode block: that
     # kernel is unwired (measured slower, see ops/flash_decode.py), and
-    # padding would bill every decode step for masked slots
+    # padding would bill every decode step for masked slots.
+    # kv_quant="int8" stores int8 values + per-token-per-head f32 scales
+    # ([B, KV, L] — ~6% size overhead at hd=64), halving the stream.
     hd = cfg.d_model // cfg.n_heads
     kv = cfg.kv_heads
-    return {
-        f"l{i}": {
+
+    def layer():
+        if cfg.kv_quant == "int8":
+            return {
+                "k": jnp.zeros((batch, kv, max_len, hd), jnp.int8),
+                "v": jnp.zeros((batch, kv, max_len, hd), jnp.int8),
+                "k_s": jnp.zeros((batch, kv, max_len), jnp.float32),
+                "v_s": jnp.zeros((batch, kv, max_len), jnp.float32),
+            }
+        return {
             "k": jnp.zeros((batch, kv, max_len, hd), cfg.dtype),
             "v": jnp.zeros((batch, kv, max_len, hd), cfg.dtype),
         }
-        for i in range(cfg.n_layers)
-    }
+
+    return {f"l{i}": layer() for i in range(cfg.n_layers)}
+
+
+def _quantize_kv(t):
+    """t [B, KV, S, hd] float -> (int8 values, f32 scales [B, KV, S]).
+
+    Symmetric per-token-per-head absmax — one scale per cache position, so
+    the score/PV dots recover it as a rank-1 broadcast over the length
+    axis (no per-element dequant tensor ever materialises)."""
+    t32 = t.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(t32), axis=-1)
+    scales = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(
+        jnp.round(t32 / scales[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scales
 
 
 def _heads(t, B, S, H, hd):
@@ -74,41 +99,57 @@ def sanitize_prompt(X, vocab: int):
     return jnp.clip(jnp.nan_to_num(X), 0, vocab - 1).astype(jnp.int32)
 
 
-def _grouped_qk(q, cache_k):
+def _grouped_qk(q, cache_k, k_s=None):
     """q [B,H,S,hd] x cache_k [B,KV,L,hd] -> scores [B,KV,g,S,L] f32.
 
     The group axis folds into the dot_general row axis so K streams from
     HBM once at its stored (grouped) size — decode is HBM-bound on exactly
     this stream, and with GQA it is n_heads/n_kv_heads smaller.  Reads use
-    the stored dtype (bf16) with f32 accumulation via
-    ``preferred_element_type``; an explicit .astype(f32) would materialise
-    a second, twice-as-large copy of the cache every step."""
+    the stored dtype with f32 accumulation via ``preferred_element_type``;
+    an explicit .astype(f32) would materialise a second, larger copy of
+    the cache every step.  Int8 caches (``k_s`` [B,KV,L] scales) cast
+    inside the dot — XLA fuses the convert into the weight-side read, the
+    dequant_matmul trick — and the per-position scale multiplies the f32
+    SCORES (a rank-1 broadcast over L), never the cache."""
     B, H, S, hd = q.shape
     KV, L = cache_k.shape[1], cache_k.shape[2]
     g = H // KV
     scale = jnp.float32(1.0 / (hd ** 0.5))
+    k = cache_k.astype(q.dtype) if cache_k.dtype == jnp.int8 else cache_k
     s = jax.lax.dot_general(
-        q.reshape(B, KV, g * S, hd), cache_k,
+        q.reshape(B, KV, g * S, hd), k,
         (((3,), (3,)), ((0, 1), (0, 1))),
         preferred_element_type=jnp.float32,
     ) * scale
-    return s.reshape(B, KV, g, S, L)
+    s = s.reshape(B, KV, g, S, L)
+    if k_s is not None:
+        s = s * k_s[:, :, None, None, :]
+    return s
 
 
-def _grouped_pv(p, cache_v, out_shape):
-    """p [B,KV,g,S,L] x cache_v [B,KV,L,hd] -> [B,H,S,hd] (stored dtype)."""
+def _grouped_pv(p, cache_v, out_shape, out_dtype, v_s=None):
+    """p [B,KV,g,S,L] x cache_v [B,KV,L,hd] -> [B,H,S,hd] ``out_dtype``.
+
+    Int8 caches fold the per-position scale into p BEFORE the dot
+    (out = (p * v_s) @ v_q): p is [*, L]-shaped so the scale is a cheap
+    broadcast there, while scaling V would rebuild a full-size float
+    cache copy."""
     B, KV, g, S, L = p.shape
+    if v_s is not None:
+        p = p * v_s[:, :, None, None, :]
+    v = (cache_v.astype(out_dtype)
+         if cache_v.dtype == jnp.int8 else cache_v)
     out = jax.lax.dot_general(
-        p.astype(cache_v.dtype).reshape(B, KV, g * S, L), cache_v,
+        p.astype(out_dtype).reshape(B, KV, g * S, L), v,
         (((3,), (2,)), ((0, 1), (0, 1))),
         preferred_element_type=jnp.float32,
-    ).astype(cache_v.dtype)
+    ).astype(out_dtype)
     return out.reshape(out_shape)
 
 
-def _attend_cached(q, cache_k, cache_v, n_valid):
-    """q [B,H,1,hd] against the (possibly grouped) cache; positions >=
-    n_valid (scalar) masked.
+def _attend_cached(q, cache_layer, n_valid):
+    """q [B,H,1,hd] against the (possibly grouped, possibly int8) cache
+    layer {k, v, k_s?, v_s?}; positions >= n_valid (scalar) masked.
 
     Deliberately the grouped-XLA formulation: the fused Pallas
     flash-decode kernel (ops/flash_decode.py) was measured SLOWER here —
@@ -116,24 +157,26 @@ def _attend_cached(q, cache_k, cache_v, n_valid):
     the whole batch as a few large batched dots (see that module's
     docstring for numbers).  Keep the dots batched; revisit only with a
     batch-blocked kernel design."""
-    s = _grouped_qk(q, cache_k)  # [B,KV,g,1,L]
-    valid = jnp.arange(cache_k.shape[2]) < n_valid  # [L]
+    s = _grouped_qk(q, cache_layer["k"], cache_layer.get("k_s"))
+    valid = jnp.arange(cache_layer["k"].shape[2]) < n_valid  # [L]
     s = jnp.where(valid[None, None, None, None, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return _grouped_pv(p, cache_v, q.shape)
+    return _grouped_pv(p, cache_layer["v"], q.shape, q.dtype,
+                       cache_layer.get("v_s"))
 
 
-def _attend_cached_causal(q, cache_k, cache_v, start):
+def _attend_cached_causal(q, cache_layer, start):
     """q [B,H,S,hd] for global positions start..start+S-1 over the cache:
     query i may see cache positions <= start + i (speculative segments)."""
     S = q.shape[2]
-    s = _grouped_qk(q, cache_k)  # [B,KV,g,S,L]
+    s = _grouped_qk(q, cache_layer["k"], cache_layer.get("k_s"))
     qpos = start + jnp.arange(S)[:, None]
-    kpos = jnp.arange(cache_k.shape[2])[None, :]
+    kpos = jnp.arange(cache_layer["k"].shape[2])[None, :]
     mask = kpos <= qpos  # [S, L]
     s = jnp.where(mask[None, None, None, :, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return _grouped_pv(p, cache_v, q.shape)
+    return _grouped_pv(p, cache_layer["v"], q.shape, q.dtype,
+                       cache_layer.get("v_s"))
 
 
 def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
@@ -160,30 +203,47 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
         positions = start + jnp.arange(S)
         q = apply_rope(q, positions, cfg.rope_base)
         k = apply_rope(k, positions, cfg.rope_base)
-    cache_k = jax.lax.dynamic_update_slice(
-        cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, 0, start, 0)
-    )
-    cache_v = jax.lax.dynamic_update_slice(
-        cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, 0, start, 0)
-    )
+    if cache_layer["k"].dtype == jnp.int8:
+        k_w, k_sw = _quantize_kv(k)
+        v_w, v_sw = _quantize_kv(v)
+        new_cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache_layer["k"], k_w, (0, 0, start, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache_layer["v"], v_w, (0, 0, start, 0)),
+            "k_s": jax.lax.dynamic_update_slice(
+                cache_layer["k_s"], k_sw, (0, 0, start)),
+            "v_s": jax.lax.dynamic_update_slice(
+                cache_layer["v_s"], v_sw, (0, 0, start)),
+        }
+    else:
+        new_cache = {
+            "k": jax.lax.dynamic_update_slice(
+                cache_layer["k"], k.astype(cache_layer["k"].dtype),
+                (0, 0, start, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cache_layer["v"], v.astype(cache_layer["v"].dtype),
+                (0, 0, start, 0)),
+        }
     if segment:
         # mid-sequence continuation (speculative draft/verify): causal over
         # the whole cache with global position offsets (any S, traced start)
-        a = _attend_cached_causal(q, cache_k, cache_v, start)
+        a = _attend_cached_causal(q, new_cache, start)
     elif S > 1:
         # prefill: causal attention over the fresh k/v only — the cache
         # tail past S is all-masked zeros, no need to attend over it.
         # Reuses the LM's _attention (flash kernel when available, same
-        # fallback numerics as lm_apply) so the two paths cannot drift.
+        # fallback numerics as lm_apply) so the two paths cannot drift;
+        # int8 caches still prefill from the EXACT pre-quantization k/v.
         a = _attention(q, k, v, None, causal=True, use_flash=use_flash)
     else:
-        a = _attend_cached(q, cache_k, cache_v, n_valid)
+        a = _attend_cached(q, new_cache, n_valid)
     a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
     x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
     h = _rmsnorm(x, lp["ln2"])
     y, _lb = _ffn(lp, h, cfg, mesh=None)  # dense or MoE FFN
     x = x + y
-    return x, {"k": cache_k, "v": cache_v}
+    return x, new_cache
 
 
 def segment_forward(params, tokens, cache, start, cfg: LMConfig,
@@ -372,6 +432,7 @@ class TransformerGenerator(Unit):
                  dtype: str = "bfloat16", moe_every: int = 0,
                  n_experts: int = 8, moe_k: int = 2, mesh=None,
                  quant: str = "none", attention: str = "auto",
+                 kv_quant: str = "none",
                  n_kv_heads: int = 0, weights_path: str = "",
                  rope: bool = True, rope_base: float = 10000.0):
         # mesh (from the binding's mesh_axes, e.g. {"tp": 4}): params are
@@ -385,6 +446,7 @@ class TransformerGenerator(Unit):
             dtype=jnp.dtype(dtype).type,
             moe_every=int(moe_every), n_experts=int(n_experts),
             moe_k=int(moe_k), quant=str(quant),
+            kv_quant=str(kv_quant),
             n_kv_heads=int(n_kv_heads),
             rope=bool(rope), rope_base=float(rope_base),
         )

@@ -73,6 +73,13 @@ class LMConfig:
     # weight-only W8A16 (ops/quant.py dequant_matmul) — weights stream at
     # half the bytes, activations never quantize.  Serving-only.
     quant: str = "none"
+    # "int8": store the KV cache as int8 with per-token-per-head f32
+    # scales (absmax over the head dim).  Cached decode is HBM-bound on
+    # the K/V stream — at large batch it is ~6x the weight stream — so
+    # halving cache bytes is the decode-throughput lever int8 WEIGHTS
+    # cannot be (models/generate.py reads the scales back into the score
+    # and PV dots; prefill/training numerics untouched).  Serving-only.
+    kv_quant: str = "none"
     # rotary position embeddings (RoPE, the modern standard).  Without ANY
     # positional signal a causal transformer cannot express
     # position-relative behavior (it must fall back to content-based
@@ -95,6 +102,10 @@ class LMConfig:
         if self.quant not in ("none", "int8"):
             raise ValueError(
                 f"quant={self.quant!r} not supported (none | int8)"
+            )
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"kv_quant={self.kv_quant!r} not supported (none | int8)"
             )
         kv = self.kv_heads
         if self.n_heads % kv != 0:

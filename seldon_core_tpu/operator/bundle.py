@@ -271,9 +271,16 @@ def _gateway(values: Dict[str, Any]) -> List[dict]:
                 {"containerPort": v["rest_port"], "name": "http"},
                 {"containerPort": v["grpc_port"], "name": "grpc"},
             ],
+            # /ready is 503 until a deployment registers; gateway_main
+            # registers file specs BEFORE binding the server, so a probe
+            # can only stay red while the spec source is genuinely empty.
+            # Pin period/threshold explicitly: unready (no restart) for as
+            # long as that lasts, green within ~5 s of the first register.
             "readinessProbe": {
                 "httpGet": {"path": "/ready", "port": v["rest_port"]},
                 "initialDelaySeconds": 5,
+                "periodSeconds": 5,
+                "failureThreshold": 3,
             },
             "volumeMounts": [{"name": "gateway-state",
                               "mountPath": state_dir}],

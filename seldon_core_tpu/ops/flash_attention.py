@@ -8,9 +8,10 @@ online (running max ``m``, normaliser ``l``, weighted sum ``acc``), so HBM
 traffic is O(S*D) and the score matrix never exists.
 
 Layout: grid (B*H, S/bq, S/bk) — the K-block axis is innermost, so the
-(m, l, acc) VMEM scratch carries across K steps of one Q block; stats are
-kept lane-broadcast ([bq, bk] blocks with bq = bk = 128) to stay on the
-VPU's native tiles.  Causality is applied by global-position masking.
+(m, l, acc) VMEM scratch carries across K steps of one Q block; the m/l
+stats live in one native [bq, 128] lane tile (values broadcast across the
+128 lanes — lane-sliced [:, :1] reads recover them).  Causality is
+applied by global-position masking.
 
 ``flash_attention`` raises ValueError when its constraints don't hold
 (S % 128, head dim <= 256); callers fall back to the XLA path.
@@ -22,15 +23,15 @@ dK/dV run on a (heads, k-block, q-block) grid accumulating over Q blocks —
 two passes instead of atomics, the standard TPU formulation.  Gradients
 match the XLA attention VJP to ~1e-5 in f32 (tests/test_flash_attention.py).
 
-Measured on v5e THROUGH the full LM forward (interleaved A/B, chained
-100-rep dispatches, bf16, causal, H=8/D=128): **1.4x faster than XLA's
-fused attention at S=8192** and 1.7x slower at S=2048 — XLA's own fusion
-is strong at moderate lengths; this kernel's causal block-skip and
-O(S*D) HBM traffic win as S^2 grows.  ``models/transformer.py``'s auto
-mode therefore takes the kernel only from ``FLASH_AUTO_MIN_S`` up, and
-``attention="flash"`` forces it.  K-block size auto-selects up to 512
-(grid-step overhead amortization — the bk=128 variant measured 0.6x XLA
-at S=8192; bk=512 flipped it to 1.4x).
+Block sizes auto-select LARGE — bq up to 512, bk up to 1024 (divisibility
+permitting): per-grid-step overhead (~1 us) dominates the per-block dot
+at moderate S long before the MXU does, and a wider q block also divides
+total K/V streaming by bq/128.  The round-3 kernel (bq=128, bk<=512,
+[bq, bk] broadcast stats) measured 1.4x XLA at S=8192 but 1.7x SLOWER at
+S=2048, which set ``FLASH_AUTO_MIN_S``; the current shape is re-measured
+by bench.py's ``flash_vs_xla_x`` keys each round and the auto threshold
+follows those measurements.  ``attention="flash"`` forces the kernel at
+any length.
 """
 
 from __future__ import annotations
@@ -44,6 +45,12 @@ __all__ = ["flash_attention"]
 
 _BLOCK = 128
 _NEG_INF = -1e30
+
+
+#: stats scratch lane width — one native VPU tile, independent of bk (the
+#: round-3 kernel kept [bq, bk] broadcast stats, which at bk=512 burned
+#: VPU time rebroadcasting [bq, 512] tiles every block step)
+_STATS_LANES = 128
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
@@ -80,15 +87,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             )
             s = jnp.where(qpos >= kpos, s, _NEG_INF)
 
-        m_prev = m_ref[:]                               # [bq, bk] lane-bcast
-        l_prev = l_ref[:]
+        m_prev = m_ref[:][:, :1]                        # [bq, 1]
+        l_prev = l_ref[:][:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_cur)                 # [bq, bk] lane-bcast
-        p = jnp.exp(s - m_cur)                          # m_cur same per lane
+        alpha = jnp.exp(m_prev - m_cur)                 # [bq, 1]
+        p = jnp.exp(s - m_cur)                          # [bq, bk] (bcast sub)
         l_cur = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        m_ref[:] = m_cur
-        l_ref[:] = l_cur
-        acc_ref[:] = acc_ref[:] * alpha[:, :1] + jax.lax.dot_general(
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -97,13 +104,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     def _done():
         # fully-masked rows (can't happen causally, but keep the guard
         # for masked variants) divide by at least 1
+        l_fin = l_ref[:][:, :1]
         o_ref[0] = (
-            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+            acc_ref[:] / jnp.maximum(l_fin, 1e-30)
         ).astype(o_ref.dtype)
         # per-row log-sum-exp of the scaled scores, saved for the backward
         # pass (p is recomputed there as exp(s - lse))
-        lse_ref[0] = m_ref[:, :1] + jnp.log(
-            jnp.maximum(l_ref[:, :1], 1e-30)
+        lse_ref[0] = m_ref[:][:, :1] + jnp.log(
+            jnp.maximum(l_fin, 1e-30)
         )
 
 
@@ -137,11 +145,13 @@ def _fwd_impl(q, k, v, causal: bool, interpret: bool):
     B, H, S, D = _validate(q, k, v)
     KV = k.shape[1]
     g = H // KV
-    # larger K blocks amortize the per-grid-step overhead at long S (the
-    # VMEM budget easily holds [bk, D] K/V tiles at bk=512); bq stays at
-    # the native 128 so the stats tiles keep the lane-broadcast layout
-    bq = _BLOCK
-    bk = max(b for b in (512, 256, _BLOCK) if S % b == 0)
+    # large blocks are the moderate-S lever: per-grid-step overhead (~1 us)
+    # dominates the tiny per-block dot long before the MXU does, and a
+    # wider q block also divides total K/V streaming by bq/128.  VMEM holds
+    # the [bq, bk] f32 score tile + [bk, D] K/V tiles comfortably at
+    # 512x1024xD<=256 (~6 MB with double buffering, of ~16 MB)
+    bq = max(b for b in (512, 256, _BLOCK) if S % b == 0)
+    bk = max(b for b in (1024, 512, 256, _BLOCK) if S % b == 0)
     n_k = S // bk
     scale = float(1.0 / (D ** 0.5))
 
@@ -185,9 +195,9 @@ def _fwd_impl(q, k, v, causal: bool, interpret: bool):
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((bq, bk), jnp.float32),  # m (lane-broadcast)
-            pltpu.VMEM((bq, bk), jnp.float32),  # l
-            pltpu.VMEM((bq, D), jnp.float32),   # acc
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),  # m (lane-bcast)
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),  # l
+            pltpu.VMEM((bq, D), jnp.float32),             # acc
         ],
         interpret=interpret,
     )(q.reshape(B * H, S, D), k.reshape(B * KV, S, D),

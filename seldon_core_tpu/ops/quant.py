@@ -90,10 +90,12 @@ def dequant_matmul(x, w_q, w_scales, out_dtype=None):
     to the f32-accumulated OUTPUT (cheaper than scaling the [in, out]
     weights and avoids a second bf16 rounding)."""
     ct = x.dtype if x.dtype in (jnp.bfloat16, jnp.float16) else jnp.bfloat16
+    # trailing-axis broadcast (no [None, :]): a rank-1 x yields a rank-1
+    # [out] result, matching the plain-matmul path for any input rank
     y = jax.lax.dot_general(
         x.astype(ct), w_q.astype(ct), (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * w_scales[None, :]
+    ) * w_scales
     return y.astype(out_dtype) if out_dtype is not None else y
 
 

@@ -20,7 +20,7 @@ def test_flash_decode_matches_xla_attend(kv, g):
     k = jnp.asarray(rng.normal(size=(B, kv, L, hd)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(B, kv, L, hd)), jnp.float32)
     n_valid = 130  # mid-block mask boundary
-    want = np.asarray(_attend_cached(q, k, v, n_valid))  # [B,H,1,hd]
+    want = np.asarray(_attend_cached(q, {"k": k, "v": v}, n_valid))
     got = np.asarray(flash_decode(
         q.reshape(B, kv, g, hd), k, v, n_valid, interpret=True
     )).reshape(B, H, 1, hd)
@@ -35,7 +35,7 @@ def test_flash_decode_full_valid_and_single_position():
     v = jnp.asarray(rng.normal(size=(B, kv, L, hd)), jnp.float32)
     for nv in (1, L):
         want = np.asarray(_attend_cached(
-            q.reshape(B, kv * g, 1, hd), k, v, nv
+            q.reshape(B, kv * g, 1, hd), {"k": k, "v": v}, nv
         ))
         got = np.asarray(flash_decode(q, k, v, nv, interpret=True)).reshape(
             B, kv * g, 1, hd

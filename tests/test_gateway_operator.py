@@ -67,6 +67,22 @@ def two_predictor_spec(name="canary-dep", main_replicas=3, canary_replicas=1):
     )
 
 
+def test_engine_url_template_validated_at_boot(monkeypatch):
+    """A template with an unknown placeholder is a one-line SystemExit at
+    boot, not a KeyError from the spec poll loop."""
+    from seldon_core_tpu.gateway.gateway_main import _engine_url_template
+
+    monkeypatch.setenv(
+        "GATEWAY_ENGINE_URL_TEMPLATE", "http://{namespace}.{name}:8000"
+    )
+    with pytest.raises(SystemExit, match="GATEWAY_ENGINE_URL_TEMPLATE"):
+        _engine_url_template()
+    monkeypatch.setenv(
+        "GATEWAY_ENGINE_URL_TEMPLATE", "http://{name}-{predictor}:9000"
+    )
+    assert _engine_url_template() == "http://{name}-{predictor}:9000"
+
+
 def test_oauth_token_flow():
     spec = two_predictor_spec()
     store = DeploymentStore()

@@ -28,6 +28,23 @@ def test_quantize_weight_roundtrip_error_bounded():
     assert err <= float(np.asarray(s).max()) * 0.5 + 1e-6
 
 
+def test_dequant_matmul_preserves_input_rank():
+    from seldon_core_tpu.ops.quant import dequant_matmul
+
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    w_q, s = quantize_weight(w)
+    # rank-1 input -> rank-1 [out] output, same as a plain matmul
+    x1 = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    y1 = dequant_matmul(x1, w_q, s)
+    assert y1.shape == (32,)
+    # rank-3 leading dims pass through
+    x3 = jnp.asarray(rng.normal(size=(2, 3, 64)), jnp.float32)
+    assert dequant_matmul(x3, w_q, s).shape == (2, 3, 32)
+    ref = np.asarray(x1 @ w)
+    assert np.abs(np.asarray(y1) - ref).max() / np.abs(ref).max() < 0.02
+
+
 def test_quant_matmul_close_to_f32():
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.normal(size=(8, 64)), jnp.float32)
