@@ -352,23 +352,31 @@ def _probe_mfu_main(smoke: bool) -> None:
     # let the subtraction go negative (real configs are >> the floor)
     t_prefill = max(raw - relay_s, 0.05 * raw) / n_prefill
     prefill_tok_s = B * S / t_prefill
+    # prefill unembeds ONLY the last position (generate.py last_only), so
+    # the 2dv term is per ROW here, not per token — count what runs
     prefill_flops = (
-        B * S * matmul_per_tok + L * 2 * B * S * S * d  # causal: S^2/2 x 4BSSD
+        B * S * (matmul_per_tok - 2 * d * v) + B * 2 * d * v
+        + L * 2 * B * S * S * d  # causal: S^2/2 x 4BSSD
     )
     prefill_mfu = prefill_flops / t_prefill / peak
 
     # ---- decode: one scan over NEW cached steps ---------------------------
+    # two-tier shape (models/generate.py): prompt-sized read-only main +
+    # NEW-slot chunk buffer, exactly what generate() runs for this config
     def decode_measure(ps, qcfg, b):
         btoks = toks0[:1].repeat(b, axis=0) if b != B else toks0
-        cache = init_cache(qcfg, b, total_len)
-        logits, cache = jax.jit(
+        main = init_cache(qcfg, b, S)
+        logits, main = jax.jit(
             lambda p, t, c: prefill(p, t, c, qcfg, use_flash=True)
-        )(ps, btoks, cache)
+        )(ps, btoks, main)
         first = jnp.argmax(logits, -1).astype(jnp.int32)
-        carry = (first, cache, jnp.int32(S), jax.random.key(0))
+        chunk = init_cache(qcfg, b, NEW)
+        carry = (first, main, chunk, jnp.int32(S), jnp.int32(0),
+                 jax.random.key(0))
         step = jax.jit(
-            lambda p, tok, c, pos, key: _chunk_step(
-                p, tok, c, pos, key, qcfg, NEW, 0.0
+            lambda p, tok, m, c, nm, used, key: _chunk_step(
+                p, tok, m, c, nm, used, key, qcfg, NEW, 0.0,
+                main_full=True,  # main is exactly the prompt
             )
         )
         jax.block_until_ready(step(ps, *carry))  # compile
@@ -388,13 +396,59 @@ def _probe_mfu_main(smoke: bool) -> None:
     decode_flops = B * matmul_per_tok + L * 4 * B * total_len * d
     decode_mfu = decode_flops / t_step / peak
 
-    # ---- int8 serving path ------------------------------------------------
+    # ---- decode HBM roofline ---------------------------------------------
+    # decode is bandwidth-bound, so MFU is the wrong axis; the honest
+    # figure is bytes/step vs MEASURED achievable bandwidth.  Achievable:
+    # chained full reads of a large bf16 array (max(abs(a - alpha))
+    # resists loop-invariant hoisting; the first attempt with max(a+alpha)
+    # was algebraically hoisted and reported > spec-sheet numbers).
+    bw_elems = int((0.125 if smoke else 1.0) * (1 << 30)) // 2
+    bw_arr = jnp.ones((bw_elems,), jnp.bfloat16)
+
+    @jax.jit
+    def bw_chain(a):
+        def body(alpha, _):
+            m = jnp.max(jnp.abs(a - alpha))
+            return m * jnp.bfloat16(1e-3), m
+        _, ms = jax.lax.scan(body, jnp.bfloat16(0), None, length=16)
+        return ms
+
+    jax.block_until_ready(bw_chain(bw_arr))
+    t0 = time.perf_counter()
+    jax.block_until_ready(bw_chain(bw_arr))
+    raw = time.perf_counter() - t0
+    hbm_bw = (bw_elems * 2) / (max(raw - relay_s, 0.05 * raw) / 16)
+
+    def step_bytes(qcfg, b):
+        """HBM bytes a decode step streams: matmul'd weights at serving
+        dtype + the whole two-tier cache read (main S + chunk NEW slots,
+        + scales when int8)."""
+        wb = 1 if qcfg.quant == "int8" else 2
+        per_layer_w = (d * qkv_out + d * d + 2 * d * ff) * wb
+        unembed = d * v * 2  # tied head stays bf16
+        kvb = 1 if qcfg.kv_quant == "int8" else 2
+        kv_read = 2 * b * qcfg.kv_heads * total_len * (d // cfg.n_heads) * kvb
+        kv_scales = (2 * b * qcfg.kv_heads * total_len * 4
+                     if qcfg.kv_quant == "int8" else 0)
+        return L * (per_layer_w + kv_read + kv_scales) + unembed
+
+    bw_util = step_bytes(cfg, B) / t_step / hbm_bw
+    bw_util_max = step_bytes(cfg, B_MAX) / t_step_max / hbm_bw
+
+    # ---- int8 weights / int8 KV serving paths -----------------------------
     import dataclasses
 
     cfg_q = dataclasses.replace(cfg, quant="int8")
     qparams = quantize_lm_params(params)
     t_step_q = decode_measure(qparams, cfg_q, B)
     decode_tok_s_q = B / t_step_q
+
+    # int8 KV cache: at max batch the cache stream dominates the weight
+    # stream ~6x, so this is where int8 actually moves decode
+    cfg_kv = dataclasses.replace(cfg, kv_quant="int8")
+    t_step_kv = decode_measure(params, cfg_kv, B_MAX)
+    decode_tok_s_kv = B_MAX / t_step_kv
+    kv_bw_util = step_bytes(cfg_kv, B_MAX) / t_step_kv / hbm_bw
 
     # ---- end-to-end generate (the TransformerGenerator.predict body):
     # one dispatch = prefill + NEW cached steps, relay INCLUDED — what a
@@ -457,8 +511,17 @@ def _probe_mfu_main(smoke: bool) -> None:
         "decode_tok_s_maxbatch": round(decode_tok_s_maxb, 1),
         "decode_maxbatch": B_MAX,
         "mfu_pct": round(100 * prefill_mfu, 2),
+        "hbm_bw_measured_gbs": round(hbm_bw / 1e9, 1),
+        "decode_bytes_per_step_mb": round(step_bytes(cfg, B) / 1e6, 1),
+        "decode_bytes_per_step_mb_maxbatch": round(
+            step_bytes(cfg, B_MAX) / 1e6, 1),
+        "decode_hbm_bw_util_pct": round(100 * bw_util, 1),
+        "decode_hbm_bw_util_pct_maxbatch": round(100 * bw_util_max, 1),
         "decode_tok_s_int8": round(decode_tok_s_q, 1),
         "int8_vs_bf16_x": round(t_step / t_step_q, 2),
+        "decode_tok_s_int8kv": round(decode_tok_s_kv, 1),
+        "int8kv_vs_bf16_x": round(t_step_max / t_step_kv, 2),
+        "int8kv_hbm_bw_util_pct": round(100 * kv_bw_util, 1),
         "e2e_gen_tok_s": round(e2e_tok_s, 1),
         "e2e_gen_latency_ms": round(t_e2e * 1e3, 1),
         "flash_vs_xla_x": flash_vs_xla,
@@ -472,6 +535,179 @@ def _probe_mfu_main(smoke: bool) -> None:
             "bf16 peak"
         ),
     }
+    print(json.dumps(doc))
+
+
+def probe_spec(smoke: bool) -> dict:
+    """Speculative-decoding evidence: acceptance and tok/s vs plain decode
+    — subprocess owning the TPU."""
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_probe_spec"]
+        + (["--smoke"] if smoke else []),
+        capture_output=True, text=True, cwd=REPO, timeout=2400,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"spec probe failed: {out.stderr[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _probe_spec_main(smoke: bool) -> None:
+    """Speculative decoding measured honestly in BOTH regimes:
+
+      * ``spec_trained_*`` — a quickly-trained small target/draft pair on
+        the copy task (the regime speculation exists for: a draft that
+        tracks the target on predictable continuations).  Reports the
+        measured acceptance length and tok/s ratio vs plain decode of the
+        SAME trained target at matched batch/prompt.
+      * ``spec_random_*`` — the MFU-probe flagship config with its
+        derived quarter-size draft at random init (acceptance ~0 by
+        construction): the floor.  A serving stack that enables
+        speculation without a trained draft pays this.
+
+    Crossover: per round, speculation spends k draft steps + one (k+1)-
+    wide target pass to gain (accept_len + 1) tokens; plain decode spends
+    one target step per token.  It wins when
+    accept_len + 1 > k * (t_draft / t_target) + t_verify / t_target —
+    with the measured times emitted here the inequality is checkable from
+    the artifact alone."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from seldon_core_tpu.models.generate import generate
+    from seldon_core_tpu.models.speculative import speculative_generate
+    from seldon_core_tpu.models.transformer import (
+        LMConfig, lm_init, lm_train_step,
+    )
+    from seldon_core_tpu.runtime.compilecache import enable_compile_cache
+
+    enable_compile_cache()
+
+    # relay floor (same probe as --_probe_mfu)
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.zeros((1, 8), jnp.float32)
+    np.asarray(f(x))
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        lat.append(time.perf_counter() - t0)
+    relay_s = float(np.percentile(lat, 50))
+
+    def timed_tok_s(fn, args, n_tokens, batch):
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        raw = time.perf_counter() - t0
+        t = max(raw - relay_s, 0.05 * raw)
+        return batch * n_tokens / t, out
+
+    doc = {}
+
+    # ---- trained-pair arm: copy task ------------------------------------
+    if smoke:
+        tcfg = LMConfig(vocab=64, d_model=128, n_heads=4, n_layers=2,
+                        d_ff=256, dtype=jnp.float32)
+        dcfg = LMConfig(vocab=64, d_model=64, n_heads=2, n_layers=1,
+                        d_ff=128, dtype=jnp.float32)
+        steps, B, half, NEW, k = 60, 8, 12, 24, 4
+    else:
+        tcfg = LMConfig(vocab=256, d_model=256, n_heads=8, n_layers=4,
+                        d_ff=1024, dtype=jnp.float32)
+        dcfg = LMConfig(vocab=256, d_model=128, n_heads=4, n_layers=1,
+                        d_ff=256, dtype=jnp.float32)
+        steps, B, half, NEW, k = 300, 32, 32, 64, 4
+
+    def copy_batch(rng, b):
+        head = rng.integers(1, tcfg.vocab, size=(b, half))
+        row = np.concatenate([head, head, head], axis=1)
+        return jnp.asarray(row, jnp.int32)
+
+    rng = np.random.default_rng(0)
+    opt = optax.adam(3e-3)
+    trained = {}
+    for (name, seed), cfg in ((("target", 0), tcfg), (("draft", 1), dcfg)):
+        params = lm_init(jax.random.key(seed), cfg)
+        opt_state = opt.init(params)
+        step = jax.jit(
+            lambda p, o, b, _cfg=cfg: lm_train_step(p, o, b, opt, _cfg)
+        )
+        for i in range(steps):
+            params, opt_state, loss = step(
+                params, opt_state, {"tokens": copy_batch(rng, B)}
+            )
+        trained[name] = (params, float(loss))
+    t_params, t_loss = trained["target"]
+    d_params, d_loss = trained["draft"]
+
+    prompt = copy_batch(rng, B)[:, : 2 * half]  # full period visible
+
+    plain = jax.jit(
+        lambda p, t: generate(p, t, tcfg, max_new_tokens=NEW)
+    )
+    spec = jax.jit(
+        lambda tp, dp, t: speculative_generate(
+            tp, dp, t, tcfg, dcfg, max_new_tokens=NEW, k=k
+        )
+    )
+    plain_tok_s, plain_out = timed_tok_s(
+        plain, (t_params, prompt), NEW, B)
+    spec_tok_s, (spec_toks, rounds) = timed_tok_s(
+        spec, (t_params, d_params, prompt), NEW, B)
+    rounds = np.asarray(rounds)
+    agree = float(
+        (np.asarray(spec_toks) == np.asarray(plain_out)).mean()
+    )
+    doc.update({
+        "spec_trained_vs_plain_x": round(spec_tok_s / plain_tok_s, 2),
+        "spec_trained_accept_len": round(float(NEW / rounds.mean()) - 1, 2),
+        "spec_trained_agreement": round(agree, 4),
+        "spec_trained_target_loss": round(t_loss, 3),
+        "spec_trained_draft_loss": round(d_loss, 3),
+        "spec_k": k,
+    })
+
+    # ---- flagship floor arm: random-init derived draft ------------------
+    if smoke:
+        fcfg = tcfg
+        fdcfg = dcfg
+        fB, fS, fNEW = 4, 24, 16
+    else:
+        fcfg = LMConfig(vocab=32768, d_model=1024, n_heads=16, n_layers=12,
+                        d_ff=4096, n_kv_heads=4)
+        # SpeculativeGenerator's derivation: quarter width, half depth
+        fdcfg = LMConfig(vocab=32768, d_model=256, n_heads=8, n_layers=6,
+                         d_ff=1024)
+        fB, fS, fNEW = 8, 128, 32  # vmapped while_loop: keep compile sane
+    fp = lm_init(jax.random.key(0), fcfg)
+    fd = lm_init(jax.random.key(1), fdcfg)
+    fprompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, fcfg.vocab, size=(fB, fS)),
+        jnp.int32,
+    )
+    fplain = jax.jit(
+        lambda p, t: generate(p, t, fcfg, max_new_tokens=fNEW)
+    )
+    fspec = jax.jit(
+        lambda tp, dp, t: speculative_generate(
+            tp, dp, t, fcfg, fdcfg, max_new_tokens=fNEW, k=k
+        )
+    )
+    fplain_tok_s, _ = timed_tok_s(fplain, (fp, fprompt), fNEW, fB)
+    fspec_tok_s, (_, frounds) = timed_tok_s(
+        fspec, (fp, fd, fprompt), fNEW, fB)
+    frounds = np.asarray(frounds)
+    doc.update({
+        "spec_random_vs_plain_x": round(fspec_tok_s / fplain_tok_s, 2),
+        "spec_random_accept_len": round(
+            float(fNEW / frounds.mean()) - 1, 2),
+        # the compact-line headline pair: trained-regime ratio + accept len
+        "spec_vs_plain_x": round(spec_tok_s / plain_tok_s, 2),
+        "spec_accept_len": round(float(NEW / rounds.mean()) - 1, 2),
+    })
     print(json.dumps(doc))
 
 
@@ -669,6 +905,7 @@ def main() -> None:
     parser.add_argument("--smoke", action="store_true")
     parser.add_argument("--_probe", action="store_true")
     parser.add_argument("--_probe_mfu", action="store_true")
+    parser.add_argument("--_probe_spec", action="store_true")
     parser.add_argument("--duration", type=float, default=None)
     args = parser.parse_args()
     if args._probe:
@@ -677,6 +914,9 @@ def main() -> None:
     if args._probe_mfu:
         _probe_mfu_main(args.smoke)
         return
+    if args._probe_spec:
+        _probe_spec_main(args.smoke)
+        return
     duration = args.duration or (3.0 if args.smoke else 8.0)
 
     # ---- device probe (owns the TPU before any engine boots) -------------
@@ -684,6 +924,10 @@ def main() -> None:
 
     # ---- compute-bound evidence: real-size LM MFU + kernel deltas --------
     mfu = probe_mfu(args.smoke)
+
+    # ---- speculative decoding: trained-pair + random-floor arms ----------
+    time.sleep(6.0)
+    spec = probe_spec(args.smoke)
 
     # ---- the same LM served end-to-end through the engine ----------------
     time.sleep(8.0)  # let the relay release the chip after the probe
@@ -834,6 +1078,7 @@ def main() -> None:
         ),
         **probe,
         **mfu,
+        **spec,
         **served_gen,
         "duration_s": duration,
     }

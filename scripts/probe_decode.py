@@ -25,7 +25,14 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
+import sys
 import time
+
+# script lives in scripts/ — put the repo root on the path (sys.path
+# insertion, NOT the PYTHONPATH env var: the latter set at interpreter
+# startup breaks this environment's TPU backend registration)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -94,17 +101,18 @@ def decode_bytes_per_step(cfg, batch: int, cache_len: int) -> int:
 def decode_step_time(params, cfg, B, S, NEW, toks0, relay_s):
     from seldon_core_tpu.models.generate import _chunk_step, init_cache, prefill
 
-    total_len = S + NEW
     btoks = toks0[:1].repeat(B, axis=0) if toks0.shape[0] != B else toks0
-    cache = init_cache(cfg, B, total_len)
-    logits, cache = jax.jit(
+    main = init_cache(cfg, B, S)
+    logits, main = jax.jit(
         lambda p, t, c: prefill(p, t, c, cfg)
-    )(params, btoks, cache)
+    )(params, btoks, main)
     first = jnp.argmax(logits, -1).astype(jnp.int32)
-    carry = (first, cache, jnp.int32(S), jax.random.key(0))
+    chunk = init_cache(cfg, B, NEW)
+    carry = (first, main, chunk, jnp.int32(S), jnp.int32(0),
+             jax.random.key(0))
     step = jax.jit(
-        lambda p, tok, c, pos, key: _chunk_step(p, tok, c, pos, key, cfg,
-                                                NEW, 0.0)
+        lambda p, tok, m, c, nm, used, key: _chunk_step(
+            p, tok, m, c, nm, used, key, cfg, NEW, 0.0, main_full=True)
     )
     return _timed(step, params, *carry, relay_s=relay_s, n=NEW)
 

@@ -147,6 +147,144 @@ def _grouped_pv(p, cache_v, out_shape, out_dtype, v_s=None):
     return out.reshape(out_shape)
 
 
+def _pv_f32(p, cache_v, v_s=None):
+    """p [B,KV,g,S,L] x cache_v [B,KV,L,hd] -> f32 [B,KV,g*S,hd] partial
+    attention output (un-cast so two-tier partials add exactly)."""
+    B, KV, g, S, L = p.shape
+    if v_s is not None:
+        p = p * v_s[:, :, None, None, :]
+    ct = jnp.bfloat16
+    v = cache_v.astype(ct) if cache_v.dtype == jnp.int8 else cache_v
+    return jax.lax.dot_general(
+        p.astype(ct).reshape(B, KV, g * S, L), v,
+        (((3,), (2,)), ((0, 1), (0, 1))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _attend_two_tier(q, main_layer, chunk_layer, n_main, n_chunk,
+                     main_full: bool = False):
+    """q [B,H,1,hd] over (frozen main cache)[:n_main] + (chunk
+    buffer)[:n_chunk]: one softmax over the concatenated scores, partial
+    PV dots summed in f32.
+
+    THE decode-hot-loop formulation: profiling the single-tier scan on
+    v5e showed ~half of every step going to dynamic_update_slice on the
+    big cache plus ~2 ms/step of layout copies — XLA cannot keep a
+    mutated while-loop carry in place at this size.  Keeping the big
+    cache READ-ONLY inside the scan and writing only a chunk-sized
+    buffer measured 144 us/layer-step vs ~960 us (B=256, L=640; see
+    scripts/probe_dus.py and docs/benchmarking.md).
+
+    ``main_full`` (static): caller guarantees every main slot is valid
+    (n_main == main length) — skips the validity select, which profiling
+    showed streaming the whole f32 score tensor twice per layer
+    (bitcast_select_fusion, ~1.2 ms/step at B=256).  The single-chunk
+    serving path (prompt-sized main) always qualifies."""
+    sm = _grouped_qk(q, main_layer["k"], main_layer.get("k_s"))
+    sc = _grouped_qk(q, chunk_layer["k"], chunk_layer.get("k_s"))
+    Lm = main_layer["k"].shape[2]
+    C = chunk_layer["k"].shape[2]
+    if not main_full:
+        sm = jnp.where((jnp.arange(Lm) < n_main)[None, None, None, None, :],
+                       sm, -1e30)
+    sc = jnp.where((jnp.arange(C) < n_chunk)[None, None, None, None, :],
+                   sc, -1e30)
+    p = jax.nn.softmax(jnp.concatenate([sm, sc], axis=-1), axis=-1)
+    om = _pv_f32(p[..., :Lm], main_layer["v"], main_layer.get("v_s"))
+    oc = _pv_f32(p[..., Lm:], chunk_layer["v"], chunk_layer.get("v_s"))
+    return (om + oc).astype(q.dtype).reshape(q.shape)
+
+
+def _block_two_tier(lp, x, main_layer, chunk_layer, n_main, n_chunk,
+                    cfg: LMConfig, main_full: bool = False):
+    """One decoder block for a single cached step: K/V written into the
+    CHUNK buffer at slot ``n_chunk`` (the big cache is never touched),
+    attention over main[:n_main] + chunk[:n_chunk+1].  Global position of
+    this token is n_main + n_chunk."""
+    from seldon_core_tpu.ops.quant import lm_matmul
+
+    B, S, D = x.shape  # S == 1
+    hd = cfg.d_model // cfg.n_heads
+    kv_h = cfg.kv_heads
+    h = _rmsnorm(x, lp["ln1"])
+    qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)
+    q, k, v = jnp.split(qkv, [D, D + kv_h * hd], axis=-1)
+    q = _heads(q, B, S, cfg.n_heads, hd)
+    k = _heads(k, B, S, kv_h, hd)
+    v = _heads(v, B, S, kv_h, hd)
+    if cfg.rope:
+        positions = n_main + n_chunk + jnp.arange(S)
+        q = apply_rope(q, positions, cfg.rope_base)
+        k = apply_rope(k, positions, cfg.rope_base)
+    if chunk_layer["k"].dtype == jnp.int8:
+        k_w, k_sw = _quantize_kv(k)
+        v_w, v_sw = _quantize_kv(v)
+        new_chunk = {
+            "k": jax.lax.dynamic_update_slice(
+                chunk_layer["k"], k_w, (0, 0, n_chunk, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                chunk_layer["v"], v_w, (0, 0, n_chunk, 0)),
+            "k_s": jax.lax.dynamic_update_slice(
+                chunk_layer["k_s"], k_sw, (0, 0, n_chunk)),
+            "v_s": jax.lax.dynamic_update_slice(
+                chunk_layer["v_s"], v_sw, (0, 0, n_chunk)),
+        }
+    else:
+        new_chunk = {
+            "k": jax.lax.dynamic_update_slice(
+                chunk_layer["k"], k.astype(chunk_layer["k"].dtype),
+                (0, 0, n_chunk, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                chunk_layer["v"], v.astype(chunk_layer["v"].dtype),
+                (0, 0, n_chunk, 0)),
+        }
+    a = _attend_two_tier(q, main_layer, new_chunk, n_main, n_chunk + 1,
+                         main_full)
+    a = a.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
+    h = _rmsnorm(x, lp["ln2"])
+    y, _lb = _ffn(lp, h, cfg, mesh=None)
+    return x + y, new_chunk
+
+
+def decode_step_two_tier(params, token, main, chunk, n_main, n_chunk,
+                         cfg: LMConfig, main_full: bool = False):
+    """One cached step against (frozen main, growing chunk).  token [B]
+    -> (logits [B, V], chunk')."""
+    x = params["embed"][token][:, None, :]
+    for i in range(cfg.n_layers):
+        x, chunk[f"l{i}"] = _block_two_tier(
+            params[f"l{i}"], x, main[f"l{i}"], chunk[f"l{i}"],
+            n_main, n_chunk, cfg, main_full,
+        )
+    x = _rmsnorm(x, params["ln_f"])
+    return (x[:, 0, :] @ params["embed"].T).astype(jnp.float32), chunk
+
+
+def merge_chunk(main, chunk, n_main, cfg: LMConfig):
+    """Fold a (full or partial) chunk buffer into the main cache at
+    position ``n_main``.  Callers jit this with the main (and chunk)
+    buffers DONATED — measured in-place on v5e, i.e. dispatch-cost only;
+    run OUTSIDE the decode scan, once per chunk."""
+    out = {}
+    for i in range(cfg.n_layers):
+        ml, cl = main[f"l{i}"], chunk[f"l{i}"]
+        layer = {
+            "k": jax.lax.dynamic_update_slice(
+                ml["k"], cl["k"].astype(ml["k"].dtype), (0, 0, n_main, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                ml["v"], cl["v"].astype(ml["v"].dtype), (0, 0, n_main, 0)),
+        }
+        if "k_s" in ml:
+            layer["k_s"] = jax.lax.dynamic_update_slice(
+                ml["k_s"], cl["k_s"], (0, 0, n_main))
+            layer["v_s"] = jax.lax.dynamic_update_slice(
+                ml["v_s"], cl["v_s"], (0, 0, n_main))
+        out[f"l{i}"] = layer
+    return out
+
+
 def _attend_cached(q, cache_layer, n_valid):
     """q [B,H,1,hd] against the (possibly grouped, possibly int8) cache
     layer {k, v, k_s?, v_s?}; positions >= n_valid (scalar) masked.
@@ -247,16 +385,22 @@ def _block_cached(lp, x, cache_layer, start, n_valid, cfg: LMConfig,
 
 
 def segment_forward(params, tokens, cache, start, cfg: LMConfig,
-                    use_flash: bool = False, segment: bool = True):
+                    use_flash: bool = False, segment: bool = True,
+                    last_only: bool = False):
     """Forward S tokens at global positions start.. over the cache
     (filling it); returns (logits [B, S, V] for EVERY position, cache').
-    ``segment=False`` is the prefill special case (start must be 0)."""
+    ``segment=False`` is the prefill special case (start must be 0).
+    ``last_only`` unembeds ONLY the final position (returns [B, 1, V]):
+    the unembed is ~20% of prefill FLOPs at real vocab sizes and a
+    [B, S, V] f32 write besides — generation never reads the rest."""
     x = params["embed"][tokens]
     for i in range(cfg.n_layers):
         x, cache[f"l{i}"] = _block_cached(
             params[f"l{i}"], x, cache[f"l{i}"], start, tokens.shape[1], cfg,
             use_flash, segment,
         )
+    if last_only:
+        x = x[:, -1:, :]  # before the (positionwise) norm: same numerics
     x = _rmsnorm(x, params["ln_f"])
     return (x @ params["embed"].T).astype(jnp.float32), cache
 
@@ -266,7 +410,8 @@ def prefill(params, tokens, cache, cfg: LMConfig, use_flash: bool = False):
 
     tokens [B, S_prompt] -> (last-position logits [B, V], cache')."""
     logits, cache = segment_forward(
-        params, tokens, cache, 0, cfg, use_flash, segment=False
+        params, tokens, cache, 0, cfg, use_flash, segment=False,
+        last_only=True,
     )
     return logits[:, -1, :], cache
 
@@ -283,6 +428,12 @@ def decode_step(params, token, cache, pos, cfg: LMConfig):
     return (x[:, 0, :] @ params["embed"].T).astype(jnp.float32), cache
 
 
+#: generation chunk-buffer capacity: generations up to this length run
+#: with a prompt-sized main cache and ZERO merges; longer ones merge the
+#: chunk into main once per CAP tokens (a donated-in-place bulk write)
+GEN_CHUNK_CAP = 256
+
+
 def generate(
     params,
     prompt,
@@ -295,10 +446,18 @@ def generate(
     """prompt [B, S] int32 -> generated [B, max_new_tokens] int32.
 
     Greedy when temperature == 0 (a static python branch), else sampled.
-    The decode loop is a single lax.scan; jit the whole function."""
+    Decode runs the TWO-TIER cache: the prefilled main cache is read-only
+    inside the scan (mutating a large while-loop carry measured ~10x the
+    logical write cost in dus + layout copies — see _attend_two_tier),
+    new K/V land in a chunk buffer, merged into main between scans only
+    when max_new_tokens exceeds GEN_CHUNK_CAP."""
     B, S = prompt.shape
-    cache = init_cache(cfg, B, S + max_new_tokens)
-    logits, cache = prefill(params, prompt, cache, cfg, use_flash)
+    chunked = max_new_tokens - 1 > GEN_CHUNK_CAP
+    # single-chunk generations never merge, so main holds ONLY the prompt
+    # — decode then streams S cache slots, not S + max_new masked ones
+    main_len = S + max_new_tokens if chunked else S
+    main = init_cache(cfg, B, main_len)
+    logits, main = prefill(params, prompt, main, cfg, use_flash)
     if rng is None:
         rng = jax.random.key(0)
 
@@ -310,28 +469,51 @@ def generate(
     key0, rng = jax.random.split(rng)
     first = pick(logits, key0).astype(jnp.int32)
 
-    def step(carry, _):
-        token, cache, pos, key = carry
-        key, sub = jax.random.split(key)
-        logits, cache = decode_step(params, token, cache, pos, cfg)
-        nxt = pick(logits, sub).astype(jnp.int32)
-        return (nxt, cache, pos + 1, key), nxt
+    def scan_steps(main, n_main, token, key, n, cap):
+        # n_main is a python int here: slice the valid prefix statically,
+        # so the scan neither streams nor masks the unwritten tail and
+        # the validity select disappears (main_full)
+        if main["l0"]["k"].shape[2] > n_main:
+            main = {
+                li: {kk: vv[:, :, :n_main] for kk, vv in layer.items()}
+                for li, layer in main.items()
+            }
+        chunk = init_cache(cfg, B, cap)
+        # one scan body for one-shot and streamed decoding — the
+        # stream-equals-generate contract rests on this delegation
+        toks, (token, chunk, _, key) = _chunk_step(
+            params, token, main, chunk, jnp.int32(n_main), jnp.int32(0),
+            key, cfg, n, temperature, main_full=True,
+        )
+        return toks, chunk, token, key
 
-    # first token came from prefill; the scan emits the remaining N-1 (no
+    # first token came from prefill; the scans emit the remaining N-1 (no
     # wasted final forward whose logits would be discarded)
-    (_, _, _, _), rest = jax.lax.scan(
-        step, (first, cache, jnp.int32(S), rng), None,
-        length=max_new_tokens - 1,
-    )
-    return jnp.concatenate([first[:, None], rest.T], axis=1)  # [B, max_new]
+    out = [first[:, None]]
+    token, key = first, rng
+    n_main, remaining = S, max_new_tokens - 1
+    while remaining > 0:
+        n = min(remaining, GEN_CHUNK_CAP) if chunked else remaining
+        toks, chunk, token, key = scan_steps(
+            main, n_main, token, key, n, GEN_CHUNK_CAP if chunked else n
+        )
+        out.append(toks)
+        remaining -= n
+        if remaining > 0:  # fold the finished chunk in before the next
+            main = merge_chunk(main, chunk, n_main, cfg)
+            n_main += n
+    return jnp.concatenate(out, axis=1)  # [B, max_new]
 
 
-def _chunk_step(params, token, cache, pos, key, cfg: LMConfig, n: int,
-                temperature: float):
-    """n cached decode steps as ONE jitted scan: (last token [B], cache,
-    pos, key) -> (tokens [B, n], new carry).  The per-(B, n) executable is
-    cached by jit, so a stream costs ceil(max_new/chunk) device dispatches
-    regardless of length."""
+def _chunk_step(params, token, main, chunk_buf, n_main, used, key,
+                cfg: LMConfig, n: int, temperature: float,
+                main_full: bool = False):
+    """n cached decode steps as ONE jitted scan over the two-tier cache:
+    main is READ-ONLY (see _attend_two_tier), new K/V go to ``chunk_buf``
+    slots used..used+n-1.  Returns (tokens [B, n], (token, chunk_buf,
+    used', key)).  The per-(B, n) executable is cached by jit, so a
+    stream costs ceil(max_new/chunk) device dispatches regardless of
+    length."""
 
     def pick(logits, k):
         if temperature > 0.0:
@@ -339,27 +521,38 @@ def _chunk_step(params, token, cache, pos, key, cfg: LMConfig, n: int,
         return jnp.argmax(logits, axis=-1)
 
     def step(carry, _):
-        token, cache, pos, key = carry
+        token, chunk_buf, used, key = carry
         key, sub = jax.random.split(key)
-        logits, cache = decode_step(params, token, cache, pos, cfg)
+        logits, chunk_buf = decode_step_two_tier(
+            params, token, main, chunk_buf, n_main, used, cfg, main_full
+        )
         nxt = pick(logits, sub).astype(jnp.int32)
-        return (nxt, cache, pos + 1, key), nxt
+        return (nxt, chunk_buf, used + 1, key), nxt
 
-    (token, cache, pos, key), toks = jax.lax.scan(
-        step, (token, cache, pos, key), None, length=n
+    (token, chunk_buf, used, key), toks = jax.lax.scan(
+        step, (token, chunk_buf, used, key), None, length=n
     )
-    return toks.T, (token, cache, pos, key)  # [B, n]
+    return toks.T, (token, chunk_buf, used, key)  # [B, n]
 
 
-# cache buffers DONATED across chunk dispatches: each SSE chunk would
-# otherwise copy the whole KV cache in and out of the program (stream
-# serving pays that per event; the one-shot generate() runs a single
-# program and never sees the boundary).  Callers must treat the passed
-# carry as consumed — stream_chunks reassigns it every iteration.
+# chunk buffer DONATED across chunk dispatches (each SSE chunk would
+# otherwise copy it in and out of the program); main is NOT donated — it
+# is read-only and stays resident across every dispatch of a stream.
+# Callers must treat the passed chunk_buf as consumed — stream_chunks
+# reassigns it every iteration.
 _chunk_step_jit = jax.jit(
-    _chunk_step, static_argnames=("cfg", "n", "temperature"),
-    donate_argnums=(2,),
+    _chunk_step, static_argnames=("cfg", "n", "temperature", "main_full"),
+    donate_argnums=(3,),
 )
+
+# merge dispatch for streams that outgrow the chunk buffer: both buffers
+# donated — measured in-place on v5e (dispatch cost only)
+_merge_chunk_jit = jax.jit(
+    merge_chunk, static_argnames=("cfg",), donate_argnums=(0, 1),
+)
+
+#: stream chunk-buffer capacity (slots between merges)
+STREAM_CHUNK_CAP = 128
 
 
 def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
@@ -371,12 +564,23 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     semantics, same PRNG stream).
 
     The host loop exists ONLY to surface tokens early — each iteration is
-    one jitted scan over ``chunk`` cached steps, so the device work is the
-    same one-scan-per-chunk shape serving wants; first token arrives after
-    prefill + (chunk-1) steps instead of after max_new_tokens steps."""
+    one jitted scan over ``chunk`` two-tier cached steps, so the device
+    work is the same one-scan-per-chunk shape serving wants; first token
+    arrives after prefill + (chunk-1) steps instead of after
+    max_new_tokens steps.  When the chunk buffer fills
+    (STREAM_CHUNK_CAP), the host folds it into the main cache with one
+    donated merge dispatch and continues."""
     B, S = prompt.shape
-    cache = init_cache(cfg, B, S + max_new_tokens)
-    logits, cache = prefill(params, prompt, cache, cfg, use_flash)
+    cap = STREAM_CHUNK_CAP
+    # a per-dispatch scan may not outgrow the chunk buffer: a larger
+    # request would dus past the buffer (clamped to the last slot =
+    # silent KV corruption).  Engine clients may ask up to 256.
+    chunk = min(int(chunk), cap)
+    # main must be able to absorb every merged chunk; single-chunk
+    # streams keep it prompt-sized like generate()
+    merges = max_new_tokens - 1 > cap
+    main = init_cache(cfg, B, S + max_new_tokens if merges else S)
+    logits, main = prefill(params, prompt, main, cfg, use_flash)
     if rng is None:
         rng = jax.random.key(0)
     key0, rng = jax.random.split(rng)
@@ -387,24 +591,40 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     else:
         first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    token, key = first, rng
+    chunk_buf = init_cache(cfg, B, cap)
+    n_main, used = S, 0
+    done = 0
+
+    def emit(n):
+        nonlocal token, key, chunk_buf, main, n_main, used
+        if used + n > cap:  # fold the full buffer in, then continue
+            main = _merge_chunk_jit(main, chunk_buf, jnp.int32(n_main),
+                                    cfg=cfg)
+            n_main += used
+            chunk_buf = init_cache(cfg, B, cap)
+            used = 0
+        toks, (token, chunk_buf, _, key) = _chunk_step_jit(
+            params, token, main, chunk_buf, jnp.int32(n_main),
+            jnp.int32(used), key, cfg=cfg, n=n, temperature=temperature,
+            # static per dispatch (at most two variants per stream): the
+            # host knows whether every main slot is valid right now
+            main_full=(n_main == main["l0"]["k"].shape[2]),
+        )
+        used += n
+        return toks
+
     # first chunk: the prefill token + (chunk-1) scanned steps
-    carry = (first, cache, jnp.int32(S), rng)
     n_first = min(chunk - 1, max_new_tokens - 1)
     if n_first > 0:
-        toks, carry = _chunk_step_jit(
-            params, *carry, cfg=cfg, n=n_first, temperature=temperature
-        )
-        yield jnp.concatenate([first[:, None], toks], axis=1)
+        yield jnp.concatenate([first[:, None], emit(n_first)], axis=1)
     else:
         yield first[:, None]
     done = 1 + n_first
     while done < max_new_tokens:
         n = min(chunk, max_new_tokens - done)
-        toks, carry = _chunk_step_jit(
-            params, *carry, cfg=cfg, n=n, temperature=temperature
-        )
+        yield emit(n)
         done += n
-        yield toks
 
 
 @register_unit("TransformerGenerator")

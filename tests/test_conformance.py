@@ -4,11 +4,13 @@ guarantee that the internal API (docs/internal-api.md) admits any
 language, the way the reference's R and Java wrappers did
 (wrappers/s2i/R/microservice.R).
 
-Lanes (each skipped when its toolchain is absent):
-  * cpp — zero-dependency C++ server (examples/cpp_model/model_server.cpp)
-  * r   — zero-package base-R server (wrappers/R/microservice.R)
+Lanes (each skipped when its toolchain is absent; the CI image installs
+all three toolchains so CI skips none):
+  * cpp  — zero-dependency C++ server (examples/cpp_model/model_server.cpp)
+  * r    — zero-package base-R server (wrappers/R/microservice.R)
+  * java — zero-dependency JDK server (wrappers/java/ModelServer.java)
 
-Both implement the conformance semantics: scale features by the `scale`
+All implement the conformance semantics: scale features by the `scale`
 FLOAT parameter, output name "scaled", kind preservation, /send-feedback.
 """
 
@@ -30,10 +32,11 @@ ROOT = os.path.join(os.path.dirname(__file__), "..")
 SRC = os.path.join(ROOT, "examples", "cpp_model", "model_server.cpp")
 R_SERVER = os.path.join(ROOT, "wrappers", "R", "microservice.R")
 R_MODEL = os.path.join(ROOT, "wrappers", "R", "example_model.R")
+JAVA_SRC = os.path.join(ROOT, "wrappers", "java", "ModelServer.java")
 
 PARAMS = json.dumps([{"name": "scale", "value": "2.0", "type": "FLOAT"}])
 
-LANES = ["cpp", "r"]
+LANES = ["cpp", "r", "java"]
 
 
 def free_port():
@@ -64,6 +67,12 @@ def _spawn_lane(lane, tmp_path_factory):
         if shutil.which("Rscript") is None:
             pytest.skip("no R toolchain")
         cmd = ["Rscript", R_SERVER, "--model", R_MODEL, "--service", "MODEL"]
+    elif lane == "java":
+        if shutil.which("javac") is None or shutil.which("java") is None:
+            pytest.skip("no JVM toolchain")
+        outdir = str(tmp_path_factory.mktemp("java"))
+        subprocess.run(["javac", "-d", outdir, JAVA_SRC], check=True)
+        cmd = ["java", "-cp", outdir, "ModelServer"]
     else:  # pragma: no cover
         raise ValueError(lane)
     proc = subprocess.Popen(cmd, env=env, stderr=subprocess.PIPE)

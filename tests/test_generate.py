@@ -41,6 +41,58 @@ def test_cached_generation_matches_naive():
     np.testing.assert_array_equal(got, ref)
 
 
+def test_chunked_generation_merge_path_matches(monkeypatch):
+    """Force the chunk-merge path (GEN_CHUNK_CAP smaller than max_new):
+    tokens must match the single-chunk result exactly — merging relocates
+    K/V between tiers without changing the attended set."""
+    import seldon_core_tpu.models.generate as gen_mod
+
+    params = lm_init(jax.random.key(5), CFG)
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 48, size=(2, 6)), jnp.int32
+    )
+    ref = np.asarray(generate(params, prompt, CFG, max_new_tokens=13))
+    monkeypatch.setattr(gen_mod, "GEN_CHUNK_CAP", 4)
+    got = np.asarray(generate(params, prompt, CFG, max_new_tokens=13))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_stream_merge_path_matches_generate(monkeypatch):
+    """Streams that outgrow STREAM_CHUNK_CAP merge mid-stream; the
+    concatenated tokens still equal generate()'s."""
+    import seldon_core_tpu.models.generate as gen_mod
+    from seldon_core_tpu.models.generate import stream_chunks
+
+    monkeypatch.setattr(gen_mod, "STREAM_CHUNK_CAP", 5)
+    params = lm_init(jax.random.key(6), CFG)
+    prompt = jnp.asarray(
+        np.random.default_rng(8).integers(0, 48, size=(2, 6)), jnp.int32
+    )
+    ref = np.asarray(generate(params, prompt, CFG, max_new_tokens=14))
+    chunks = [np.asarray(c) for c in stream_chunks(
+        params, prompt, CFG, max_new_tokens=14, chunk=3
+    )]
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), ref)
+
+
+def test_stream_chunk_larger_than_cap_clamped(monkeypatch):
+    """A requested chunk bigger than STREAM_CHUNK_CAP must be clamped,
+    not dus'd past the buffer (which would silently corrupt K/V)."""
+    import seldon_core_tpu.models.generate as gen_mod
+    from seldon_core_tpu.models.generate import stream_chunks
+
+    monkeypatch.setattr(gen_mod, "STREAM_CHUNK_CAP", 4)
+    params = lm_init(jax.random.key(9), CFG)
+    prompt = jnp.asarray(
+        np.random.default_rng(10).integers(0, 48, size=(1, 5)), jnp.int32
+    )
+    ref = np.asarray(generate(params, prompt, CFG, max_new_tokens=11))
+    chunks = [np.asarray(c) for c in stream_chunks(
+        params, prompt, CFG, max_new_tokens=11, chunk=9  # > cap
+    )]
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), ref)
+
+
 def test_int8_kv_attention_close_to_float():
     """Int8 cached attention vs the float formulation: per-token absmax
     rounding bounds the relative error at a few percent."""

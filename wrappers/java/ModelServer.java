@@ -1,0 +1,197 @@
+// Minimal Java graph-node microservice — the JVM conformance lane.
+//
+// Role parity: the reference shipped a Spring Boot + Maven JVM wrapper
+// (reference wrappers/s2i/java/, template app at
+// wrappers/s2i/java/test/model-template-app/src/main/java/io/seldon/example/App.java,
+// docs/wrappers/java.md); this framework's any-language answer is the
+// internal REST API (docs/internal-api.md) plus a conformance suite, so
+// the JVM lane is ONE dependency-free file on the JDK's built-in
+// com.sun.net.httpserver — javac ModelServer.java && java ModelServer is
+// the whole build, no Maven/Spring.
+//
+// Contract implemented (identical to examples/cpp_model/model_server.cpp
+// and wrappers/R/microservice.R, driven by tests/test_conformance.py):
+//
+//   * listens on PREDICTIVE_UNIT_SERVICE_PORT (default 9000);
+//   * reads typed parameters from PREDICTIVE_UNIT_PARAMETERS
+//     (JSON list [{"name":"scale","value":"2.0","type":"FLOAT"}]);
+//   * POST /predict          SeldonMessage in -> SeldonMessage out, every
+//                            value multiplied by `scale`, wire kind
+//                            (ndarray vs tensor) preserved;
+//   * POST /transform-input  same behaviour (TRANSFORMER service type);
+//   * POST /send-feedback    acknowledges with a SUCCESS status;
+//   * GET  /ping             liveness.
+//
+// Like the C++ lane, payload handling is deliberately structural rather
+// than a full JSON object model: the data section's numeric literals are
+// rewritten in place (brackets and shape preserved), which keeps the
+// whole lane auditable at a glance.
+
+import com.sun.net.httpserver.HttpExchange;
+import com.sun.net.httpserver.HttpServer;
+
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.net.InetSocketAddress;
+import java.nio.charset.StandardCharsets;
+import java.util.concurrent.Executors;
+
+public class ModelServer {
+
+    static double scale = 1.0;
+
+    // --- parameter loading -------------------------------------------------
+
+    /** Pull "scale" out of the PREDICTIVE_UNIT_PARAMETERS JSON list; a
+     *  present-but-unparseable value is a fatal config error (exit 2) —
+     *  silently serving the identity model would be worse. */
+    static void loadParameters() {
+        String raw = System.getenv("PREDICTIVE_UNIT_PARAMETERS");
+        if (raw == null || raw.isEmpty()) return;
+        int at = raw.indexOf("\"scale\"");
+        if (at < 0) return;
+        int v = raw.indexOf("\"value\"", at);
+        if (v < 0) return;
+        int colon = raw.indexOf(':', v + 7);
+        if (colon < 0) return;
+        int i = colon + 1;
+        while (i < raw.length()
+               && (raw.charAt(i) == ' ' || raw.charAt(i) == '"')) i++;
+        int j = i;
+        while (j < raw.length() && "+-.0123456789eE".indexOf(raw.charAt(j)) >= 0) j++;
+        try {
+            scale = Double.parseDouble(raw.substring(i, j));
+        } catch (NumberFormatException e) {
+            System.err.println("bad scale parameter: " + raw.substring(i, j));
+            System.exit(2);
+        }
+    }
+
+    // --- payload transformation --------------------------------------------
+
+    /** Scale every numeric literal inside body[from, to). */
+    static String scaleNumbers(String s) {
+        StringBuilder out = new StringBuilder(s.length() + 16);
+        int i = 0;
+        while (i < s.length()) {
+            char c = s.charAt(i);
+            if (c == '-' || Character.isDigit(c)) {
+                int j = i;
+                if (s.charAt(j) == '-') j++;
+                while (j < s.length()
+                       && "0123456789.eE+-".indexOf(s.charAt(j)) >= 0) j++;
+                double val = Double.parseDouble(s.substring(i, j));
+                double scaled = val * scale;
+                if (scaled == Math.rint(scaled) && !s.substring(i, j).contains("e")
+                        && Math.abs(scaled) < 1e15) {
+                    out.append((long) scaled).append(".0");
+                } else {
+                    out.append(scaled);
+                }
+                i = j;
+            } else {
+                out.append(c);
+                i++;
+            }
+        }
+        return out.toString();
+    }
+
+    /** End index (exclusive) of the balanced bracket region opening at
+     *  {@code open} (handles nesting; data payloads contain no strings). */
+    static int balanced(String s, int open, char lo, char hi) {
+        int depth = 0;
+        for (int i = open; i < s.length(); i++) {
+            char c = s.charAt(i);
+            if (c == lo) depth++;
+            else if (c == hi && --depth == 0) return i + 1;
+        }
+        return -1;
+    }
+
+    /** SeldonMessage in -> scaled SeldonMessage out (kind preserved);
+     *  null on a payload without a data section we understand. */
+    static String predict(String body) {
+        int nd = body.indexOf("\"ndarray\"");
+        int tn = body.indexOf("\"tensor\"");
+        if (nd >= 0 && (tn < 0 || nd < tn)) {
+            int open = body.indexOf('[', nd);
+            int end = balanced(body, open, '[', ']');
+            if (open < 0 || end < 0) return null;
+            String scaled = scaleNumbers(body.substring(open, end));
+            return "{\"meta\":{},\"data\":{\"names\":[\"scaled\"],"
+                    + "\"ndarray\":" + scaled + "}}";
+        }
+        if (tn >= 0) {
+            int shapeAt = body.indexOf("\"shape\"", tn);
+            int valuesAt = body.indexOf("\"values\"", tn);
+            if (shapeAt < 0 || valuesAt < 0) return null;
+            int sOpen = body.indexOf('[', shapeAt);
+            int sEnd = balanced(body, sOpen, '[', ']');
+            int vOpen = body.indexOf('[', valuesAt);
+            int vEnd = balanced(body, vOpen, '[', ']');
+            if (sOpen < 0 || sEnd < 0 || vOpen < 0 || vEnd < 0) return null;
+            String shape = body.substring(sOpen, sEnd);
+            String values = scaleNumbers(body.substring(vOpen, vEnd));
+            return "{\"meta\":{},\"data\":{\"names\":[\"scaled\"],"
+                    + "\"tensor\":{\"shape\":" + shape
+                    + ",\"values\":" + values + "}}}";
+        }
+        return null;
+    }
+
+    // --- HTTP plumbing -----------------------------------------------------
+
+    static void respond(HttpExchange ex, int code, String body)
+            throws IOException {
+        byte[] bytes = body.getBytes(StandardCharsets.UTF_8);
+        ex.getResponseHeaders().set("Content-Type", "application/json");
+        ex.sendResponseHeaders(code, bytes.length);
+        try (OutputStream os = ex.getResponseBody()) {
+            os.write(bytes);
+        }
+    }
+
+    static String readBody(HttpExchange ex) throws IOException {
+        try (InputStream is = ex.getRequestBody()) {
+            return new String(is.readAllBytes(), StandardCharsets.UTF_8);
+        }
+    }
+
+    public static void main(String[] args) throws IOException {
+        loadParameters();
+        String portEnv = System.getenv("PREDICTIVE_UNIT_SERVICE_PORT");
+        int port = portEnv == null ? 9000 : Integer.parseInt(portEnv);
+        HttpServer server = HttpServer.create(
+                new InetSocketAddress("0.0.0.0", port), 64);
+
+        server.createContext("/ping", ex -> respond(ex, 200, "pong"));
+        server.createContext("/send-feedback", ex -> respond(
+                ex, 200, "{\"status\":{\"status\":\"SUCCESS\"}}"));
+        // /predict and /transform-input share the scaling behaviour, the
+        // same dual-role the MODEL/TRANSFORMER service types allow
+        com.sun.net.httpserver.HttpHandler handler = ex -> {
+            String body = readBody(ex);
+            String out;
+            try {
+                out = predict(body);
+            } catch (RuntimeException e) {  // malformed numerics etc.
+                out = null;
+            }
+            if (out == null) {
+                respond(ex, 400, "{\"status\":{\"status\":\"FAILURE\","
+                        + "\"info\":\"no ndarray/tensor data section\"}}");
+            } else {
+                respond(ex, 200, out);
+            }
+        };
+        server.createContext("/predict", handler);
+        server.createContext("/transform-input", handler);
+
+        server.setExecutor(Executors.newFixedThreadPool(4));
+        server.start();
+        System.out.println("java model server on :" + port
+                + " scale=" + scale);
+    }
+}
