@@ -302,7 +302,7 @@ def _probe_mfu_main(smoke: bool) -> None:
         cfg = LMConfig(vocab=32768, d_model=1024, n_heads=16, n_layers=12,
                        d_ff=4096, n_kv_heads=4)
         B, B_MAX, S, NEW = 32, 256, 512, 64
-        flash_Ss = [2048, 8192]
+        flash_Ss = [2048, 4096, 8192]  # 4096 = the MHA auto threshold
         n_prefill, n_flash = 8, 3
 
     params = lm_init(jax.random.key(0), cfg)
@@ -380,9 +380,14 @@ def _probe_mfu_main(smoke: bool) -> None:
             )
         )
         jax.block_until_ready(step(ps, *carry))  # compile
-        t0 = time.perf_counter()
-        jax.block_until_ready(step(ps, *carry))
-        raw = time.perf_counter() - t0
+        # best-of-2: a single relay hiccup (~±10 ms is routine, spikes
+        # reach 100s of ms) otherwise lands verbatim in the artifact
+        raws = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(step(ps, *carry))
+            raws.append(time.perf_counter() - t0)
+        raw = min(raws)
         return max(raw - relay_s, 0.05 * raw) / NEW
 
     t_step = decode_measure(params, cfg, B)
@@ -405,19 +410,27 @@ def _probe_mfu_main(smoke: bool) -> None:
     bw_elems = int((0.125 if smoke else 1.0) * (1 << 30)) // 2
     bw_arr = jnp.ones((bw_elems,), jnp.bfloat16)
 
+    # 64 chained reads (~75 ms of device time at spec bandwidth): enough
+    # signal that relay variance (~±10 ms) cannot inflate the figure past
+    # the spec sheet (a 16-rep attempt measured an impossible 1976 GB/s)
+    bw_reps = 64
+
     @jax.jit
     def bw_chain(a):
         def body(alpha, _):
             m = jnp.max(jnp.abs(a - alpha))
             return m * jnp.bfloat16(1e-3), m
-        _, ms = jax.lax.scan(body, jnp.bfloat16(0), None, length=16)
+        _, ms = jax.lax.scan(body, jnp.bfloat16(0), None, length=bw_reps)
         return ms
 
     jax.block_until_ready(bw_chain(bw_arr))
-    t0 = time.perf_counter()
-    jax.block_until_ready(bw_chain(bw_arr))
-    raw = time.perf_counter() - t0
-    hbm_bw = (bw_elems * 2) / (max(raw - relay_s, 0.05 * raw) / 16)
+    raws = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.block_until_ready(bw_chain(bw_arr))
+        raws.append(time.perf_counter() - t0)
+    raw = min(raws)
+    hbm_bw = (bw_elems * 2) / (max(raw - relay_s, 0.05 * raw) / bw_reps)
 
     def step_bytes(qcfg, b):
         """HBM bytes a decode step streams: matmul'd weights at serving
@@ -467,32 +480,42 @@ def _probe_mfu_main(smoke: bool) -> None:
     acfg = LMConfig(vocab=1024, d_model=1024, n_heads=8, n_layers=2,
                     d_ff=2048)
     aparams = lm_init(jax.random.key(1), acfg)
-    flash_vs_xla = {}
-    for s_len in flash_Ss:
-        at = jnp.asarray(
+    arms = [
+        (str(s_len), acfg, aparams, jnp.asarray(
             np.random.default_rng(1).integers(0, 1024, size=(1, s_len)),
             jnp.int32,
-        )
+        ))
+        for s_len in flash_Ss
+    ]
+    if not smoke:
+        # grouped-K/V arm at the flagship prefill shape (B=32, S=512,
+        # GQA-4): the auto gate routes here from FLASH_AUTO_MIN_S_GQA up
+        arms.append(("512_gqa", cfg, params, toks0))
+    flash_vs_xla = {}
+    for label, fcfg, fparams, at in arms:
         times = {}
         # "force" pins the kernel arm regardless of the auto-mode length
         # threshold — this ratio is the kernel-vs-XLA measurement itself
         for mode, uf in (("flash", "force"), ("xla", False)):
             @jax.jit
-            def reps(ps, t, _uf=uf):
+            def reps(ps, t, _uf=uf, _cfg=fcfg):
                 def body(tk, _):
-                    logits = lm_apply(ps, tk, acfg, use_flash=_uf)
+                    logits = lm_apply(ps, tk, _cfg, use_flash=_uf)
                     nxt = (tk + jnp.argmax(
                         logits, -1
-                    ).astype(jnp.int32)) % 1024
+                    ).astype(jnp.int32)) % _cfg.vocab
                     return nxt, ()
                 out, _ = jax.lax.scan(body, t, None, length=n_flash)
                 return out
-            jax.block_until_ready(reps(aparams, at))
-            t0 = time.perf_counter()
-            jax.block_until_ready(reps(aparams, at))
-            raw = time.perf_counter() - t0
+            jax.block_until_ready(reps(fparams, at))
+            raws = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                jax.block_until_ready(reps(fparams, at))
+                raws.append(time.perf_counter() - t0)
+            raw = min(raws)
             times[mode] = max(raw - relay_s, 0.05 * raw) / n_flash
-        flash_vs_xla[str(s_len)] = round(times["xla"] / times["flash"], 2)
+        flash_vs_xla[label] = round(times["xla"] / times["flash"], 2)
 
     doc = {
         "model_params": n_params,
@@ -569,7 +592,17 @@ def _probe_spec_main(smoke: bool) -> None:
     one target step per token.  It wins when
     accept_len + 1 > k * (t_draft / t_target) + t_verify / t_target —
     with the measured times emitted here the inequality is checkable from
-    the artifact alone."""
+    the artifact alone.
+
+    Round-4 measured honesty: the trained pair reaches ~3.9/4 acceptance
+    yet still LOSES (~0.1x) — models/speculative.py vmaps per-row
+    while_loops, whose lockstep rounds + masked carries cost far more
+    than the two-tier plain scan when the target itself is this cheap;
+    the flagship arm's random draft accepts ~0 by construction.  The
+    component is correctness-complete (greedy-exact per its own forward);
+    making it PAY requires a shared-loop batched formulation and a
+    distilled draft for a target whose step time dwarfs the draft's —
+    recorded as future work, not claimed as a win."""
     import numpy as np
 
     import jax
@@ -617,9 +650,12 @@ def _probe_spec_main(smoke: bool) -> None:
     else:
         tcfg = LMConfig(vocab=256, d_model=256, n_heads=8, n_layers=4,
                         d_ff=1024, dtype=jnp.float32)
-        dcfg = LMConfig(vocab=256, d_model=128, n_heads=4, n_layers=1,
+        # draft keeps TWO layers: copying needs an induction circuit
+        # (previous-token head + induction head), which one layer cannot
+        # express — a 1-layer draft never tracks the target on this task
+        dcfg = LMConfig(vocab=256, d_model=128, n_heads=4, n_layers=2,
                         d_ff=256, dtype=jnp.float32)
-        steps, B, half, NEW, k = 300, 32, 32, 64, 4
+        steps, B, half, NEW, k = 400, 32, 32, 64, 4
 
     def copy_batch(rng, b):
         head = rng.integers(1, tcfg.vocab, size=(b, half))

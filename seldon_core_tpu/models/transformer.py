@@ -261,10 +261,13 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
     to the plain XLA path silently.  Grouped K/V (KV < H) takes the GQA
     formulation; the ring path requires full MHA heads."""
     # auto mode only takes the kernel where it measures faster than XLA's
-    # fused attention (long sequences); "force" overrides (explicit opt-in
-    # / the benchmarking arm)
+    # fused attention (thresholds above; grouped K/V wins from much
+    # shorter S); "force" overrides (explicit opt-in / the benchmarking
+    # arm)
+    auto_min = (FLASH_AUTO_MIN_S_GQA if k.shape[1] != q.shape[1]
+                else FLASH_AUTO_MIN_S)
     flash_eligible = use_flash == "force" or (
-        use_flash and q.shape[2] >= FLASH_AUTO_MIN_S
+        use_flash and q.shape[2] >= auto_min
     )
     if k.shape[1] != q.shape[1]:
         if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
@@ -508,12 +511,14 @@ def lm_pipeline_train_step(pp_params, opt_state, batch, optimizer,
     )
 
 
-#: ``auto`` mode takes the Pallas flash kernel only at-or-past this
-#: sequence length: interleaved A/B through the LM forward on v5e
-#: measured the kernel 1.4x FASTER than XLA's fused attention at S=8192
-#: but 1.7x SLOWER at S=2048 (XLA's fusion is strong at moderate S; the
-#: kernel's block-skip + O(S*D) HBM traffic win out as S^2 grows).
+#: ``auto`` mode thresholds, from interleaved A/B through the LM forward
+#: on v5e (round 4, wide-block kernel: bq<=512/bk<=1024).  MHA hd=128:
+#: 0.93x XLA at S=2048, 1.36x at S=8192 — kernel from 4096 up.  GROUPED
+#: K/V (GQA) wins much earlier: 1.20x at S=512/B=32 and 3.13x at
+#: S=2048/B=4 (hd=64, kv=4) — XLA's fallback materialises the grouped
+#: score tensor while the kernel streams K/V once at stored size.
 FLASH_AUTO_MIN_S = 4096
+FLASH_AUTO_MIN_S_GQA = 512
 
 
 def resolve_flash(attention: str, mesh: Optional[Mesh]):
@@ -521,9 +526,10 @@ def resolve_flash(attention: str, mesh: Optional[Mesh]):
 
     ``auto``  — Pallas flash kernel when the runtime supports it, the
                 mesh is single-chip (pallas_call is not auto-partitionable
-                under GSPMD), AND the sequence is long enough to win
-                (``FLASH_AUTO_MIN_S``, checked per call in
-                ``_attention``);  returns True/False;
+                under GSPMD), AND the sequence is long enough to win —
+                checked per call in ``_attention``: grouped K/V (GQA)
+                from ``FLASH_AUTO_MIN_S_GQA`` (512) up, MHA from
+                ``FLASH_AUTO_MIN_S`` (4096) up;  returns True/False;
     ``flash`` — force the kernel at ANY length (returns ``"force"``, the
                 benchmarking arm / explicit opt-in); a runtime without
                 Pallas support or a multi-chip mesh still falls back to

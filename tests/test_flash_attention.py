@@ -140,3 +140,38 @@ def test_lm_train_step_with_flash_matches_xla_attention():
     for a, b in zip(flat_f, flat_x):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-4, rtol=5e-4)
+
+
+def test_auto_gate_thresholds_route_as_measured():
+    """The auto-mode thresholds are measurement-pinned (v5e round 4):
+    grouped K/V takes the kernel from FLASH_AUTO_MIN_S_GQA (512) up, MHA
+    from FLASH_AUTO_MIN_S (4096); "force" overrides everywhere."""
+    import sys
+
+    import seldon_core_tpu.ops.flash_attention  # noqa: F401 - for sys.modules
+    import seldon_core_tpu.models.transformer as T
+
+    fa = sys.modules["seldon_core_tpu.ops.flash_attention"]
+    calls = []
+    real = fa.flash_attention
+
+    def spy(q, k, v, causal=True, interpret=False):
+        calls.append(tuple(q.shape))
+        return real(q, k, v, causal, True)
+
+    def route(B, H, KV, S, use_flash):
+        calls.clear()
+        q = jnp.zeros((B, H, S, 64), jnp.float32)
+        k = jnp.zeros((B, KV, S, 64), jnp.float32)
+        T._attention(q, k, k, None, causal=True, use_flash=use_flash)
+        return bool(calls)
+
+    fa.flash_attention = spy
+    try:
+        assert route(1, 8, 2, 512, True), "GQA S=512 -> kernel"
+        assert not route(1, 8, 2, 256, True), "GQA S=256 -> XLA"
+        assert not route(1, 4, 4, 2048, True), "MHA S=2048 -> XLA"
+        assert route(1, 4, 4, 4096, True), "MHA S=4096 -> kernel"
+        assert route(1, 4, 4, 256, "force"), "force overrides the gate"
+    finally:
+        fa.flash_attention = real

@@ -116,6 +116,7 @@ _EXAMPLE_FEATURES = {
     "generator_tp_deployment.json": 5,  # tp=4 mesh-sharded LM generator
     "generator_ep_deployment.json": 5,  # ep=4 MoE expert-parallel generator
     "generator_int8_deployment.json": 4,  # int8 + GQA + flash opt-ins
+    "speculative_deployment.json": 5,  # draft/verify generation opt-in
 }
 
 
