@@ -463,6 +463,11 @@ def _probe_mfu_main(smoke: bool) -> None:
     decode_tok_s_kv = B_MAX / t_step_kv
     kv_bw_util = step_bytes(cfg_kv, B_MAX) / t_step_kv / hbm_bw
 
+    # both quantizations stacked: int8 weights + int8 KV
+    cfg_both = dataclasses.replace(cfg, quant="int8", kv_quant="int8")
+    t_step_both = decode_measure(qparams, cfg_both, B_MAX)
+    decode_tok_s_both = B_MAX / t_step_both
+
     # ---- end-to-end generate (the TransformerGenerator.predict body):
     # one dispatch = prefill + NEW cached steps, relay INCLUDED — what a
     # serving caller actually observes per batched request
@@ -545,6 +550,7 @@ def _probe_mfu_main(smoke: bool) -> None:
         "decode_tok_s_int8kv": round(decode_tok_s_kv, 1),
         "int8kv_vs_bf16_x": round(t_step_max / t_step_kv, 2),
         "int8kv_hbm_bw_util_pct": round(100 * kv_bw_util, 1),
+        "decode_tok_s_int8both": round(decode_tok_s_both, 1),
         "e2e_gen_tok_s": round(e2e_tok_s, 1),
         "e2e_gen_latency_ms": round(t_e2e * 1e3, 1),
         "flash_vs_xla_x": flash_vs_xla,
@@ -826,6 +832,36 @@ def _probe_main(smoke: bool) -> None:
     spans = TRACER.recent(100000)
     req = [s.duration_ms for s in spans if s.kind == "request"]
     disp = [s.duration_ms for s in spans if s.kind == "dispatch"]
+
+    # ensemble flat-scaling control (BASELINE.md north star), isolated
+    # from socket/load-gen noise: a 1024-row dispatch through 1-member vs
+    # 8-member AVERAGE_COMBINER graphs — the fan-out runs inside one XLA
+    # program, so the ratio should be ~1.0 regardless of what the
+    # socketed series shows on a loaded host core
+    ens_ms = {}
+    ens_rows = 64 if smoke else 1024
+    ens_wide = 2 if smoke else 8
+    big = json.dumps(
+        {"data": {"ndarray": np.zeros((ens_rows, 784)).tolist()}})
+    for members in (1, ens_wide):
+        espec = SeldonDeploymentSpec.from_json_dict(
+            mnist_deployment(members))
+        eeng = EngineService(espec, max_batch=ens_rows, max_wait_ms=1.0,
+                             pipeline_depth=4)
+        # no prewarm: the warm pass below compiles the one bucket used
+
+        async def edrive(n):
+            # min over requests, same reason as decode_measure's
+            # best-of-2: one relay spike must not land in the ratio
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                await eeng.predict_json(big)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        asyncio.run(edrive(2))  # warm/compile
+        ens_ms[members] = asyncio.run(edrive(4)) * 1e3
     doc = {
         "relay_floor_ms": round(relay_floor_ms, 2),
         "gen_tokens_per_s": round(gen_tps, 1),
@@ -835,6 +871,9 @@ def _probe_main(smoke: bool) -> None:
         "oneshot_latency_ms": round(dt_oneshot * 1e3, 1),
         "stream_total_ms": round(stream_total * 1e3, 1),
         "device": str(jax.devices()[0]),
+        "ensemble_dispatch_ms_1": round(ens_ms[1], 1),
+        "ensemble_dispatch_ms_8": round(ens_ms[ens_wide], 1),
+        "ensemble_dispatch_8v1_x": round(ens_ms[ens_wide] / ens_ms[1], 2),
     }
     if req and disp:
         span_request_ms = float(np.percentile(req, 50))
@@ -1132,7 +1171,7 @@ def main() -> None:
         "decode_tok_s_int8kv", "int8kv_vs_bf16_x",
         "decode_tok_s_int8", "int8_vs_bf16_x",
         "spec_vs_plain_x", "spec_accept_len",
-        "flash_vs_xla_x",
+        "flash_vs_xla_x", "ensemble_dispatch_8v1_x",
         "e2e_gen_tok_s", "served_gen_tok_s",
         "span_framework_p50_ms", "relay_floor_ms",
         "model_params_m", "lm_config",

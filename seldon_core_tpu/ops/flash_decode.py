@@ -13,20 +13,22 @@ stored grouped size [B, KV, L, hd].  The valid-length mask (positions >=
 n_valid are preallocated-but-unwritten cache slots) rides a prefetched
 scalar.
 
-STATUS — correct but NOT wired into serving: measured on v5e (166M-param
-GQA-4 LM, L=576, B=32/256) the kernel is ~1.6-2.3x SLOWER per decode
-step than the grouped-XLA formulation.  The (B*KV, L/128) grid runs
-sequentially with a tiny [G, 128] dot per step, while XLA executes the
-whole batch as a few large batched dots — at decode's short L the
-per-grid-step overhead dominates anything saved on the score row.  A win
-here needs a batch-blocked design (fold B onto the sublane axis, grid
-over L only); until someone builds and MEASURES that, serving keeps the
-XLA path (models/generate.py:_attend_cached).  The op stays for the
-kernel-correctness suite and as the starting point for that redesign.
+STATUS — correct but NOT wired into serving, and now SUPERSEDED: round 3
+measured this kernel ~1.6-2.3x SLOWER per decode step than the
+grouped-XLA formulation (the (B*KV, L/128) grid serializes tiny [G, 128]
+dots where XLA runs a few large batched ones).  Round 4 then found the
+real decode bottleneck was never the attention math at all but cache
+mutation inside the scan (dus + layout copies), fixed by the two-tier
+cache in models/generate.py — after which the isolated XLA attention
+read streams at ~70-80% of measured HBM bandwidth (scripts/
+probe_layout.py), leaving a fused decode kernel little to win.  The op
+stays for the kernel-correctness suite and as a starting point should a
+batch-blocked variant ever be worth measuring again.
 
-Constraints (ValueError): L divisible by 128, hd <= 256.
-``models/generate.py:init_cache`` rounds cache lengths up to 128 so
-caches stay eligible.
+Constraints (ValueError): L divisible by 128, hd <= 256.  NOTE:
+``models/generate.py:init_cache`` allocates EXACT lengths (it does NOT
+round up to this kernel's block — see its comment), so wiring this op
+into serving would also require length padding at the call sites.
 """
 
 from __future__ import annotations
