@@ -410,10 +410,12 @@ def _probe_mfu_main(smoke: bool) -> None:
     bw_elems = int((0.125 if smoke else 1.0) * (1 << 30)) // 2
     bw_arr = jnp.ones((bw_elems,), jnp.bfloat16)
 
-    # 64 chained reads (~75 ms of device time at spec bandwidth): enough
-    # signal that relay variance (~±10 ms) cannot inflate the figure past
-    # the spec sheet (a 16-rep attempt measured an impossible 1976 GB/s)
-    bw_reps = 64
+    # 256 chained reads (~300 ms of device time at spec bandwidth): the
+    # signal must dwarf relay variance in BOTH directions — 16 reps
+    # measured an impossible 1976 GB/s, and even 64 reps (76 ms signal)
+    # let a below-floor relay draw inflate the figure to 1547 GB/s; at
+    # 300 ms the ±15 ms tail is <5% error
+    bw_reps = 256
 
     @jax.jit
     def bw_chain(a):
