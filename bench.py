@@ -177,7 +177,7 @@ class Engine:
 
 
 def run_load(contract: str, port: int, api: str, clients: int,
-             duration_s: float) -> dict:
+             duration_s: float, _retry: bool = True) -> dict:
     # back-to-back runs bias each other through relay backlog (measured:
     # the same config drops ~30% right after a saturation run); let the
     # pipeline drain before measuring
@@ -192,6 +192,13 @@ def run_load(contract: str, port: int, api: str, clients: int,
         raise RuntimeError(f"loadtest failed: {out.stderr[-2000:]}")
     report = json.loads(out.stdout.strip().splitlines()[-1])
     if report.get("requests", 0) == 0:
+        # a transiently starved host (another process hogging the one
+        # core) can produce an all-zero window; one retry after a drain
+        # pause keeps a single hiccup from aborting the whole bench
+        if _retry:
+            time.sleep(15.0)
+            return run_load(contract, port, api, clients, duration_s,
+                            _retry=False)
         raise RuntimeError(f"loadtest measured zero requests: {report}")
     return report
 
