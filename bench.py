@@ -444,7 +444,14 @@ def _probe_mfu_main(smoke: bool) -> None:
     def step_bytes(qcfg, b):
         """HBM bytes a decode step streams: matmul'd weights at serving
         dtype + the whole two-tier cache read (main S + chunk NEW slots,
-        + scales when int8)."""
+        + scales when int8).
+
+        ALL chunk slots are billed, not just the currently-valid prefix:
+        the QK/PV dot_generals read the full [B, KV, NEW, hd] buffer from
+        HBM every step — validity masking applies to the f32 SCORES after
+        the dot, never to the cache read, so the masked slots' bytes
+        really do cross the HBM bus and belong in the utilization
+        numerator."""
         wb = 1 if qcfg.quant == "int8" else 2
         per_layer_w = (d * qkv_out + d * d + 2 * d * ff) * wb
         unembed = d * v * 2  # tied head stays bf16

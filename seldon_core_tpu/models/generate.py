@@ -156,11 +156,16 @@ def _grouped_pv(p, cache_v, out_shape, out_dtype, v_s=None):
 
 def _pv_f32(p, cache_v, v_s=None):
     """p [B,KV,g,S,L] x cache_v [B,KV,L,hd] -> f32 [B,KV,g*S,hd] partial
-    attention output (un-cast so two-tier partials add exactly)."""
+    attention output (un-cast so two-tier partials add exactly).
+
+    The dot's input dtype follows the CACHE dtype: bf16 only for bf16 or
+    int8 caches — an f32-dtype model keeps f32 weights so its greedy
+    ties break identically to prefill/naive decode."""
     B, KV, g, S, L = p.shape
     if v_s is not None:
         p = p * v_s[:, :, None, None, :]
-    ct = jnp.bfloat16
+    ct = (jnp.bfloat16 if cache_v.dtype in (jnp.int8, jnp.bfloat16)
+          else cache_v.dtype)
     v = cache_v.astype(ct) if cache_v.dtype == jnp.int8 else cache_v
     return jax.lax.dot_general(
         p.astype(ct).reshape(B, KV, g * S, L), v,
@@ -562,11 +567,53 @@ _chunk_step_jit = jax.jit(
     donate_argnums=(3,),
 )
 
-# merge dispatch for streams that outgrow the chunk buffer: both buffers
-# donated — measured in-place on v5e (dispatch cost only)
-_merge_chunk_jit = jax.jit(
-    merge_chunk, static_argnames=("cfg",), donate_argnums=(0, 1),
-)
+def grow_merge(main, chunk, cfg: LMConfig, used: int):
+    """Concatenate chunk[:used] onto main along the length axis, returning
+    a main cache that is EXACTLY full (every slot valid).
+
+    Streams use this instead of a dus into a max_new-sized preallocation:
+    a big mostly-empty main would make every decode step pay the QK dot
+    and validity select over unwritten slots (the bitcast_select_fusion
+    cost, ~1.2 ms/step at B=256, the two-tier design exists to remove).
+    The full-buffer copy here runs once per STREAM_CHUNK_CAP tokens —
+    ~2 decode-steps' worth of HBM traffic amortised over 128 steps — and
+    buys ``main_full=True`` on every step of arbitrarily long streams.
+
+    Costs, stated plainly:
+      * each merge grows main's length, so the NEXT chunk-scan is a new
+        shape — one XLA compile per merge point.  Merge offsets are fixed
+        for a given (B, S, chunk, cap), the serving engine pins max_new
+        per deployment, and the persistent compile cache keeps them
+        across restarts, so this is a one-time cost per deployment shape
+        (the one-shot ``generate`` path has sliced main to n_main per
+        chunk since round 4 — same shape-per-chunk property).  The
+        steady-state alternative (fixed max_new-sized main) pays the
+        mostly-empty select ~1.2 ms/EVERY step at B=256 instead;
+      * concat cannot donate, so a merge transiently holds old+new main
+        (~2x cache HBM) before GC frees the old one.  Streams whose KV
+        cache approaches half of free HBM should lower max_new or batch
+        instead of relying on this path."""
+    out = {}
+    for i in range(cfg.n_layers):
+        ml, cl = main[f"l{i}"], chunk[f"l{i}"]
+        layer = {
+            "k": jnp.concatenate(
+                [ml["k"], cl["k"][:, :, :used].astype(ml["k"].dtype)], axis=2),
+            "v": jnp.concatenate(
+                [ml["v"], cl["v"][:, :, :used].astype(ml["v"].dtype)], axis=2),
+        }
+        if "k_s" in ml:
+            layer["k_s"] = jnp.concatenate(
+                [ml["k_s"], cl["k_s"][:, :, :used]], axis=2)
+            layer["v_s"] = jnp.concatenate(
+                [ml["v_s"], cl["v_s"][:, :, :used]], axis=2)
+        out[f"l{i}"] = layer
+    return out
+
+
+# shape-changing, so donation cannot alias outputs to inputs; freeing the
+# old buffers immediately after is the caller's job (Python GC suffices)
+_grow_merge_jit = jax.jit(grow_merge, static_argnames=("cfg", "used"))
 
 #: stream chunk-buffer capacity (slots between merges)
 STREAM_CHUNK_CAP = 128
@@ -585,18 +632,19 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     work is the same one-scan-per-chunk shape serving wants; first token
     arrives after prefill + (chunk-1) steps instead of after
     max_new_tokens steps.  When the chunk buffer fills
-    (STREAM_CHUNK_CAP), the host folds it into the main cache with one
-    donated merge dispatch and continues."""
+    (STREAM_CHUNK_CAP), the host grows the main cache by the buffered
+    tokens (grow_merge — main stays exactly full, so every step of a
+    long stream decodes over valid slots only) and continues."""
     B, S = prompt.shape
     cap = STREAM_CHUNK_CAP
     # a per-dispatch scan may not outgrow the chunk buffer: a larger
     # request would dus past the buffer (clamped to the last slot =
     # silent KV corruption).  Engine clients may ask up to 256.
     chunk = min(int(chunk), cap)
-    # main must be able to absorb every merged chunk; single-chunk
-    # streams keep it prompt-sized like generate()
-    merges = max_new_tokens - 1 > cap
-    main = init_cache(cfg, B, S + max_new_tokens if merges else S)
+    # main starts prompt-sized and GROWS at each merge (grow_merge), so
+    # it is exactly full at every decode step — long streams never pay
+    # the mostly-empty-buffer QK dot + validity select
+    main = init_cache(cfg, B, S)
     logits, main = prefill(params, prompt, main, cfg, use_flash)
     if rng is None:
         rng = jax.random.key(0)
@@ -615,18 +663,16 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
 
     def emit(n):
         nonlocal token, key, chunk_buf, main, n_main, used
-        if used + n > cap:  # fold the full buffer in, then continue
-            main = _merge_chunk_jit(main, chunk_buf, jnp.int32(n_main),
-                                    cfg=cfg)
+        if used + n > cap:  # grow main by the buffered tokens, continue
+            main = _grow_merge_jit(main, chunk_buf, cfg=cfg, used=used)
             n_main += used
             chunk_buf = init_cache(cfg, B, cap)
             used = 0
         toks, (token, chunk_buf, _, key) = _chunk_step_jit(
             params, token, main, chunk_buf, jnp.int32(n_main),
             jnp.int32(used), key, cfg=cfg, n=n, temperature=temperature,
-            # static per dispatch (at most two variants per stream): the
-            # host knows whether every main slot is valid right now
-            main_full=(n_main == main["l0"]["k"].shape[2]),
+            # grow_merge keeps main exactly full at every step
+            main_full=True,
         )
         used += n
         return toks
