@@ -99,7 +99,7 @@ def decode_bytes_per_step(cfg, batch: int, cache_len: int) -> int:
 
 
 def decode_step_time(params, cfg, B, S, NEW, toks0, relay_s):
-    from seldon_core_tpu.models.generate import _chunk_step, init_cache, prefill
+    from seldon_core_tpu.models.generate import _chunk_step, init_cache, init_chunk, prefill
 
     btoks = toks0[:1].repeat(B, axis=0) if toks0.shape[0] != B else toks0
     main = init_cache(cfg, B, S)
@@ -107,7 +107,7 @@ def decode_step_time(params, cfg, B, S, NEW, toks0, relay_s):
         lambda p, t, c: prefill(p, t, c, cfg)
     )(params, btoks, main)
     first = jnp.argmax(logits, -1).astype(jnp.int32)
-    chunk = init_cache(cfg, B, NEW)
+    chunk = init_chunk(cfg, B, NEW)
     carry = (first, main, chunk, jnp.int32(S), jnp.int32(0),
              jax.random.key(0))
     step = jax.jit(

@@ -46,8 +46,8 @@ from seldon_core_tpu.models.transformer import (
     lm_init,
 )
 
-__all__ = ["init_cache", "prefill", "decode_step", "generate",
-           "stream_chunks", "TransformerGenerator"]
+__all__ = ["init_cache", "init_chunk", "prefill", "decode_step",
+           "generate", "stream_chunks", "TransformerGenerator"]
 
 
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
@@ -75,6 +75,16 @@ def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
         }
 
     return {f"l{i}": layer() for i in range(cfg.n_layers)}
+
+
+def init_chunk(cfg: LMConfig, batch: int, cap: int) -> Dict[str, Any]:
+    """Decode chunk buffer — same layout as init_cache, named for the
+    role.  Round-5 restructures (stacked all-layer buffers, position-
+    major scales, unrolled sub-scans with straight-line merges, a Pallas
+    aliased writer) all measured SLOWER than this layout; see
+    scripts/probe_step_profile.py and docs/benchmarking.md for the
+    numbers and the while-carry dus serialization analysis."""
+    return init_cache(cfg, batch, cap)
 
 
 def _quantize_kv(t):
@@ -192,20 +202,36 @@ def _attend_two_tier(q, main_layer, chunk_layer, n_main, n_chunk,
     (n_main == main length) — skips the validity select, which profiling
     showed streaming the whole f32 score tensor twice per layer
     (bitcast_select_fusion, ~1.2 ms/step at B=256).  The single-chunk
-    serving path (prompt-sized main) always qualifies."""
+    serving path (prompt-sized main) always qualifies.
+
+    Two score-stream economies (profiled round 5, B=256 — together
+    bf16 4.18 -> 3.98 ms/step, int8kv 3.29 -> 3.10):
+      * validity masks are ADDED (0 / -1e30) instead of selected —
+        jnp.where materialised as its own fusion re-streaming the f32
+        chunk scores (~22 us/layer), an add joins the exp chain;
+      * the softmax normalisation happens AFTER the PV dots: partial PV
+        runs on unnormalised exp weights (globally max-shifted, so in
+        [0, 1] like p) and the division by the sum touches only the
+        [B, H, 1, hd] output — dividing p re-streamed the full score
+        tensor per layer (divide_convert fusions, ~8 us/layer)."""
     sm = _grouped_qk(q, main_layer["k"], main_layer.get("k_s"))
     sc = _grouped_qk(q, chunk_layer["k"], chunk_layer.get("k_s"))
-    Lm = main_layer["k"].shape[2]
     C = chunk_layer["k"].shape[2]
     if not main_full:
-        sm = jnp.where((jnp.arange(Lm) < n_main)[None, None, None, None, :],
-                       sm, -1e30)
-    sc = jnp.where((jnp.arange(C) < n_chunk)[None, None, None, None, :],
-                   sc, -1e30)
-    p = jax.nn.softmax(jnp.concatenate([sm, sc], axis=-1), axis=-1)
-    om = _pv_f32(p[..., :Lm], main_layer["v"], main_layer.get("v_s"))
-    oc = _pv_f32(p[..., Lm:], chunk_layer["v"], chunk_layer.get("v_s"))
-    return (om + oc).astype(q.dtype).reshape(q.shape)
+        Lm = main_layer["k"].shape[2]
+        sm = sm + jnp.where(jnp.arange(Lm) < n_main, 0.0, -1e30
+                            ).astype(jnp.float32)[None, None, None, None, :]
+    sc = sc + jnp.where(jnp.arange(C) < n_chunk, 0.0, -1e30
+                        ).astype(jnp.float32)[None, None, None, None, :]
+    m = jnp.maximum(jnp.max(sm, axis=-1), jnp.max(sc, axis=-1))
+    em = jnp.exp(sm - m[..., None])
+    ec = jnp.exp(sc - m[..., None])
+    l = jnp.sum(em, axis=-1) + jnp.sum(ec, axis=-1)  # [B,KV,g,S]
+    om = _pv_f32(em, main_layer["v"], main_layer.get("v_s"))
+    oc = _pv_f32(ec, chunk_layer["v"], chunk_layer.get("v_s"))
+    B, KV, g, S = m.shape
+    out = (om + oc) / l.reshape(B, KV, g * S)[..., None]
+    return out.astype(q.dtype).reshape(q.shape)
 
 
 def _block_two_tier(lp, x, main_layer, chunk_layer, n_main, n_chunk,
@@ -500,7 +526,7 @@ def generate(
                 li: {kk: vv[:, :, :n_main] for kk, vv in layer.items()}
                 for li, layer in main.items()
             }
-        chunk = init_cache(cfg, B, cap)
+        chunk = init_chunk(cfg, B, cap)
         # one scan body for one-shot and streamed decoding — the
         # stream-equals-generate contract rests on this delegation
         toks, (token, chunk, _, key) = _chunk_step(
@@ -657,7 +683,7 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
         first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     token, key = first, rng
-    chunk_buf = init_cache(cfg, B, cap)
+    chunk_buf = init_chunk(cfg, B, cap)
     n_main, used = S, 0
     done = 0
 
@@ -666,7 +692,7 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
         if used + n > cap:  # grow main by the buffered tokens, continue
             main = _grow_merge_jit(main, chunk_buf, cfg=cfg, used=used)
             n_main += used
-            chunk_buf = init_cache(cfg, B, cap)
+            chunk_buf = init_chunk(cfg, B, cap)
             used = 0
         toks, (token, chunk_buf, _, key) = _chunk_step_jit(
             params, token, main, chunk_buf, jnp.int32(n_main),
