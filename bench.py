@@ -662,11 +662,16 @@ def _probe_spec_main(smoke: bool) -> None:
     relay_s = float(np.percentile(lat, 50))
 
     def timed_tok_s(fn, args, n_tokens, batch):
+        # best-of-2 timed dispatches: a single relay hiccup (spikes reach
+        # 100s of ms) otherwise swings the spec/plain RATIO both ways
         jax.block_until_ready(fn(*args))
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        raw = time.perf_counter() - t0
+        raws = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            raws.append(time.perf_counter() - t0)
+        raw = min(raws)
         t = max(raw - relay_s, 0.05 * raw)
         return batch * n_tokens / t, out
 
@@ -775,6 +780,179 @@ def _probe_spec_main(smoke: bool) -> None:
         # the compact-line headline pair: trained-regime ratio + accept len
         "spec_vs_plain_x": round(spec_tok_s / plain_tok_s, 2),
         "spec_accept_len": round(float(NEW / rounds.mean()) - 1, 2),
+    })
+
+    # ---- crossover arm: component timings at a BIG target ----------------
+    # Speculation wins iff accept_len + 1 > (k*t_draft + t_verify)/t_target.
+    # Neither measured arm can win (tiny trained pair: overhead-bound;
+    # flagship: random draft accepts 0), so measure the inequality's
+    # components at a ~0.9B-param target with a d256 draft and emit the
+    # minimum acceptance that would flip it — checkable from the artifact.
+    if smoke:
+        bcfg, bdcfg = tcfg, dcfg
+        bB, bS, bLO, bHI = 2, 16, 8, 32  # (target steps, draft steps)
+    else:
+        bcfg = LMConfig(vocab=32768, d_model=2048, n_heads=16, n_layers=16,
+                        d_ff=8192, n_kv_heads=4)
+        bdcfg = LMConfig(vocab=32768, d_model=256, n_heads=4, n_layers=4,
+                         d_ff=1024, n_kv_heads=4)
+        # the draft's tiny step needs many more chained reps than the
+        # target's for the device signal to dwarf relay variance
+        bB, bS, bLO, bHI = 8, 128, 48, 256
+    bp = lm_init(jax.random.key(2), bcfg)
+    bd = lm_init(jax.random.key(3), bdcfg)
+    bprompt = jnp.asarray(
+        np.random.default_rng(2).integers(0, bcfg.vocab, size=(bB, bS)),
+        jnp.int32,
+    )
+
+    from seldon_core_tpu.models.generate import (
+        _chunk_step, init_cache, init_chunk, segment_forward)
+    from seldon_core_tpu.models.generate import prefill as prefill_fn
+
+    def step_ms(params, cfg, n_steps):
+        # chained decode scan in ONE dispatch minus the relay floor (the
+        # decode_measure method): n_steps sized so the device signal
+        # dwarfs relay variance for each model scale
+        main = init_cache(cfg, bB, bS)
+        logits, main = jax.jit(
+            lambda p, t, c, _c=cfg: prefill_fn(p, t, c, _c)
+        )(params, bprompt, main)
+        first = jnp.argmax(logits, -1).astype(jnp.int32)
+        chunk = init_chunk(cfg, bB, n_steps)
+        carry = (first, main, chunk, jnp.int32(bS), jnp.int32(0),
+                 jax.random.key(0))
+        stepf = jax.jit(
+            lambda p, tok, m, c, nm, used, key, _c=cfg, _n=n_steps:
+            _chunk_step(p, tok, m, c, nm, used, key, _c, _n, 0.0,
+                        main_full=True)
+        )
+        jax.block_until_ready(stepf(params, *carry))
+        raws = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(stepf(params, *carry))
+            raws.append(time.perf_counter() - t0)
+        raw = min(raws)
+        doc[f"spec_dbg_raw_ms_{cfg.d_model}_{n_steps}"] = round(raw * 1e3, 1)
+        return max(raw - relay_s, 0.05 * raw) / n_steps * 1e3
+
+    t_target_ms = step_ms(bp, bcfg, bLO)
+    t_draft_ms = step_ms(bd, bdcfg, bHI)
+
+    # verify pass: (k+1)-wide segment forward over a live-size cache,
+    # chained with a data dependency so reps cannot overlap
+
+    vcache = init_cache(bcfg, bB, bS + 8 * (k + 1))
+    _, vcache = jax.jit(
+        lambda p, t, c: segment_forward(p, t, c, 0, bcfg, segment=False)
+    )(bp, bprompt, vcache)
+    # 64 chained reps: a (k+1)-wide verify is ~2 ms of device time at
+    # this scale, and 8 reps' signal drowned in ±15 ms relay variance
+    # (one run read t_verify BELOW the weight-stream floor)
+    n_ver = 8 if smoke else 64
+
+    @jax.jit
+    def verify_reps(p, seg, cache):
+        def bodyf(carry, i):
+            seg, cache = carry
+            logits, cache = segment_forward(p, seg, cache, bS, bcfg)
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return (nxt, cache), ()
+        (seg, cache), _ = jax.lax.scan(
+            bodyf, (seg, cache), jnp.arange(n_ver))
+        return seg
+
+    seg0 = bprompt[:, : k + 1]
+    jax.block_until_ready(verify_reps(bp, seg0, vcache))
+    raws = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.block_until_ready(verify_reps(bp, seg0, vcache))
+        raws.append(time.perf_counter() - t0)
+    raw = min(raws)
+    doc["spec_dbg_raw_verify_ms"] = round(raw * 1e3, 1)
+    t_verify_ms = max(raw - relay_s, 0.05 * raw) / n_ver * 1e3
+
+    crossover = (k * t_draft_ms + t_verify_ms) / t_target_ms - 1
+    doc.update({
+        "spec_big_target_params_m": round(sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(bp)) / 1e6, 1),
+        "spec_big_t_target_step_ms": round(t_target_ms, 3),
+        "spec_big_t_draft_step_ms": round(t_draft_ms, 3),
+        "spec_big_t_verify_ms": round(t_verify_ms, 3),
+        # minimum accepted-draft length at which speculation breaks even
+        # at this target/draft scale; the trained copy-task pair measures
+        # 3.9/4 — speculation pays here iff this is below that
+        "spec_crossover_accept_len": round(crossover, 2),
+    })
+
+    # ---- the WIN arm: trained pair at a big-enough scale -----------------
+    # Train a ~244M f32 target + d256 draft on the copy task and run the
+    # shared round loop for real: the measured ratio should sit near the
+    # crossover model's prediction, and above 1.  f32, NOT bf16: a first
+    # attempt trained the 772M target in bf16 and adam diverged (loss
+    # stuck at ln(vocab)) — acceptance was 0 and the "win" was relay
+    # noise.  f32 params at this size still fit adam state in HBM.
+    if smoke:
+        bwcfg, bwdcfg = tcfg, dcfg
+        bsteps, trB, bhalf, bNEW = 30, 4, 8, 8
+    else:
+        bwcfg = LMConfig(vocab=32768, d_model=1280, n_heads=16,
+                         n_layers=12, d_ff=5120, n_kv_heads=4,
+                         dtype=jnp.float32)
+        bwdcfg = LMConfig(vocab=32768, d_model=256, n_heads=4, n_layers=4,
+                          d_ff=1024, n_kv_heads=4, dtype=jnp.float32)
+        bsteps, trB, bhalf, bNEW = 700, 16, 32, 32
+
+    def copy_batch_v(rng, b):
+        head = rng.integers(1, bwcfg.vocab, size=(b, bhalf))
+        return jnp.asarray(
+            np.concatenate([head, head, head], axis=1), jnp.int32)
+
+    brng = np.random.default_rng(7)
+    btrained = {}
+    # the d1280 target diverges at the small pair's 3e-3 (loss pinned at
+    # ~ln V); larger models want a smaller step
+    big_opt = optax.adam(5e-4)
+    for (name, seed), cfg in ((("target", 4), bwcfg),
+                              (("draft", 5), bwdcfg)):
+        params = lm_init(jax.random.key(seed), cfg)
+        opt_state = big_opt.init(params)
+        stepf = jax.jit(
+            lambda p, o, b, _cfg=cfg: lm_train_step(p, o, b, big_opt, _cfg)
+        )
+        for i in range(bsteps):
+            params, opt_state, loss = stepf(
+                params, opt_state, {"tokens": copy_batch_v(brng, trB)}
+            )
+        del opt_state  # free adam moments before generation
+        btrained[name] = (params, float(loss))
+    btp, bt_loss = btrained["target"]
+    bdp, bd_loss = btrained["draft"]
+    bprompt2 = copy_batch_v(brng, bB)[:, : 2 * bhalf]
+    bplain = jax.jit(
+        lambda p, t: generate(p, t, bwcfg, max_new_tokens=bNEW)
+    )
+    bspec = jax.jit(
+        lambda tp, dp, t: speculative_generate(
+            tp, dp, t, bwcfg, bwdcfg, max_new_tokens=bNEW, k=k
+        )
+    )
+    bplain_tok_s, _ = timed_tok_s(bplain, (btp, bprompt2), bNEW, bB)
+    bspec_tok_s, (_, brounds) = timed_tok_s(
+        bspec, (btp, bdp, bprompt2), bNEW, bB)
+    brounds = np.asarray(brounds)
+    doc.update({
+        "spec_big_trained_params_m": round(sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(btp)) / 1e6, 1),
+        "spec_big_trained_vs_plain_x": round(bspec_tok_s / bplain_tok_s, 2),
+        "spec_big_trained_accept_len": round(
+            float(bNEW / brounds.mean()) - 1, 2),
+        "spec_big_trained_target_loss": round(bt_loss, 3),
+        "spec_big_trained_draft_loss": round(bd_loss, 3),
     })
     print(json.dumps(doc))
 

@@ -1,0 +1,185 @@
+"""Generate every artifact-quoted figure in the docs from ONE committed
+bench snapshot — and fail CI when the docs drift from it.
+
+Rounds 3 and 4 both shipped doc ranges that excluded the judged
+artifacts (an int8 "~1.1x" against measured 0.79-0.93x being the worst).
+The fix is mechanical honesty: the numeric tables in
+``docs/benchmarking.md`` and ``PARITY.md`` live between GENERATED
+markers and are rendered by this script from the committed round
+snapshot (newest ``BENCH_r*_full.json``), each table naming the exact
+artifact file it came from.  Prose outside the markers may narrate
+attribution stories (profiler measurements, deltas) but must not quote
+artifact keys.
+
+    python scripts/docs_sync.py            # rewrite the generated blocks
+    python scripts/docs_sync.py --check    # exit 1 if docs drift (CI)
+
+The CI drift gate runs in ci/pipeline.yml; ``make docs-sync`` /
+``make docs-check`` wrap the two modes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BEGIN = "<!-- BEGIN GENERATED: {name} (scripts/docs_sync.py) -->"
+END = "<!-- END GENERATED: {name} -->"
+
+
+def _artifact():
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*_full.json")))
+    if not paths:
+        raise SystemExit("no BENCH_r*_full.json artifact at repo root")
+    return paths[-1]
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    if isinstance(v, int):
+        return f"{v:,}"
+    return str(v)
+
+
+def bench_figures(doc: dict, src: str) -> str:
+    g = doc.get
+    rows = [
+        ("REST socketed max qps (stub graph)", _fmt(g("value")),
+         f'{_fmt(g("vs_baseline"), 2)}× the reference 12,089'),
+        ("gRPC socketed max qps (stub graph)", _fmt(g("grpc_max_qps")),
+         f'{_fmt(g("grpc_vs_baseline"), 2)}× the reference 28,256'),
+        ("MNIST MLP served qps (REST)", _fmt(g("mnist_max_qps")),
+         "per-request payload-byte bound (single host core)"),
+        ("prefill MFU %", _fmt(g("prefill_mfu_pct"), 2),
+         "165.7M GQA-4 LM, B=32 S=512, vs 197 TF dense bf16 peak"),
+        ("decode tok/s (B=32, bf16)", _fmt(g("decode_tok_s")), ""),
+        ("decode tok/s (B=256, bf16)", _fmt(g("decode_tok_s_maxbatch")),
+         f'{_fmt(g("decode_hbm_bw_util_pct_maxbatch"))}% of measured HBM bw'),
+        ("decode tok/s (B=256, int8 KV)", _fmt(g("decode_tok_s_int8kv")),
+         f'{_fmt(g("int8kv_vs_bf16_x"), 2)}× bf16; '
+         f'{_fmt(g("int8kv_hbm_bw_util_pct"))}% of its own smaller stream'),
+        ("decode tok/s (B=256, int8 weights+KV)",
+         _fmt(g("decode_tok_s_int8both")),
+         f'{_fmt(g("int8both_vs_bf16_x"), 2)}× bf16; '
+         f'{_fmt(g("int8both_hbm_bw_util_pct"))}% bw-util'),
+        ("int8 weights alone (B=32)", f'{_fmt(g("int8_vs_bf16_x"), 2)}×',
+         "weight bytes are the minor stream at this size — see prose"),
+        ("measured HBM bandwidth GB/s", _fmt(g("hbm_bw_measured_gbs")),
+         "chained 256-rep reduction; ~92% of the 819 GB/s spec sheet"),
+        ("one-shot generate tok/s (jit path)", _fmt(g("e2e_gen_tok_s")), ""),
+        ("served generation tok/s (engine+socket)",
+         _fmt(g("served_gen_tok_s")),
+         f'{_fmt(g("served_gen_efficiency_pct"))}% of the raw jit path'
+         if g("served_gen_efficiency_pct") else ""),
+        ("speculative (trained pair, d256 target)",
+         f'{_fmt(g("spec_trained_vs_plain_x"), 2)}×',
+         f'accept len {_fmt(g("spec_trained_accept_len"), 1)}/4'),
+        ("speculative (trained pair, "
+         f'{_fmt(g("spec_big_trained_params_m"))}M f32 target)',
+         f'{_fmt(g("spec_big_trained_vs_plain_x"), 2)}×',
+         f'accept len {_fmt(g("spec_big_trained_accept_len"), 1)}/4'),
+        ("speculative crossover accept len ("
+         f'{_fmt(g("spec_big_target_params_m"))}M target)',
+         _fmt(g("spec_crossover_accept_len"), 2),
+         "min acceptance where speculation breaks even, from "
+         "spec_big_t_* component timings"),
+    ]
+    flash = g("flash_vs_xla_x") or {}
+    for key in sorted(flash):
+        rows.append((f"flash kernel vs XLA, S={key}",
+                     f"{_fmt(flash[key], 2)}×", "kernel forced, LM forward"))
+    lines = [
+        f"Source of record: `{os.path.basename(src)}` (the committed "
+        "round snapshot; the driver's own BENCH_rNN.json is captured "
+        "after the round closes and socketed keys vary ±15-25% "
+        "run-to-run on the shared host core).",
+        "",
+        "| metric | value | note |",
+        "|---|---|---|",
+    ]
+    for name, val, note in rows:
+        lines.append(f"| {name} | {val} | {note} |")
+    return "\n".join(lines)
+
+
+def parity_figures(doc: dict, src: str) -> str:
+    g = doc.get
+    lines = [
+        f"Source of record: `{os.path.basename(src)}` — regenerate with "
+        "`make docs-sync`.",
+        "",
+        "| axis | this framework | reference | ratio |",
+        "|---|---|---|---|",
+        f'| REST max throughput | {_fmt(g("value"))} '
+        f'req/s | 12,089 | {_fmt(g("vs_baseline"), 2)}× |',
+        f'| gRPC max throughput | {_fmt(g("grpc_max_qps"))} '
+        f'req/s | 28,256 | {_fmt(g("grpc_vs_baseline"), 2)}× |',
+        f'| engine-added p50 latency | '
+        f'{_fmt(g("span_framework_p50_ms"), 2)} ms | ~1-3 ms (JVM engine) '
+        "| comparable |",
+        f'| prefill MFU | {_fmt(g("prefill_mfu_pct"), 2)}% | n/a '
+        "(no LM serving in the reference) | beyond-reference |",
+        f'| max-batch decode | {_fmt(g("decode_tok_s_maxbatch"))} tok/s '
+        f'bf16, {_fmt(g("decode_tok_s_int8both"))} int8 | n/a | '
+        "beyond-reference |",
+    ]
+    return "\n".join(lines)
+
+
+BLOCKS = {
+    "docs/benchmarking.md": [("bench-figures", bench_figures)],
+    "PARITY.md": [("parity-figures", parity_figures)],
+}
+
+
+def splice(text: str, name: str, body: str) -> str:
+    b, e = BEGIN.format(name=name), END.format(name=name)
+    pat = re.compile(re.escape(b) + r".*?" + re.escape(e), re.S)
+    repl = f"{b}\n{body}\n{e}"
+    if not pat.search(text):
+        raise SystemExit(f"markers for block {name!r} not found")
+    return pat.sub(lambda _m: repl, text)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--artifact", default=None)
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args()
+    src = args.artifact or _artifact()
+    with open(src) as f:
+        doc = json.load(f)
+    drift = False
+    for rel, blocks in BLOCKS.items():
+        path = os.path.join(ROOT, rel)
+        with open(path) as f:
+            text = f.read()
+        new = text
+        for name, render in blocks:
+            new = splice(new, name, render(doc, src))
+        if new != text:
+            if args.check:
+                print(f"DRIFT: {rel} generated blocks out of date "
+                      f"(run `make docs-sync`)", file=sys.stderr)
+                drift = True
+            else:
+                with open(path, "w") as f:
+                    f.write(new)
+                print(f"updated {rel}")
+        else:
+            print(f"ok {rel}")
+    if drift:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Record which cross-language toolchains exist in THIS build/CI host —
+the execution-evidence ledger for the R and Java wrapper lanes.
+
+The conformance suite (tests/test_conformance.py) parameterizes the model
+contract over {cpp, r, java}; the R and Java lanes need an R interpreter
+and a Java toolchain.  This probe documents, mechanically, what the
+current host can and cannot run, so a skipped lane in a test report is
+attributable to the environment rather than the code.  Findings on the
+round-5 build host (zero-egress, no package installs):
+
+  * no R interpreter anywhere (`Rscript`/`R` absent from PATH and a
+    filesystem sweep);
+  * no Java compiler: the only JVM is bazel's embedded Zulu 21 JRE
+    (`~/.cache/bazel/.../embedded_tools/jdk`), a 13-module runtime
+    WITHOUT jdk.compiler (so `javac` and single-file `java Foo.java`
+    both fail) and WITHOUT jdk.httpserver; bazel's own Java rules can't
+    compile either (remote_java_tools needs network).
+
+Writes ``conformance_env.json`` (or --out) and prints it.  The CI image
+(ci/docker/Dockerfile `test` target) installs r-base-core +
+default-jdk-headless precisely so this probe reports both lanes
+runnable there and the no-skip conformance job holds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+
+def _run(cmd, timeout=30):
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout
+        )
+        return out.returncode, (out.stdout + out.stderr).strip()[:400]
+    except FileNotFoundError:
+        return None, "not found"
+    except Exception as e:  # pragma: no cover - defensive
+        return None, f"{type(e).__name__}: {e}"
+
+
+def probe() -> dict:
+    doc = {"host": os.uname().nodename, "python": sys.version.split()[0]}
+
+    # ---- R ----------------------------------------------------------------
+    r = {}
+    for exe in ("Rscript", "R"):
+        path = shutil.which(exe)
+        r[exe] = {"path": path}
+        if path:
+            rc, ver = _run([exe, "--version"])
+            r[exe].update({"rc": rc, "version": ver.splitlines()[0] if ver
+                           else ""})
+    doc["r"] = r
+    doc["r_lane_runnable"] = bool(r["Rscript"]["path"])
+
+    # ---- Java -------------------------------------------------------------
+    j = {}
+    javac = shutil.which("javac")
+    java = shutil.which("java")
+    # bazel release binaries carry an embedded JRE in their install base
+    embedded = sorted(glob.glob(os.path.expanduser(
+        "~/.cache/bazel/_bazel_*/install/*/embedded_tools/jdk/bin/java")))
+    j["javac_path"] = javac
+    j["java_path"] = java
+    j["bazel_embedded_jre"] = embedded[-1] if embedded else None
+    java_exe = java or (embedded[-1] if embedded else None)
+    if java_exe:
+        rc, ver = _run([java_exe, "-version"])
+        j["java_version"] = ver.splitlines()[0] if ver else ""
+        rc, mods = _run([java_exe, "--list-modules"])
+        mods = [m.split("@")[0] for m in mods.splitlines()] if rc == 0 else []
+        j["modules"] = mods
+        j["has_jdk_compiler"] = "jdk.compiler" in mods
+        j["has_jdk_httpserver"] = "jdk.httpserver" in mods
+    doc["java"] = j
+    # the lane needs BOTH a compiler and the httpserver module (or a full
+    # JDK, which implies both)
+    doc["java_lane_runnable"] = bool(
+        javac or j.get("has_jdk_compiler", False)
+    )
+
+    doc["conformance_expected_skips"] = [
+        lane for lane, ok in (
+            ("r", doc["r_lane_runnable"]),
+            ("java", doc["java_lane_runnable"]),
+        ) if not ok
+    ]
+    return doc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="conformance_env.json")
+    args = ap.parse_args()
+    doc = probe()
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

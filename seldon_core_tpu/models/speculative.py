@@ -12,21 +12,28 @@ positions — same-quality tokens, not errors.  The target runs
 ~(accepted+1)x fewer sequential passes; acceptance rate tracks how well
 the draft approximates the target (an unrelated random draft accepts ~0).
 
-TPU shape: the whole loop is one ``lax.while_loop`` under jit — draft scan,
-target segment-verify, acceptance, cache advance — so an entire generation
-is still a single device dispatch.  Caches are preallocated; partially
-rejected segments need no rewind because attention masks by global position
-and later segments overwrite the stale tail (``dynamic_update_slice``).
+TPU shape: the whole loop is one SHARED batched ``lax.while_loop`` under
+jit — every round, ALL rows draft k tokens (batched one-token forwards),
+ALL rows verify in one (k+1)-wide target pass, and acceptance is a masked
+per-row reduction.  There is no per-row program and no vmap-lifted
+while_loop: rows at different sequence lengths share every MXU pass.
 
-Batch: rows decode INDEPENDENTLY (per-row caches, per-row acceptance), so
-B>1 runs the single-row program under ``vmap`` — JAX lifts the
-``while_loop`` to run-until-every-row-finishes with masked carries, which
-is the standard batched-speculative trade: rows advance in lockstep
-rounds, the fastest rows idle (masked) until the slowest accepts its last
-token, and every round's draft scan + target verify is one batched MXU
-pass over all rows.  Per-row outputs are exactly the B=1 outputs (pinned
-by tests in f32); serving coalesces concurrent callers into one such
-batch.
+The layout trick that makes the shared loop scatter-free: cache slots are
+ROUND-ALIGNED.  Round r writes its k+1 candidate K/V at slots
+``S + r*(k+1)..`` — the SAME offset for every row — so cache writes are
+ordinary ``dynamic_update_slice`` ops, never per-row scatters (the old
+vmapped design's per-row offsets lowered each cache write to a scatter).
+Rejected candidates leave holes; a per-row VALIDITY BITMAP masks them out
+of every later attention (additive -1e30), and RoPE rotates by per-row
+LOGICAL positions (apply_rope takes [B, S] position arrays), so the math
+over the valid set is exactly vanilla greedy decoding of the target.
+Memory trades for regularity: caches are sized S + (max_new-1)*(k+1)
+worst-case instead of S + max_new.
+
+Rows that finish early keep riding the loop with their validity updates
+masked off (gained = 0), and outputs are written round-aligned
+([B, rounds, k+1] + per-row gained counts), compacted once at the end —
+the only scatter in the program.
 """
 
 from __future__ import annotations
@@ -38,13 +45,78 @@ import jax.numpy as jnp
 
 from seldon_core_tpu.graph.units import Unit, register_unit
 from seldon_core_tpu.models.generate import (
+    _grouped_pv,
+    _grouped_qk,
+    _heads,
     init_cache,
     sanitize_prompt,
     segment_forward,
 )
-from seldon_core_tpu.models.transformer import LMConfig, lm_init
+from seldon_core_tpu.models.transformer import (
+    LMConfig,
+    _ffn,
+    _rmsnorm,
+    apply_rope,
+    lm_init,
+)
 
 __all__ = ["speculative_generate", "SpeculativeGenerator"]
+
+
+def _forward_seg(params, tokens, cache, off, pos0, valid, cfg: LMConfig):
+    """Bitmap-masked segment forward for the shared round loop.
+
+    tokens [B, W] at per-row logical positions pos0[:, None] + arange(W);
+    K/V written at cache slots off..off+W-1 (``off`` is round-uniform —
+    a regular dus, never a scatter).  Attention allows, per row, the
+    ``valid`` [B, L] bitmap slots plus in-segment causal slots (slot
+    off+j visible to query i iff j <= i).  Returns
+    (logits [B, W, vocab] f32, cache')."""
+    from seldon_core_tpu.ops.quant import lm_matmul
+
+    B, W = tokens.shape
+    D = cfg.d_model
+    hd = D // cfg.n_heads
+    kv_h = cfg.kv_heads
+    L = cache["l0"]["k"].shape[2]
+    lidx = jnp.arange(L)
+    seg = (lidx >= off) & (lidx < off + W)              # [L]
+    incause = (lidx - off)[None, :] <= jnp.arange(W)[:, None]  # [W, L]
+    allowed = jnp.where(seg[None, None, :], incause[None, :, :],
+                        valid[:, None, :])              # [B, W, L]
+    mask_add = jnp.where(allowed, 0.0, -1e30).astype(jnp.float32)
+    positions = pos0[:, None] + jnp.arange(W)[None, :]  # [B, W]
+    x = params["embed"][tokens]                         # [B, W, D]
+    for i in range(cfg.n_layers):
+        lp = params[f"l{i}"]
+        cl = cache[f"l{i}"]
+        h = _rmsnorm(x, lp["ln1"])
+        qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)
+        q, k, v = jnp.split(qkv, [D, D + kv_h * hd], axis=-1)
+        q = _heads(q, B, W, cfg.n_heads, hd)
+        k = _heads(k, B, W, kv_h, hd)
+        v = _heads(v, B, W, kv_h, hd)
+        if cfg.rope:
+            q = apply_rope(q, positions, cfg.rope_base)
+            k = apply_rope(k, positions, cfg.rope_base)
+        cl = {
+            "k": jax.lax.dynamic_update_slice(
+                cl["k"], k.astype(cl["k"].dtype), (0, 0, off, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                cl["v"], v.astype(cl["v"].dtype), (0, 0, off, 0)),
+        }
+        s = _grouped_qk(q, cl["k"])                     # [B,KV,g,W,L]
+        s = s + mask_add[:, None, None, :, :]
+        p = jax.nn.softmax(s, axis=-1)
+        a = _grouped_pv(p, cl["v"], q.shape, q.dtype)
+        a = a.transpose(0, 2, 1, 3).reshape(B, W, D)
+        x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
+        h2 = _rmsnorm(x, lp["ln2"])
+        y, _lb = _ffn(lp, h2, cfg, mesh=None)
+        x = x + y
+        cache[f"l{i}"] = cl
+    x = _rmsnorm(x, params["ln_f"])
+    return (x @ params["embed"].T).astype(jnp.float32), cache
 
 
 def speculative_generate(
@@ -60,98 +132,120 @@ def speculative_generate(
     rounds int32 [B] — verify passes used per row; ~max_new/rounds tokens
     per target pass, vs exactly 1 for vanilla decoding).
 
-    Greedy only; per-row output is exactly vanilla greedy decoding of the
-    target.  Rows vmap over the single-row program (see module docstring).
-    """
-    return jax.vmap(
-        lambda row: _speculative_row(
-            target_params, draft_params, row, target_cfg, draft_cfg,
-            max_new_tokens, k,
-        )
-    )(prompt)
-
-
-def _speculative_row(
-    target_params, draft_params, row, target_cfg: LMConfig,
-    draft_cfg: LMConfig, max_new_tokens: int, k: int,
-) -> Tuple[jax.Array, jax.Array]:
-    """row [S] int32 -> (tokens [max_new_tokens], rounds scalar)."""
-    prompt = row[None, :]
+    Greedy only; per-row output equals vanilla greedy decoding of the
+    target over its confirmed prefix.  One SHARED batched round loop —
+    see the module docstring for the round-aligned/bitmap design."""
+    if target_cfg.kv_quant == "int8" or draft_cfg.kv_quant == "int8":
+        raise NotImplementedError(
+            "speculative decoding runs float KV caches; quantize weights "
+            "(quant='int8'), not the cache")
     B, S = prompt.shape
-    max_len = S + max_new_tokens + k + 2
-    t_cache = init_cache(target_cfg, B, max_len)
-    d_cache = init_cache(draft_cfg, B, max_len)
+    W = k + 1
+    R = max(max_new_tokens - 1, 1)  # worst case: 1 token gained per round
+    Lmax = S + R * W
+    t_cache = init_cache(target_cfg, B, Lmax)
+    d_cache = init_cache(draft_cfg, B, Lmax)
 
     # prefill both models on the prompt; last-position argmax = first token
     t_logits, t_cache = segment_forward(
         target_params, prompt, t_cache, 0, target_cfg, segment=False)
     _d_logits, d_cache = segment_forward(
         draft_params, prompt, d_cache, 0, draft_cfg, segment=False)
-    first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [1]
+    first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)  # [B]
+    if max_new_tokens == 1:
+        return first[:, None], jnp.zeros((B,), jnp.int32)
 
-    out = jnp.zeros((max_new_tokens + k + 1,), jnp.int32)
-    out = out.at[0].set(first[0])
+    valid0 = jnp.broadcast_to(jnp.arange(Lmax) < S, (B, Lmax))
+    toks_rounds = jnp.zeros((B, R, W), jnp.int32)
+    gained_rounds = jnp.zeros((B, R), jnp.int32)
 
-    def cond(carry):
-        n, *_ = carry
-        return n < max_new_tokens
+    def cond(c):
+        r, n = c[0], c[1]
+        return (r < R) & jnp.any(n < max_new_tokens)
 
-    def body(carry):
-        n, rounds, out, t_cache, d_cache = carry
-        # positions: the last accepted token sits at global index S + n - 1
-        last = jax.lax.dynamic_index_in_dim(
-            out, n - 1, 0, keepdims=False
-        )  # newest token (scalar)
+    def body(c):
+        (r, n, last, toks_rounds, gained_rounds, rounds_used,
+         t_cache, d_cache, t_valid, d_valid) = c
+        off = S + r * W
+        P = S + n - 1  # logical position of `last`, per row [B]
 
-        # -- draft proposes k tokens with its cache ------------------------
-        # k+1 steps: the extra step writes the KV of the LAST proposal so a
-        # fully-accepted round leaves no cache hole behind (holes would
-        # degrade every later round's acceptance); its proposal is unused
-        def draft_step(c, i):
-            tok, d_cache = c
-            logits, d_cache = segment_forward(
-                draft_params, tok[None, None], d_cache, S + n - 1 + i,
-                draft_cfg)
-            nxt = jnp.argmax(logits[0, -1, :]).astype(jnp.int32)
-            return (nxt, d_cache), nxt
+        # -- every row drafts k tokens: k+1 batched one-token forwards.
+        # The extra step writes the LAST proposal's KV so a fully-
+        # accepted round leaves no cache hole.  Earlier in-round slots
+        # become visible through the provisional bitmap ``dv``.
+        def draft_step(carry, i):
+            tok, d_cache, dv = carry
+            logits, d_cache = _forward_seg(
+                draft_params, tok[:, None], d_cache, off + i, P + i,
+                dv, draft_cfg)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            dv = jax.lax.dynamic_update_slice(
+                dv, jnp.ones((B, 1), bool), (0, off + i))
+            return (nxt, d_cache, dv), tok
 
-        (_, d_cache), proposals = jax.lax.scan(
-            draft_step, (last, d_cache), jnp.arange(k + 1))  # [k+1]
-        draft_toks = proposals[:k]
+        (_, d_cache, _), seg_toks = jax.lax.scan(
+            draft_step, (last, d_cache, d_valid), jnp.arange(W))
+        # seg_toks[i] is the token FED at step i: [last, d1..dk]
+        seg_toks = seg_toks.T  # [B, W]
+        draft_toks = seg_toks[:, 1:]  # [B, k]
 
-        # -- target verifies last + k draft tokens in ONE forward ----------
-        seg = jnp.concatenate([last[None], draft_toks])[None, :]  # [1, k+1]
-        t_logits, t_cache = segment_forward(
-            target_params, seg, t_cache, S + n - 1, target_cfg)
-        t_argmax = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)  # [k+1]
+        # -- one (k+1)-wide target pass verifies every row ----------------
+        t_logits, t_cache = _forward_seg(
+            target_params, seg_toks, t_cache, off, P, t_valid, target_cfg)
+        t_argmax = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B,W]
 
         # greedy acceptance: longest prefix where draft == target argmax
-        match = draft_toks == t_argmax[:k]
-        accepted = jnp.argmin(
-            jnp.concatenate([match, jnp.zeros((1,), bool)])
-        )  # first False; k if all matched
-        # tokens gained this round: accepted drafts + 1 corrected/extended
+        match = draft_toks == t_argmax[:, :k]  # [B, k]
+        a = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((B, 1), bool)], axis=1),
+            axis=1,
+        )  # [B] first False; k if all matched
+        corrected = jnp.take_along_axis(t_argmax, a[:, None], axis=1)[:, 0]
+        padded = jnp.concatenate(
+            [draft_toks, jnp.zeros((B, 1), jnp.int32)], axis=1)  # [B, W]
         new_toks = jnp.where(
-            jnp.arange(k + 1) < accepted,
-            jnp.concatenate([draft_toks, jnp.zeros((1,), jnp.int32)]),
-            jnp.broadcast_to(
-                jax.lax.dynamic_index_in_dim(
-                    t_argmax, accepted, 0, keepdims=False
-                ),
-                (k + 1,),
-            ),
-        )  # positions > accepted are garbage; masked by the write below
-        gained = accepted + 1
-        keep = jnp.arange(k + 1) < gained
-        cur = jax.lax.dynamic_slice_in_dim(out, n, k + 1)
-        out = jax.lax.dynamic_update_slice_in_dim(
-            out, jnp.where(keep, new_toks, cur), n, 0)
-        return n + gained, rounds + 1, out, t_cache, d_cache
+            jnp.arange(W)[None, :] < a[:, None], padded, corrected[:, None])
+        active = n < max_new_tokens
+        gained = jnp.where(active, a + 1, 0)
 
-    n0 = jnp.int32(1)
-    n, rounds, out, _, _ = jax.lax.while_loop(
-        cond, body, (n0, jnp.int32(0), out, t_cache, d_cache))
-    return out[:max_new_tokens], rounds
+        toks_rounds = jax.lax.dynamic_update_slice(
+            toks_rounds, new_toks[:, None, :], (0, r, 0))
+        gained_rounds = jax.lax.dynamic_update_slice(
+            gained_rounds, gained[:, None], (0, r))
+        # confirmed slots this round: off+0 (last) .. off+a — `last` was
+        # materialised here for the first time (the corrected token is
+        # never forwarded in the round it is emitted), so slot 0 is the
+        # ONLY copy and stays valid; rejected tails stay holes
+        vmask = ((jnp.arange(W)[None, :] <= a[:, None])
+                 & active[:, None])  # [B, W]
+        t_valid = jax.lax.dynamic_update_slice(t_valid, vmask, (0, off))
+        d_valid = jax.lax.dynamic_update_slice(d_valid, vmask, (0, off))
+        last = jnp.where(active, corrected, last)
+        return (r + 1, n + gained, last, toks_rounds, gained_rounds,
+                rounds_used + active.astype(jnp.int32),
+                t_cache, d_cache, t_valid, d_valid)
+
+    (r, n, last, toks_rounds, gained_rounds, rounds_used,
+     *_rest) = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.ones((B,), jnp.int32), first, toks_rounds,
+         gained_rounds, jnp.zeros((B,), jnp.int32), t_cache, d_cache,
+         valid0, valid0),
+    )
+
+    # compact the round-aligned tokens into dense rows — the program's
+    # ONE scatter, run once after the loop
+    flat = toks_rounds.reshape(B, R * W)
+    keep = (jnp.arange(W)[None, None, :]
+            < gained_rounds[:, :, None]).reshape(B, R * W)
+    dest = jnp.cumsum(keep, axis=1)  # kept token j -> output index 1..
+    pad = max_new_tokens + W  # clipped rows' overflow lands past the end
+    dest = jnp.where(keep, jnp.minimum(dest, pad), pad)
+    out = jnp.zeros((B, pad + 1), jnp.int32)
+    out = out.at[:, 0].set(first)
+    out = out.at[jnp.arange(B)[:, None], dest].set(
+        jnp.where(keep, flat, 0))
+    return out[:, :max_new_tokens], rounds_used
 
 
 @register_unit("SpeculativeGenerator")
@@ -159,11 +253,13 @@ class SpeculativeGenerator(Unit):
     """Serving unit: speculative draft/verify generation over the standard
     data plane.  Target and draft dimensions are graph parameters (draft_*
     defaults to a quarter-size model).  Concurrent callers coalesce into
-    one vmapped draft/verify loop (rows independent; lockstep rounds)."""
+    ONE shared batched round loop (round-aligned cache slots + per-row
+    validity bitmaps — see speculative_generate); per-row outputs equal
+    the single-row outputs, so coalescing never changes an answer."""
 
     pure = True
-    # rows are independent (vmapped row programs): concurrent callers
-    # coalesce into one batched draft/verify loop like any other unit
+    # per-row outputs are independent of co-batched rows (pinned by
+    # tests), so concurrent callers coalesce like any other unit
 
     def __init__(self, vocab: int = 256, d_model: int = 128, n_heads: int = 4,
                  n_layers: int = 2, d_ff: int = 512,

@@ -131,17 +131,23 @@ def _rmsnorm(x, w, eps=1e-6):
 
 
 def apply_rope(x, positions, base: float = 10000.0):
-    """Rotate [B, H, S, hd] by per-position angles; positions [S] (may be
-    traced — cached decode passes start+arange).  Half-split convention;
-    f32 trig, output in the input dtype."""
+    """Rotate [B, H, S, hd] by per-position angles; positions [S] shared
+    across the batch (may be traced — cached decode passes start+arange)
+    or [B, S] PER-ROW (batched speculative decoding, where rows sit at
+    different sequence lengths).  Half-split convention; f32 trig,
+    output in the input dtype."""
     hd = x.shape[-1]
     half = hd // 2
     freqs = base ** (
         -jnp.arange(0, half, dtype=jnp.float32) / half
     )  # [half]
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S,half]
-    cos = jnp.cos(angles)[None, None]  # [1,1,S,half]
-    sin = jnp.sin(angles)[None, None]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [...,S,half]
+    if angles.ndim == 2:  # shared positions [S, half]
+        cos = jnp.cos(angles)[None, None]  # [1,1,S,half]
+        sin = jnp.sin(angles)[None, None]
+    else:  # per-row positions [B, S, half] -> broadcast over heads
+        cos = jnp.cos(angles)[:, None]  # [B,1,S,half]
+        sin = jnp.sin(angles)[:, None]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     return jnp.concatenate(

@@ -65,11 +65,14 @@ def _spawn_lane(lane, tmp_path_factory):
         cmd = [binary]
     elif lane == "r":
         if shutil.which("Rscript") is None:
-            pytest.skip("no R toolchain")
+            pytest.skip("no R toolchain (scripts/toolchain_probe.py "
+                        "records what this host has)")
         cmd = ["Rscript", R_SERVER, "--model", R_MODEL, "--service", "MODEL"]
     elif lane == "java":
         if shutil.which("javac") is None or shutil.which("java") is None:
-            pytest.skip("no JVM toolchain")
+            pytest.skip("no JVM toolchain (scripts/toolchain_probe.py "
+                        "records what this host has — bazel's embedded "
+                        "JRE lacks jdk.compiler)")
         outdir = str(tmp_path_factory.mktemp("java"))
         subprocess.run(["javac", "-d", outdir, JAVA_SRC], check=True)
         cmd = ["java", "-cp", outdir, "ModelServer"]
