@@ -34,10 +34,15 @@ END = "<!-- END GENERATED: {name} -->"
 
 
 def _artifact():
-    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*_full.json")))
+    paths = glob.glob(os.path.join(ROOT, "BENCH_r*_full.json"))
     if not paths:
         raise SystemExit("no BENCH_r*_full.json artifact at repo root")
-    return paths[-1]
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)_full", p)
+        return int(m.group(1)) if m else -1
+
+    return max(paths, key=round_no)
 
 
 def _fmt(v, nd=1):

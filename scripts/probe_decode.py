@@ -39,6 +39,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from seldon_core_tpu.utils.fence import fetch_sync
+
+
+
 
 def _relay_floor():
     f = jax.jit(lambda x: x * 2.0)
@@ -54,9 +58,9 @@ def _relay_floor():
 
 def _timed(fn, *args, relay_s=0.0, n=1):
     """Compile, then time one dispatch; returns seconds per rep."""
-    jax.block_until_ready(fn(*args))
+    fetch_sync(fn(*args))
     t0 = time.perf_counter()
-    jax.block_until_ready(fn(*args))
+    fetch_sync(fn(*args))
     raw = time.perf_counter() - t0
     return max(raw - relay_s, 0.05 * raw) / n
 

@@ -33,6 +33,10 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from seldon_core_tpu.utils.fence import fetch_sync
+
+
 from jax.experimental import pallas as pl
 
 
@@ -100,11 +104,11 @@ def main():
                 step, (buf, jnp.zeros((), jnp.float32)),
                 jnp.arange(args.steps))
             return buf, acc
-        jax.block_until_ready(prog(buf0, q))
+        fetch_sync(prog(buf0, q))
         raws = []
         for _ in range(2):
             t0 = time.perf_counter()
-            jax.block_until_ready(prog(buf0, q))
+            fetch_sync(prog(buf0, q))
             raws.append(time.perf_counter() - t0)
         raw = min(raws)
         return max(raw - relay_s, 0.05 * raw) / args.steps * 1e6

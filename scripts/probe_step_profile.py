@@ -34,6 +34,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from seldon_core_tpu.utils.fence import fetch_sync
+
+
+
 
 def _trace_events(trace_dir: str):
     """Load the newest perfetto trace under ``trace_dir`` and yield
@@ -138,11 +142,11 @@ def main():
                 p, tok, m, c, nm, used, key, _c, NEW, 0.0, main_full=True,
             )
         )
-        jax.block_until_ready(step(ps, *carry))  # compile outside trace
+        fetch_sync(step(ps, *carry))  # compile outside trace
         tdir = tempfile.mkdtemp(prefix=f"prof_{mode}_")
         t0 = time.perf_counter()
         with jax.profiler.trace(tdir):
-            jax.block_until_ready(step(ps, *carry))
+            fetch_sync(step(ps, *carry))
         wall = time.perf_counter() - t0
         grand_us, grand_bytes, top_ops = _aggregate(
             _trace_events(tdir), args.top)

@@ -80,11 +80,10 @@ def probe() -> dict:
         j["has_jdk_compiler"] = "jdk.compiler" in mods
         j["has_jdk_httpserver"] = "jdk.httpserver" in mods
     doc["java"] = j
-    # the lane needs BOTH a compiler and the httpserver module (or a full
-    # JDK, which implies both)
-    doc["java_lane_runnable"] = bool(
-        javac or j.get("has_jdk_compiler", False)
-    )
+    # mirror the conformance gate exactly (tests/test_conformance.py
+    # skips unless BOTH javac and java are on PATH), so the ledger never
+    # misattributes a skip
+    doc["java_lane_runnable"] = bool(javac and java)
 
     doc["conformance_expected_skips"] = [
         lane for lane, ok in (

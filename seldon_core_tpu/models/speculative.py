@@ -71,7 +71,14 @@ def _forward_seg(params, tokens, cache, off, pos0, valid, cfg: LMConfig):
     a regular dus, never a scatter).  Attention allows, per row, the
     ``valid`` [B, L] bitmap slots plus in-segment causal slots (slot
     off+j visible to query i iff j <= i).  Returns
-    (logits [B, W, vocab] f32, cache')."""
+    (logits [B, W, vocab] f32, cache').
+
+    NOTE: this deliberately re-states the per-layer forward that
+    generate.py's _block_cached implements for prefix-valid caches —
+    the bitmap mask and per-row positions cut across every one of that
+    function's masking modes.  The two MUST evolve together (new quant
+    modes, attention changes); the float-only guard in
+    speculative_generate is the current honest gap."""
     from seldon_core_tpu.ops.quant import lm_matmul
 
     B, W = tokens.shape

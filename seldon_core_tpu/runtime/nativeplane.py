@@ -308,35 +308,48 @@ class NativeDataPlane:
             width = int(view.width)
             x = np.ctypeslib.as_array(view.data, shape=(rows, width))
             try:
-                padded = _pad_rows(x, self.max_batch)
-                y, routing, tags = engine.compiled.predict_arrays(
-                    padded, update_states=False
-                )
-                if routing or tags:
-                    # data-dependent tags slipped past the static checks:
-                    # the C++ composer cannot merge them into meta, so
-                    # refuse loudly rather than strip them silently
-                    logger.error(
-                        "native plane cannot serve tag/routing-emitting "
-                        "graph; set ENGINE_HTTP_IMPL=fast"
+                # spans (when tracing is enabled): "plane_batch" covers
+                # the Python side of one native batch — pad, device
+                # dispatch, output marshalling — and the nested
+                # "dispatch" isolates the device round-trip, so a served
+                # request decomposes into C++ parse/queue (total minus
+                # plane) + framework (plane minus dispatch) + device+relay
+                with engine.tracer.span(
+                    "", "plane_batch", kind="plane", rows=rows
+                ):
+                    padded = _pad_rows(x, self.max_batch)
+                    with engine.tracer.span(
+                        "", "dispatch", kind="dispatch", method="native",
+                        rows=rows,
+                    ):
+                        y, routing, tags = engine.compiled.predict_arrays(
+                            padded, update_states=False
+                        )
+                    if routing or tags:
+                        # data-dependent tags slipped past the static
+                        # checks: the C++ composer cannot merge them into
+                        # meta, so refuse loudly rather than strip them
+                        logger.error(
+                            "native plane cannot serve tag/routing-"
+                            "emitting graph; set ENGINE_HTTP_IMPL=fast"
+                        )
+                        lib.dp_fail_batch(
+                            handle, view.id, 500, fail_tags, len(fail_tags)
+                        )
+                        continue
+                    y = np.ascontiguousarray(
+                        np.asarray(y)[:rows], dtype=np.float64
                     )
-                    lib.dp_fail_batch(
-                        handle, view.id, 500, fail_tags, len(fail_tags)
+                    # the C++ composer emits 2-D fragments; higher-rank
+                    # model outputs flatten per row (same wire width)
+                    if y.ndim != 2:
+                        y = y.reshape(rows, -1)
+                    engine._known_good_widths.add((width,))
+                    lib.dp_complete_batch(
+                        handle, view.id,
+                        y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                        y.shape[0], y.shape[1],
                     )
-                    continue
-                y = np.ascontiguousarray(
-                    np.asarray(y)[:rows], dtype=np.float64
-                )
-                # the C++ composer emits 2-D fragments; higher-rank model
-                # outputs flatten per row (same wire width, flat shape)
-                if y.ndim != 2:
-                    y = y.reshape(rows, -1)
-                engine._known_good_widths.add((width,))
-                lib.dp_complete_batch(
-                    handle, view.id,
-                    y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-                    y.shape[0], y.shape[1],
-                )
             except (TypeError, ValueError) as e:
                 # novel width failing at trace time = client shape error
                 # (engine.py:_batched_predict_sync's 400/500 split)

@@ -1,0 +1,25 @@
+"""Completion fence for timed relay dispatches.
+
+On the axon relay ``jax.block_until_ready`` can return WITHOUT waiting
+(observed after compile-helper restarts): a timing loop built on it then
+measures ~0.05 ms for a 100+ ms dispatch.  A host fetch of any output is
+a true fence — the program completes as a unit before results transfer —
+so every wall-clock measurement in bench.py and scripts/ fences through
+``fetch_sync``, which fetches the SMALLEST output leaf to keep the fence
+itself cheap.
+"""
+
+from __future__ import annotations
+
+__all__ = ["fetch_sync"]
+
+
+def fetch_sync(out):
+    import numpy as np
+
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(out)
+    leaf = min(leaves, key=lambda a: getattr(a, "size", 1 << 62))
+    np.asarray(leaf)
+    return out
