@@ -135,24 +135,41 @@ def apply_rope(x, positions, base: float = 10000.0):
     across the batch (may be traced — cached decode passes start+arange)
     or [B, S] PER-ROW (batched speculative decoding, where rows sit at
     different sequence lengths).  Half-split convention; f32 trig,
-    output in the input dtype."""
+    output in the input dtype.
+
+    The rotate-half is computed as ``x @ R`` with R the constant signed
+    permutation [[0, I], [-I, 0]] — EXACT arithmetic (each output is
+    ±one input) and MXU-fusable.  The obvious
+    ``concat([-x2, x1])`` lowers to lane-dim pad+maximum fusions that
+    cannot fuse into the flash kernel's custom-call boundary: profiled
+    at ~290 us/layer on the B=32 S=512 prefill (~3.5 ms/pass, ~7% of
+    the whole forward)."""
     hd = x.shape[-1]
     half = hd // 2
     freqs = base ** (
         -jnp.arange(0, half, dtype=jnp.float32) / half
     )  # [half]
     angles = positions.astype(jnp.float32)[..., None] * freqs  # [...,S,half]
+    cos = jnp.cos(angles)
+    sin = jnp.sin(angles)
     if angles.ndim == 2:  # shared positions [S, half]
-        cos = jnp.cos(angles)[None, None]  # [1,1,S,half]
-        sin = jnp.sin(angles)[None, None]
+        c = jnp.concatenate([cos, cos], axis=-1)[None, None]  # [1,1,S,hd]
+        s = jnp.concatenate([sin, sin], axis=-1)[None, None]
     else:  # per-row positions [B, S, half] -> broadcast over heads
-        cos = jnp.cos(angles)[:, None]  # [B,1,S,half]
-        sin = jnp.sin(angles)[:, None]
-    x1 = x[..., :half].astype(jnp.float32)
-    x2 = x[..., half:].astype(jnp.float32)
-    return jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
-    ).astype(x.dtype)
+        c = jnp.concatenate([cos, cos], axis=-1)[:, None]  # [B,1,S,hd]
+        s = jnp.concatenate([sin, sin], axis=-1)[:, None]
+    eye = jnp.eye(half, dtype=x.dtype)
+    zero = jnp.zeros((half, half), x.dtype)
+    rot = jnp.concatenate([
+        jnp.concatenate([zero, eye], axis=1),    # rows i<half: +x1 -> out2
+        jnp.concatenate([-eye, zero], axis=1),   # rows i>=half: -x2 -> out1
+    ], axis=0)  # [hd, hd]
+    rx = jax.lax.dot_general(
+        x, rot, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    out = x.astype(jnp.float32) * c + rx * s
+    return out.astype(x.dtype)
 
 
 def lm_init(rng, cfg: LMConfig) -> Dict[str, Any]:
