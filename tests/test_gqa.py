@@ -138,6 +138,43 @@ def test_rope_properties():
     LMConfig(d_model=12, n_heads=4, rope=False)  # fine without rope
 
 
+def test_rope_matmul_form_equals_concat_form():
+    """apply_rope computes the rotate-half as x @ [[0,I],[-I,0]] (the
+    concat form lowered to unfusable lane-pad fusions on TPU — round-5
+    profile); the signed-permutation matmul must reproduce the textbook
+    [x1*cos - x2*sin, x2*cos + x1*sin] EXACTLY in f32, and accept
+    per-row [B, S] position arrays."""
+    from seldon_core_tpu.models.transformer import apply_rope
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 3, 5, 16)), jnp.float32)
+    base = 10000.0
+
+    def concat_form(x, positions):
+        hd = x.shape[-1]
+        half = hd // 2
+        freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+        ang = positions.astype(jnp.float32)[..., None] * freqs
+        cos = (jnp.cos(ang)[None, None] if ang.ndim == 2
+               else jnp.cos(ang)[:, None])
+        sin = (jnp.sin(ang)[None, None] if ang.ndim == 2
+               else jnp.sin(ang)[:, None])
+        x1 = x[..., :half]
+        x2 = x[..., half:]
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+    pos = jnp.arange(5) + 7
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(x, pos)),
+        np.asarray(concat_form(x, pos)), rtol=0, atol=1e-6)
+    # per-row positions (batched speculative decoding)
+    pos2 = jnp.asarray(rng.integers(0, 100, size=(2, 5)), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(apply_rope(x, pos2)),
+        np.asarray(concat_form(x, pos2)), rtol=0, atol=1e-6)
+
+
 def test_weights_path_roundtrip_and_validation(tmp_path):
     """save_lm_weights -> weights_path serves the EXACT checkpoint;
     wrong-architecture or state-format checkpoints fail at load time."""
