@@ -1,0 +1,125 @@
+"""Round-5 measurement/docs infrastructure: the docs-sync drift gate
+must actually gate, the toolchain ledger must mirror the conformance
+skip conditions, and the timing fence must fetch the smallest leaf."""
+
+import importlib.util
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    """Import a scripts/*.py module hermetically (no cwd / sys.path
+    dependence — scripts/ is not a package)."""
+    spec = importlib.util.spec_from_file_location(
+        f"_r5_{name}", os.path.join(ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_docs_sync_check_passes_then_catches_drift(tmp_path):
+    """--check is clean on the committed tree; corrupting a generated
+    figure must flip it to a non-zero exit (the CI gate's contract)."""
+    out = subprocess.run(
+        [sys.executable, "scripts/docs_sync.py", "--check"],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert out.returncode == 0, out.stderr
+    # drift in a scratch copy of the repo docs
+    work = tmp_path / "repo"
+    (work / "docs").mkdir(parents=True)
+    (work / "scripts").mkdir()
+    for rel in ("docs/benchmarking.md", "PARITY.md", "scripts/docs_sync.py"):
+        shutil.copy(os.path.join(ROOT, rel), work / rel)
+    art = sorted(
+        p for p in os.listdir(ROOT)
+        if p.startswith("BENCH_r") and p.endswith("_full.json")
+    )[-1]
+    shutil.copy(os.path.join(ROOT, art), work / art)
+    doc = (work / "docs" / "benchmarking.md").read_text()
+    # corrupt a digit INSIDE the generated block
+    start = doc.index("BEGIN GENERATED")
+    end = doc.index("END GENERATED")
+    block = doc[start:end]
+    for ch in "0123456789":
+        if ch in block:
+            block2 = block.replace(ch, "9" if ch != "9" else "8", 1)
+            break
+    (work / "docs" / "benchmarking.md").write_text(
+        doc[:start] + block2 + doc[end:])
+    out = subprocess.run(
+        [sys.executable, "scripts/docs_sync.py", "--check"],
+        capture_output=True, text=True, cwd=work,
+    )
+    assert out.returncode == 1
+    assert "DRIFT" in out.stderr
+
+
+def test_docs_sync_artifact_numeric_round_order(tmp_path, monkeypatch):
+    """BENCH_r9 vs BENCH_r10: the newest round must win numerically,
+    not lexicographically."""
+    docs_sync = _load_script("docs_sync")
+    for name in ("BENCH_r9_full.json", "BENCH_r10_full.json"):
+        (tmp_path / name).write_text("{}")
+    monkeypatch.setattr(docs_sync, "ROOT", str(tmp_path))
+    assert docs_sync._artifact().endswith("BENCH_r10_full.json")
+
+
+def test_toolchain_probe_mirrors_conformance_gate(monkeypatch):
+    """java_lane_runnable must equal the test gate's condition (javac AND
+    java on PATH), so the ledger never misattributes a skip.  Every
+    scenario stubs _run and the bazel-JRE glob so no subprocess spawns
+    and no host state leaks in."""
+    tp = _load_script("toolchain_probe")
+    monkeypatch.setattr(
+        tp, "_run", lambda cmd, timeout=30: (0, "openjdk 21\njava.base"))
+    monkeypatch.setattr(tp.glob, "glob", lambda pat: [])
+
+    def which(names):
+        return lambda exe: f"/usr/bin/{exe}" if exe in names else None
+
+    monkeypatch.setattr(tp.shutil, "which", which({"javac"}))
+    doc = tp.probe()
+    assert doc["java_lane_runnable"] is False
+    assert "java" in doc["conformance_expected_skips"]
+
+    monkeypatch.setattr(tp.shutil, "which", which({"javac", "java"}))
+    doc = tp.probe()
+    assert doc["java_lane_runnable"] is True
+    assert "java" not in doc["conformance_expected_skips"]
+
+    monkeypatch.setattr(tp.shutil, "which", which({"Rscript", "R"}))
+    doc = tp.probe()
+    assert doc["r_lane_runnable"] is True
+    assert "r" not in doc["conformance_expected_skips"]
+
+
+def test_fence_fetches_smallest_leaf_only():
+    """fetch_sync must MATERIALIZE exactly the smallest leaf (the cheap
+    fence) — observed through recording leaves, so a regression to
+    max(), to no-fetch, or to fetch-everything fails here."""
+    from seldon_core_tpu.utils.fence import fetch_sync
+
+    fetched = []
+
+    class FakeLeaf:
+        def __init__(self, name, size):
+            self.name = name
+            self.size = size
+            self.dtype = np.float32  # looks array-like to np.asarray
+
+        def __array__(self, dtype=None, copy=None):
+            fetched.append(self.name)
+            return np.zeros((1,), np.float32)
+
+    big = FakeLeaf("big", 4096)
+    small = FakeLeaf("small", 2)
+    out = fetch_sync({"a": big, "b": (small, big)})
+    assert fetched == ["small"]
+    assert out["b"][0] is small
