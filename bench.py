@@ -396,16 +396,18 @@ def _probe_mfu_main(smoke: bool) -> None:
         # docs/benchmarking.md are the ground truth for the step.
         return 16 if smoke else (64 if b >= 128 else 256)
 
-    def decode_measure(ps, qcfg, b):
+    def decode_measure(ps, qcfg, b, prompt=None):
         n_dec = n_dec_for(b)
-        btoks = toks0[:1].repeat(b, axis=0) if b != B else toks0
-        main = init_cache(qcfg, b, S)
+        if prompt is None:
+            prompt = toks0[:1].repeat(b, axis=0) if b != B else toks0
+        s_len = prompt.shape[1]
+        main = init_cache(qcfg, b, s_len)
         logits, main = jax.jit(
             lambda p, t, c: prefill(p, t, c, qcfg, use_flash=True)
-        )(ps, btoks, main)
+        )(ps, prompt, main)
         first = jnp.argmax(logits, -1).astype(jnp.int32)
         chunk = init_chunk(qcfg, b, n_dec)
-        carry = (first, main, chunk, jnp.int32(S), jnp.int32(0),
+        carry = (first, main, chunk, jnp.int32(s_len), jnp.int32(0),
                  jax.random.key(0))
         step = jax.jit(
             lambda p, tok, m, c, nm, used, key: _chunk_step(
@@ -519,6 +521,35 @@ def _probe_mfu_main(smoke: bool) -> None:
     q_bw_util = step_bytes(cfg_q, B) / t_step_q / hbm_bw
     both_bw_util = step_bytes(cfg_both, B_MAX) / t_step_both / hbm_bw
 
+    # ---- long-context decode arm: the same serving path at S=4096 --------
+    # long context is first-class: the cache IS the stream at this length
+    # (32 rows x 4 KV heads x 4096+256 slots), so this is where the int8
+    # KV cache and GQA grouping earn their keep
+    S_LC = 512 if smoke else 4096
+    B_LC = 4 if smoke else 32
+    toks_lc = jnp.asarray(
+        np.random.default_rng(3).integers(0, v, size=(B_LC, S_LC)),
+        jnp.int32,
+    )
+    t_step_lc = decode_measure(params, cfg, B_LC, prompt=toks_lc)
+    decode_tok_s_lc = B_LC / t_step_lc
+    t_step_lc_kv = decode_measure(params, cfg_kv, B_LC, prompt=toks_lc)
+    decode_tok_s_lc_kv = B_LC / t_step_lc_kv
+
+    def lc_bytes(qcfg):
+        wb = 1 if qcfg.quant == "int8" else 2
+        per_layer_w = (d * qkv_out + d * d + 2 * d * ff) * wb
+        kvb = 1 if qcfg.kv_quant == "int8" else 2
+        slots = S_LC + n_dec_for(B_LC)
+        hd_ = d // cfg.n_heads
+        kv_read = 2 * B_LC * qcfg.kv_heads * slots * hd_ * kvb
+        kv_scales = (2 * B_LC * qcfg.kv_heads * slots * 4
+                     if qcfg.kv_quant == "int8" else 0)
+        return L * (per_layer_w + kv_read + kv_scales) + d * v * 2
+
+    lc_bw_util = lc_bytes(cfg) / t_step_lc / hbm_bw
+    lc_kv_bw_util = lc_bytes(cfg_kv) / t_step_lc_kv / hbm_bw
+
     # ---- end-to-end generate (the TransformerGenerator.predict body):
     # one dispatch = prefill + NEW cached steps, relay INCLUDED — what a
     # serving caller actually observes per batched request
@@ -605,6 +636,13 @@ def _probe_mfu_main(smoke: bool) -> None:
         "decode_tok_s_int8both": round(decode_tok_s_both, 1),
         "int8both_vs_bf16_x": round(t_step_max / t_step_both, 2),
         "int8both_hbm_bw_util_pct": round(100 * both_bw_util, 1),
+        "longctx_prompt_len": S_LC,
+        "longctx_batch": B_LC,
+        "decode_tok_s_longctx": round(decode_tok_s_lc, 1),
+        "longctx_hbm_bw_util_pct": round(100 * lc_bw_util, 1),
+        "decode_tok_s_longctx_int8kv": round(decode_tok_s_lc_kv, 1),
+        "longctx_int8kv_vs_bf16_x": round(t_step_lc / t_step_lc_kv, 2),
+        "longctx_int8kv_hbm_bw_util_pct": round(100 * lc_kv_bw_util, 1),
         "e2e_gen_tok_s": round(e2e_tok_s, 1),
         "e2e_gen_latency_ms": round(t_e2e * 1e3, 1),
         "flash_vs_xla_x": flash_vs_xla,

@@ -78,6 +78,17 @@ def bench_figures(doc: dict, src: str) -> str:
          f'{_fmt(g("int8both_hbm_bw_util_pct"))}% bw-util'),
         ("int8 weights alone (B=32)", f'{_fmt(g("int8_vs_bf16_x"), 2)}×',
          "weight bytes are the minor stream at this size — see prose"),
+        (f'long-context decode (B={_fmt(g("longctx_batch"))}, '
+         f'S={_fmt(g("longctx_prompt_len"))}, bf16)',
+         _fmt(g("decode_tok_s_longctx")),
+         f'{_fmt(g("longctx_hbm_bw_util_pct"))}% of measured HBM bw — '
+         "the cache IS the stream here"),
+        (f'long-context decode (B={_fmt(g("longctx_batch"))}, '
+         f'S={_fmt(g("longctx_prompt_len"))}, int8 KV)',
+         _fmt(g("decode_tok_s_longctx_int8kv")),
+         f'{_fmt(g("longctx_int8kv_vs_bf16_x"), 2)}× bf16; '
+         f'{_fmt(g("longctx_int8kv_hbm_bw_util_pct"))}% of its own '
+         "halved stream"),
         ("measured HBM bandwidth GB/s", _fmt(g("hbm_bw_measured_gbs")),
          "chained 256-rep reduction; ~92% of the 819 GB/s spec sheet"),
         ("one-shot generate tok/s (jit path)", _fmt(g("e2e_gen_tok_s")), ""),
