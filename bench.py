@@ -471,9 +471,9 @@ def _probe_mfu_main(smoke: bool) -> None:
     raw = min(raws)
     hbm_bw = (bw_elems * 2) / (max(raw - relay_s, 0.05 * raw) / bw_reps)
 
-    def step_bytes(qcfg, b):
+    def step_bytes(qcfg, b, s_len=None):
         """HBM bytes a decode step streams: matmul'd weights at serving
-        dtype + the whole two-tier cache read (main S + chunk NEW slots,
+        dtype + the whole two-tier cache read (main s_len + chunk slots,
         + scales when int8).
 
         ALL chunk slots are billed, not just the currently-valid prefix:
@@ -486,7 +486,8 @@ def _probe_mfu_main(smoke: bool) -> None:
         per_layer_w = (d * qkv_out + d * d + 2 * d * ff) * wb
         unembed = d * v * 2  # tied head stays bf16
         kvb = 1 if qcfg.kv_quant == "int8" else 2
-        dec_len = S + n_dec_for(b)  # match what the measured step streams
+        # match what the measured step streams at this batch's step count
+        dec_len = (S if s_len is None else s_len) + n_dec_for(b)
         kv_read = 2 * b * qcfg.kv_heads * dec_len * (d // cfg.n_heads) * kvb
         kv_scales = (2 * b * qcfg.kv_heads * dec_len * 4
                      if qcfg.kv_quant == "int8" else 0)
@@ -536,19 +537,9 @@ def _probe_mfu_main(smoke: bool) -> None:
     t_step_lc_kv = decode_measure(params, cfg_kv, B_LC, prompt=toks_lc)
     decode_tok_s_lc_kv = B_LC / t_step_lc_kv
 
-    def lc_bytes(qcfg):
-        wb = 1 if qcfg.quant == "int8" else 2
-        per_layer_w = (d * qkv_out + d * d + 2 * d * ff) * wb
-        kvb = 1 if qcfg.kv_quant == "int8" else 2
-        slots = S_LC + n_dec_for(B_LC)
-        hd_ = d // cfg.n_heads
-        kv_read = 2 * B_LC * qcfg.kv_heads * slots * hd_ * kvb
-        kv_scales = (2 * B_LC * qcfg.kv_heads * slots * 4
-                     if qcfg.kv_quant == "int8" else 0)
-        return L * (per_layer_w + kv_read + kv_scales) + d * v * 2
-
-    lc_bw_util = lc_bytes(cfg) / t_step_lc / hbm_bw
-    lc_kv_bw_util = lc_bytes(cfg_kv) / t_step_lc_kv / hbm_bw
+    lc_bw_util = step_bytes(cfg, B_LC, s_len=S_LC) / t_step_lc / hbm_bw
+    lc_kv_bw_util = (step_bytes(cfg_kv, B_LC, s_len=S_LC)
+                     / t_step_lc_kv / hbm_bw)
 
     # ---- end-to-end generate (the TransformerGenerator.predict body):
     # one dispatch = prefill + NEW cached steps, relay INCLUDED — what a

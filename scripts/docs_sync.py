@@ -90,11 +90,16 @@ def bench_figures(doc: dict, src: str) -> str:
          f'{_fmt(g("longctx_int8kv_hbm_bw_util_pct"))}% of its own '
          "halved stream"),
         ("measured HBM bandwidth GB/s", _fmt(g("hbm_bw_measured_gbs")),
-         "chained 256-rep reduction; ~92% of the 819 GB/s spec sheet"),
+         f'chained 256-rep reduction; '
+         f'{_fmt(100 * (g("hbm_bw_measured_gbs") or 0) / 819.0)}% of the '
+         "819 GB/s spec sheet (>100% flags relay-floor over-subtraction "
+         "in that run)"),
         ("one-shot generate tok/s (jit path)", _fmt(g("e2e_gen_tok_s")), ""),
         ("served generation tok/s (engine+socket)",
          _fmt(g("served_gen_tok_s")),
-         f'{_fmt(g("served_gen_efficiency_pct"))}% of the raw jit path'
+         f'{_fmt(g("served_gen_efficiency_pct"))}% of the raw jit path '
+         "(values near/above 100% = the two arms drew different relay "
+         "floors; stack overhead is the span keys)"
          if g("served_gen_efficiency_pct") else ""),
         ("speculative (trained pair, d256 target)",
          f'{_fmt(g("spec_trained_vs_plain_x"), 2)}×',
