@@ -91,9 +91,9 @@ def bench_figures(doc: dict, src: str) -> str:
          "halved stream"),
         ("measured HBM bandwidth GB/s", _fmt(g("hbm_bw_measured_gbs")),
          f'chained 256-rep reduction; '
-         f'{_fmt(100 * (g("hbm_bw_measured_gbs") or 0) / 819.0)}% of the '
+         f'{_fmt(100 * g("hbm_bw_measured_gbs") / 819.0)}% of the '
          "819 GB/s spec sheet (>100% flags relay-floor over-subtraction "
-         "in that run)"),
+         "in that run)" if g("hbm_bw_measured_gbs") else ""),
         ("one-shot generate tok/s (jit path)", _fmt(g("e2e_gen_tok_s")), ""),
         ("served generation tok/s (engine+socket)",
          _fmt(g("served_gen_tok_s")),
