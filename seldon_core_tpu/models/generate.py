@@ -47,7 +47,8 @@ from seldon_core_tpu.models.transformer import (
 )
 
 __all__ = ["init_cache", "init_chunk", "prefill", "decode_step",
-           "generate", "stream_chunks", "TransformerGenerator"]
+           "generate", "stream_chunks", "sample_token", "mask_after_eos",
+           "TransformerGenerator"]
 
 
 def init_cache(cfg: LMConfig, batch: int, max_len: int) -> Dict[str, Any]:
@@ -477,6 +478,51 @@ def decode_step(params, token, cache, pos, cfg: LMConfig):
 
 
 #: generation chunk-buffer capacity: generations up to this length run
+def sample_token(logits, key, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0):
+    """[B, V] f32 logits -> [B] int32 next-token ids.
+
+    All knobs are STATIC python values (jit caches one executable per
+    sampling config): temperature <= 0 is greedy argmax; otherwise
+    temperature-scaled sampling, optionally truncated to the ``top_k``
+    highest logits and/or the top-p nucleus (the smallest set of tokens
+    whose cumulative probability reaches ``top_p`` — always at least
+    one).  Nucleus filtering sorts the [B, V] logits per step (~17
+    bitonic passes over the row at V=32k — measurable but small next to
+    the decode step's cache stream); top-k alone uses lax.top_k."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = (logits / temperature).astype(jnp.float32)
+    if top_k and top_k > 0:
+        # clamp: a deployment's top_k may exceed a small model's vocab,
+        # and lax.top_k would raise at trace time inside the scan
+        kk = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(logits, kk)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and 0.0 < top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]      # descending
+        probs = jax.nn.softmax(srt, axis=-1)
+        mass_before = jnp.cumsum(probs, axis=-1) - probs
+        keep = mass_before < top_p                       # >= 1 token
+        cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def mask_after_eos(toks, eos_token: int):
+    """Force every position strictly AFTER a row's first ``eos_token``
+    to eos: fixed-shape scans keep decoding past a stop token, so the
+    serving contract is 'output is eos-padded after the stop'.  No-op
+    when eos_token < 0 (disabled)."""
+    if eos_token < 0:
+        return toks
+    is_eos = toks == eos_token
+    after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+             - is_eos.astype(jnp.int32)) > 0
+    return jnp.where(after, jnp.int32(eos_token), toks)
+
+
 #: with a prompt-sized main cache and ZERO merges; longer ones merge the
 #: chunk into main once per CAP tokens (a donated-in-place bulk write)
 GEN_CHUNK_CAP = 256
@@ -490,10 +536,15 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     use_flash: bool = False,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    eos_token: int = -1,
 ) -> jax.Array:
     """prompt [B, S] int32 -> generated [B, max_new_tokens] int32.
 
-    Greedy when temperature == 0 (a static python branch), else sampled.
+    Greedy when temperature == 0 (a static python branch), else sampled
+    (optionally top-k / nucleus truncated — sample_token); rows that
+    emit ``eos_token`` are eos-padded afterwards (mask_after_eos).
     Decode runs the TWO-TIER cache: the prefilled main cache is read-only
     inside the scan (mutating a large while-loop carry measured ~10x the
     logical write cost in dus + layout copies — see _attend_two_tier),
@@ -509,13 +560,8 @@ def generate(
     if rng is None:
         rng = jax.random.key(0)
 
-    def pick(logits, key):
-        if temperature > 0.0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
     key0, rng = jax.random.split(rng)
-    first = pick(logits, key0).astype(jnp.int32)
+    first = sample_token(logits, key0, temperature, top_k, top_p)
 
     def scan_steps(main, n_main, token, key, n, cap):
         # n_main is a python int here: slice the valid prefix statically,
@@ -532,6 +578,7 @@ def generate(
         toks, (token, chunk, _, key) = _chunk_step(
             params, token, main, chunk, jnp.int32(n_main), jnp.int32(0),
             key, cfg, n, temperature, main_full=True,
+            top_k=top_k, top_p=top_p,
         )
         return toks, chunk, token, key
 
@@ -550,12 +597,14 @@ def generate(
         if remaining > 0:  # fold the finished chunk in before the next
             main = merge_chunk(main, chunk, n_main, cfg)
             n_main += n
-    return jnp.concatenate(out, axis=1)  # [B, max_new]
+    return mask_after_eos(
+        jnp.concatenate(out, axis=1), eos_token)  # [B, max_new]
 
 
 def _chunk_step(params, token, main, chunk_buf, n_main, used, key,
                 cfg: LMConfig, n: int, temperature: float,
-                main_full: bool = False):
+                main_full: bool = False, top_k: int = 0,
+                top_p: float = 0.0):
     """n cached decode steps as ONE jitted scan over the two-tier cache:
     main is READ-ONLY (see _attend_two_tier), new K/V go to ``chunk_buf``
     slots used..used+n-1.  Returns (tokens [B, n], (token, chunk_buf,
@@ -563,18 +612,13 @@ def _chunk_step(params, token, main, chunk_buf, n_main, used, key,
     stream costs ceil(max_new/chunk) device dispatches regardless of
     length."""
 
-    def pick(logits, k):
-        if temperature > 0.0:
-            return jax.random.categorical(k, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
     def step(carry, _):
         token, chunk_buf, used, key = carry
         key, sub = jax.random.split(key)
         logits, chunk_buf = decode_step_two_tier(
             params, token, main, chunk_buf, n_main, used, cfg, main_full
         )
-        nxt = pick(logits, sub).astype(jnp.int32)
+        nxt = sample_token(logits, sub, temperature, top_k, top_p)
         return (nxt, chunk_buf, used + 1, key), nxt
 
     (token, chunk_buf, used, key), toks = jax.lax.scan(
@@ -589,7 +633,9 @@ def _chunk_step(params, token, main, chunk_buf, n_main, used, key,
 # Callers must treat the passed chunk_buf as consumed — stream_chunks
 # reassigns it every iteration.
 _chunk_step_jit = jax.jit(
-    _chunk_step, static_argnames=("cfg", "n", "temperature", "main_full"),
+    _chunk_step,
+    static_argnames=("cfg", "n", "temperature", "main_full", "top_k",
+                     "top_p"),
     donate_argnums=(3,),
 )
 
@@ -648,10 +694,16 @@ STREAM_CHUNK_CAP = 128
 def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
                   chunk: int = 8, temperature: float = 0.0,
                   rng: Optional[jax.Array] = None,
-                  use_flash: bool = False):
+                  use_flash: bool = False, top_k: int = 0,
+                  top_p: float = 0.0, eos_token: int = -1):
     """Incremental decoding: yields token arrays [B, <=chunk] whose
-    concatenation equals ``generate(...)`` token-for-token (same pick
-    semantics, same PRNG stream).
+    concatenation equals ``generate(...)`` token-for-token (same
+    sampling semantics, same PRNG stream, same eos padding).
+
+    With ``eos_token`` set, once EVERY row has emitted it the remaining
+    chunks are host-generated eos padding — no further device work —
+    and within-stream tokens after a row's first eos are masked to eos
+    (the generate() contract).
 
     The host loop exists ONLY to surface tokens early — each iteration is
     one jitted scan over ``chunk`` two-tier cached steps, so the device
@@ -675,17 +727,29 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     if rng is None:
         rng = jax.random.key(0)
     key0, rng = jax.random.split(rng)
-    if temperature > 0.0:
-        first = jax.random.categorical(
-            key0, logits / temperature, axis=-1
-        ).astype(jnp.int32)
-    else:
-        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    first = sample_token(logits, key0, temperature, top_k, top_p)
 
     token, key = first, rng
     chunk_buf = init_chunk(cfg, B, cap)
     n_main, used = S, 0
     done = 0
+    # per-row "has emitted eos" latch (host side, numpy) — drives both
+    # the after-eos masking and the all-rows-done early stop
+    import numpy as _np
+
+    seen_eos = _np.zeros((B,), bool)
+
+    def finalize(toks):
+        nonlocal seen_eos
+        if eos_token < 0:
+            return toks
+        t = _np.asarray(toks)
+        t = _np.where(seen_eos[:, None], _np.int32(eos_token), t)
+        is_eos = t == eos_token
+        after = (_np.cumsum(is_eos, axis=1) - is_eos) > 0  # within-chunk
+        t = _np.where(after, _np.int32(eos_token), t)
+        seen_eos = seen_eos | is_eos.any(axis=1)
+        return jnp.asarray(t)
 
     def emit(n):
         nonlocal token, key, chunk_buf, main, n_main, used
@@ -698,7 +762,7 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
             params, token, main, chunk_buf, jnp.int32(n_main),
             jnp.int32(used), key, cfg=cfg, n=n, temperature=temperature,
             # grow_merge keeps main exactly full at every step
-            main_full=True,
+            main_full=True, top_k=top_k, top_p=top_p,
         )
         used += n
         return toks
@@ -706,13 +770,18 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     # first chunk: the prefill token + (chunk-1) scanned steps
     n_first = min(chunk - 1, max_new_tokens - 1)
     if n_first > 0:
-        yield jnp.concatenate([first[:, None], emit(n_first)], axis=1)
+        yield finalize(jnp.concatenate([first[:, None], emit(n_first)],
+                                       axis=1))
     else:
-        yield first[:, None]
+        yield finalize(first[:, None])
     done = 1 + n_first
     while done < max_new_tokens:
         n = min(chunk, max_new_tokens - done)
-        yield emit(n)
+        if eos_token >= 0 and seen_eos.all():
+            # every row is finished: pad from the host, skip the device
+            yield jnp.full((B, n), jnp.int32(eos_token))
+        else:
+            yield finalize(emit(n))
         done += n
 
 
@@ -738,6 +807,7 @@ class TransformerGenerator(Unit):
     def __init__(self, vocab: int = 256, d_model: int = 128, n_heads: int = 4,
                  n_layers: int = 2, d_ff: int = 512, seed: int = 0,
                  max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0, eos_token: int = -1,
                  dtype: str = "bfloat16", moe_every: int = 0,
                  n_experts: int = 8, moe_k: int = 2, mesh=None,
                  quant: str = "none", attention: str = "auto",
@@ -766,6 +836,9 @@ class TransformerGenerator(Unit):
         self.seed = int(seed)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token = int(eos_token)
         # sampled decoding draws per-row noise from one key, so a row's
         # tokens depend on its position in the stacked batch; MoE capacity
         # routing likewise couples rows (shared capacity over the flattened
@@ -806,6 +879,8 @@ class TransformerGenerator(Unit):
             temperature=self.temperature,
             rng=key,
             use_flash=self.use_flash,
+            top_k=self.top_k, top_p=self.top_p,
+            eos_token=self.eos_token,
         ).astype(jnp.float32)
         if self.temperature > 0.0:
             new_state = {"params": state["params"],
@@ -831,6 +906,8 @@ class TransformerGenerator(Unit):
             max_new_tokens=self.max_new_tokens, chunk=int(chunk),
             temperature=self.temperature, rng=key,
             use_flash=self.use_flash,
+            top_k=self.top_k, top_p=self.top_p,
+            eos_token=self.eos_token,
         )
 
 

@@ -245,3 +245,77 @@ def test_out_of_range_prompt_tokens_clamped():
     y_tame = np.asarray(u.predict(st, tame))
     np.testing.assert_array_equal(y_wild, y_tame)  # clamp contract
     assert ((0 <= y_wild) & (y_wild < 48)).all()
+
+
+def test_sample_token_top_k_and_top_p_truncation():
+    """top-k must never sample outside the k highest logits; top-p must
+    never sample outside the smallest prefix reaching mass p (and always
+    keeps at least one token)."""
+    from seldon_core_tpu.models.generate import sample_token
+
+    logits = jnp.asarray([[5.0, 4.0, 3.0, -2.0, -3.0, -9.0]] * 4)
+    for i in range(8):
+        k = jax.random.key(i)
+        tk = np.asarray(sample_token(logits, k, temperature=1.0, top_k=2))
+        assert set(tk.tolist()) <= {0, 1}, tk
+        tp = np.asarray(sample_token(logits, k, temperature=1.0,
+                                     top_p=0.5))
+        assert set(tp.tolist()) <= {0}, tp  # token 0 alone has mass >0.5
+    # extreme top_p still yields a valid token
+    t = np.asarray(sample_token(logits, jax.random.key(0),
+                                temperature=1.0, top_p=1e-9))
+    assert set(t.tolist()) <= {0}
+    # greedy path ignores truncation knobs entirely
+    g = np.asarray(sample_token(logits, jax.random.key(0)))
+    np.testing.assert_array_equal(g, [0, 0, 0, 0])
+
+
+def test_mask_after_eos_and_generate_eos_contract():
+    """Positions strictly after a row's first eos become eos; rows
+    without eos are untouched; generate() and stream_chunks() apply the
+    same padding, and a stream whose rows have ALL stopped pads from
+    the host (the early-stop branch is exercised, not just declared)."""
+    from seldon_core_tpu.models.generate import (
+        mask_after_eos, stream_chunks,
+    )
+
+    toks = jnp.asarray([[3, 7, 7, 5], [1, 2, 3, 4], [7, 1, 7, 2]])
+    got = np.asarray(mask_after_eos(toks, 7))
+    np.testing.assert_array_equal(
+        got, [[3, 7, 7, 7], [1, 2, 3, 4], [7, 7, 7, 7]])
+    # disabled sentinel is a no-op
+    np.testing.assert_array_equal(np.asarray(mask_after_eos(toks, -1)),
+                                  np.asarray(toks))
+
+    # B=1 so one row stopping means ALL rows stopped: pick an eos whose
+    # FIRST occurrence in the baseline is mid-sequence, so (a) masking
+    # changes real tokens and (b) the stream's host-padding branch runs
+    params = lm_init(jax.random.key(0), CFG)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 48, size=(1, 7)), jnp.int32
+    )
+    # SAMPLED with a fixed key: the untrained greedy baseline is a
+    # constant token (eos at position 0 masks nothing); sampling gives a
+    # varied, still-deterministic sequence with a usable mid-stream eos
+    kw = dict(temperature=1.0, rng=jax.random.key(5))
+    base = np.asarray(generate(params, prompt, CFG, max_new_tokens=12,
+                               **kw))[0]
+    eos = first_at = None
+    for j in range(1, 9):
+        tok = int(base[j])
+        if tok not in base[:j].tolist() and (base[j + 1:] != tok).any():
+            eos, first_at = tok, j
+            break
+    assert eos is not None, f"no usable eos in baseline {base}"
+    ref = np.asarray(generate(params, prompt[:1], CFG, max_new_tokens=12,
+                              eos_token=eos, **kw))[0]
+    np.testing.assert_array_equal(ref[:first_at + 1], base[:first_at + 1])
+    assert (ref[first_at:] == eos).all()
+    assert (base[first_at + 1:] != eos).any()  # masking changed tokens
+    # stream == generate under eos padding, including host-padded chunks
+    chunks = [np.asarray(c) for c in stream_chunks(
+        params, prompt[:1], CFG, max_new_tokens=12, chunk=3,
+        eos_token=eos, **kw)]
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1)[0], ref)
+    # the final chunk(s) past the stop are pure eos padding
+    assert (chunks[-1] == eos).all()
