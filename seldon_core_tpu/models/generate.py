@@ -48,6 +48,7 @@ from seldon_core_tpu.models.transformer import (
 
 __all__ = ["init_cache", "init_chunk", "prefill", "decode_step",
            "generate", "stream_chunks", "sample_token", "mask_after_eos",
+           "build_prefix_main",
            "TransformerGenerator"]
 
 
@@ -477,6 +478,29 @@ def decode_step(params, token, cache, pos, cfg: LMConfig):
     return (x[:, 0, :] @ params["embed"].T).astype(jnp.float32), cache
 
 
+def build_prefix_main(prefix_cache, batch: int, total_len: int,
+                      cfg: LMConfig):
+    """Batched main cache [B, KV, total_len, hd] whose first P slots are
+    a shared B=1 PREFIX cache broadcast across the batch — the serving
+    trick for common system prompts: the prefix's K/V are computed once
+    per deployment (init_state), so each request prefills only its
+    suffix (prefill FLOPs drop by the prefix's share of S², which at
+    long prefixes is most of them)."""
+    out = {}
+    for li, layer in prefix_cache.items():
+        new_layer = {}
+        for kk, vv in layer.items():
+            P = vv.shape[2]
+            pad_shape = list(vv.shape)
+            pad_shape[0] = batch
+            pad_shape[2] = total_len - P
+            pref = jnp.broadcast_to(vv, (batch,) + vv.shape[1:])
+            new_layer[kk] = jnp.concatenate(
+                [pref, jnp.zeros(pad_shape, vv.dtype)], axis=2)
+        out[li] = new_layer
+    return out
+
+
 #: generation chunk-buffer capacity: generations up to this length run
 def sample_token(logits, key, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0):
@@ -539,24 +563,57 @@ def generate(
     top_k: int = 0,
     top_p: float = 0.0,
     eos_token: int = -1,
+    prefix: Optional[Dict[str, Any]] = None,
 ) -> jax.Array:
     """prompt [B, S] int32 -> generated [B, max_new_tokens] int32.
 
     Greedy when temperature == 0 (a static python branch), else sampled
     (optionally top-k / nucleus truncated — sample_token); rows that
     emit ``eos_token`` are eos-padded afterwards (mask_after_eos).
+
+    ``prefix``: optional B=1 prefix KV cache (build it once with
+    prefill at B=1; its length is its own shape).  The request then
+    prefills only its suffix (``prompt`` holds the suffix tokens)
+    against the broadcast prefix via the causal segment path; decode is
+    unchanged.  Positions are global, so outputs equal generating over
+    the concatenated sequence EXACTLY for float caches; with
+    ``kv_quant="int8"`` the prefix is read back quantized where a full
+    prefill attends pre-quantization k/v, so near-tie argmaxes may
+    differ (same class as every int8-KV read-back).
     Decode runs the TWO-TIER cache: the prefilled main cache is read-only
     inside the scan (mutating a large while-loop carry measured ~10x the
     logical write cost in dus + layout copies — see _attend_two_tier),
     new K/V land in a chunk buffer, merged into main between scans only
     when max_new_tokens exceeds GEN_CHUNK_CAP."""
     B, S = prompt.shape
+    P = 0 if prefix is None else prefix["l0"]["k"].shape[2]
     chunked = max_new_tokens - 1 > GEN_CHUNK_CAP
     # single-chunk generations never merge, so main holds ONLY the prompt
-    # — decode then streams S cache slots, not S + max_new masked ones
-    main_len = S + max_new_tokens if chunked else S
-    main = init_cache(cfg, B, main_len)
-    logits, main = prefill(params, prompt, main, cfg, use_flash)
+    # — decode then streams P+S cache slots, not P+S+max_new masked ones
+    main_len = P + S + max_new_tokens if chunked else P + S
+    if prefix is None:
+        main = init_cache(cfg, B, main_len)
+        logits, main = prefill(params, prompt, main, cfg, use_flash)
+    else:
+        # suffix-prefill against a cache sized EXACTLY P+S (the causal
+        # segment dots stream the whole buffer, so pre-sizing to
+        # main_len would bill every suffix position for max_new dead
+        # slots); chunked mode pads up to main_len afterwards, once
+        main = build_prefix_main(prefix, B, P + S, cfg)
+        logits, main = segment_forward(
+            params, prompt, main, P, cfg, segment=True, last_only=True)
+        logits = logits[:, -1, :]
+        if main_len > P + S:
+            main = {
+                li: {
+                    kk: jnp.concatenate(
+                        [vv, jnp.zeros(
+                            vv.shape[:2] + (main_len - P - S,)
+                            + vv.shape[3:], vv.dtype)], axis=2)
+                    for kk, vv in layer.items()
+                }
+                for li, layer in main.items()
+            }
     if rng is None:
         rng = jax.random.key(0)
 
@@ -586,7 +643,7 @@ def generate(
     # wasted final forward whose logits would be discarded)
     out = [first[:, None]]
     token, key = first, rng
-    n_main, remaining = S, max_new_tokens - 1
+    n_main, remaining = P + S, max_new_tokens - 1
     while remaining > 0:
         n = min(remaining, GEN_CHUNK_CAP) if chunked else remaining
         toks, chunk, token, key = scan_steps(
@@ -695,10 +752,12 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
                   chunk: int = 8, temperature: float = 0.0,
                   rng: Optional[jax.Array] = None,
                   use_flash: bool = False, top_k: int = 0,
-                  top_p: float = 0.0, eos_token: int = -1):
+                  top_p: float = 0.0, eos_token: int = -1,
+                  prefix=None):
     """Incremental decoding: yields token arrays [B, <=chunk] whose
     concatenation equals ``generate(...)`` token-for-token (same
-    sampling semantics, same PRNG stream, same eos padding).
+    sampling semantics, same PRNG stream, same eos padding, same
+    optional shared-prefix cache).
 
     With ``eos_token`` set, once EVERY row has emitted it the remaining
     chunks are host-generated eos padding — no further device work —
@@ -722,8 +781,15 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     # main starts prompt-sized and GROWS at each merge (grow_merge), so
     # it is exactly full at every decode step — long streams never pay
     # the mostly-empty-buffer QK dot + validity select
-    main = init_cache(cfg, B, S)
-    logits, main = prefill(params, prompt, main, cfg, use_flash)
+    P = 0 if prefix is None else prefix["l0"]["k"].shape[2]
+    if prefix is None:
+        main = init_cache(cfg, B, S)
+        logits, main = prefill(params, prompt, main, cfg, use_flash)
+    else:
+        main = build_prefix_main(prefix, B, P + S, cfg)
+        logits, main = segment_forward(
+            params, prompt, main, P, cfg, segment=True, last_only=True)
+        logits = logits[:, -1, :]
     if rng is None:
         rng = jax.random.key(0)
     key0, rng = jax.random.split(rng)
@@ -731,7 +797,7 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
 
     token, key = first, rng
     chunk_buf = init_chunk(cfg, B, cap)
-    n_main, used = S, 0
+    n_main, used = P + S, 0
     done = 0
     # per-row "has emitted eos" latch (host side, numpy) — drives both
     # the after-eos masking and the all-rows-done early stop
@@ -808,6 +874,7 @@ class TransformerGenerator(Unit):
                  n_layers: int = 2, d_ff: int = 512, seed: int = 0,
                  max_new_tokens: int = 32, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, eos_token: int = -1,
+                 prefix_tokens: str = "",
                  dtype: str = "bfloat16", moe_every: int = 0,
                  n_experts: int = 8, moe_k: int = 2, mesh=None,
                  quant: str = "none", attention: str = "auto",
@@ -839,6 +906,16 @@ class TransformerGenerator(Unit):
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.eos_token = int(eos_token)
+        # shared system-prompt prefix ("1,2,3" token ids): its KV cache
+        # is computed ONCE in init_state and reused by every request
+        self.prefix_ids = [
+            int(t) for t in str(prefix_tokens).replace(" ", "").split(",")
+            if t != ""
+        ]
+        for t in self.prefix_ids:
+            if not 0 <= t < self.cfg.vocab:
+                raise ValueError(
+                    f"prefix token {t} outside vocab [0, {self.cfg.vocab})")
         # sampled decoding draws per-row noise from one key, so a row's
         # tokens depend on its position in the stacked batch; MoE capacity
         # routing likewise couples rows (shared capacity over the flattened
@@ -849,6 +926,9 @@ class TransformerGenerator(Unit):
             self.temperature > 0.0 or self.cfg.moe_every > 0
         )
         self.updates_state_on_predict = self.temperature > 0.0
+
+    def _prefix(self, state):
+        return state.get("prefix_cache")
 
     def init_state(self, rng):
         from seldon_core_tpu.models.transformer import load_lm_weights
@@ -867,7 +947,15 @@ class TransformerGenerator(Unit):
             params = jax.device_put(
                 params, param_shardings(self.mesh, params)
             )
-        return {"params": params, "requests": jnp.zeros((), jnp.int32)}
+        state = {"params": params, "requests": jnp.zeros((), jnp.int32)}
+        if self.prefix_ids:
+            pc = init_cache(self.cfg, 1, len(self.prefix_ids))
+            _, pc = prefill(
+                params, jnp.asarray([self.prefix_ids], jnp.int32), pc,
+                self.cfg, self.use_flash,
+            )
+            state["prefix_cache"] = pc
+        return state
 
     def predict(self, state, X):
         prompt = sanitize_prompt(X, self.cfg.vocab)
@@ -881,10 +969,12 @@ class TransformerGenerator(Unit):
             use_flash=self.use_flash,
             top_k=self.top_k, top_p=self.top_p,
             eos_token=self.eos_token,
+            prefix=self._prefix(state),
         ).astype(jnp.float32)
         if self.temperature > 0.0:
-            new_state = {"params": state["params"],
-                         "requests": state["requests"] + 1}
+            # preserve EVERY state key (prefix_cache!) — only the
+            # request counter advances
+            new_state = {**state, "requests": state["requests"] + 1}
             return y, UnitAux(state=new_state)
         return y
 
@@ -908,6 +998,7 @@ class TransformerGenerator(Unit):
             use_flash=self.use_flash,
             top_k=self.top_k, top_p=self.top_p,
             eos_token=self.eos_token,
+            prefix=self._prefix(state),
         )
 
 

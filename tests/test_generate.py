@@ -319,3 +319,69 @@ def test_mask_after_eos_and_generate_eos_contract():
     np.testing.assert_array_equal(np.concatenate(chunks, axis=1)[0], ref)
     # the final chunk(s) past the stop are pure eos padding
     assert (chunks[-1] == eos).all()
+
+
+def test_prefix_cache_equals_full_prefill():
+    """A shared-prefix KV cache built once at B=1 must reproduce the
+    full-prompt generation EXACTLY (f32 greedy) for batched suffixes,
+    through both generate() and stream_chunks()."""
+    from seldon_core_tpu.models.generate import (
+        init_cache, prefill, stream_chunks,
+    )
+
+    params = lm_init(jax.random.key(3), CFG)
+    rng = np.random.default_rng(11)
+    prefix_ids = rng.integers(0, 48, size=(6,)).tolist()
+    sufs = jnp.asarray(rng.integers(0, 48, size=(3, 5)), jnp.int32)
+    full = jnp.concatenate(
+        [jnp.broadcast_to(jnp.asarray(prefix_ids, jnp.int32), (3, 6)),
+         sufs], axis=1)
+    ref = np.asarray(generate(params, full, CFG, max_new_tokens=10))
+
+    pc = init_cache(CFG, 1, len(prefix_ids))
+    _, pc = prefill(params, jnp.asarray([prefix_ids], jnp.int32), pc, CFG)
+    got = np.asarray(generate(
+        params, sufs, CFG, max_new_tokens=10, prefix=pc))
+    np.testing.assert_array_equal(got, ref)
+
+    chunks = [np.asarray(c) for c in stream_chunks(
+        params, sufs, CFG, max_new_tokens=10, chunk=4, prefix=pc)]
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), ref)
+
+
+def test_prefix_cache_unit_serves():
+    """prefix_tokens as a deployment parameter: the unit builds the
+    prefix cache once in init_state and every predict equals the
+    no-prefix unit fed the concatenated prompt."""
+    plain = TransformerGenerator(
+        vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_new_tokens=6, dtype="float32")
+    pref = TransformerGenerator(
+        vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_new_tokens=6, dtype="float32", prefix_tokens="4, 9, 2")
+    sp, s2 = plain.init_state(None), pref.init_state(None)
+    assert "prefix_cache" in s2
+    suf = jnp.asarray([[7, 8, 20, 1]], jnp.float32)
+    full = jnp.asarray([[4, 9, 2, 7, 8, 20, 1]], jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(pref.predict(s2, suf)),
+        np.asarray(plain.predict(sp, full)))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="outside vocab"):
+        TransformerGenerator(vocab=48, prefix_tokens="99")
+
+
+def test_sampled_state_writeback_preserves_prefix_cache():
+    """temperature>0 writes state back (request counter); the write-back
+    must carry EVERY state key — dropping prefix_cache silently turned
+    every later request prefix-less."""
+    unit = TransformerGenerator(
+        vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_new_tokens=4, dtype="float32", temperature=0.8,
+        prefix_tokens="4,9,2")
+    state = unit.init_state(None)
+    y, aux = unit.predict(state, jnp.asarray([[7, 8]], jnp.float32))
+    assert "prefix_cache" in aux.state
+    y2 = unit.predict(aux.state, jnp.asarray([[7, 8]], jnp.float32))[0]
+    assert np.asarray(y2).shape == (1, 4)
