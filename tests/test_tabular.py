@@ -117,6 +117,8 @@ _EXAMPLE_FEATURES = {
     "generator_ep_deployment.json": 5,  # ep=4 MoE expert-parallel generator
     "generator_int8_deployment.json": 4,  # int8 + GQA + flash opt-ins
     "speculative_deployment.json": 5,  # draft/verify generation opt-in
+    # shared-prefix KV cache + eos stop handling opt-ins
+    "generator_prefix_deployment.json": 4,
 }
 
 
