@@ -385,3 +385,25 @@ def test_sampled_state_writeback_preserves_prefix_cache():
     assert "prefix_cache" in aux.state
     y2 = unit.predict(aux.state, jnp.asarray([[7, 8]], jnp.float32))[0]
     assert np.asarray(y2).shape == (1, 4)
+
+
+def test_prefix_cache_with_int8_kv_serves():
+    """prefix caching composes with kv_quant='int8' (scales broadcast
+    and concatenate like K/V): outputs are valid tokens — exactness is
+    deliberately NOT claimed here (the prefix reads back quantized; see
+    generate()'s docstring)."""
+    import dataclasses
+
+    from seldon_core_tpu.models.generate import init_cache, prefill
+
+    cfg_q = dataclasses.replace(CFG, kv_quant="int8")
+    params = lm_init(jax.random.key(3), CFG)
+    prefix_ids = [4, 9, 2, 30]
+    pc = init_cache(cfg_q, 1, len(prefix_ids))
+    _, pc = prefill(params, jnp.asarray([prefix_ids], jnp.int32), pc,
+                    cfg_q)
+    sufs = jnp.asarray([[7, 8, 20], [1, 2, 3]], jnp.int32)
+    got = np.asarray(generate(params, sufs, cfg_q, max_new_tokens=6,
+                              prefix=pc))
+    assert got.shape == (2, 6)
+    assert (got >= 0).all() and (got < CFG.vocab).all()
