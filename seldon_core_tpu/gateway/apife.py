@@ -120,6 +120,11 @@ class DeploymentStore:
     def deployments(self) -> List[str]:
         return [r.deployment_id for r in self._by_key.values()]
 
+    def active_token_count(self) -> int:
+        """Unexpired issued tokens (expiry eviction is lazy, so this
+        counts live entries, not strictly valid ones)."""
+        return len(self._tokens)
+
 
 class ApiGateway:
     def __init__(
@@ -234,6 +239,25 @@ class ApiGateway:
                 return SeldonMessage.failure(f"engine error: {e}", code=503)
         return SeldonMessage.failure(f"engine unreachable: {last}", code=503)
 
+    def stats(self) -> dict:
+        """Zero-dependency JSON snapshot for ``GET /stats`` — ingress
+        latency percentiles, routing table, firehose backpressure, and the
+        process-level flight-recorder telemetry (engines sharing this
+        process report their batcher/generation internals here too)."""
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        return {
+            "gateway": {
+                "require_auth": self.require_auth,
+                "deployments": self.store.deployments(),
+                "active_tokens": self.store.active_token_count(),
+            },
+            "firehose": (
+                None if self.firehose is None else self.firehose.snapshot()
+            ),
+            "telemetry": RECORDER.snapshot(),
+        }
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
@@ -246,7 +270,7 @@ class ApiGateway:
 
 def make_gateway_app(gateway: ApiGateway):
     """aiohttp app: /oauth/token, /api/v0.1/predictions, /api/v0.1/feedback,
-    /ping, /prometheus — the apife REST surface."""
+    /ping, /prometheus, /stats — the apife REST surface."""
     from aiohttp import web
 
     from seldon_core_tpu.runtime.rest import _error_response, _msg_response, _payload_text
@@ -393,6 +417,9 @@ def make_gateway_app(gateway: ApiGateway):
             headers={"Content-Type": CONTENT_TYPE_LATEST},
         )
 
+    async def stats(_):
+        return web.json_response(gateway.stats())
+
     app.router.add_post("/oauth/token", token)
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
@@ -400,6 +427,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/ping", ping)
     app.router.add_get("/ready", ready)
     app.router.add_get("/prometheus", prometheus)
+    app.router.add_get("/stats", stats)
 
     async def _cleanup(_app):
         await gateway.close()  # pooled upstream session/connector

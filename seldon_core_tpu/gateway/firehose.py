@@ -51,6 +51,15 @@ class Firehose:
             self._task.cancel()
             self._task = None
 
+    def snapshot(self) -> dict:
+        """Backpressure picture for ``/stats`` — queue depth vs bound and
+        the lifetime drop count."""
+        return {
+            "queued": self._queue.qsize(),
+            "max_queue": self._queue.maxsize,
+            "dropped": self.dropped,
+        }
+
     def publish(
         self, deployment: str, request: SeldonMessage, response: SeldonMessage
     ) -> None:

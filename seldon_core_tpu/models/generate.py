@@ -29,12 +29,17 @@ TPU-shaped decoding:
 from __future__ import annotations
 
 import itertools
+import logging
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
+from seldon_core_tpu.utils.telemetry import RECORDER
+
+logger = logging.getLogger(__name__)
 
 _stream_counter = itertools.count()  # per-process sampled-stream key source
 from seldon_core_tpu.models.transformer import (
@@ -45,6 +50,32 @@ from seldon_core_tpu.models.transformer import (
     apply_rope,
     lm_init,
 )
+
+_warned_prefix_flash = False  # one-time flash-vs-prefix warning latch
+
+
+def _warn_prefix_flash() -> None:
+    """One-time notice that the shared-prefix path runs the XLA segment
+    attention for the suffix prefill: the flash kernel has no causal-
+    SEGMENT variant (mid-sequence offsets + cache-wide attention), so a
+    deployment that opted into flash pays unfused O((P+S)*S) attention
+    there.  Decode is unaffected (two-tier path has no flash either way)."""
+    global _warned_prefix_flash
+    if not _warned_prefix_flash:
+        _warned_prefix_flash = True
+        logger.warning(
+            "prefix cache active with use_flash=True: the suffix prefill "
+            "runs unfused segment attention (no flash kernel for causal "
+            "segments); long suffixes pay O((P+S)*S) unfused attention"
+        )
+
+
+def _eager(x) -> bool:
+    """True when ``x`` is a concrete array — i.e. we are executing, not
+    being traced into someone's jit.  Telemetry must only record on
+    execution: a traced ``time.perf_counter()`` would bake trace-time
+    constants into the program."""
+    return not isinstance(x, jax.core.Tracer)
 
 __all__ = ["init_cache", "init_chunk", "prefill", "decode_step",
            "generate", "stream_chunks", "sample_token", "mask_after_eos",
@@ -534,6 +565,29 @@ def sample_token(logits, key, temperature: float = 0.0,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _chunk_eos_mask(toks, seen_eos, eos_token: int):
+    """Per-chunk after-eos masking with a carried latch — the DEVICE-side
+    form of mask_after_eos for streaming: rows already stopped
+    (``seen_eos`` [B] bool) are forced to eos wholesale, within-chunk
+    positions after a fresh eos are forced to eos, and the latch is
+    updated.  Returns (masked [B, n], seen_eos', all_done scalar).  The
+    caller reads back ONLY the scalar ``all_done`` flag to drive the
+    early-stop branch — the token chunk itself stays on device (the old
+    host-side masking forced a full [B, n] readback per chunk, serializing
+    the stream's device/host overlap)."""
+    eos = jnp.int32(eos_token)
+    t = jnp.where(seen_eos[:, None], eos, toks)
+    is_eos = t == eos
+    after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+             - is_eos.astype(jnp.int32)) > 0
+    t = jnp.where(after, eos, t)
+    seen2 = seen_eos | is_eos.any(axis=1)
+    return t, seen2, jnp.all(seen2)
+
+
+_chunk_eos_mask_jit = jax.jit(_chunk_eos_mask, static_argnames=("eos_token",))
+
+
 def mask_after_eos(toks, eos_token: int):
     """Force every position strictly AFTER a row's first ``eos_token``
     to eos: fixed-shape scans keep decoding past a stop token, so the
@@ -579,7 +633,19 @@ def generate(
     the concatenated sequence EXACTLY for float caches; with
     ``kv_quant="int8"`` the prefix is read back quantized where a full
     prefill attends pre-quantization k/v, so near-tie argmaxes may
-    differ (same class as every int8-KV read-back).
+    differ (same class as every int8-KV read-back).  NOTE: prefix mode
+    DISABLES flash for the suffix prefill — the causal-segment attend
+    (mid-sequence offsets over the whole cache) has no flash kernel, so
+    ``use_flash=True`` is ignored there with a one-time warning; plain
+    (no-prefix) prefill still uses the flash kernel when available.
+
+    Telemetry (eager calls only — traced calls skip; see _eager):
+    time-to-first-token and whole-call tokens/sec land in the flight
+    recorder (``seldon_tpu_ttft_seconds`` /
+    ``seldon_tpu_decode_tokens_per_second``).  TTFT costs ONE host sync
+    at the prefill boundary — the decode scan depends on the first token
+    anyway, so no device idle is added, only the host-side enqueue
+    overlap of one dispatch.
     Decode runs the TWO-TIER cache: the prefilled main cache is read-only
     inside the scan (mutating a large while-loop carry measured ~10x the
     logical write cost in dus + layout copies — see _attend_two_tier),
@@ -587,6 +653,10 @@ def generate(
     when max_new_tokens exceeds GEN_CHUNK_CAP."""
     B, S = prompt.shape
     P = 0 if prefix is None else prefix["l0"]["k"].shape[2]
+    eager = _eager(prompt)
+    t0 = time.perf_counter() if eager else 0.0
+    if prefix is not None and use_flash:
+        _warn_prefix_flash()
     chunked = max_new_tokens - 1 > GEN_CHUNK_CAP
     # single-chunk generations never merge, so main holds ONLY the prompt
     # — decode then streams P+S cache slots, not P+S+max_new masked ones
@@ -619,6 +689,14 @@ def generate(
 
     key0, rng = jax.random.split(rng)
     first = sample_token(logits, key0, temperature, top_k, top_p)
+    if eager:
+        # the decode scan depends on `first` anyway — blocking here adds
+        # no device idle, just surfaces the true prefill latency
+        jax.block_until_ready(first)
+        RECORDER.observe_ttft(time.perf_counter() - t0)
+        RECORDER.set_kv_slots(
+            active=B * (P + S), reserved=B * (main_len - P - S)
+        )
 
     def scan_steps(main, n_main, token, key, n, cap):
         # n_main is a python int here: slice the valid prefix statically,
@@ -654,8 +732,15 @@ def generate(
         if remaining > 0:  # fold the finished chunk in before the next
             main = merge_chunk(main, chunk, n_main, cfg)
             n_main += n
-    return mask_after_eos(
+    result = mask_after_eos(
         jnp.concatenate(out, axis=1), eos_token)  # [B, max_new]
+    if eager:
+        # block before timing: serving callers materialize next anyway
+        jax.block_until_ready(result)
+        elapsed = time.perf_counter() - t0
+        if elapsed > 0:
+            RECORDER.observe_decode_rate(B * max_new_tokens / elapsed)
+    return result
 
 
 def _chunk_step(params, token, main, chunk_buf, n_main, used, key,
@@ -771,8 +856,20 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     max_new_tokens steps.  When the chunk buffer fills
     (STREAM_CHUNK_CAP), the host grows the main cache by the buffered
     tokens (grow_merge — main stays exactly full, so every step of a
-    long stream decodes over valid slots only) and continues."""
+    long stream decodes over valid slots only) and continues.
+
+    With ``eos_token`` set, after-eos masking runs ON DEVICE
+    (_chunk_eos_mask: a carried ``seen_eos`` latch jitted with the mask)
+    and the host reads back only a scalar all-done flag per chunk to
+    drive the early-stop branch — yielded chunks stay device arrays, so
+    the consumer decides when to pay the readback.
+
+    Telemetry (flight recorder): TTFT recorded at the first sampled
+    token (one host sync at the prefill boundary — the first scan
+    depends on that token anyway), tokens/sec over the whole stream at
+    exhaustion, KV slot occupancy per merge."""
     B, S = prompt.shape
+    t0 = time.perf_counter()
     cap = STREAM_CHUNK_CAP
     # a per-dispatch scan may not outgrow the chunk buffer: a larger
     # request would dus past the buffer (clamped to the last slot =
@@ -782,6 +879,8 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     # it is exactly full at every decode step — long streams never pay
     # the mostly-empty-buffer QK dot + validity select
     P = 0 if prefix is None else prefix["l0"]["k"].shape[2]
+    if prefix is not None and use_flash:
+        _warn_prefix_flash()
     if prefix is None:
         main = init_cache(cfg, B, S)
         logits, main = prefill(params, prompt, main, cfg, use_flash)
@@ -794,28 +893,28 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
         rng = jax.random.key(0)
     key0, rng = jax.random.split(rng)
     first = sample_token(logits, key0, temperature, top_k, top_p)
+    jax.block_until_ready(first)  # the first scan depends on it anyway
+    RECORDER.observe_ttft(time.perf_counter() - t0)
 
     token, key = first, rng
     chunk_buf = init_chunk(cfg, B, cap)
     n_main, used = P + S, 0
     done = 0
-    # per-row "has emitted eos" latch (host side, numpy) — drives both
-    # the after-eos masking and the all-rows-done early stop
-    import numpy as _np
-
-    seen_eos = _np.zeros((B,), bool)
+    # per-row "has emitted eos" latch — DEVICE-side; the host sees only
+    # the scalar all_done flag (one tiny readback per chunk instead of
+    # the whole [B, chunk] token array)
+    seen_eos = jnp.zeros((B,), bool)
+    all_done = False
 
     def finalize(toks):
-        nonlocal seen_eos
+        nonlocal seen_eos, all_done
         if eos_token < 0:
             return toks
-        t = _np.asarray(toks)
-        t = _np.where(seen_eos[:, None], _np.int32(eos_token), t)
-        is_eos = t == eos_token
-        after = (_np.cumsum(is_eos, axis=1) - is_eos) > 0  # within-chunk
-        t = _np.where(after, _np.int32(eos_token), t)
-        seen_eos = seen_eos | is_eos.any(axis=1)
-        return jnp.asarray(t)
+        toks, seen_eos, flag = _chunk_eos_mask_jit(
+            toks, seen_eos, eos_token=eos_token
+        )
+        all_done = bool(flag)  # scalar readback drives the early stop
+        return toks
 
     def emit(n):
         nonlocal token, key, chunk_buf, main, n_main, used
@@ -824,6 +923,9 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
             n_main += used
             chunk_buf = init_chunk(cfg, B, cap)
             used = 0
+            RECORDER.set_kv_slots(
+                active=B * n_main, reserved=B * cap
+            )
         toks, (token, chunk_buf, _, key) = _chunk_step_jit(
             params, token, main, chunk_buf, jnp.int32(n_main),
             jnp.int32(used), key, cfg=cfg, n=n, temperature=temperature,
@@ -841,14 +943,21 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     else:
         yield finalize(first[:, None])
     done = 1 + n_first
+    decoded = done  # device-decoded tokens only (host eos pads excluded)
     while done < max_new_tokens:
         n = min(chunk, max_new_tokens - done)
-        if eos_token >= 0 and seen_eos.all():
+        if eos_token >= 0 and all_done:
             # every row is finished: pad from the host, skip the device
             yield jnp.full((B, n), jnp.int32(eos_token))
         else:
             yield finalize(emit(n))
+            decoded += n
         done += n
+    elapsed = time.perf_counter() - t0
+    if elapsed > 0:
+        # rate counts only device-decoded tokens — an early-stopped
+        # stream's host-padded filler must not inflate the SLO histogram
+        RECORDER.observe_decode_rate(B * decoded / elapsed)
 
 
 @register_unit("TransformerGenerator")

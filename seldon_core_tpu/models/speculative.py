@@ -134,6 +134,7 @@ def speculative_generate(
     draft_cfg: LMConfig,
     max_new_tokens: int = 32,
     k: int = 4,
+    max_rounds: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """prompt [B, S] int32 -> (tokens [B, max_new_tokens] int32,
     rounds int32 [B] — verify passes used per row; ~max_new/rounds tokens
@@ -141,7 +142,24 @@ def speculative_generate(
 
     Greedy only; per-row output equals vanilla greedy decoding of the
     target over its confirmed prefix.  One SHARED batched round loop —
-    see the module docstring for the round-aligned/bitmap design."""
+    see the module docstring for the round-aligned/bitmap design.
+
+    CACHE SIZING: round-aligned slots make both caches worst-case sized
+    ``Lmax = S + R*(k+1)`` where ``R = max_new_tokens - 1`` — about
+    (k+1)x the S + max_new a vanilla decode allocates (5x at k=4).
+    ``max_rounds > 0`` caps R by an EXPECTED-ACCEPTANCE bound: a draft
+    that tracks the target at mean acceptance ``a`` finishes in about
+    ``max_new / (a*k + 1)`` rounds, so e.g. ``max_rounds =
+    ceil(max_new / (0.5*k + 1)) + slack`` cuts the cache to that many
+    rounds' worth.  The cap trades worst-case completeness for memory:
+    rows still decoding when rounds run out get zero-padded tails
+    (``rounds`` returned == cap for such rows — observable), so pick the
+    cap from measured acceptance, not hope.  0 (default) keeps the exact
+    worst-case sizing.
+
+    Telemetry: eager calls record the per-request mean acceptance ratio
+    into the flight recorder (seldon_tpu_speculative_accept_ratio);
+    traced calls skip (trace-time constants are not serving data)."""
     if target_cfg.kv_quant == "int8" or draft_cfg.kv_quant == "int8":
         raise NotImplementedError(
             "speculative decoding runs float KV caches; quantize weights "
@@ -149,6 +167,8 @@ def speculative_generate(
     B, S = prompt.shape
     W = k + 1
     R = max(max_new_tokens - 1, 1)  # worst case: 1 token gained per round
+    if max_rounds > 0:
+        R = min(R, int(max_rounds))
     Lmax = S + R * W
     t_cache = init_cache(target_cfg, B, Lmax)
     d_cache = init_cache(draft_cfg, B, Lmax)
@@ -252,7 +272,24 @@ def speculative_generate(
     out = out.at[:, 0].set(first)
     out = out.at[jnp.arange(B)[:, None], dest].set(
         jnp.where(keep, flat, 0))
-    return out[:, :max_new_tokens], rounds_used
+    toks_out = out[:, :max_new_tokens]
+    if not isinstance(rounds_used, jax.core.Tracer):
+        # eager execution: per-request acceptance telemetry.  gained
+        # tokens per round = accepted drafts + 1 corrected, so accepted
+        # fraction = (emitted_after_first - rounds) / (rounds * k)
+        import numpy as _np
+
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        rounds = _np.asarray(rounds_used, dtype=_np.float64)
+        emitted = _np.minimum(
+            _np.asarray(n, dtype=_np.float64), float(max_new_tokens)) - 1.0
+        with _np.errstate(divide="ignore", invalid="ignore"):
+            ratio = _np.where(
+                rounds > 0, (emitted - rounds) / (rounds * max(k, 1)), 0.0)
+        RECORDER.observe_accept_ratio(
+            float(_np.clip(ratio, 0.0, 1.0).mean()))
+    return toks_out, rounds_used
 
 
 @register_unit("SpeculativeGenerator")
@@ -262,7 +299,21 @@ class SpeculativeGenerator(Unit):
     defaults to a quarter-size model).  Concurrent callers coalesce into
     ONE shared batched round loop (round-aligned cache slots + per-row
     validity bitmaps — see speculative_generate); per-row outputs equal
-    the single-row outputs, so coalescing never changes an answer."""
+    the single-row outputs, so coalescing never changes an answer.
+
+    MEMORY: round-aligned cache slots size BOTH the target and draft KV
+    caches at ``Lmax = S + (max_new_tokens - 1) * (k + 1)`` — worst case
+    one gained token per verify round, ~(k+1)x the ``S + max_new`` a
+    vanilla decode allocates (5x at k=4).  Deployments sized before this
+    layout (round 4 and earlier) can OOM on the same graph parameters;
+    either lower ``max_new_tokens``/``k`` or set ``max_rounds`` to an
+    expected-acceptance bound.  Example: ``max_new_tokens=256, k=4`` is
+    worst-case Lmax = S + 1275 slots/row/model; a draft measured at ~50%
+    acceptance finishes in ~256/(0.5*4+1) = 86 rounds, so
+    ``max_rounds=110`` (bound + ~25% slack) cuts that to S + 550 while
+    leaving headroom.  Rows that exhaust the capped rounds get
+    zero-padded tails — watch seldon_tpu_speculative_accept_ratio and
+    resize when the measured acceptance drifts below the bound."""
 
     pure = True
     # per-row outputs are independent of co-batched rows (pinned by
@@ -273,6 +324,7 @@ class SpeculativeGenerator(Unit):
                  draft_d_model: int = 0, draft_n_heads: int = 0,
                  draft_n_layers: int = 0, draft_d_ff: int = 0,
                  seed: int = 0, max_new_tokens: int = 32, k: int = 4,
+                 max_rounds: int = 0,
                  dtype: str = "float32", rope: bool = True,
                  rope_base: float = 10000.0):
         dt = jnp.dtype(dtype).type
@@ -302,6 +354,7 @@ class SpeculativeGenerator(Unit):
         self.seed = int(seed)
         self.max_new_tokens = int(max_new_tokens)
         self.k = int(k)
+        self.max_rounds = int(max_rounds)
 
     def init_state(self, rng):
         if rng is None:
@@ -317,5 +370,6 @@ class SpeculativeGenerator(Unit):
             state["target"], state["draft"], prompt,
             self.target_cfg, self.draft_cfg,
             max_new_tokens=self.max_new_tokens, k=self.k,
+            max_rounds=self.max_rounds,
         )
         return toks.astype(jnp.float32)

@@ -24,6 +24,7 @@ auto-batching for router-free graphs (checked by ``graph_is_batchable``).
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import deque
 from typing import Any, Awaitable, Callable, Deque, Dict, Tuple
 
@@ -31,6 +32,7 @@ import numpy as np
 
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
+from seldon_core_tpu.utils.telemetry import RECORDER
 
 __all__ = ["MicroBatcher", "graph_is_batchable"]
 
@@ -88,6 +90,7 @@ class MicroBatcher:
         self._buckets: Dict[Tuple, Deque] = {}
         self._pumps: Dict[Tuple, asyncio.Task] = {}
         self._inflight: set = set()  # strong refs: bare create_task is GC-able
+        self.recorder = RECORDER  # flight-recorder hub (occupancy/wait/slots)
 
     async def submit(self, x: np.ndarray):
         """x: [b, ...feature] rows of one request.  Returns (y_rows, aux)."""
@@ -98,10 +101,31 @@ class MicroBatcher:
             x = np.atleast_2d(x)
         key = (x.shape[1:], x.dtype)  # np.dtype hashes fine; str() is ~5us
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._buckets.setdefault(key, deque()).append((x, fut))
+        self._buckets.setdefault(key, deque()).append(
+            (x, fut, time.perf_counter())
+        )
         if key not in self._pumps:
             self._pumps[key] = asyncio.create_task(self._pump(key))
         return await fut
+
+    def snapshot(self) -> dict:
+        """Point-in-time batcher state for ``/stats`` — queued rows per
+        shape bucket plus the dispatch-slot picture."""
+        buckets = {}
+        for (shape, dtype), entries in self._buckets.items():
+            buckets[f"{tuple(shape)}/{dtype}"] = {
+                "requests": len(entries),
+                "rows": sum(len(e[0]) for e in entries),
+            }
+        return {
+            "buckets": buckets,
+            "inflight_dispatches": len(self._inflight),
+            "max_inflight": self.max_inflight,
+            "max_batch": self.max_batch,
+            "pad_to_buckets": self.pad_to_buckets,
+            "coalesce_ms": self.coalesce_s * 1e3,
+            "atomic_chunks": self.atomic_chunks,
+        }
 
     async def _pump(self, key) -> None:
         """One pump per shape bucket: take a dispatch slot, give same-burst
@@ -130,7 +154,11 @@ class MicroBatcher:
                     continue
                 t = asyncio.get_running_loop().create_task(self._run_batch(take))
                 self._inflight.add(t)
+                self.recorder.set_inflight(len(self._inflight))
                 t.add_done_callback(self._inflight.discard)
+                t.add_done_callback(
+                    lambda _t: self.recorder.set_inflight(len(self._inflight))
+                )
                 t.add_done_callback(lambda _t: self._sem.release())
         finally:
             # reached only with the bucket empty and no awaits since that
@@ -140,9 +168,15 @@ class MicroBatcher:
     async def _run_batch(self, bucket) -> None:
         xs = [e[0] for e in bucket]
         futs = [e[1] for e in bucket]
+        now = time.perf_counter()
+        for _, _, t_enq in bucket:
+            self.recorder.observe_queue_wait(now - t_enq)
         try:
             stacked = np.concatenate(xs, axis=0)
             total = len(stacked)
+            # occupancy = real client rows per dispatch (pre-padding: the
+            # pad rows are compiler fodder, not served traffic)
+            self.recorder.observe_batch(total)
             ys, aux = await self._dispatch_chunked(stacked)
             ys = np.asarray(ys)[:total]
             # one walk decides whether aux carries per-row arrays at all;

@@ -15,8 +15,19 @@ def enable_compile_cache() -> bool:
     """Point JAX at a persistent on-disk cache.  Opt out with
     SELDON_COMPILE_CACHE=0; dir overridable via SELDON_COMPILE_CACHE_DIR.
     Returns True when active; failures log a warning and serve uncached
-    (readiness timing then assumes full compiles)."""
+    (readiness timing then assumes full compiles).
+
+    Outcomes land in ``seldon_tpu_compile_cache_events_total{outcome}``
+    (utils/telemetry.py): enabled/disabled/error at boot, then hit/miss
+    per compile via the jax.monitoring listener — the signal that says
+    whether a restart re-pays XLA compiles or rides the cache."""
+    from seldon_core_tpu.utils.telemetry import (
+        RECORDER,
+        install_compile_cache_listener,
+    )
+
     if os.environ.get("SELDON_COMPILE_CACHE", "1") == "0":
+        RECORDER.record_compile_cache("disabled")
         return False
     cache_dir = os.environ.get(
         "SELDON_COMPILE_CACHE_DIR",
@@ -28,6 +39,8 @@ def enable_compile_cache() -> bool:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        install_compile_cache_listener()
+        RECORDER.record_compile_cache("enabled")
         return True
     except (ImportError, OSError, ValueError, AttributeError) as e:
         # AttributeError: jax raises it for unrecognized config options
@@ -36,4 +49,5 @@ def enable_compile_cache() -> bool:
             "XLA compiles; check SELDON_COMPILE_CACHE_DIR writability",
             type(e).__name__, e,
         )
+        RECORDER.record_compile_cache("error")
         return False

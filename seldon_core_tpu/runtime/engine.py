@@ -43,6 +43,7 @@ from seldon_core_tpu.messages import (
     new_puid,
 )
 from seldon_core_tpu.utils.metrics import MetricsRegistry
+from seldon_core_tpu.utils.telemetry import RECORDER, AuditLog
 
 __all__ = ["EngineService"]
 
@@ -85,6 +86,7 @@ class EngineService:
         max_wait_ms: float = 2.0,
         pipeline_depth: int = 8,
         dispatch_timeout_s: float = 30.0,
+        audit: Optional[AuditLog] = None,
     ):
         from seldon_core_tpu.utils.tracing import TRACER
 
@@ -95,6 +97,12 @@ class EngineService:
             deployment_name=deployment.name,
             predictor_name=self.predictor.name,
             project_name=str(deployment.annotations.get("project_name", "")),
+        )
+        # request-audit firehose (flight recorder): off unless configured —
+        # AuditLog() reads SELDON_TPU_AUDIT / SELDON_TPU_AUDIT_DIR
+        self.audit = audit if audit is not None else AuditLog()
+        self._graph_path = "/".join(
+            n.name for n in self.predictor.graph.walk()
         )
         self.paused = False
         # compiled-mode state advances via read-modify-write of
@@ -208,6 +216,51 @@ class EngineService:
             native_available()
 
 
+    # -- flight recorder -----------------------------------------------
+
+    def _audit_request(self, puid: str, method: str, status: int, t0: float,
+                       rows: Optional[int] = None, **extra) -> None:
+        """One puid-correlated audit entry per served request; a disabled
+        logger costs one attribute load."""
+        if not self.audit.enabled:
+            return
+        self.audit.record(
+            puid=puid,
+            deployment=self.deployment.name,
+            predictor=self.predictor.name,
+            graph=self._graph_path,
+            method=method,
+            status=int(status),
+            rows=rows,
+            latency_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            mode=self.mode,
+            **extra,
+        )
+
+    def stats(self) -> dict:
+        """Zero-dependency JSON snapshot behind ``GET /stats`` — batcher
+        occupancy/bucket state, in-flight dispatch slots, rolling latency
+        percentiles, generation SLO telemetry, tracer and audit status."""
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+                "paused": self.paused,
+                "pipelined": self._pipelined,
+                "dispatch_timeout_s": self.dispatch_timeout_s,
+                "known_good_widths": sorted(
+                    str(w) for w in self._known_good_widths
+                ),
+            },
+            "batcher": None if self.batcher is None else self.batcher.snapshot(),
+            "telemetry": RECORDER.snapshot(),
+            "tracer": {"enabled": TRACER.enabled},
+            "audit": self.audit.snapshot(),
+        }
+
     # -- streaming generation ------------------------------------------
 
     def can_stream(self) -> bool:
@@ -278,19 +331,52 @@ class EngineService:
         state = self.compiled.states[name]
         loop = asyncio.get_running_loop()
         gen = unit.stream_tokens(state, rows, chunk=chunk)
-        with self.metrics.time_server("generate-stream", "POST"), \
-                self.tracer.span(puid, "request", kind="request",
-                                 method="generate_stream"):
-            while True:
-                toks = await loop.run_in_executor(
-                    None, next, gen, None
-                )
-                if toks is None:
-                    break
-                yield _json.dumps({
-                    "tokens": np.asarray(toks).astype(float).tolist(),
-                    "done": False,
-                })
+        t0 = time.perf_counter()
+        ttft_s = None
+        tokens = 0
+        status = 200
+        try:
+            with self.metrics.time_server("generate-stream", "POST"), \
+                    self.tracer.span(puid, "request", kind="request",
+                                     method="generate_stream"):
+                while True:
+                    toks = await loop.run_in_executor(
+                        None, next, gen, None
+                    )
+                    if toks is None:
+                        break
+                    arr = np.asarray(toks)  # materialized for serialization
+                    if ttft_s is None:
+                        # engine-truth TTFT for the audit entry (prefill +
+                        # first decode scan + readback); the Prometheus
+                        # ttft/decode-rate families are recorded ONCE, by
+                        # stream_chunks itself — recording here too would
+                        # double-count every stream
+                        ttft_s = time.perf_counter() - t0
+                    tokens += int(arr.shape[0] * arr.shape[1])
+                    yield _json.dumps({
+                        "tokens": arr.astype(float).tolist(),
+                        "done": False,
+                    })
+        except GeneratorExit:
+            status = 499  # client abandoned the stream mid-flight
+            raise
+        except Exception:
+            status = 500  # surfaced in-band by the SSE error frame
+            raise
+        finally:
+            # failed/abandoned streams consumed device work and hold a
+            # puid — they must appear in the audit log like unary errors
+            elapsed = time.perf_counter() - t0
+            self._audit_request(
+                puid, "generate_stream", status, t0,
+                rows=int(rows.shape[0]),
+                tokens=tokens,
+                ttft_ms=None if ttft_s is None else round(ttft_s * 1e3, 3),
+                tokens_per_s=(
+                    None if elapsed <= 0 else round(tokens / elapsed, 1)
+                ),
+            )
         yield _json.dumps({"done": True, "meta": {"puid": puid}})
 
     def prewarm(self, widths) -> int:
@@ -435,6 +521,7 @@ class EngineService:
                 and "strData" not in envelope
             ):
                 puid = meta_in.get("puid") or new_puid()
+                t0 = time.perf_counter()
                 with self.metrics.time_server(
                     "predictions", "POST"
                 ) as code, self.tracer.span(
@@ -446,6 +533,10 @@ class EngineService:
                         y_rows, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = str(e.http_code)
+                        self._audit_request(
+                            puid, "predict", e.http_code, t0,
+                            rows=len(rows), lane="rest",
+                        )
                         return (
                             SeldonMessage.failure(
                                 str(e), code=e.http_code,
@@ -453,6 +544,9 @@ class EngineService:
                             ).to_json(),
                             e.http_code,
                         )
+                    self._audit_request(
+                        puid, "predict", 200, t0, rows=len(rows), lane="rest",
+                    )
                     meta_out = dict(meta_in)
                     meta_out["puid"] = puid
                     if tags or routing:
@@ -526,6 +620,7 @@ class EngineService:
             if parsed is not None:
                 puid, rows = parsed
                 puid = puid or new_puid()
+                t0 = time.perf_counter()
                 # method=GRPC: the gRPC surface records its own metric
                 # children (native h2 lane matches — nativeplane merge)
                 with self.metrics.time_server(
@@ -538,6 +633,10 @@ class EngineService:
                         y, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = str(e.http_code)
+                        self._audit_request(
+                            puid, "predict", e.http_code, t0,
+                            rows=len(rows), lane="grpc",
+                        )
                         from seldon_core_tpu.protoconv import msg_to_proto
 
                         # echo the request puid, like the object path does
@@ -546,6 +645,9 @@ class EngineService:
                                 str(e), code=e.http_code, meta=Meta(puid=puid)
                             )
                         ).SerializeToString()
+                    self._audit_request(
+                        puid, "predict", 200, t0, rows=len(rows), lane="grpc",
+                    )
                     if not routing and not tags:
                         return self._build_tensor_response(
                             puid, y, self._proto_names_frag
@@ -586,6 +688,7 @@ class EngineService:
                 if rows.ndim < 2:
                     rows = rows.reshape(1, -1)
                 puid = req.meta.puid or new_puid()
+                t0 = time.perf_counter()
                 with self.metrics.time_server(
                     "predictions", "GRPC"
                 ) as code, self.tracer.span(
@@ -596,11 +699,18 @@ class EngineService:
                         y, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = str(e.http_code)
+                        self._audit_request(
+                            puid, "predict", e.http_code, t0,
+                            rows=len(rows), lane="grpc",
+                        )
                         return msg_to_proto(
                             SeldonMessage.failure(
                                 str(e), code=e.http_code, meta=Meta(puid=puid)
                             )
                         )
+                    self._audit_request(
+                        puid, "predict", 200, t0, rows=len(rows), lane="grpc",
+                    )
                     return self._compose_proto_response(puid, y, routing, tags)
         resp_msg = await self.predict(msg_from_proto(req))
         return msg_to_proto(resp_msg)
@@ -629,6 +739,8 @@ class EngineService:
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         if not msg.meta.puid:
             msg.meta.puid = new_puid()
+        t0 = time.perf_counter()
+        n_rows = None
         with self.metrics.time_server("predictions", "POST") as code, self.tracer.span(
             msg.meta.puid, "request", kind="request", method="predict",
             mode=self.mode,
@@ -644,6 +756,7 @@ class EngineService:
                         )
                 if self.batcher is not None and msg.data is not None:
                     rows = np.atleast_2d(msg.array())
+                    n_rows = len(rows)
                     y_rows, (routing, tags) = await self._submit(rows)
                     resp = msg.with_array(y_rows, names=self._static_names)
                     # fresh Meta/Status: with_array shares the request's meta
@@ -658,6 +771,10 @@ class EngineService:
                         requestPath=dict(msg.meta.requestPath),
                     )
                     resp.status = Status()
+                    self._audit_request(
+                        msg.meta.puid, "predict", 200, t0, rows=n_rows,
+                        lane="object",
+                    )
                     return resp
                 if self.compiled is not None:
                     # device dispatch is synchronous but brief; keep the loop
@@ -669,11 +786,19 @@ class EngineService:
                 else:
                     resp = await self.executor.predict(msg)
             except (SeldonMessageError, GraphSpecError) as e:
-                code["code"] = str(getattr(e, "http_code", 400))
+                http_code = getattr(e, "http_code", 400)
+                code["code"] = str(http_code)
+                self._audit_request(
+                    msg.meta.puid, "predict", http_code, t0, rows=n_rows,
+                    lane="object",
+                )
                 return SeldonMessage.failure(
-                    str(e), code=getattr(e, "http_code", 400), meta=msg.meta
+                    str(e), code=http_code, meta=msg.meta
                 )
             resp.meta.puid = msg.meta.puid
+            self._audit_request(
+                msg.meta.puid, "predict", 200, t0, rows=n_rows, lane="object",
+            )
             return resp
 
     async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
@@ -715,12 +840,14 @@ class EngineService:
         return ack
 
     async def close(self) -> None:
-        """Release pooled remote-node clients (host mode)."""
+        """Release pooled remote-node clients (host mode) and flush the
+        request-audit firehose."""
         if self.executor is not None:
             for rt in self.executor.runtimes.values():
                 closer = getattr(rt, "close", None)
                 if closer is not None:
                     await closer()
+        await self.audit.stop()
 
     # -- admin (engine RestClientController.java:57-99) -----------------
 

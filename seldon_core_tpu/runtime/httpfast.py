@@ -14,7 +14,7 @@ Semantics match ``rest.py:make_engine_app`` route for route:
 
   POST /api/v0.1/predictions   JSON body or form field ``json=``
   POST /api/v0.1/feedback
-  GET  /ping /ready /pause /unpause /prometheus
+  GET  /ping /ready /pause /unpause /prometheus /stats
   GET  /trace /trace/enable /trace/disable
 
 Protocol scope (documented contract, tested in tests/test_httpfast.py):
@@ -105,6 +105,7 @@ class _EngineRoutes:
             b"/pause": self._pause,
             b"/unpause": self._unpause,
             b"/prometheus": self._prometheus,
+            b"/stats": self._stats,
             b"/trace": self._trace,
             b"/trace/enable": self._trace_enable,
             b"/trace/disable": self._trace_disable,
@@ -168,6 +169,11 @@ class _EngineRoutes:
 
     async def _prometheus(self, body, ctype, query) -> Result:
         return 200, self.engine.metrics.exposition(), CONTENT_TYPE_LATEST
+
+    async def _stats(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return 200, _json.dumps(self.engine.stats()).encode(), _JSON
 
     async def _trace(self, body, ctype, query) -> Result:
         import json as _json

@@ -6,6 +6,8 @@ External (per-predictor engine, mirroring engine RestClientController.java):
   GET  /ping /ready /pause /unpause (admin drain,
        engine RestClientController.java:57-99)
   GET  /prometheus             metric exposition
+  GET  /stats                  flight-recorder JSON snapshot (batcher,
+       latency percentiles, generation telemetry — utils/telemetry.py)
 
 Internal (single-unit microservice, mirroring wrappers/python/
 model_microservice.py REST routes):
@@ -111,6 +113,11 @@ def make_engine_app(engine: EngineService) -> web.Application:
             headers={"Content-Type": CONTENT_TYPE_LATEST},
         )
 
+    async def stats(_):
+        # flight-recorder snapshot: batcher/bucket state, latency
+        # percentiles, generation SLO telemetry — zero-dependency JSON
+        return web.json_response(engine.stats())
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER
 
@@ -179,6 +186,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/pause", pause)
     app.router.add_get("/unpause", unpause)
     app.router.add_get("/prometheus", prometheus)
+    app.router.add_get("/stats", stats)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/enable", trace_enable)
     app.router.add_get("/trace/disable", trace_disable)
@@ -197,6 +205,11 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
 
     def handler(method_name):
         async def handle(request: web.Request) -> web.Response:
+            import time as _time
+
+            from seldon_core_tpu.utils.telemetry import RECORDER
+
+            t0 = _time.perf_counter()
             try:
                 text = await _payload_text(request)
                 if method_name == "aggregate":
@@ -225,6 +238,10 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
                 return _error_response(str(e))
             except NotImplementedError as e:
                 return _error_response(str(e), code=501)
+            finally:
+                RECORDER.request_latency(
+                    f"unit:{method_name}", _time.perf_counter() - t0
+                )
             return _msg_response(resp)
 
         return handle
@@ -238,7 +255,19 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
 
     async def ping(_): return web.Response(text="pong")
 
+    async def stats(_):
+        # unit pods carry the process-level flight recorder too (compile
+        # cache, generation telemetry of in-unit generators)
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        return web.json_response({
+            "unit": {"name": runtime.node.name,
+                     "type": getattr(runtime.node.type, "name", None)},
+            "telemetry": RECORDER.snapshot(),
+        })
+
     app.router.add_get("/ping", ping)
+    app.router.add_get("/stats", stats)
     return app
 
 

@@ -9,13 +9,24 @@ configs/predictions-analytics-dashboard.json):
   * seldon_api_model_feedback_total / seldon_api_model_feedback_reward_total
 
 All tagged with deployment_name / predictor_name / model_name / model_image /
-model_version / project_name where applicable."""
+model_version / project_name where applicable.
+
+Beyond the reference families, ``exposition()`` merges in the process-level
+``seldon_tpu_*`` TPU-serving families owned by the flight recorder
+(utils/telemetry.py) — batch occupancy, queue wait, inflight dispatches,
+TTFT, decode rate, speculative acceptance, compile-cache and KV-cache
+state — so every existing ``/prometheus`` scrape target picks them up with
+zero config.  ``family_names()`` enumerates everything exported; the
+dashboard-honesty test (tests/test_monitoring_configs.py) checks
+monitoring/ configs against it."""
 
 from __future__ import annotations
 
 import time
 from contextlib import contextmanager
-from typing import Optional
+from typing import FrozenSet, Optional
+
+from seldon_core_tpu.utils.telemetry import RECORDER, TPU_METRIC_FAMILIES
 
 try:
     from prometheus_client import (
@@ -36,6 +47,15 @@ __all__ = ["MetricsRegistry", "CONTENT_TYPE_LATEST"]
 _BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
     2.5, 5.0, 10.0,
+)
+
+#: reference-parity families owned by MetricsRegistry itself
+_OWN_FAMILIES = (
+    "seldon_api_engine_server_requests_duration_seconds",
+    "seldon_api_engine_client_requests_duration_seconds",
+    "seldon_api_ingress_server_requests_duration_seconds",
+    "seldon_api_model_feedback_total",
+    "seldon_api_model_feedback_reward_total",
 )
 
 
@@ -117,10 +137,11 @@ class MetricsRegistry:
             code_holder["code"] = "500"
             raise
         finally:
+            dt = time.perf_counter() - start
+            # /stats percentile reservoirs run even without prometheus_client
+            RECORDER.request_latency(f"server:{service}", dt)
             if self.registry is not None:
-                self._server_child(service, method, code_holder["code"]).observe(
-                    time.perf_counter() - start
-                )
+                self._server_child(service, method, code_holder["code"]).observe(dt)
 
     @contextmanager
     def time_client(self, model_name: str, method: str, model_image: str = "",
@@ -146,18 +167,30 @@ class MetricsRegistry:
             code_holder["code"] = "500"
             raise
         finally:
+            dt = time.perf_counter() - start
+            RECORDER.request_latency(f"ingress:{service}", dt)
             if self.registry is not None:
                 self.ingress_requests.labels(
                     **self._common(), service=service, method=method,
                     code=code_holder["code"],
-                ).observe(time.perf_counter() - start)
+                ).observe(dt)
 
     def record_feedback(self, reward: float) -> None:
         if self.registry is not None:
             self.feedback_total.labels(**self._common()).inc()
             self.feedback_reward_total.labels(**self._common()).inc(max(reward, 0.0))
 
+    @classmethod
+    def family_names(cls) -> FrozenSet[str]:
+        """Every Prometheus family base name this process exports through
+        ``exposition()`` — reference-parity families plus the flight
+        recorder's ``seldon_tpu_*`` set."""
+        return frozenset(_OWN_FAMILIES) | frozenset(TPU_METRIC_FAMILIES)
+
     def exposition(self) -> bytes:
+        """Own (deployment-labelled) families + the process-level
+        ``seldon_tpu_*`` families — one scrape target per serving process
+        carries both layers."""
         if self.registry is None:
-            return b""
-        return generate_latest(self.registry)
+            return RECORDER.exposition()
+        return generate_latest(self.registry) + RECORDER.exposition()

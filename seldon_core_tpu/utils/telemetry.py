@@ -1,0 +1,494 @@
+"""Serving flight recorder — TPU-serving telemetry hub.
+
+The reference's observability is per-hop HTTP latencies plus a Kafka
+request firehose; both are deployment-level.  The TPU-native internals
+that actually govern throughput — micro-batch occupancy, queue wait,
+in-flight dispatch slots, time-to-first-token, decode rate, speculative
+acceptance, compile-cache traffic, KV-cache occupancy — are PROCESS-level
+(one TPU runtime per process), so they live in one process-global hub
+instead of the per-predictor ``MetricsRegistry``:
+
+  * ``FlightRecorder`` (module global ``RECORDER``, the ``TRACER``
+    pattern) keeps every family twice: a Prometheus metric in its own
+    ``CollectorRegistry`` (merged into every ``MetricsRegistry``
+    exposition, so existing ``/prometheus`` scrape targets pick the new
+    families up with zero config) and a plain-Python mirror — bounded
+    reservoirs for distributions, ints for gauges/counters — so the
+    ``/stats`` JSON snapshot needs no dependency at all.
+  * ``AuditLog`` is the engine-side analogue of the gateway firehose
+    (gateway/firehose.py): an async bounded-queue JSONL request-audit
+    stream (puid, graph path, batch size, latency breakdown, token
+    counts).  ``record()`` never blocks — a full queue counts a drop,
+    the same trade the reference's Kafka producer makes with
+    MAX_BLOCK_MS=20.
+
+Everything here must stay safe to call from jit-traced code paths'
+EAGER surroundings only; model code guards with
+``isinstance(x, jax.core.Tracer)`` before recording (a traced call would
+record trace-time constants, not serving behaviour).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+
+__all__ = [
+    "Reservoir",
+    "FlightRecorder",
+    "AuditLog",
+    "RECORDER",
+    "TPU_METRIC_FAMILIES",
+    "install_compile_cache_listener",
+]
+
+#: every TPU-serving metric family the recorder exports, base name ->
+#: (kind, label names).  The single source of truth: the Prometheus
+#: constructions below and the dashboard-honesty test
+#: (tests/test_monitoring_configs.py) both read it.
+TPU_METRIC_FAMILIES: Dict[str, tuple] = {
+    "seldon_tpu_batch_occupancy": ("histogram", ()),
+    "seldon_tpu_batch_queue_wait_seconds": ("histogram", ()),
+    "seldon_tpu_inflight_dispatches": ("gauge", ()),
+    "seldon_tpu_ttft_seconds": ("histogram", ()),
+    "seldon_tpu_decode_tokens_per_second": ("histogram", ()),
+    "seldon_tpu_speculative_accept_ratio": ("histogram", ()),
+    "seldon_tpu_compile_cache_events_total": ("counter", ("outcome",)),
+    "seldon_tpu_kv_cache_slots": ("gauge", ("state",)),
+    "seldon_tpu_audit_events_total": ("counter", ("outcome",)),
+}
+
+_OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+_WAIT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+_TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0, 30.0)
+_RATE_BUCKETS = (1, 10, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+                 50000, 100000)
+_RATIO_BUCKETS = (0.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+
+class Reservoir:
+    """Bounded sample ring with percentile snapshots — the zero-dependency
+    distribution store behind ``/stats``.  A plain deque keeps the LAST
+    ``capacity`` observations (serving wants "recent behaviour", and a
+    sliding window is cheaper and more legible than decaying reservoirs);
+    thread-safe because observations arrive from the event loop and from
+    device-dispatch executor threads."""
+
+    def __init__(self, capacity: int = 2048):
+        self._samples: deque = deque(maxlen=int(capacity))
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._samples.append(float(value))
+            self._count += 1
+            self._total += float(value)
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{count, mean, p50, p95, p99, max} over the retained window;
+        count/mean are lifetime (count is what rate() needs, the window
+        is what percentiles need)."""
+        with self._lock:
+            vals = sorted(self._samples)
+            count, total = self._count, self._total
+        if not vals:
+            return {"count": count, "mean": 0.0, "p50": 0.0, "p95": 0.0,
+                    "p99": 0.0, "max": 0.0}
+
+        def pct(p: float) -> float:
+            return vals[min(len(vals) - 1, int(p * len(vals)))]
+
+        return {
+            "count": count,
+            "mean": total / max(count, 1),
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+            "max": vals[-1],
+        }
+
+
+class FlightRecorder:
+    """Process-global TPU-serving telemetry: Prometheus families plus
+    plain-Python mirrors (see module docstring).  All observe/set methods
+    are cheap (a deque append + a child .observe) and never raise — the
+    hot path must not grow failure modes from its own instrumentation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.batch_occupancy = Reservoir()
+        self.batch_queue_wait = Reservoir()
+        self.ttft = Reservoir()
+        self.decode_rate = Reservoir()
+        self.accept_ratio = Reservoir()
+        self.inflight = 0
+        self.kv_slots: Dict[str, int] = {}
+        self.compile_cache_events: Dict[str, int] = {}
+        #: per-service rolling request latencies feeding /stats percentiles;
+        #: bounded — an exploding label set must not grow memory
+        self._latency: Dict[str, Reservoir] = {}
+        self._latency_cap = 64
+        self.registry = None
+        if HAVE_PROMETHEUS:
+            self.registry = CollectorRegistry()
+            self._p_occupancy = Histogram(
+                "seldon_tpu_batch_occupancy",
+                "Rows per stacked device dispatch",
+                registry=self.registry, buckets=_OCCUPANCY_BUCKETS)
+            self._p_queue_wait = Histogram(
+                "seldon_tpu_batch_queue_wait_seconds",
+                "Submit-to-dispatch wait in the micro-batch queue",
+                registry=self.registry, buckets=_WAIT_BUCKETS)
+            self._p_inflight = Gauge(
+                "seldon_tpu_inflight_dispatches",
+                "Stacked dispatches currently riding the device",
+                registry=self.registry)
+            self._p_ttft = Histogram(
+                "seldon_tpu_ttft_seconds",
+                "Time to first generated token (prefill + first sample)",
+                registry=self.registry, buckets=_TTFT_BUCKETS)
+            self._p_decode_rate = Histogram(
+                "seldon_tpu_decode_tokens_per_second",
+                "Generated tokens per second per request (batch x length / "
+                "wall)", registry=self.registry, buckets=_RATE_BUCKETS)
+            self._p_accept = Histogram(
+                "seldon_tpu_speculative_accept_ratio",
+                "Per-request mean accepted-draft fraction per verify round",
+                registry=self.registry, buckets=_RATIO_BUCKETS)
+            self._p_compile = Counter(
+                "seldon_tpu_compile_cache_events_total",
+                "Persistent XLA compile cache events", ["outcome"],
+                registry=self.registry)
+            self._p_kv = Gauge(
+                "seldon_tpu_kv_cache_slots",
+                "KV cache slots by state (most recent generation dispatch)",
+                ["state"], registry=self.registry)
+            self._p_audit = Counter(
+                "seldon_tpu_audit_events_total",
+                "Request-audit firehose events", ["outcome"],
+                registry=self.registry)
+
+    # -- batcher ---------------------------------------------------------
+
+    def observe_batch(self, rows: int,
+                      queue_wait_s: Optional[float] = None) -> None:
+        self.batch_occupancy.observe(rows)
+        if self.registry is not None:
+            self._p_occupancy.observe(rows)
+        if queue_wait_s is not None:
+            self.observe_queue_wait(queue_wait_s)
+
+    def observe_queue_wait(self, seconds: float) -> None:
+        self.batch_queue_wait.observe(seconds)
+        if self.registry is not None:
+            self._p_queue_wait.observe(seconds)
+
+    def set_inflight(self, n: int) -> None:
+        self.inflight = int(n)
+        if self.registry is not None:
+            self._p_inflight.set(n)
+
+    # -- generation ------------------------------------------------------
+
+    def observe_ttft(self, seconds: float) -> None:
+        self.ttft.observe(seconds)
+        if self.registry is not None:
+            self._p_ttft.observe(seconds)
+
+    def observe_decode_rate(self, tokens_per_s: float) -> None:
+        self.decode_rate.observe(tokens_per_s)
+        if self.registry is not None:
+            self._p_decode_rate.observe(tokens_per_s)
+
+    def observe_accept_ratio(self, ratio: float) -> None:
+        self.accept_ratio.observe(ratio)
+        if self.registry is not None:
+            self._p_accept.observe(ratio)
+
+    def set_kv_slots(self, **states: int) -> None:
+        """e.g. set_kv_slots(active=1040, reserved=256) — slot counts of
+        the most recent generation dispatch (a point-in-time gauge, not an
+        aggregate: TPU HBM pressure is about the current resident cache)."""
+        with self._lock:
+            self.kv_slots.update({k: int(v) for k, v in states.items()})
+        if self.registry is not None:
+            for k, v in states.items():
+                self._p_kv.labels(state=k).set(v)
+
+    # -- compile cache / audit accounting -------------------------------
+
+    def record_compile_cache(self, outcome: str, n: int = 1) -> None:
+        with self._lock:
+            self.compile_cache_events[outcome] = (
+                self.compile_cache_events.get(outcome, 0) + n)
+        if self.registry is not None:
+            self._p_compile.labels(outcome=outcome).inc(n)
+
+    def record_audit(self, outcome: str) -> None:
+        if self.registry is not None:
+            self._p_audit.labels(outcome=outcome).inc()
+
+    # -- request latencies (feeds /stats; Prometheus side is the existing
+    # -- seldon_api_* histograms in MetricsRegistry) ---------------------
+
+    def request_latency(self, service: str, seconds: float) -> None:
+        res = self._latency.get(service)
+        if res is None:
+            with self._lock:
+                res = self._latency.get(service)
+                if res is None:
+                    if len(self._latency) >= self._latency_cap:
+                        return  # bounded label space; drop novel keys
+                    res = self._latency[service] = Reservoir()
+        res.observe(seconds)
+
+    # -- snapshots -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The zero-dependency JSON body behind ``GET /stats``."""
+        with self._lock:
+            kv = dict(self.kv_slots)
+            cc = dict(self.compile_cache_events)
+            latency_keys = list(self._latency)
+        return {
+            "batch": {
+                "occupancy": self.batch_occupancy.snapshot(),
+                "queue_wait_s": self.batch_queue_wait.snapshot(),
+                "inflight_dispatches": self.inflight,
+            },
+            "generation": {
+                "ttft_s": self.ttft.snapshot(),
+                "decode_tokens_per_s": self.decode_rate.snapshot(),
+                "speculative_accept_ratio": self.accept_ratio.snapshot(),
+                "kv_cache_slots": kv,
+            },
+            "compile_cache_events": cc,
+            "request_latency_s": {
+                k: self._latency[k].snapshot() for k in latency_keys
+            },
+        }
+
+    def exposition(self) -> bytes:
+        if self.registry is None:
+            return b""
+        return generate_latest(self.registry)
+
+    def reset(self) -> None:
+        """Fresh distributions/counters — tests only (Prometheus counters
+        are monotone by design and are left alone)."""
+        self.batch_occupancy = Reservoir()
+        self.batch_queue_wait = Reservoir()
+        self.ttft = Reservoir()
+        self.decode_rate = Reservoir()
+        self.accept_ratio = Reservoir()
+        self.inflight = 0
+        with self._lock:
+            self.kv_slots = {}
+            self.compile_cache_events = {}
+            self._latency = {}
+
+
+RECORDER = FlightRecorder()
+
+
+# ---------------------------------------------------------------------------
+# Request-audit firehose (engine side)
+# ---------------------------------------------------------------------------
+
+
+def _default_audit_dir() -> str:
+    return os.environ.get(
+        "SELDON_TPU_AUDIT_DIR", os.path.expanduser("~/.seldon_tpu_audit")
+    )
+
+
+class AuditLog:
+    """Async bounded-queue JSONL request-audit logger — the Kafka-firehose
+    analogue at the ENGINE edge (the gateway's firehose logs request/
+    response bodies; this logs the SERVING TELEMETRY of each request:
+    puid, graph path, batch rows, latency breakdown, token counts).
+
+    ``record()`` is non-blocking by construction: ``put_nowait`` into a
+    bounded queue; a full queue increments ``dropped`` and the event is
+    gone (matching the reference's fire-and-forget Kafka producer).  The
+    drain task writes JSONL lines off the hot path; it is started lazily
+    on the first ``record()`` made with a running event loop, so no lane
+    needs boot wiring.
+
+    Disabled (``enabled=False``, the default unless ``SELDON_TPU_AUDIT=1``
+    or a path/sink is given) the logger is a null object: ``record()``
+    returns False at the cost of one attribute load."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        sink: Optional[Callable[[dict], None]] = None,
+        max_queue: int = 4096,
+        enabled: Optional[bool] = None,
+    ):
+        if enabled is None:
+            enabled = (
+                path is not None
+                or sink is not None
+                or os.environ.get("SELDON_TPU_AUDIT", "") not in ("", "0")
+            )
+        self.enabled = bool(enabled)
+        self.path = path or os.path.join(_default_audit_dir(), "audit.jsonl")
+        self.sink = sink
+        self.max_queue = int(max_queue)
+        self.recorded = 0
+        self.dropped = 0
+        self.written = 0
+        self._queue: deque = deque()
+        self._wakeup: Optional[Any] = None  # asyncio.Event, loop-bound
+        self._task = None
+
+    def record(self, **event: Any) -> bool:
+        """Enqueue one audit event; returns False when disabled or
+        dropped.  Never blocks, never raises."""
+        if not self.enabled:
+            return False
+        if len(self._queue) >= self.max_queue:
+            self.dropped += 1
+            RECORDER.record_audit("dropped")
+            return False
+        event.setdefault("ts", time.time())
+        self._queue.append(event)
+        self.recorded += 1
+        RECORDER.record_audit("recorded")
+        self._ensure_drain()
+        return True
+
+    def _ensure_drain(self) -> None:
+        import asyncio
+
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop: events wait in the bounded deque
+        if self._task is None or self._task.done():
+            self._wakeup = asyncio.Event()
+            self._task = loop.create_task(self._drain())
+        if self._wakeup is not None:
+            self._wakeup.set()
+
+    async def _drain(self) -> None:
+        import asyncio
+
+        while True:
+            if not self._queue:
+                self._wakeup.clear()
+                await self._wakeup.wait()
+            batch: List[dict] = []
+            while self._queue and len(batch) < 256:
+                batch.append(self._queue.popleft())
+            if not batch:
+                continue
+            try:
+                if self.sink is not None:
+                    for ev in batch:
+                        self.sink(ev)
+                else:
+                    # one writev-sized append per batch, built off-queue
+                    lines = "".join(
+                        json.dumps(ev, separators=(",", ":"), default=str)
+                        + "\n"
+                        for ev in batch
+                    )
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._append, lines
+                    )
+                self.written += len(batch)
+            except Exception:
+                self.dropped += len(batch)
+                RECORDER.record_audit("write_error")
+
+    def _append(self, lines: str) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(lines)
+
+    async def flush(self, timeout_s: float = 5.0) -> None:
+        """Wait until everything recorded so far is written (tests and
+        graceful shutdown; serving never calls this)."""
+        import asyncio
+
+        self._ensure_drain()
+        deadline = time.monotonic() + timeout_s
+        while self._queue and time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "path": None if self.sink is not None else self.path,
+            "queued": len(self._queue),
+            "max_queue": self.max_queue,
+            "recorded": self.recorded,
+            "written": self.written,
+            "dropped": self.dropped,
+        }
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            await self.flush()
+            self._task.cancel()
+            self._task = None
+
+
+# ---------------------------------------------------------------------------
+# Compile-cache event listener
+# ---------------------------------------------------------------------------
+
+_compile_listener_installed = False
+
+
+def install_compile_cache_listener() -> bool:
+    """Map jax.monitoring compilation-cache events onto
+    ``seldon_tpu_compile_cache_events_total{outcome=hit|miss}``.  Event
+    names vary across jax versions; anything compilation-cache-flavoured
+    is classified by substring, everything else ignored.  Idempotent;
+    returns True when a listener is registered."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        import jax.monitoring as _mon
+
+        def _on_event(name: str, **kw) -> None:
+            if "compilation_cache" not in name:
+                return
+            if "hit" in name:
+                RECORDER.record_compile_cache("hit")
+            elif "miss" in name:
+                RECORDER.record_compile_cache("miss")
+
+        _mon.register_event_listener(_on_event)
+        _compile_listener_installed = True
+        return True
+    except Exception:
+        return False

@@ -349,6 +349,44 @@ def test_prefix_cache_equals_full_prefill():
     np.testing.assert_array_equal(np.concatenate(chunks, axis=1), ref)
 
 
+def _prefix_chunked_roundtrip(cfg, monkeypatch, cap=4, max_new=13):
+    """Shared body: chunked (max_new > GEN_CHUNK_CAP) prefix-path equality
+    — the zero-pad-to-main_len + merge_chunk-into-padded-region lane,
+    including int8 k_s/v_s scale buffers when cfg quantizes the cache."""
+    from seldon_core_tpu.models.generate import init_cache, prefill
+    import seldon_core_tpu.models.generate as gen_mod
+
+    params = lm_init(jax.random.key(3), cfg)
+    rng = np.random.default_rng(21)
+    prefix_ids = rng.integers(0, 48, size=(6,)).tolist()
+    sufs = jnp.asarray(rng.integers(0, 48, size=(2, 5)), jnp.int32)
+
+    pc = init_cache(cfg, 1, len(prefix_ids))
+    _, pc = prefill(params, jnp.asarray([prefix_ids], jnp.int32), pc, cfg)
+    # single-chunk reference FIRST (no cap patch): same prefix cache, so
+    # any mismatch isolates the chunked merge path itself
+    ref = np.asarray(generate(
+        params, sufs, cfg, max_new_tokens=max_new, prefix=pc))
+    monkeypatch.setattr(gen_mod, "GEN_CHUNK_CAP", cap)
+    got = np.asarray(generate(
+        params, sufs, cfg, max_new_tokens=max_new, prefix=pc))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_prefix_cache_chunked_merge_matches(monkeypatch):
+    """Float cache: prefix + chunked decode (merge_chunk into the padded
+    main region) must equal the single-chunk prefix result exactly."""
+    _prefix_chunked_roundtrip(CFG, monkeypatch)
+
+
+def test_prefix_cache_chunked_merge_matches_int8(monkeypatch):
+    """int8 KV cache variant: the padded main carries k_s/v_s scale
+    buffers that merge_chunk must relocate alongside the quantized K/V."""
+    cfg = LMConfig(vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                   dtype=jnp.float32, kv_quant="int8")
+    _prefix_chunked_roundtrip(cfg, monkeypatch)
+
+
 def test_prefix_cache_unit_serves():
     """prefix_tokens as a deployment parameter: the unit builds the
     prefix cache once in init_state and every predict equals the

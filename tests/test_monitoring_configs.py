@@ -1,0 +1,106 @@
+"""Dashboards must stay honest: every metric name referenced by
+monitoring/alerts.yml and the Grafana dashboards must be a family that
+``MetricsRegistry.exposition()`` actually exports (its own reference-parity
+families plus the flight recorder's ``seldon_tpu_*`` set).  A renamed or
+deleted family fails HERE instead of silently flatlining a panel."""
+
+import json
+import os
+import re
+
+import pytest
+
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+MONITORING = os.path.join(os.path.dirname(__file__), "..", "monitoring")
+
+#: Prometheus exposition appends these to histogram/counter family names;
+#: promQL references them directly
+_SUFFIXES = ("", "_bucket", "_count", "_sum", "_total", "_created")
+
+_NAME_RE = re.compile(r"\bseldon_[a-z0-9_]+")
+
+
+def _allowed_names():
+    allowed = set()
+    for base in MetricsRegistry.family_names():
+        # counter families already carry _total; strip before re-suffixing
+        root = base[: -len("_total")] if base.endswith("_total") else base
+        for suffix in _SUFFIXES:
+            allowed.add(root + suffix)
+        allowed.add(base)
+    return allowed
+
+
+def _assert_known(referenced, source):
+    allowed = _allowed_names()
+    unknown = sorted(n for n in referenced if n not in allowed)
+    assert not unknown, (
+        f"{source} references metric names not exported by "
+        f"MetricsRegistry.exposition(): {unknown} — update "
+        f"utils/metrics.py::family_names / utils/telemetry.py::"
+        f"TPU_METRIC_FAMILIES or fix the config"
+    )
+
+
+def test_alert_rules_reference_exported_families():
+    yaml = pytest.importorskip("yaml")
+    path = os.path.join(MONITORING, "alerts.yml")
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    exprs = [
+        str(rule.get("expr", ""))
+        for group in doc.get("groups", [])
+        for rule in group.get("rules", [])
+    ]
+    assert exprs, "alerts.yml parsed to zero rules — wrong structure?"
+    referenced = set()
+    for expr in exprs:
+        referenced.update(_NAME_RE.findall(expr))
+    assert referenced, "alert rules reference no seldon_* metrics at all"
+    _assert_known(referenced, "monitoring/alerts.yml")
+
+
+def test_grafana_dashboards_reference_exported_families():
+    grafana_dir = os.path.join(MONITORING, "grafana")
+    dashboards = [
+        os.path.join(grafana_dir, f)
+        for f in os.listdir(grafana_dir)
+        if f.endswith(".json")
+    ]
+    assert dashboards, "no grafana dashboards found"
+    for path in dashboards:
+        with open(path) as f:
+            doc = json.load(f)
+        referenced = set()
+        for panel in doc.get("panels", []):
+            for target in panel.get("targets", []):
+                referenced.update(_NAME_RE.findall(str(target.get("expr", ""))))
+        # templating queries (label_values(...)) reference families too
+        for var in doc.get("templating", {}).get("list", []):
+            referenced.update(_NAME_RE.findall(str(var.get("query", ""))))
+        assert referenced, f"{path} references no seldon_* metrics at all"
+        _assert_known(referenced, os.path.basename(path))
+
+
+def test_new_tpu_families_are_dashboarded():
+    """The flight-recorder families exist to steer perf work — at least
+    the core ones must actually appear on a dashboard, or the telemetry
+    layer is write-only."""
+    grafana_dir = os.path.join(MONITORING, "grafana")
+    text = ""
+    for f in os.listdir(grafana_dir):
+        if f.endswith(".json"):
+            with open(os.path.join(grafana_dir, f)) as fh:
+                text += fh.read()
+    for family in (
+        "seldon_tpu_batch_occupancy",
+        "seldon_tpu_batch_queue_wait_seconds",
+        "seldon_tpu_inflight_dispatches",
+        "seldon_tpu_ttft_seconds",
+        "seldon_tpu_decode_tokens_per_second",
+        "seldon_tpu_speculative_accept_ratio",
+        "seldon_tpu_compile_cache_events_total",
+        "seldon_tpu_kv_cache_slots",
+    ):
+        assert family in text, f"{family} missing from every dashboard"
