@@ -12,6 +12,11 @@ native:
 test:
 	python -m pytest tests/ -q
 
+# deterministic fault-injection suite: combiner quorum, router fallback,
+# breaker transitions, end-to-end deadlines, pause/drain (tests/test_chaos.py)
+chaos:
+	python -m pytest tests/ -q -m chaos
+
 bench:
 	python bench.py
 

@@ -33,6 +33,13 @@ import numpy as np
 from seldon_core_tpu.gateway.firehose import Firehose
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.messages import Feedback, SeldonMessage, SeldonMessageError
+from seldon_core_tpu.runtime.resilience import (
+    DEADLINE_HEADER,
+    deadline_header_value,
+    deadline_ms_header,
+    maybe_deadline_scope,
+    remaining_s,
+)
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 
 __all__ = ["ApiGateway", "DeploymentStore", "AuthError"]
@@ -224,12 +231,27 @@ class ApiGateway:
         # connection-establishment failures — once bytes may have reached the
         # engine, re-POSTing could double-apply feedback training
         session = self._get_session()
-        timeout = aiohttp.ClientTimeout(total=20)
         last = "unreachable"
         for _ in range(3):
+            # deadline propagation (runtime/resilience.py): recomputed per
+            # attempt — the caller's REMAINING budget clamps this hop's
+            # timeout and rides to the engine as milliseconds, so a
+            # deadline set AT the gateway is honored end-to-end instead of
+            # resetting per hop (or per connect-retry)
+            total = 20.0
+            headers = None
+            rem = remaining_s()
+            if rem is not None:
+                if rem <= 0:
+                    return SeldonMessage.failure(
+                        "request deadline exhausted at gateway", code=504
+                    )
+                total = min(total, rem)
+                headers = {DEADLINE_HEADER: deadline_header_value()}
+            timeout = aiohttp.ClientTimeout(total=total)
             try:
                 async with session.post(
-                    url, data=payload, timeout=timeout
+                    url, data=payload, timeout=timeout, headers=headers
                 ) as r:
                     return SeldonMessage.from_json(await r.text())
             except aiohttp.ClientConnectorError as e:
@@ -311,7 +333,11 @@ def make_gateway_app(gateway: ApiGateway):
         except SeldonMessageError as e:
             return _error_response(str(e))
         try:
-            resp = await gateway.predict(msg, _bearer(request))
+            # deadline set at the gateway governs the whole request tree
+            with maybe_deadline_scope(
+                deadline_ms_header(request.headers.get(DEADLINE_HEADER))
+            ):
+                resp = await gateway.predict(msg, _bearer(request))
         except AuthError as e:
             return _error_response(str(e), code=401)
         status = 200 if resp.status is None or resp.status.status == "SUCCESS" else (
@@ -325,7 +351,10 @@ def make_gateway_app(gateway: ApiGateway):
         except SeldonMessageError as e:
             return _error_response(str(e))
         try:
-            ack = await gateway.send_feedback(fb, _bearer(request))
+            with maybe_deadline_scope(
+                deadline_ms_header(request.headers.get(DEADLINE_HEADER))
+            ):
+                ack = await gateway.send_feedback(fb, _bearer(request))
         except AuthError as e:
             return _error_response(str(e), code=401)
         return _msg_response(ack)

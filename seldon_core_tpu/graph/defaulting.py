@@ -114,6 +114,40 @@ def _check_unit(unit: PredictiveUnit, comp_names: set, errors: List[str]) -> Non
         errors.append(f"COMBINER unit {unit.name!r} needs children to combine")
     if unit.type is UnitType.ROUTER and not unit.children:
         errors.append(f"ROUTER unit {unit.name!r} needs children to route to")
+    # degradation declarations (resilience layer): structural sanity
+    if unit.quorum is not None:
+        combinerish = (
+            unit.type is UnitType.COMBINER
+            or unit.implementation is UnitImplementation.AVERAGE_COMBINER
+            or (unit.methods is not None and UnitMethod.AGGREGATE in unit.methods)
+        )
+        if not combinerish:
+            errors.append(
+                f"unit {unit.name!r} declares a quorum but has no AGGREGATE "
+                f"method (quorum only applies to combiners)"
+            )
+        if not (1 <= unit.quorum <= len(unit.children)):
+            errors.append(
+                f"unit {unit.name!r}: quorum {unit.quorum} out of range for "
+                f"{len(unit.children)} children"
+            )
+    if unit.fallback is not None:
+        routerish = (
+            unit.type is UnitType.ROUTER
+            or unit.implementation
+            in (UnitImplementation.SIMPLE_ROUTER, UnitImplementation.RANDOM_ABTEST)
+            or (unit.methods is not None and UnitMethod.ROUTE in unit.methods)
+        )
+        if not routerish:
+            errors.append(
+                f"unit {unit.name!r} declares a fallback branch but has no "
+                f"ROUTE method (fallback only applies to routers)"
+            )
+        if not (0 <= unit.fallback < len(unit.children)):
+            errors.append(
+                f"unit {unit.name!r}: fallback branch {unit.fallback} out of "
+                f"range for {len(unit.children)} children"
+            )
     for child in unit.children:
         _check_unit(child, comp_names, errors)
 

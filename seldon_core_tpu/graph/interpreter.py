@@ -25,7 +25,16 @@ from typing import Any, Dict, List, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from seldon_core_tpu.messages import Feedback, Meta, SeldonMessage, Status
+from seldon_core_tpu.messages import (
+    DeadlineExceededError,
+    Feedback,
+    Meta,
+    SeldonMessage,
+    SeldonMessageError,
+    Status,
+)
+from seldon_core_tpu.runtime.resilience import current_deadline
+from seldon_core_tpu.utils.telemetry import RECORDER
 from seldon_core_tpu.graph.spec import (
     ComponentBinding,
     GraphSpecError,
@@ -315,6 +324,17 @@ class GraphExecutor:
     async def _get_output(
         self, node: PredictiveUnit, msg: SeldonMessage
     ) -> SeldonMessage:
+        # deadline propagation: the request-level budget (set at the edge,
+        # runtime/resilience.py) is checked at every node hop — an expired
+        # budget fails fast here instead of starting work the caller has
+        # already given up on (gRPC-style deadline semantics)
+        dl = current_deadline()
+        if dl is not None and dl.expired:
+            RECORDER.record_deadline_exceeded(f"node:{node.name}")
+            raise DeadlineExceededError(
+                f"request deadline exhausted before node {node.name!r}"
+            )
+
         methods = methods_for(node)
         rt = self.runtimes[node.name]
         tracer = self.tracer
@@ -333,6 +353,7 @@ class GraphExecutor:
 
         # 2. route + children (engine PredictiveUnitBean.java:91-112)
         if node.children:
+            routed_branch: Optional[int] = None
             if UnitMethod.ROUTE in methods:
                 with tracer.span(puid, node.name, method="route") as sp:
                     branch = await rt.route(msg)
@@ -347,12 +368,13 @@ class GraphExecutor:
                         f"{len(node.children)} children"
                     )
                 msg.meta.routing[node.name] = branch
+                routed_branch = branch
                 selected = node.children if branch == -1 else [node.children[branch]]
             else:
                 selected = node.children
 
-            child_msgs = await asyncio.gather(
-                *[self._get_output(c, _fork_message(msg)) for c in selected]
+            child_msgs = await self._dispatch_children(
+                node, msg, selected, routed_branch, methods
             )
 
             # 3. merge (engine PredictiveUnitBean.java:115-124)
@@ -379,6 +401,117 @@ class GraphExecutor:
             with tracer.span(puid, node.name, method="transform_output"):
                 out = await rt.transform_output(out)
         return out
+
+    # -- graceful degradation (resilience layer) ----------------------------
+
+    @staticmethod
+    def _degradable(exc: BaseException) -> bool:
+        """Call failures a declared degradation policy may absorb: remote
+        call errors, breaker-open refusals, deadline/timeout expiry,
+        transport-level OS errors.  GraphSpecError (misconfiguration) and
+        anything unexpected always propagate — degrading over a bug would
+        hide it."""
+        if isinstance(exc, GraphSpecError):
+            return False
+        return isinstance(
+            exc, (SeldonMessageError, asyncio.TimeoutError, OSError)
+        )
+
+    async def _dispatch_children(
+        self,
+        node: PredictiveUnit,
+        msg: SeldonMessage,
+        selected: List[PredictiveUnit],
+        routed_branch: Optional[int],
+        methods: List[UnitMethod],
+    ) -> List[SeldonMessage]:
+        """Fan out to the selected children, applying the node's declared
+        degradation policy (COMBINER ``quorum`` / ROUTER ``fallback``)."""
+        if (
+            node.quorum is not None
+            and UnitMethod.AGGREGATE in methods
+            and len(selected) > 1
+        ):
+            return await self._gather_quorum(node, msg, selected)
+
+        fallback = node.fallback
+        if (
+            fallback is not None
+            and routed_branch is not None
+            and routed_branch != -1
+            and 0 <= fallback < len(node.children)
+            and fallback != routed_branch
+        ):
+            try:
+                return [await self._get_output(selected[0], _fork_message(msg))]
+            except BaseException as e:  # noqa: BLE001 - filtered below
+                if not self._degradable(e):
+                    raise
+                # the routed branch failed (or its breaker is open): try
+                # the declared fallback branch.  The fork's routing names
+                # the fallback so the child-side merge carries it; the
+                # degradation is only RECORDED (metric, parent routing,
+                # tags) once the fallback actually served — a failed
+                # fallback is a failed request, not a degraded serve
+                fb_msg = _fork_message(msg)
+                fb_msg.meta.routing[node.name] = fallback
+                out = await self._get_output(node.children[fallback], fb_msg)
+                RECORDER.record_degraded("fallback")
+                msg.meta.routing[node.name] = fallback
+                msg.meta.tags[f"seldon.fallback.{node.name}"] = int(fallback)
+                msg.meta.tags[f"seldon.fallback.{node.name}.reason"] = (
+                    f"branch {routed_branch}: {type(e).__name__}: {str(e)[:160]}"
+                )
+                return [out]
+
+        return list(
+            await asyncio.gather(
+                *[self._get_output(c, _fork_message(msg)) for c in selected]
+            )
+        )
+
+    async def _gather_quorum(
+        self,
+        node: PredictiveUnit,
+        msg: SeldonMessage,
+        selected: List[PredictiveUnit],
+    ) -> List[SeldonMessage]:
+        """COMBINER quorum: aggregate over the children that answered when
+        at least ``node.quorum`` succeed; dropped branches are annotated in
+        ``meta.tags['seldon.degraded.<node>']``.  Below quorum, the first
+        child failure propagates unchanged."""
+        results = await asyncio.gather(
+            *[self._get_output(c, _fork_message(msg)) for c in selected],
+            return_exceptions=True,
+        )
+        ok_msgs: List[SeldonMessage] = []
+        dropped: List[str] = []
+        first_err: Optional[BaseException] = None
+        for child, res in zip(selected, results):
+            if isinstance(res, BaseException):
+                if not self._degradable(res):
+                    raise res
+                dropped.append(child.name)
+                if first_err is None:
+                    first_err = res
+            elif res.data is None:
+                # a payload-free/malformed child answer would poison the
+                # aggregate — under a declared quorum it is a failed branch
+                dropped.append(child.name)
+                if first_err is None:
+                    first_err = SeldonMessageError(
+                        f"combiner {node.name!r}: child {child.name!r} "
+                        f"returned no tensor payload"
+                    )
+            else:
+                ok_msgs.append(res)
+        if len(ok_msgs) < int(node.quorum):
+            assert first_err is not None
+            raise first_err
+        if dropped:
+            RECORDER.record_degraded("quorum")
+            msg.meta.tags[f"seldon.degraded.{node.name}"] = sorted(dropped)
+        return ok_msgs
 
     # -- feedback path ------------------------------------------------------
 

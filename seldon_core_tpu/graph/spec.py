@@ -155,7 +155,17 @@ def params_to_kwargs(params: List[Parameter]) -> dict:
 
 @dataclass
 class PredictiveUnit:
-    """One node of the inference graph (seldon_deployment.proto:90-97)."""
+    """One node of the inference graph (seldon_deployment.proto:90-97).
+
+    TPU-native degradation extensions (runtime resilience layer):
+
+    * ``quorum`` (COMBINER/AGGREGATE nodes): aggregate over the children
+      that answered when at least ``quorum`` of them succeed, instead of
+      failing the whole request on the first child error; dropped branches
+      are annotated into ``meta.tags``.
+    * ``fallback`` (ROUTER nodes): child index served when the routed
+      branch's call fails or its circuit breaker is open.
+    """
 
     name: str
     children: List["PredictiveUnit"] = field(default_factory=list)
@@ -164,6 +174,8 @@ class PredictiveUnit:
     methods: Optional[List[UnitMethod]] = None
     endpoint: Optional[Endpoint] = None
     parameters: List[Parameter] = field(default_factory=list)
+    quorum: Optional[int] = None
+    fallback: Optional[int] = None
 
     # -- traversal ----------------------------------------------------------
 
@@ -194,6 +206,10 @@ class PredictiveUnit:
             out["endpoint"] = self.endpoint.to_json_dict()
         if self.parameters:
             out["parameters"] = [p.to_json_dict() for p in self.parameters]
+        if self.quorum is not None:
+            out["quorum"] = int(self.quorum)
+        if self.fallback is not None:
+            out["fallback"] = int(self.fallback)
         return out
 
     @staticmethod
@@ -210,7 +226,11 @@ class PredictiveUnit:
             methods = (
                 [UnitMethod(m) for m in d["methods"]] if "methods" in d else None
             )
-        except ValueError as e:
+            quorum = int(d["quorum"]) if d.get("quorum") is not None else None
+            fallback = (
+                int(d["fallback"]) if d.get("fallback") is not None else None
+            )
+        except (ValueError, TypeError) as e:
             raise GraphSpecError(f"graph node {d['name']!r}: {e}") from e
         return PredictiveUnit(
             name=str(d["name"]),
@@ -220,6 +240,8 @@ class PredictiveUnit:
             methods=methods,
             endpoint=Endpoint.from_json_dict(d["endpoint"]) if d.get("endpoint") else None,
             parameters=[Parameter.from_json_dict(p) for p in d.get("parameters", []) or []],
+            quorum=quorum,
+            fallback=fallback,
         )
 
 

@@ -42,6 +42,8 @@ __all__ = [
     "SeldonMessageList",
     "Feedback",
     "SeldonMessageError",
+    "DispatchTimeoutError",
+    "DeadlineExceededError",
     "new_puid",
 ]
 
@@ -61,6 +63,16 @@ class DispatchTimeoutError(SeldonMessageError):
     """Device dispatch exceeded the engine deadline — the per-node budget
     the reference enforced with 5 s gRPC deadlines
     (engine InternalPredictionService.java:77)."""
+
+    http_code = 504
+
+
+class DeadlineExceededError(SeldonMessageError):
+    """The request-level deadline budget (``Seldon-Deadline-Ms`` header /
+    gRPC deadline, runtime/resilience.py) ran out before the call could
+    complete.  Distinct from ``DispatchTimeoutError``: that is the engine's
+    own per-dispatch ceiling; this is the budget the CALLER set, decremented
+    across every node hop and retry so timeouts never stack."""
 
     http_code = 504
 

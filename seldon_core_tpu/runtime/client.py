@@ -4,9 +4,21 @@ The reference's ``InternalPredictionService`` builds a NEW gRPC channel per
 call and posts form-encoded JSON per node hop (engine
 InternalPredictionService.java:211-285, a known inefficiency).  Here each
 remote node gets ONE pooled ``aiohttp`` session (keep-alive) reused across
-requests, with a per-node deadline budget like the reference's 5 s gRPC
-deadline (InternalPredictionService.java:77) and model-identity headers
-(``Seldon-model-name`` etc., InternalPredictionService.java:73-75).
+requests, with model-identity headers (``Seldon-model-name`` etc.,
+InternalPredictionService.java:73-75), and the resilience layer
+(runtime/resilience.py) threaded through both transports:
+
+* every attempt's timeout is clamped to the request's remaining deadline
+  budget (``Seldon-Deadline-Ms`` header / native gRPC deadline on the
+  wire), so retries share ONE budget instead of stacking fresh timeouts —
+  the reference's 5 s deadline could silently become 15 s across its
+  3-attempt HTTP loop (apife HttpRetryHandler.java:34-45);
+* a unified ``RetryPolicy`` (exponential backoff + full jitter, transient-
+  status classification, per-method idempotency gating, global
+  ``RetryBudget``) applies identically to REST and gRPC — the reference
+  retried REST blindly (feedback included) and gRPC never;
+* a per-node ``CircuitBreaker`` fails calls fast while the node is known
+  unhealthy, with state exported through the flight recorder.
 """
 
 from __future__ import annotations
@@ -24,13 +36,30 @@ from seldon_core_tpu.messages import (
     SeldonMessageError,
     SeldonMessageList,
 )
+from seldon_core_tpu.runtime.resilience import (
+    CircuitBreaker,
+    DEADLINE_HEADER,
+    RetryBudget,
+    RetryPolicy,
+    _BreakerGuard,
+    clamp_timeout,
+    deadline_header_value,
+    is_idempotent,
+    remaining_s,
+)
+from seldon_core_tpu.utils.telemetry import RECORDER
 
 __all__ = ["RestNodeRuntime", "GrpcNodeRuntime", "RemoteCallError", "make_node_runtime"]
 
 DEFAULT_TIMEOUT_S = 5.0  # reference TIMEOUT, InternalPredictionService.java:77
 
 
-class RemoteCallError(RuntimeError):
+class RemoteCallError(SeldonMessageError):
+    """A remote node call failed after the retry policy gave up.  502 at
+    the serving edge (upstream node failure, not client fault)."""
+
+    http_code = 502
+
     def __init__(self, node: str, path: str, detail: str):
         super().__init__(f"remote node {node!r} {path}: {detail}")
         self.node = node
@@ -45,7 +74,49 @@ def _branch_from_msg(node_name: str, resp: SeldonMessage, where: str) -> int:
         raise RemoteCallError(node_name, where, f"bad branch: {e}") from e
 
 
-class RestNodeRuntime(NodeRuntime):
+class _ResilientCallMixin:
+    """Retry/breaker/deadline choreography shared by both transports.
+
+    Subclasses provide ``_attempt(op, attempt_timeout_s)`` (one transport
+    attempt; raises ``_transient_error_types`` on retryable transport
+    failures) and set ``node``, ``timeout_s``, ``retry_policy``,
+    ``breaker``, ``retry_budget``."""
+
+    node: PredictiveUnit
+    timeout_s: float
+    retry_policy: RetryPolicy
+    breaker: Optional[CircuitBreaker]
+    retry_budget: Optional[RetryBudget]
+
+    def _retry_allowed(self, attempt: int, method: str) -> bool:
+        """Attempt-count + idempotency gate for the NEXT attempt.  Side-
+        effect free (the budget is only charged once the retry is known
+        feasible — see ``_retry_after_backoff``)."""
+        if attempt + 1 >= self.retry_policy.max_attempts:
+            RECORDER.record_retry(method, "exhausted")
+            return False
+        return is_idempotent(method)
+
+    async def _retry_after_backoff(self, attempt: int, method: str) -> bool:
+        """Final retry gate, in feasibility-first order: (1) would the
+        jittered backoff outlive the remaining deadline budget?  (2) does
+        the global retry budget grant a token?  (3) sleep.  Checking the
+        deadline BEFORE withdrawing means a deadline-doomed call cannot
+        drain the shared budget other callers still need."""
+        delay = self.retry_policy.backoff_s(attempt)
+        rem = remaining_s()
+        if rem is not None and delay >= rem:
+            RECORDER.record_retry(method, "exhausted")
+            return False
+        if self.retry_budget is not None and not self.retry_budget.withdraw():
+            RECORDER.record_retry(method, "exhausted")
+            return False
+        if delay > 0:
+            await asyncio.sleep(delay)
+        return True
+
+
+class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
     """REST microservice client for one graph node (internal API of
     docs/reference/internal-api.md: /predict, /route, /aggregate,
     /transform-input, /transform-output, /send-feedback)."""
@@ -56,6 +127,9 @@ class RestNodeRuntime(NodeRuntime):
         binding: ComponentBinding,
         timeout_s: float = DEFAULT_TIMEOUT_S,
         retries: int = 3,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        retry_budget: Optional[RetryBudget] = None,
     ):
         import aiohttp
 
@@ -63,7 +137,9 @@ class RestNodeRuntime(NodeRuntime):
         self.binding = binding
         self.base = f"http://{binding.host or 'localhost'}:{binding.port}"
         self.timeout_s = timeout_s
-        self.retries = retries
+        self.retry_policy = retry_policy or RetryPolicy(max_attempts=retries)
+        self.breaker = breaker
+        self.retry_budget = retry_budget
         image, _, version = (binding.image or "").partition(":")
         self._headers = {
             "Seldon-model-name": node.name,
@@ -76,10 +152,10 @@ class RestNodeRuntime(NodeRuntime):
         import aiohttp
 
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=self.timeout_s),
-                headers=self._headers,
-            )
+            # no session-level total timeout: each ATTEMPT gets its own
+            # ClientTimeout clamped to the remaining request budget — a
+            # session-wide total would multiply by the retry count
+            self._session = aiohttp.ClientSession(headers=self._headers)
         return self._session
 
     async def close(self) -> None:
@@ -87,7 +163,7 @@ class RestNodeRuntime(NodeRuntime):
             await self._session.close()
 
     async def _post(
-        self, path: str, payload: str, puid: str = ""
+        self, path: str, payload: str, puid: str = "", method: str = "predict"
     ) -> SeldonMessage:
         from seldon_core_tpu.utils.tracing import TRACER
 
@@ -95,74 +171,133 @@ class RestNodeRuntime(NodeRuntime):
             puid, self.node.name, kind="client", method=path.strip("/"),
             transport="rest",
         ):
-            return await self._post_traced(path, payload)
+            return await self._post_traced(path, payload, method)
 
-    async def _post_traced(self, path: str, payload: str) -> SeldonMessage:
+    async def _post_traced(
+        self, path: str, payload: str, method: str
+    ) -> SeldonMessage:
         import aiohttp
 
         session = await self._get_session()
-        last_err = "unknown"
-        for attempt in range(self.retries):  # apife HttpRetryHandler.java:34-45
-            try:
-                async with session.post(
-                    self.base + path, data={"json": payload, "isDefault": "false"}
-                ) as resp:
-                    body = await resp.text()
-                    if resp.status != 200:
-                        raise RemoteCallError(
-                            self.node.name, path, f"HTTP {resp.status}: {body[:200]}"
-                        )
-                    try:
-                        return SeldonMessage.from_json(body)
-                    except SeldonMessageError as e:
-                        raise RemoteCallError(
-                            self.node.name, path, f"bad response: {e}"
-                        ) from e
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                last_err = f"{type(e).__name__}: {e}"
-                await asyncio.sleep(0.01 * (attempt + 1))
-        raise RemoteCallError(self.node.name, path, f"retries exhausted: {last_err}")
+        policy = self.retry_policy
+        guard = _BreakerGuard(self.breaker)
+        attempt = 0
+        try:
+            while True:
+                # per-attempt admission: a breaker that opened mid-loop
+                # stops the remaining attempts
+                guard.gate(self.node.name)
+                # each attempt draws from the ONE request budget; an
+                # exhausted budget raises DeadlineExceededError (504)
+                # before any I/O
+                att_timeout = clamp_timeout(
+                    self.timeout_s, where=f"rest:{self.node.name}"
+                )
+                hdr = deadline_header_value()
+                headers = {DEADLINE_HEADER: hdr} if hdr is not None else None
+                retryable = False
+                try:
+                    async with session.post(
+                        self.base + path,
+                        data={"json": payload, "isDefault": "false"},
+                        timeout=aiohttp.ClientTimeout(total=att_timeout),
+                        headers=headers,
+                    ) as resp:
+                        body = await resp.text()
+                        if resp.status == 200:
+                            try:
+                                out = SeldonMessage.from_json(body)
+                            except SeldonMessageError as e:
+                                # malformed 200 body: the node is
+                                # misbehaving deterministically — a breaker
+                                # failure, not a retry candidate
+                                guard.record(False)
+                                raise RemoteCallError(
+                                    self.node.name, path, f"bad response: {e}"
+                                ) from e
+                            guard.record(True)
+                            if self.retry_budget is not None and attempt == 0:
+                                self.retry_budget.deposit()
+                            return out
+                        # non-200: 5xx/429 count against the breaker and
+                        # may retry; 4xx are the caller's fault — neither
+                        retryable = policy.retryable_http(resp.status)
+                        guard.record(not (retryable or resp.status >= 500))
+                        last_err = f"HTTP {resp.status}: {body[:200]}"
+                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    # transport failure (connect refused, reset, attempt
+                    # timeout): always a breaker failure, retryable for
+                    # idempotent methods
+                    guard.record(False)
+                    retryable = True
+                    last_err = f"{type(e).__name__}: {e}"
+                if not (
+                    retryable
+                    and self._retry_allowed(attempt, method)
+                    and await self._retry_after_backoff(attempt, method)
+                ):
+                    raise RemoteCallError(self.node.name, path, last_err)
+                attempt += 1
+                RECORDER.record_retry(method, "retry")
+        finally:
+            guard.close()
 
     # -- NodeRuntime API ----------------------------------------------------
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._post("/predict", msg.to_json(), msg.meta.puid)
+        return await self._post("/predict", msg.to_json(), msg.meta.puid, "predict")
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._post("/transform-input", msg.to_json(), msg.meta.puid)
+        return await self._post(
+            "/transform-input", msg.to_json(), msg.meta.puid, "transform_input"
+        )
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
-        return await self._post("/transform-output", msg.to_json(), msg.meta.puid)
+        return await self._post(
+            "/transform-output", msg.to_json(), msg.meta.puid, "transform_output"
+        )
 
     async def route(self, msg: SeldonMessage) -> int:
-        resp = await self._post("/route", msg.to_json(), msg.meta.puid)
+        # route is NOT idempotent (bandit routers update exploration state
+        # per call) — the policy grants it a single attempt
+        resp = await self._post("/route", msg.to_json(), msg.meta.puid, "route")
         return _branch_from_msg(self.node.name, resp, "/route")
 
     async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
         payload = SeldonMessageList(messages=msgs).to_json()
         puid = msgs[0].meta.puid if msgs else ""
-        return await self._post("/aggregate", payload, puid)
+        return await self._post("/aggregate", payload, puid, "aggregate")
 
     async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        # never retried: a duplicated feedback delivery trains the unit
+        # twice (the reference retried it blindly — satellite fix)
         puid = (
             feedback.response.meta.puid if feedback.response is not None else ""
         )
-        await self._post("/send-feedback", feedback.to_json(), puid)
+        await self._post("/send-feedback", feedback.to_json(), puid, "send_feedback")
 
 
-class GrpcNodeRuntime(NodeRuntime):
+class GrpcNodeRuntime(_ResilientCallMixin, NodeRuntime):
     """gRPC microservice client for one graph node.  One persistent channel
     per node, reused across requests — unlike the reference, which creates a
     ManagedChannel per call (engine InternalPredictionService.java:211-214, a
     known hot-loop inefficiency).  Method routing follows the reference's
     type dispatch: MODEL -> Model.Predict, ROUTER -> Router.Route, ...
-    (engine InternalPredictionService.java:132-161)."""
+    (engine InternalPredictionService.java:132-161).
+
+    Retry parity with REST (the reference's gRPC path failed on the first
+    transient UNAVAILABLE): same policy, same budget, same breaker; the
+    per-attempt gRPC deadline is the clamped remaining request budget —
+    gRPC-native deadline propagation."""
 
     def __init__(
         self,
         node: PredictiveUnit,
         binding: ComponentBinding,
         timeout_s: float = DEFAULT_TIMEOUT_S,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        retry_budget: Optional[RetryBudget] = None,
     ):
         import grpc
 
@@ -172,6 +307,9 @@ class GrpcNodeRuntime(NodeRuntime):
         self.node = node
         self.binding = binding
         self.timeout_s = timeout_s
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker
+        self.retry_budget = retry_budget
         self._pb = pb
         self._channel = grpc.aio.insecure_channel(
             f"{binding.host or 'localhost'}:{binding.port}",
@@ -211,56 +349,108 @@ class GrpcNodeRuntime(NodeRuntime):
     async def close(self) -> None:
         await self._channel.close()
 
-    async def _call(self, stub, proto_req) -> SeldonMessage:
+    async def _call(self, stub, proto_req, method: str = "predict") -> SeldonMessage:
         import grpc
 
         from seldon_core_tpu import protoconv
 
+        policy = self.retry_policy
+        guard = _BreakerGuard(self.breaker)
+        attempt = 0
         try:
-            resp = await stub(proto_req, timeout=self.timeout_s)
-        except grpc.aio.AioRpcError as e:
-            raise RemoteCallError(
-                self.node.name, str(stub._method), f"{e.code().name}: {e.details()}"
-            ) from e
-        return protoconv.msg_from_proto(resp)
+            while True:
+                guard.gate(self.node.name)
+                att_timeout = clamp_timeout(
+                    self.timeout_s, where=f"grpc:{self.node.name}"
+                )
+                try:
+                    resp = await stub(proto_req, timeout=att_timeout)
+                except grpc.aio.AioRpcError as e:
+                    code_name = e.code().name
+                    guard.record(False)
+                    if (
+                        policy.retryable_grpc(code_name)
+                        and self._retry_allowed(attempt, method)
+                        and await self._retry_after_backoff(attempt, method)
+                    ):
+                        attempt += 1
+                        RECORDER.record_retry(method, "retry")
+                        continue
+                    raise RemoteCallError(
+                        self.node.name, str(stub._method),
+                        f"{code_name}: {e.details()}",
+                    ) from e
+                guard.record(True)
+                if self.retry_budget is not None and attempt == 0:
+                    self.retry_budget.deposit()
+                return protoconv.msg_from_proto(resp)
+        finally:
+            guard.close()
 
     # -- NodeRuntime API ----------------------------------------------------
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
         from seldon_core_tpu import protoconv
 
-        return await self._call(self._predict, protoconv.msg_to_proto(msg))
+        return await self._call(
+            self._predict, protoconv.msg_to_proto(msg), "predict"
+        )
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
         from seldon_core_tpu import protoconv
 
-        return await self._call(self._transform_input, protoconv.msg_to_proto(msg))
+        return await self._call(
+            self._transform_input, protoconv.msg_to_proto(msg), "transform_input"
+        )
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
         from seldon_core_tpu import protoconv
 
-        return await self._call(self._transform_output, protoconv.msg_to_proto(msg))
+        return await self._call(
+            self._transform_output, protoconv.msg_to_proto(msg), "transform_output"
+        )
 
     async def route(self, msg: SeldonMessage) -> int:
         from seldon_core_tpu import protoconv
 
-        resp = await self._call(self._route, protoconv.msg_to_proto(msg))
+        resp = await self._call(self._route, protoconv.msg_to_proto(msg), "route")
         return _branch_from_msg(self.node.name, resp, "Route")
 
     async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
         from seldon_core_tpu import protoconv
 
         proto = protoconv.msg_list_to_proto(SeldonMessageList(messages=msgs))
-        return await self._call(self._aggregate, proto)
+        return await self._call(self._aggregate, proto, "aggregate")
 
     async def send_feedback(self, feedback: Feedback, branch: int) -> None:
         from seldon_core_tpu import protoconv
 
-        await self._call(self._send_feedback, protoconv.feedback_to_proto(feedback))
+        await self._call(
+            self._send_feedback,
+            protoconv.feedback_to_proto(feedback),
+            "send_feedback",
+        )
 
 
-def make_node_runtime(node: PredictiveUnit, binding: ComponentBinding) -> NodeRuntime:
-    """Build the right remote runtime for a binding (rest/grpc)."""
+def make_node_runtime(
+    node: PredictiveUnit,
+    binding: ComponentBinding,
+    retry_policy: Optional[RetryPolicy] = None,
+    breaker: Optional[CircuitBreaker] = None,
+    retry_budget: Optional[RetryBudget] = None,
+) -> NodeRuntime:
+    """Build the right remote runtime for a binding (rest/grpc), wired into
+    the predictor's shared resilience machinery (engine passes one
+    ``RetryBudget`` for the whole graph and one ``CircuitBreaker`` per
+    node)."""
+    if breaker is None:
+        breaker = CircuitBreaker(node.name)
     if binding.runtime == "grpc":
-        return GrpcNodeRuntime(node, binding)
-    return RestNodeRuntime(node, binding)
+        return GrpcNodeRuntime(
+            node, binding,
+            retry_policy=retry_policy, breaker=breaker, retry_budget=retry_budget,
+        )
+    return RestNodeRuntime(
+        node, binding,
+        retry_policy=retry_policy, breaker=breaker, retry_budget=retry_budget,
+    )

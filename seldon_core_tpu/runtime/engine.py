@@ -35,12 +35,18 @@ from seldon_core_tpu.graph.spec import (
     SeldonDeploymentSpec,
 )
 from seldon_core_tpu.messages import (
+    DeadlineExceededError,
     DispatchTimeoutError,
     Feedback,
     Meta,
     SeldonMessage,
     SeldonMessageError,
     new_puid,
+)
+from seldon_core_tpu.runtime.resilience import (
+    CircuitBreaker,
+    RetryBudget,
+    remaining_s,
 )
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 from seldon_core_tpu.utils.telemetry import RECORDER, AuditLog
@@ -127,6 +133,11 @@ class EngineService:
                 self.mode = "compiled"
             except GraphSpecError:
                 pass
+        # resilience layer: ONE retry budget shared by every node client of
+        # this predictor (retries cannot amplify an outage across the
+        # fan-out) and one circuit breaker per remote node
+        self.retry_budget = RetryBudget()
+        self.breakers: Dict[str, CircuitBreaker] = {}
         if self.compiled is None:
             # remote rest/grpc bindings get pooled clients automatically
             runtimes = dict(extra_runtimes or {})
@@ -140,7 +151,19 @@ class EngineService:
                 ):
                     from seldon_core_tpu.runtime.client import make_node_runtime
 
-                    runtimes[node.name] = make_node_runtime(node, binding)
+                    breaker = CircuitBreaker(node.name)
+                    self.breakers[node.name] = breaker
+                    runtimes[node.name] = make_node_runtime(
+                        node, binding,
+                        breaker=breaker, retry_budget=self.retry_budget,
+                    )
+            # runtimes supplied by the caller may carry their own breaker
+            # (e.g. tests wiring RestNodeRuntime directly) — surface those
+            # through /stats and /ready too
+            for name, rt in runtimes.items():
+                br = getattr(rt, "breaker", None)
+                if br is not None and name not in self.breakers:
+                    self.breakers[name] = br
             self.executor = GraphExecutor(
                 self.predictor, extra_runtimes=runtimes, rng=rng
             )
@@ -256,10 +279,26 @@ class EngineService:
                 ),
             },
             "batcher": None if self.batcher is None else self.batcher.snapshot(),
+            "resilience": {
+                "retry_budget": self.retry_budget.snapshot(),
+                "breakers": {
+                    name: br.snapshot() for name, br in self.breakers.items()
+                },
+            },
             "telemetry": RECORDER.snapshot(),
             "tracer": {"enabled": TRACER.enabled},
             "audit": self.audit.snapshot(),
         }
+
+    def open_breakers(self) -> "list[str]":
+        """Remote nodes whose circuit breaker is not closed — surfaced in
+        ``/ready`` so orchestration sees partial degradation without
+        scraping Prometheus."""
+        return sorted(
+            name
+            for name, br in self.breakers.items()
+            if br.state != CircuitBreaker.CLOSED
+        )
 
     # -- streaming generation ------------------------------------------
 
@@ -435,12 +474,29 @@ class EngineService:
         per-call budget (5 s gRPC deadlines,
         InternalPredictionService.java:77) applied to the device hop.  A
         hung relay/device surfaces as a 504 FAILURE instead of a request
-        that never returns."""
+        that never returns.  A request-level deadline budget
+        (Seldon-Deadline-Ms / gRPC deadline, runtime/resilience.py) clamps
+        the wait further: the device hop draws from the same budget as
+        every other hop."""
+        timeout = self.dispatch_timeout_s
+        rem = remaining_s()
+        if rem is not None:
+            if rem <= 0:
+                RECORDER.record_deadline_exceeded("dispatch")
+                raise DeadlineExceededError(
+                    "request deadline exhausted before device dispatch"
+                )
+            timeout = min(timeout, rem)
         try:
-            return await asyncio.wait_for(
-                self.batcher.submit(rows), self.dispatch_timeout_s
-            )
+            return await asyncio.wait_for(self.batcher.submit(rows), timeout)
         except asyncio.TimeoutError:
+            if timeout < self.dispatch_timeout_s:
+                # the caller's budget, not the engine ceiling, ran out
+                RECORDER.record_deadline_exceeded("dispatch")
+                raise DeadlineExceededError(
+                    f"request deadline ({timeout:.2f}s remaining) exceeded "
+                    f"during device dispatch"
+                ) from None
             raise DispatchTimeoutError(
                 f"device dispatch exceeded {self.dispatch_timeout_s:.0f}s"
             ) from None

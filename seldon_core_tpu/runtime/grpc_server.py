@@ -23,6 +23,7 @@ from seldon_core_tpu.graph.interpreter import InProcessNodeRuntime
 from seldon_core_tpu.graph.spec import GraphSpecError
 from seldon_core_tpu.messages import SeldonMessage, SeldonMessageError
 from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+from seldon_core_tpu.runtime.resilience import maybe_deadline_scope
 
 __all__ = [
     "make_engine_grpc_server",
@@ -44,15 +45,25 @@ def _failure_proto(info: str, code: int = 400) -> pb.SeldonMessage:
     return protoconv.msg_to_proto(SeldonMessage.failure(info, code=code))
 
 
+def _grpc_deadline_scope(context):
+    """The caller's native gRPC deadline, mapped onto the request-level
+    budget contextvar (runtime/resilience.py) so downstream hops, retries,
+    and device dispatches draw from it — true end-to-end deadline
+    propagation on the gRPC lane."""
+    rem = context.time_remaining() if context is not None else None
+    return maybe_deadline_scope(rem if rem is not None and rem > 0 else None)
+
+
 def _wrap(fn):
     """Convert typed framework errors into FAILURE SeldonMessages and
     unexpected ones into INTERNAL grpc errors."""
 
     async def handler(request, context):
         try:
-            return await fn(request)
+            with _grpc_deadline_scope(context):
+                return await fn(request)
         except (SeldonMessageError, GraphSpecError) as e:
-            return _failure_proto(str(e))
+            return _failure_proto(str(e), code=getattr(e, "http_code", 400))
         except NotImplementedError as e:
             await context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
 
@@ -80,9 +91,12 @@ def make_engine_grpc_server(engine, host: str, port: int) -> grpc.aio.Server:
         # mirrors _wrap: typed errors -> FAILURE message, unimplemented ->
         # UNIMPLEMENTED, anything else propagates as INTERNAL
         try:
-            return await engine.predict_proto_wire(wire)
+            with _grpc_deadline_scope(context):
+                return await engine.predict_proto_wire(wire)
         except (SeldonMessageError, GraphSpecError) as e:
-            return _failure_proto(str(e)).SerializeToString()
+            return _failure_proto(
+                str(e), code=getattr(e, "http_code", 400)
+            ).SerializeToString()
         except NotImplementedError as e:
             await context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
 

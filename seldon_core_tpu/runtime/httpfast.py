@@ -37,6 +37,10 @@ from seldon_core_tpu.messages import (
     SeldonMessage,
     SeldonMessageError,
 )
+from seldon_core_tpu.runtime.resilience import (
+    deadline_ms_header,
+    deadline_scope,
+)
 from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
 
 __all__ = ["FastHttpServer", "serve_fast"]
@@ -123,7 +127,12 @@ class _EngineRoutes:
                 _payload_text(body, ctype)
             )
         except SeldonMessageError as e:
-            return 400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
+            code = e.http_code
+            return (
+                code,
+                SeldonMessage.failure(str(e), code=code).to_json().encode(),
+                _JSON,
+            )
         return status or 200, text.encode(), _JSON
 
     async def _generate_stream(self, body, ctype, query):
@@ -156,6 +165,14 @@ class _EngineRoutes:
 
     async def _ready(self, body, ctype, query) -> Result:
         if self.engine.ready():
+            open_breakers = self.engine.open_breakers()
+            if open_breakers:
+                return (
+                    200,
+                    b"ready (breakers open: "
+                    + ",".join(open_breakers).encode() + b")",
+                    "text/plain",
+                )
             return 200, b"ready", "text/plain"
         return 503, b"paused", "text/plain"
 
@@ -202,6 +219,13 @@ class _EngineRoutes:
 
 
 _MAX_INFLIGHT = 128  # per-connection pipelined requests before pause_reading
+
+
+async def _with_deadline(coro, budget_s: float):
+    """Run a route handler under a request deadline budget (the scope must
+    be entered INSIDE the handler task so child awaits inherit it)."""
+    with deadline_scope(budget_s):
+        return await coro
 
 
 def _header_value(lower: bytes, name: bytes) -> Optional[bytes]:
@@ -464,9 +488,16 @@ class _FastHttpProtocol(asyncio.Protocol):
             return
         ctv = _header_value(lower, b"content-type:")
         ctype = ctv.decode() if ctv is not None else ""
-        task = asyncio.get_running_loop().create_task(
-            handler(body, ctype, query.decode("latin-1"))
+        coro = handler(body, ctype, query.decode("latin-1"))
+        # deadline propagation (resilience layer): same header contract as
+        # the aiohttp lane — the budget is set in the handler task's context
+        dlv = _header_value(lower, b"seldon-deadline-ms:")
+        budget_s = (
+            deadline_ms_header(dlv.decode("latin-1")) if dlv is not None else None
         )
+        if budget_s is not None:
+            coro = _with_deadline(coro, budget_s)
+        task = asyncio.get_running_loop().create_task(coro)
         self.queue.put_nowait((task, close))
 
 

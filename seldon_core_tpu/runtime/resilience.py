@@ -1,0 +1,492 @@
+"""Resilience layer for the inference graph — deadline budgets, retry
+policy, circuit breakers.
+
+The reference's only resilience story is a flat 5 s gRPC deadline per hop
+(engine InternalPredictionService.java:77) and a blind 3-attempt HTTP retry
+loop (apife HttpRetryHandler.java:34-45): REST retried everything including
+non-idempotent feedback, gRPC retried nothing, and every retry attempt got a
+fresh full timeout so a 5 s deadline silently became 15 s.  This module is
+the centralized policy those per-worker mechanisms share (the
+Podracer-style split: failure isolation per worker, policy in one place —
+PAPERS.md, arxiv 2104.06272):
+
+* **Deadline** — one request-level budget carried in a contextvar (asyncio
+  tasks inherit it across ``gather`` fan-out) and on the wire as the
+  ``Seldon-Deadline-Ms`` header / native gRPC deadline.  Every node hop,
+  retry attempt, and device dispatch clamps its own timeout to the
+  remaining budget, so timeouts never stack.
+* **RetryPolicy / RetryBudget** — exponential backoff with full jitter,
+  retryable-status classification shared by REST and gRPC, per-method
+  idempotency gating (feedback/route are never retried), and a global
+  token-bucket retry budget so retries cannot amplify an outage.
+* **CircuitBreaker** — per-remote-node closed -> open -> half-open machine
+  over a sliding failure window; state exported through the flight
+  recorder (``seldon_tpu_breaker_*``) and ``/stats`` / ``/ready``.
+
+Everything takes an injectable clock / rng so the fault-injection suite
+(tests/test_chaos.py) is deterministic.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from seldon_core_tpu.messages import DeadlineExceededError, SeldonMessageError
+
+__all__ = [
+    "Deadline",
+    "DEADLINE_VAR",
+    "current_deadline",
+    "remaining_s",
+    "clamp_timeout",
+    "deadline_scope",
+    "maybe_deadline_scope",
+    "deadline_ms_header",
+    "deadline_header_value",
+    "DEADLINE_HEADER",
+    "RetryPolicy",
+    "RetryBudget",
+    "CircuitBreaker",
+    "BreakerOpenError",
+    "IDEMPOTENT_METHODS",
+    "is_idempotent",
+]
+
+#: wire name of the deadline budget (milliseconds remaining), REST hops;
+#: gRPC hops use the channel's native deadline instead
+DEADLINE_HEADER = "Seldon-Deadline-Ms"
+
+#: graph methods safe to retry: pure reads of unit state.  ``route`` is NOT
+#: idempotent (epsilon-greedy/bandit routers update exploration state per
+#: call) and ``send_feedback`` is a training write.
+IDEMPOTENT_METHODS = frozenset(
+    {"predict", "transform_input", "transform_output", "aggregate"}
+)
+
+
+def is_idempotent(method: str) -> bool:
+    return method in IDEMPOTENT_METHODS
+
+
+class BreakerOpenError(SeldonMessageError):
+    """Fail-fast refusal: the node's circuit breaker is open, no network
+    call was attempted.  503 at the edge (the node is *known* unhealthy,
+    which is a server-side condition, not client fault)."""
+
+    http_code = 503
+
+    def __init__(self, node: str):
+        super().__init__(f"circuit breaker open for node {node!r}")
+        self.node = node
+
+
+# ---------------------------------------------------------------------------
+# Deadline propagation
+# ---------------------------------------------------------------------------
+
+
+class Deadline:
+    """An absolute point on the monotonic clock; the whole request — every
+    hop, retry, and backoff sleep — draws from the one budget."""
+
+    __slots__ = ("at", "_clock")
+
+    def __init__(self, at: float, clock: Callable[[], float] = time.monotonic):
+        self.at = float(at)
+        self._clock = clock
+
+    @classmethod
+    def after(
+        cls, budget_s: float, clock: Callable[[], float] = time.monotonic
+    ) -> "Deadline":
+        return cls(clock() + float(budget_s), clock)
+
+    def remaining_s(self) -> float:
+        return self.at - self._clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Deadline(remaining={self.remaining_s():.3f}s)"
+
+
+DEADLINE_VAR: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
+    "seldon_tpu_deadline", default=None
+)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return DEADLINE_VAR.get()
+
+
+def remaining_s() -> Optional[float]:
+    """Remaining request budget in seconds, None when no deadline is set."""
+    dl = DEADLINE_VAR.get()
+    return None if dl is None else dl.remaining_s()
+
+
+def clamp_timeout(timeout_s: float, where: str = "call") -> float:
+    """Per-attempt timeout clamped to the remaining request budget.  Raises
+    ``DeadlineExceededError`` (504 at the edge) when the budget is already
+    gone — the caller must not start work it cannot finish."""
+    rem = remaining_s()
+    if rem is None:
+        return timeout_s
+    if rem <= 0.0:
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        RECORDER.record_deadline_exceeded(where)
+        raise DeadlineExceededError(
+            f"request deadline exhausted before {where}"
+        )
+    return min(timeout_s, rem)
+
+
+@contextmanager
+def deadline_scope(budget_s: float, clock: Callable[[], float] = time.monotonic):
+    """Set the request deadline for everything awaited inside the scope.
+    A nested scope can only *tighten* an inherited deadline, never extend
+    it (child hops must not outlive the gateway budget)."""
+    dl = Deadline.after(budget_s, clock)
+    cur = DEADLINE_VAR.get()
+    if cur is not None and cur.at <= dl.at:
+        dl = cur
+    token = DEADLINE_VAR.set(dl)
+    try:
+        yield dl
+    finally:
+        DEADLINE_VAR.reset(token)
+
+
+def maybe_deadline_scope(budget_s: Optional[float]):
+    """``deadline_scope`` when a budget is given, no-op otherwise — keeps
+    edge handlers branch-free."""
+    if budget_s is None:
+        return nullcontext()
+    return deadline_scope(budget_s)
+
+
+def deadline_header_value() -> Optional[str]:
+    """Remaining budget serialized for the ``Seldon-Deadline-Ms`` header,
+    floored at 1 ms — a sub-millisecond remainder must never format as
+    ``"0"``, which the receiving hop would parse as "no deadline" and run
+    unbounded (the opposite of the tighten-only invariant).  None when no
+    deadline is set.  Callers clamp/fail on an exhausted budget BEFORE
+    building headers."""
+    rem = remaining_s()
+    if rem is None:
+        return None
+    return f"{max(rem * 1e3, 1.0):.0f}"
+
+
+def deadline_ms_header(raw: Optional[str]) -> Optional[float]:
+    """Parse a ``Seldon-Deadline-Ms`` header value to a budget in seconds.
+    Lenient: absent / malformed / non-positive values mean "no deadline"
+    (a bad client header must not fail a request that would otherwise
+    serve)."""
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return ms / 1e3 if ms > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# Retry policy + budget
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RetryPolicy:
+    """Unified retry behaviour for REST and gRPC node clients.
+
+    Exponential backoff with FULL jitter (delay ~ U(0, base * 2^attempt),
+    capped) — jitter decorrelates retry storms across fan-out branches.
+    Classification is explicit: only transient statuses retry, and only
+    idempotent methods are eligible at all.
+    """
+
+    max_attempts: int = 3
+    base_backoff_s: float = 0.025
+    max_backoff_s: float = 0.5
+    #: transient HTTP statuses worth a retry; 500 is excluded on purpose —
+    #: a deterministic handler bug retried is just amplified load
+    retryable_statuses: frozenset = frozenset({429, 502, 503, 504})
+    #: transient gRPC status names (grpc.StatusCode.<name>.name)
+    retryable_codes: frozenset = frozenset({"UNAVAILABLE", "RESOURCE_EXHAUSTED"})
+    #: jitter source; tests inject random.Random(seed) for determinism
+    rng: Any = field(default_factory=lambda: random, repr=False)
+
+    def backoff_s(self, attempt: int) -> float:
+        cap = min(self.max_backoff_s, self.base_backoff_s * (2.0 ** attempt))
+        return self.rng.uniform(0.0, cap)
+
+    def retryable_http(self, status: int) -> bool:
+        return int(status) in self.retryable_statuses
+
+    def retryable_grpc(self, code_name: str) -> bool:
+        return str(code_name) in self.retryable_codes
+
+
+class RetryBudget:
+    """Global token-bucket retry budget (the Finagle ``RetryBudget``
+    shape): each completed first attempt deposits ``deposit_per_call``
+    tokens, each retry withdraws one.  Under a full outage retries are
+    bounded to ~``deposit_per_call`` x offered load instead of
+    ``max_attempts`` x — retries stop amplifying exactly when everything
+    is failing.  Shared by every node client of a predictor."""
+
+    def __init__(
+        self,
+        deposit_per_call: float = 0.2,
+        initial_tokens: float = 10.0,
+        max_tokens: float = 100.0,
+    ):
+        self.deposit_per_call = float(deposit_per_call)
+        self.max_tokens = float(max_tokens)
+        self._tokens = min(float(initial_tokens), self.max_tokens)
+        self.exhausted_total = 0
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self.max_tokens, self._tokens + self.deposit_per_call)
+
+    def withdraw(self) -> bool:
+        """True when a retry may proceed; False (and counted) when the
+        budget is exhausted."""
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            self.exhausted_total += 1
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        RECORDER.record_retry_budget_exhausted()
+        return False
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "tokens": round(self._tokens, 3),
+            "max_tokens": self.max_tokens,
+            "deposit_per_call": self.deposit_per_call,
+            "exhausted_total": self.exhausted_total,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-remote-node breaker: closed -> open -> half-open.
+
+    Failure rate is computed over a sliding time window of recent call
+    outcomes; once ``min_calls`` have been seen in the window and the
+    failure ratio reaches ``failure_ratio``, the breaker opens and every
+    call fails fast (``BreakerOpenError``) for ``open_s`` seconds.  Then
+    one half-open probe is admitted: success closes the breaker (window
+    reset), failure re-opens it for another cooldown.
+
+    State transitions are pushed to the flight recorder
+    (``seldon_tpu_breaker_state{node}``,
+    ``seldon_tpu_breaker_transitions_total{node,to}``) so ``/stats``,
+    ``/prometheus`` and the ``SeldonTPUBreakerOpen`` alert all see the
+    same machine.  Not thread-safe beyond the GIL: breakers live on the
+    engine's event loop.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    _STATE_GAUGE = {CLOSED: 0.0, HALF_OPEN: 0.5, OPEN: 1.0}
+
+    def __init__(
+        self,
+        node: str,
+        window_s: float = 30.0,
+        min_calls: int = 10,
+        failure_ratio: float = 0.5,
+        open_s: float = 5.0,
+        half_open_probes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.node = node
+        self.window_s = float(window_s)
+        self.min_calls = int(min_calls)
+        self.failure_ratio = float(failure_ratio)
+        self.open_s = float(open_s)
+        self.half_open_probes = int(half_open_probes)
+        self._clock = clock
+        self.state = self.CLOSED
+        self._window: list = []  # [(ts, ok)] — evicted by age
+        self._opened_at = 0.0
+        self._probes_inflight = 0
+        self.transitions: Dict[str, int] = {}
+        self._publish_state()
+
+    # -- internals ---------------------------------------------------------
+
+    def _publish_state(self) -> None:
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        RECORDER.set_breaker_state(self.node, self.state, self._STATE_GAUGE[self.state])
+
+    def _transition(self, to: str) -> None:
+        if to == self.state:
+            return
+        self.state = to
+        self.transitions[to] = self.transitions.get(to, 0) + 1
+        if to == self.OPEN:
+            self._opened_at = self._clock()
+        if to in (self.OPEN, self.CLOSED):
+            self._probes_inflight = 0
+        if to == self.CLOSED:
+            self._window = []
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        RECORDER.record_breaker_transition(self.node, to)
+        self._publish_state()
+
+    def _evict(self, now: float) -> None:
+        cutoff = now - self.window_s
+        if self._window and self._window[0][0] < cutoff:
+            self._window = [e for e in self._window if e[0] >= cutoff]
+
+    def _failure_stats(self, now: float) -> Tuple[int, int]:
+        self._evict(now)
+        calls = len(self._window)
+        failures = sum(1 for _, ok in self._window if not ok)
+        return calls, failures
+
+    # -- call-site API -----------------------------------------------------
+
+    def allow(self) -> bool:
+        """May a call be attempted right now?  Open breakers admit nothing
+        until the cooldown elapses, then a bounded number of half-open
+        probes."""
+        now = self._clock()
+        if self.state == self.OPEN:
+            if now - self._opened_at < self.open_s:
+                return False
+            self._transition(self.HALF_OPEN)
+        if self.state == self.HALF_OPEN:
+            if self._probes_inflight >= self.half_open_probes:
+                return False
+            self._probes_inflight += 1
+            return True
+        return True
+
+    def record(self, ok: bool) -> None:
+        now = self._clock()
+        if self.state == self.HALF_OPEN:
+            self._probes_inflight = max(0, self._probes_inflight - 1)
+            if ok:
+                self._transition(self.CLOSED)
+            else:
+                self._transition(self.OPEN)
+            return
+        if self.state == self.OPEN:
+            return  # late completion of a pre-open call; cooldown governs
+        self._window.append((now, bool(ok)))
+        if not ok:
+            calls, failures = self._failure_stats(now)
+            if calls >= self.min_calls and failures / calls >= self.failure_ratio:
+                self._transition(self.OPEN)
+
+    def record_success(self) -> None:
+        self.record(True)
+
+    def record_failure(self) -> None:
+        self.record(False)
+
+    def release(self) -> None:
+        """Undo an ``allow()`` admission that produced NO outcome — an
+        exception fired between the gate and the call (deadline already
+        expired, task cancelled).  Without this, a half-open probe slot
+        leaks and the breaker wedges open forever (``allow()`` would
+        refuse every future probe).  No-op outside HALF_OPEN."""
+        if self.state == self.HALF_OPEN:
+            self._probes_inflight = max(0, self._probes_inflight - 1)
+
+    # -- admin / tests -----------------------------------------------------
+
+    def trip(self) -> None:
+        """Force open (admin endpoint / chaos harness)."""
+        self._transition(self.OPEN)
+
+    def reset(self) -> None:
+        self._transition(self.CLOSED)
+
+    def snapshot(self) -> Dict[str, Any]:
+        now = self._clock()
+        calls, failures = self._failure_stats(now)
+        out: Dict[str, Any] = {
+            "state": self.state,
+            "window_calls": calls,
+            "window_failures": failures,
+            "failure_ratio": round(failures / calls, 4) if calls else 0.0,
+            "transitions": dict(self.transitions),
+            "config": {
+                "window_s": self.window_s,
+                "min_calls": self.min_calls,
+                "failure_ratio": self.failure_ratio,
+                "open_s": self.open_s,
+            },
+        }
+        if self.state == self.OPEN:
+            out["reopens_in_s"] = round(
+                max(0.0, self.open_s - (now - self._opened_at)), 3
+            )
+        return out
+
+
+class _BreakerGuard:
+    """Pairs every breaker ``allow()`` admission with exactly one outcome.
+
+    An exception between the gate and the call (expired deadline budget,
+    task cancellation) would otherwise leak a half-open probe slot and
+    wedge the breaker open forever — ``allow()`` would refuse every future
+    probe.  ``close()`` in a finally releases any admission that produced
+    no ``record()``.  One guard per logical call (its retry loop)."""
+
+    __slots__ = ("breaker", "_admitted_unrecorded")
+
+    def __init__(self, breaker: Optional[CircuitBreaker]):
+        self.breaker = breaker
+        self._admitted_unrecorded = False
+
+    def gate(self, node_name: str) -> None:
+        """Per-attempt admission check — re-run inside the retry loop so a
+        breaker that opened mid-loop stops the remaining attempts."""
+        if self.breaker is None:
+            return
+        if not self.breaker.allow():
+            raise BreakerOpenError(node_name)
+        self._admitted_unrecorded = True
+
+    def record(self, ok: bool) -> None:
+        if self.breaker is None:
+            return
+        self._admitted_unrecorded = False
+        self.breaker.record(ok)
+
+    def close(self) -> None:
+        if self.breaker is not None and self._admitted_unrecorded:
+            self.breaker.release()

@@ -34,6 +34,12 @@ from seldon_core_tpu.messages import (
     SeldonMessageList,
 )
 from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.resilience import (
+    DEADLINE_HEADER,
+    current_deadline,
+    deadline_ms_header,
+    maybe_deadline_scope,
+)
 from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
 
 __all__ = ["make_engine_app", "make_unit_app", "serve_app"]
@@ -64,6 +70,13 @@ def _error_response(info: str, code: int = 400) -> web.Response:
     return _msg_response(SeldonMessage.failure(info, code=code), status=code)
 
 
+def _request_budget_s(request: web.Request) -> Optional[float]:
+    """Deadline budget from the ``Seldon-Deadline-Ms`` header (None when
+    absent/malformed — resilience layer, gRPC-style deadline
+    propagation)."""
+    return deadline_ms_header(request.headers.get(DEADLINE_HEADER))
+
+
 # ---------------------------------------------------------------------------
 # Engine app
 # ---------------------------------------------------------------------------
@@ -74,28 +87,39 @@ def make_engine_app(engine: EngineService) -> web.Application:
 
     async def predictions(request: web.Request) -> web.Response:
         try:
-            text, status = await engine.predict_json(await _payload_text(request))
+            with maybe_deadline_scope(_request_budget_s(request)):
+                text, status = await engine.predict_json(
+                    await _payload_text(request)
+                )
         except SeldonMessageError as e:
-            return _error_response(str(e))
+            return _error_response(str(e), code=e.http_code)
         return web.Response(
             text=text, status=status or 200, content_type="application/json"
         )
 
     async def feedback(request: web.Request) -> web.Response:
         try:
-            fb = Feedback.from_json(await _payload_text(request))
+            with maybe_deadline_scope(_request_budget_s(request)):
+                fb = Feedback.from_json(await _payload_text(request))
+                ack = await engine.send_feedback(fb)
         except SeldonMessageError as e:
-            return _error_response(str(e))
-        ack = await engine.send_feedback(fb)
+            return _error_response(str(e), code=e.http_code)
         status = 200 if ack.status is None or ack.status.status == "SUCCESS" else ack.status.code
         return _msg_response(ack, status=status or 200)
 
     async def ping(_): return web.Response(text="pong")
 
     async def ready(_):
-        if engine.ready():
-            return web.Response(text="ready")
-        return web.Response(text="paused", status=503)
+        if not engine.ready():
+            return web.Response(text="paused", status=503)
+        open_breakers = engine.open_breakers()
+        if open_breakers:
+            # still ready (the graph serves, degraded) but the condition is
+            # surfaced where orchestration probes look first
+            return web.Response(
+                text="ready (breakers open: %s)" % ",".join(open_breakers)
+            )
+        return web.Response(text="ready")
 
     async def pause(_):
         engine.pause()
@@ -211,40 +235,52 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
 
             t0 = _time.perf_counter()
             try:
-                text = await _payload_text(request)
-                if method_name == "aggregate":
-                    msgs = SeldonMessageList.from_json(text)
-                    resp = await runtime.aggregate(msgs.messages)
-                elif method_name == "send_feedback":
-                    fb = Feedback.from_json(text)
-                    routing = (
-                        fb.response.meta.routing if fb.response is not None else {}
-                    )
-                    branch = int(routing.get(runtime.node.name, -1))
-                    await runtime.send_feedback(fb, branch)
-                    resp = SeldonMessage()
-                elif method_name == "route":
-                    msg = SeldonMessage.from_json(text)
-                    branch = await runtime.route(msg)
-                    # branch wrapped as 1x1 tensor like the reference wrapper
-                    # (wrappers/python/router_microservice.py:39-56)
-                    import numpy as np
-
-                    resp = msg.with_array(np.array([[branch]], dtype=np.float64))
-                else:
-                    msg = SeldonMessage.from_json(text)
-                    resp = await getattr(runtime, method_name)(msg)
+                # deadline propagation: the engine's node client forwards the
+                # remaining request budget; nested work in this unit (and a
+                # unit that is itself an engine facade) draws from it
+                with maybe_deadline_scope(_request_budget_s(request)):
+                    dl = current_deadline()
+                    if dl is not None and dl.expired:
+                        return _error_response(
+                            "request deadline exhausted on arrival", code=504
+                        )
+                    return await _dispatch(method_name, request)
             except (SeldonMessageError, GraphSpecError) as e:
-                return _error_response(str(e))
+                return _error_response(str(e), code=getattr(e, "http_code", 400))
             except NotImplementedError as e:
                 return _error_response(str(e), code=501)
             finally:
                 RECORDER.request_latency(
                     f"unit:{method_name}", _time.perf_counter() - t0
                 )
-            return _msg_response(resp)
 
         return handle
+
+    async def _dispatch(method_name: str, request: web.Request) -> web.Response:
+        text = await _payload_text(request)
+        if method_name == "aggregate":
+            msgs = SeldonMessageList.from_json(text)
+            resp = await runtime.aggregate(msgs.messages)
+        elif method_name == "send_feedback":
+            fb = Feedback.from_json(text)
+            routing = (
+                fb.response.meta.routing if fb.response is not None else {}
+            )
+            branch = int(routing.get(runtime.node.name, -1))
+            await runtime.send_feedback(fb, branch)
+            resp = SeldonMessage()
+        elif method_name == "route":
+            msg = SeldonMessage.from_json(text)
+            branch = await runtime.route(msg)
+            # branch wrapped as 1x1 tensor like the reference wrapper
+            # (wrappers/python/router_microservice.py:39-56)
+            import numpy as np
+
+            resp = msg.with_array(np.array([[branch]], dtype=np.float64))
+        else:
+            msg = SeldonMessage.from_json(text)
+            resp = await getattr(runtime, method_name)(msg)
+        return _msg_response(resp)
 
     app.router.add_post("/predict", handler("predict"))
     app.router.add_post("/transform-input", handler("transform_input"))

@@ -1,0 +1,162 @@
+"""Deterministic fault injection for the inference graph.
+
+``FaultyNodeRuntime`` wraps any ``NodeRuntime`` (in-process or remote) and
+injects seeded delays, errors, client-style timeouts, and malformed
+responses per method — the chaos harness the resilience layer's contracts
+are tested against (tests/test_chaos.py).  Determinism is the whole point:
+every injection decision comes from one ``random.Random(seed)`` stream per
+wrapper, so a failing chaos scenario replays exactly.
+
+Usage::
+
+    faulty = FaultyNodeRuntime(
+        inner,
+        FaultSpec(error_rate=1.0),                 # every method
+        seed=7,
+    )
+    faulty = FaultyNodeRuntime(
+        inner,
+        {"predict": FaultSpec(delay_s=0.2, error_rate=0.3)},  # per method
+    )
+
+Wire it into a graph via ``GraphExecutor``/``EngineService``
+``extra_runtimes`` — the engine then exercises real degradation paths
+(combiner quorum, router fallback, retries, breakers) with zero network
+setup.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Union
+
+from seldon_core_tpu.graph.interpreter import NodeRuntime
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+
+__all__ = ["FaultSpec", "FaultyNodeRuntime", "InjectedFault"]
+
+
+class InjectedFault(Exception):
+    """Raised by an injected error/timeout.  Defined standalone (imported
+    as a ``RemoteCallError`` peer at the call site) so the chaos suite can
+    assert the failure came from the harness, not the system under test."""
+
+
+@dataclass
+class FaultSpec:
+    """Per-method fault probabilities, evaluated in order: delay always
+    applies, then timeout / error / malformed draw one uniform sample
+    (mutually exclusive per call)."""
+
+    delay_s: float = 0.0        # added latency on every call
+    error_rate: float = 0.0     # P(raise InjectedFault-as-RemoteCallError)
+    timeout_rate: float = 0.0   # P(raise asyncio.TimeoutError — client view)
+    malformed_rate: float = 0.0  # P(return a payload-free garbage message)
+
+    @property
+    def total_failure_rate(self) -> float:
+        return self.error_rate + self.timeout_rate + self.malformed_rate
+
+
+class FaultyNodeRuntime(NodeRuntime):
+    """A NodeRuntime wrapper injecting faults BEFORE delegating.
+
+    ``faults`` is either one ``FaultSpec`` (applied to every method) or a
+    mapping ``method-name -> FaultSpec`` (methods absent from the mapping
+    pass through untouched).  Calls are counted per method
+    (``self.calls``) so tests can assert how often the system under test
+    actually reached the node (retry counts, breaker fail-fast)."""
+
+    def __init__(
+        self,
+        inner: NodeRuntime,
+        faults: Union[FaultSpec, Mapping[str, FaultSpec]],
+        seed: int = 0,
+    ):
+        self.inner = inner
+        self.node = getattr(inner, "node", None)
+        self._faults = faults
+        self._rng = random.Random(seed)
+        self.calls: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+
+    def _spec_for(self, method: str) -> Optional[FaultSpec]:
+        if isinstance(self._faults, FaultSpec):
+            return self._faults
+        return self._faults.get(method)
+
+    def _name(self) -> str:
+        node = self.node
+        return getattr(node, "name", None) or "faulty-node"
+
+    async def _maybe_fault(self, method: str) -> bool:
+        """Apply the method's fault spec; True means "return a malformed
+        response instead of delegating"."""
+        self.calls[method] = self.calls.get(method, 0) + 1
+        spec = self._spec_for(method)
+        if spec is None:
+            return False
+        if spec.delay_s > 0:
+            await asyncio.sleep(spec.delay_s)
+        r = self._rng.random()
+        if r < spec.error_rate:
+            self.injected[method] = self.injected.get(method, 0) + 1
+            from seldon_core_tpu.runtime.client import RemoteCallError
+
+            # raised AS a RemoteCallError so the system under test treats
+            # it exactly like a real remote failure (degradable, breaker-
+            # countable); InjectedFault mixin marks the provenance
+            class _Injected(InjectedFault, RemoteCallError):
+                pass
+
+            raise _Injected(self._name(), method, "injected fault")
+        r -= spec.error_rate
+        if r < spec.timeout_rate:
+            self.injected[method] = self.injected.get(method, 0) + 1
+            raise asyncio.TimeoutError(f"injected timeout: {self._name()}.{method}")
+        r -= spec.timeout_rate
+        if r < spec.malformed_rate:
+            self.injected[method] = self.injected.get(method, 0) + 1
+            return True
+        return False
+
+    # -- NodeRuntime API ----------------------------------------------------
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        if await self._maybe_fault("predict"):
+            return SeldonMessage(str_data="\x00not-a-tensor")
+        return await self.inner.predict(msg)
+
+    async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
+        if await self._maybe_fault("transform_input"):
+            return SeldonMessage(str_data="\x00not-a-tensor")
+        return await self.inner.transform_input(msg)
+
+    async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
+        if await self._maybe_fault("transform_output"):
+            return SeldonMessage(str_data="\x00not-a-tensor")
+        return await self.inner.transform_output(msg)
+
+    async def route(self, msg: SeldonMessage) -> int:
+        if await self._maybe_fault("route"):
+            from seldon_core_tpu.runtime.client import RemoteCallError
+
+            raise RemoteCallError(self._name(), "route", "injected bad branch")
+        return await self.inner.route(msg)
+
+    async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
+        if await self._maybe_fault("aggregate"):
+            return SeldonMessage(str_data="\x00not-a-tensor")
+        return await self.inner.aggregate(msgs)
+
+    async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        if await self._maybe_fault("send_feedback"):
+            return None
+        return await self.inner.send_feedback(feedback, branch)
+
+    async def close(self) -> None:
+        closer = getattr(self.inner, "close", None)
+        if closer is not None:
+            await closer()

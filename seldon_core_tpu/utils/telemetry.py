@@ -73,6 +73,14 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_compile_cache_events_total": ("counter", ("outcome",)),
     "seldon_tpu_kv_cache_slots": ("gauge", ("state",)),
     "seldon_tpu_audit_events_total": ("counter", ("outcome",)),
+    # resilience layer (runtime/resilience.py): breaker state machine,
+    # unified retry policy, deadline propagation, graceful degradation
+    "seldon_tpu_breaker_state": ("gauge", ("node",)),
+    "seldon_tpu_breaker_transitions_total": ("counter", ("node", "to")),
+    "seldon_tpu_retry_attempts_total": ("counter", ("method", "outcome")),
+    "seldon_tpu_retry_budget_exhausted_total": ("counter", ()),
+    "seldon_tpu_deadline_exceeded_total": ("counter", ("where",)),
+    "seldon_tpu_degraded_requests_total": ("counter", ("mode",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -148,6 +156,13 @@ class FlightRecorder:
         self.inflight = 0
         self.kv_slots: Dict[str, int] = {}
         self.compile_cache_events: Dict[str, int] = {}
+        # resilience mirrors (runtime/resilience.py feeds these)
+        self.breaker_states: Dict[str, str] = {}
+        self.breaker_transitions: Dict[str, int] = {}  # "node:to" -> n
+        self.retry_attempts: Dict[str, int] = {}  # "method:outcome" -> n
+        self.retry_budget_exhausted = 0
+        self.deadline_exceeded: Dict[str, int] = {}
+        self.degraded_requests: Dict[str, int] = {}
         #: per-service rolling request latencies feeding /stats percentiles;
         #: bounded — an exploding label set must not grow memory
         self._latency: Dict[str, Reservoir] = {}
@@ -191,6 +206,31 @@ class FlightRecorder:
                 "seldon_tpu_audit_events_total",
                 "Request-audit firehose events", ["outcome"],
                 registry=self.registry)
+            self._p_breaker_state = Gauge(
+                "seldon_tpu_breaker_state",
+                "Per-remote-node circuit breaker state "
+                "(0=closed, 0.5=half-open, 1=open)", ["node"],
+                registry=self.registry)
+            self._p_breaker_transitions = Counter(
+                "seldon_tpu_breaker_transitions_total",
+                "Circuit breaker state transitions", ["node", "to"],
+                registry=self.registry)
+            self._p_retry = Counter(
+                "seldon_tpu_retry_attempts_total",
+                "Node-client retry events by graph method",
+                ["method", "outcome"], registry=self.registry)
+            self._p_retry_budget = Counter(
+                "seldon_tpu_retry_budget_exhausted_total",
+                "Retries refused because the global retry budget was empty",
+                registry=self.registry)
+            self._p_deadline = Counter(
+                "seldon_tpu_deadline_exceeded_total",
+                "Calls abandoned because the request deadline budget ran "
+                "out", ["where"], registry=self.registry)
+            self._p_degraded = Counter(
+                "seldon_tpu_degraded_requests_total",
+                "Requests served degraded (combiner quorum / router "
+                "fallback)", ["mode"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -252,6 +292,50 @@ class FlightRecorder:
         if self.registry is not None:
             self._p_audit.labels(outcome=outcome).inc()
 
+    # -- resilience layer (runtime/resilience.py) ------------------------
+
+    def set_breaker_state(self, node: str, state: str, gauge: float) -> None:
+        with self._lock:
+            self.breaker_states[node] = state
+        if self.registry is not None:
+            self._p_breaker_state.labels(node=node).set(gauge)
+
+    def record_breaker_transition(self, node: str, to: str) -> None:
+        key = f"{node}:{to}"
+        with self._lock:
+            self.breaker_transitions[key] = self.breaker_transitions.get(key, 0) + 1
+        if self.registry is not None:
+            self._p_breaker_transitions.labels(node=node, to=to).inc()
+
+    def record_retry(self, method: str, outcome: str) -> None:
+        """outcome: 'retry' (another attempt is being made) or 'exhausted'
+        (attempts/budget ran out and the failure surfaced)."""
+        key = f"{method}:{outcome}"
+        with self._lock:
+            self.retry_attempts[key] = self.retry_attempts.get(key, 0) + 1
+        if self.registry is not None:
+            self._p_retry.labels(method=method, outcome=outcome).inc()
+
+    def record_retry_budget_exhausted(self) -> None:
+        with self._lock:
+            self.retry_budget_exhausted += 1
+        if self.registry is not None:
+            self._p_retry_budget.inc()
+
+    def record_deadline_exceeded(self, where: str) -> None:
+        with self._lock:
+            self.deadline_exceeded[where] = self.deadline_exceeded.get(where, 0) + 1
+        if self.registry is not None:
+            self._p_deadline.labels(where=where).inc()
+
+    def record_degraded(self, mode: str) -> None:
+        """mode: 'quorum' (combiner served a subset) or 'fallback' (router
+        served the fallback branch)."""
+        with self._lock:
+            self.degraded_requests[mode] = self.degraded_requests.get(mode, 0) + 1
+        if self.registry is not None:
+            self._p_degraded.labels(mode=mode).inc()
+
     # -- request latencies (feeds /stats; Prometheus side is the existing
     # -- seldon_api_* histograms in MetricsRegistry) ---------------------
 
@@ -274,7 +358,16 @@ class FlightRecorder:
             kv = dict(self.kv_slots)
             cc = dict(self.compile_cache_events)
             latency_keys = list(self._latency)
+            resilience = {
+                "breaker_states": dict(self.breaker_states),
+                "breaker_transitions": dict(self.breaker_transitions),
+                "retry_attempts": dict(self.retry_attempts),
+                "retry_budget_exhausted": self.retry_budget_exhausted,
+                "deadline_exceeded": dict(self.deadline_exceeded),
+                "degraded_requests": dict(self.degraded_requests),
+            }
         return {
+            "resilience": resilience,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -310,6 +403,12 @@ class FlightRecorder:
             self.kv_slots = {}
             self.compile_cache_events = {}
             self._latency = {}
+            self.breaker_states = {}
+            self.breaker_transitions = {}
+            self.retry_attempts = {}
+            self.retry_budget_exhausted = 0
+            self.deadline_exceeded = {}
+            self.degraded_requests = {}
 
 
 RECORDER = FlightRecorder()

@@ -1,0 +1,539 @@
+"""Chaos suite: whole graphs driven through deterministic failure scenarios
+(``make chaos`` / ``-m chaos``; fast enough to ride in tier-1 too).
+
+Asserts the resilience layer's degradation contracts end-to-end:
+
+  * a COMBINER graph with one child at 100% errors still serves 200s under
+    a declared quorum, with the dropped branch annotated in ``meta.tags``;
+  * a ROUTER whose chosen branch has an open breaker (or just fails)
+    serves via its declared fallback branch;
+  * a deadline set at the gateway is respected end-to-end — retries draw
+    from one budget, so timeouts never stack;
+  * breaker open/close transitions are visible in ``/stats``, ``/ready``
+    and the Prometheus exposition;
+  * engine pause/drain keeps serving in-flight and late requests while
+    ``/ready`` reports 503 (satellite coverage);
+  * a wedged device dispatch surfaces as DispatchTimeoutError -> 504
+    through REST, and ``/stats`` stays serviceable (satellite coverage).
+
+All injected faults come from seeded ``FaultyNodeRuntime`` streams
+(seldon_core_tpu/testing/faults.py) — failing scenarios replay exactly.
+"""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.defaulting import default_and_validate
+from seldon_core_tpu.graph.interpreter import GraphExecutor
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
+from seldon_core_tpu.testing.faults import FaultSpec, FaultyNodeRuntime
+
+pytestmark = pytest.mark.chaos
+
+
+@register_unit("chaos.Router0")
+class AlwaysBranch0(Unit):
+    """Deterministic router: always branch 0 (the branch we break)."""
+
+    def route(self, state, X):
+        return 0
+
+
+def _deployment(graph, components=None):
+    spec = SeldonDeploymentSpec.from_json_dict(
+        {
+            "spec": {
+                "name": "chaos",
+                "predictors": [
+                    {"name": "p", "graph": graph, "components": components or []}
+                ],
+            }
+        }
+    )
+    return spec
+
+
+async def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+COMBINER_GRAPH = {
+    "name": "ens",
+    "implementation": "AVERAGE_COMBINER",
+    "quorum": 2,
+    "children": [
+        {"name": "a", "implementation": "SIMPLE_MODEL"},
+        {"name": "b", "implementation": "SIMPLE_MODEL"},
+        {"name": "c", "implementation": "SIMPLE_MODEL"},
+    ],
+}
+
+ROUTER_GRAPH = {
+    "name": "r",
+    "type": "ROUTER",
+    "fallback": 1,
+    "children": [
+        {"name": "a", "implementation": "SIMPLE_MODEL"},
+        {"name": "b", "implementation": "SIMPLE_MODEL"},
+    ],
+}
+ROUTER_COMPONENTS = [
+    {"name": "r", "runtime": "inprocess", "class_path": "chaos.Router0"}
+]
+
+
+def _faulty(executor: GraphExecutor, name: str, spec: FaultSpec, seed=1):
+    executor.runtimes[name] = FaultyNodeRuntime(
+        executor.runtimes[name], spec, seed=seed
+    )
+    return executor.runtimes[name]
+
+
+# ---------------------------------------------------------------------------
+# combiner quorum
+# ---------------------------------------------------------------------------
+
+
+def test_combiner_quorum_survives_dead_child_end_to_end():
+    """One of three ensemble members at 100% errors: the predictor keeps
+    serving 200s over REST, annotating the dropped branch."""
+    spec = _deployment(COMBINER_GRAPH)
+    default_and_validate(spec)
+
+    async def run():
+        executor = GraphExecutor(spec.predictor())
+        _faulty(executor, "b", FaultSpec(error_rate=1.0))
+        engine = EngineService(
+            spec, extra_runtimes=executor.runtimes, force_host=True
+        )
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                for _ in range(5):
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    ) as r:
+                        assert r.status == 200
+                        d = json.loads(await r.text())
+                    assert d["status"]["status"] == "SUCCESS"
+                    assert d["meta"]["tags"]["seldon.degraded.ens"] == ["b"]
+                    assert np.asarray(d["data"]["ndarray"]).shape == (1, 3)
+        finally:
+            await runner.cleanup()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+def test_combiner_below_quorum_fails():
+    """Two of three members dead < quorum 2: the request fails instead of
+    serving a single-member 'ensemble' silently."""
+    spec = _deployment(COMBINER_GRAPH)
+
+    async def run():
+        executor = GraphExecutor(spec.predictor())
+        _faulty(executor, "a", FaultSpec(error_rate=1.0))
+        _faulty(executor, "b", FaultSpec(timeout_rate=1.0))
+        with pytest.raises(Exception) as exc_info:
+            await executor.predict(SeldonMessage.from_array(np.ones((1, 2))))
+        # the first child failure propagates, not a quorum-internal error
+        assert "injected" in str(exc_info.value)
+
+    asyncio.run(run())
+
+
+def test_combiner_quorum_drops_malformed_child():
+    """A child returning garbage (no tensor payload) is a failed branch
+    under quorum, not a poisoned aggregate."""
+    spec = _deployment(COMBINER_GRAPH)
+
+    async def run():
+        executor = GraphExecutor(spec.predictor())
+        _faulty(executor, "c", FaultSpec(malformed_rate=1.0))
+        resp = await executor.predict(SeldonMessage.from_array(np.ones((1, 2))))
+        assert resp.meta.tags["seldon.degraded.ens"] == ["c"]
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# router fallback
+# ---------------------------------------------------------------------------
+
+
+def test_router_serves_fallback_when_branch_fails():
+    spec = _deployment(ROUTER_GRAPH, ROUTER_COMPONENTS)
+    default_and_validate(spec)
+
+    async def run():
+        executor = GraphExecutor(spec.predictor())
+        _faulty(executor, "a", FaultSpec(error_rate=1.0))
+        resp = await executor.predict(SeldonMessage.from_array(np.ones((1, 2))))
+        assert resp.status is not None and resp.status.status == "SUCCESS"
+        # routing records the branch that ACTUALLY served (feedback
+        # replay must train the fallback, not the dead branch)
+        assert resp.meta.routing["r"] == 1
+        assert resp.meta.tags["seldon.fallback.r"] == 1
+
+    asyncio.run(run())
+
+
+def test_router_open_breaker_branch_serves_via_fallback_end_to_end():
+    """The routed branch's circuit breaker is open: the call fails fast
+    (zero network attempts) and the fallback branch serves — visible in
+    /stats, /ready, and the Prometheus exposition."""
+    # child 'a' bound as a REST remote (no in-process implementation): the
+    # engine auto-wires a resilient client for it, breaker included
+    graph = {
+        "name": "r",
+        "type": "ROUTER",
+        "fallback": 1,
+        "children": [
+            {"name": "a", "type": "MODEL"},
+            {"name": "b", "implementation": "SIMPLE_MODEL"},
+        ],
+    }
+    spec = _deployment(
+        graph,
+        ROUTER_COMPONENTS
+        + [{"name": "a", "runtime": "rest", "host": "127.0.0.1", "port": 1}],
+    )
+
+    async def run():
+        engine = EngineService(spec)
+        assert engine.mode == "host"
+        breaker = engine.breakers["a"]
+        breaker.trip()  # the branch is known-dead before any traffic
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                ) as r:
+                    assert r.status == 200
+                    d = json.loads(await r.text())
+                assert d["meta"]["routing"]["r"] == 1  # fallback served
+                assert d["meta"]["tags"]["seldon.fallback.r"] == 1
+                assert "BreakerOpenError" in (
+                    d["meta"]["tags"]["seldon.fallback.r.reason"]
+                )
+
+                # breaker state visible in /stats ...
+                async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                    stats = json.loads(await r.text())
+                assert stats["resilience"]["breakers"]["a"]["state"] == "open"
+                # ... in /ready ...
+                async with s.get(f"http://127.0.0.1:{port}/ready") as r:
+                    assert r.status == 200
+                    assert "breakers open: a" in await r.text()
+                # ... and in the Prometheus exposition
+                async with s.get(f"http://127.0.0.1:{port}/prometheus") as r:
+                    expo = await r.text()
+                if "seldon_api" in expo:  # prometheus_client installed
+                    assert "seldon_tpu_breaker_state" in expo
+                    assert 'seldon_tpu_breaker_state{node="a"} 1.0' in expo
+
+                # close the breaker: /ready drops the annotation and the
+                # transition counters carry the full history
+                breaker.reset()
+                async with s.get(f"http://127.0.0.1:{port}/ready") as r:
+                    assert await r.text() == "ready"
+                async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                    stats = json.loads(await r.text())
+                trans = stats["telemetry"]["resilience"]["breaker_transitions"]
+                assert trans.get("a:open", 0) >= 1
+                assert trans.get("a:closed", 0) >= 1
+        finally:
+            await runner.cleanup()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end deadline
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_deadline_respected_end_to_end():
+    """Seldon-Deadline-Ms set at the edge bounds the WHOLE request across
+    a slow remote node and the client's retry loop: per-try timeout 5 s x
+    3 attempts under a 500 ms budget answers in well under one per-try
+    timeout (±1 retry backoff), not 15 s."""
+    from aiohttp import web
+
+    async def run():
+        # a unit server that hangs far beyond any sane budget
+        async def hang(request):
+            await asyncio.sleep(30)
+
+        uapp = web.Application()
+        uapp.router.add_post("/predict", hang)
+        urunner = web.AppRunner(uapp)
+        await urunner.setup()
+        uport = await _free_port()
+        await web.TCPSite(urunner, "127.0.0.1", uport).start()
+
+        graph = {"name": "m", "type": "MODEL"}
+        comps = [{"name": "m", "runtime": "rest", "host": "127.0.0.1",
+                  "port": uport}]
+        spec = _deployment(graph, comps)
+        engine = EngineService(spec)  # auto-wires a resilient REST client
+        assert engine.mode == "host"
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                t0 = time.monotonic()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    headers={"Seldon-Deadline-Ms": "500"},
+                ) as r:
+                    elapsed = time.monotonic() - t0
+                    body = json.loads(await r.text())
+                assert r.status in (502, 504), body
+                assert body["status"]["status"] == "FAILURE"
+                # 0.5 s budget + one max backoff + slack — NOT 5 s, NOT 15 s
+                assert elapsed < 2.5, f"timeouts stacked: {elapsed:.1f}s"
+        finally:
+            await runner.cleanup()
+            await engine.close()
+            await urunner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_deadline_set_at_gateway_respected_through_full_chain():
+    """client -> gateway (header) -> engine (forwarded header) -> node
+    client (clamped attempt timeouts) -> hung unit: the budget set once at
+    the gateway bounds the whole chain."""
+    from aiohttp import web
+
+    from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+    from seldon_core_tpu.gateway.apife import make_gateway_app
+
+    async def run():
+        async def hang(request):
+            await asyncio.sleep(30)
+
+        uapp = web.Application()
+        uapp.router.add_post("/predict", hang)
+        urunner = web.AppRunner(uapp)
+        await urunner.setup()
+        uport = await _free_port()
+        await web.TCPSite(urunner, "127.0.0.1", uport).start()
+
+        spec = _deployment(
+            {"name": "m", "type": "MODEL"},
+            [{"name": "m", "runtime": "rest", "host": "127.0.0.1",
+              "port": uport}],
+        )
+        engine = EngineService(spec)
+        eport = await _free_port()
+        erunner = await serve_app(make_engine_app(engine), "127.0.0.1", eport)
+
+        store = DeploymentStore()
+        store.register(spec, {"p": f"http://127.0.0.1:{eport}"})
+        gw = ApiGateway(store=store, require_auth=False)
+        gport = await _free_port()
+        grunner = await serve_app(make_gateway_app(gw), "127.0.0.1", gport)
+        try:
+            async with aiohttp.ClientSession() as s:
+                t0 = time.monotonic()
+                async with s.post(
+                    f"http://127.0.0.1:{gport}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    headers={"Seldon-Deadline-Ms": "500"},
+                ) as r:
+                    body = json.loads(await r.text())
+                elapsed = time.monotonic() - t0
+                assert body["status"]["status"] == "FAILURE"
+                # 0.5 s budget honored across gateway + engine + node hops
+                # (±1 retry backoff): nowhere near the 20 s gateway / 5 s
+                # node-client per-try timeouts, let alone their product
+                assert elapsed < 2.5, f"timeouts stacked: {elapsed:.1f}s"
+        finally:
+            await grunner.cleanup()
+            await erunner.cleanup()
+            await engine.close()
+            await urunner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_expired_deadline_fails_fast_without_calling_nodes():
+    spec = _deployment(COMBINER_GRAPH)
+
+    async def run():
+        executor = GraphExecutor(spec.predictor())
+        probe = _faulty(executor, "a", FaultSpec())  # pure call counter
+        from seldon_core_tpu.runtime.resilience import deadline_scope
+
+        with deadline_scope(0.0001):
+            await asyncio.sleep(0.01)
+            resp = None
+            try:
+                resp = await executor.predict(
+                    SeldonMessage.from_array(np.ones((1, 2)))
+                )
+            except Exception as e:
+                assert type(e).__name__ == "DeadlineExceededError"
+            assert resp is None
+        assert probe.calls == {}  # no node was dialed after expiry
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# satellites: pause/drain with in-flight traffic, dispatch-timeout 504
+# ---------------------------------------------------------------------------
+
+
+def test_pause_drains_with_inflight_requests():
+    """/pause flips /ready to 503 while (a) requests already in flight
+    complete 200 and (b) requests arriving during the drain window still
+    serve — the preStop contract under real concurrency."""
+    spec = _deployment(COMBINER_GRAPH)
+
+    async def run():
+        executor = GraphExecutor(spec.predictor())
+        _faulty(executor, "a", FaultSpec(delay_s=0.4))  # slow, not broken
+        engine = EngineService(
+            spec, extra_runtimes=executor.runtimes, force_host=True
+        )
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+
+                async def predict_once():
+                    async with s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    ) as r:
+                        return r.status, json.loads(await r.text())
+
+                inflight = asyncio.create_task(predict_once())
+                await asyncio.sleep(0.1)  # request is mid-graph now
+                async with s.get(f"http://127.0.0.1:{port}/pause") as r:
+                    assert r.status == 200
+                async with s.get(f"http://127.0.0.1:{port}/ready") as r:
+                    assert r.status == 503  # drained out of rotation
+                # /stats reports the pause for operators
+                async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                    assert json.loads(await r.text())["engine"]["paused"]
+                # the in-flight request completes normally
+                status, body = await inflight
+                assert status == 200 and body["status"]["status"] == "SUCCESS"
+                # a late request during the drain window still serves
+                status, body = await predict_once()
+                assert status == 200
+                async with s.get(f"http://127.0.0.1:{port}/unpause") as r:
+                    assert r.status == 200
+                async with s.get(f"http://127.0.0.1:{port}/ready") as r:
+                    assert r.status == 200
+        finally:
+            await runner.cleanup()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+def test_dispatch_timeout_propagates_504_through_rest_and_stats():
+    """A wedged device dispatch surfaces as DispatchTimeoutError -> 504
+    FAILURE over REST (not a request that never returns), and /stats stays
+    serviceable afterwards."""
+    spec = _deployment({"name": "m", "implementation": "SIMPLE_MODEL",
+                        "type": "MODEL"})
+
+    async def run():
+        engine = EngineService(spec, max_wait_ms=0.5)
+        assert engine.mode == "compiled" and engine.batcher is not None
+        engine.dispatch_timeout_s = 0.2
+
+        async def wedged(rows):
+            await asyncio.sleep(60)
+
+        engine.batcher.submit = wedged  # the device never answers
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                t0 = time.monotonic()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                ) as r:
+                    body = json.loads(await r.text())
+                assert r.status == 504
+                assert body["status"]["status"] == "FAILURE"
+                assert "dispatch" in body["status"]["info"]
+                assert time.monotonic() - t0 < 5.0
+                # the engine still answers /stats and /ready after the hang
+                async with s.get(f"http://127.0.0.1:{port}/stats") as r:
+                    assert r.status == 200
+                    stats = json.loads(await r.text())
+                assert stats["engine"]["dispatch_timeout_s"] == 0.2
+                async with s.get(f"http://127.0.0.1:{port}/ready") as r:
+                    assert r.status == 200
+        finally:
+            await runner.cleanup()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+def test_deadline_bounds_dispatch_timeout():
+    """A request-level budget tighter than dispatch_timeout_s wins: the
+    504 arrives when the BUDGET expires, typed as a deadline error."""
+    spec = _deployment({"name": "m", "implementation": "SIMPLE_MODEL",
+                        "type": "MODEL"})
+
+    async def run():
+        engine = EngineService(spec, max_wait_ms=0.5, dispatch_timeout_s=30.0)
+        assert engine.batcher is not None
+
+        async def wedged(rows):
+            await asyncio.sleep(60)
+
+        engine.batcher.submit = wedged
+        port = await _free_port()
+        runner = await serve_app(make_engine_app(engine), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as s:
+                t0 = time.monotonic()
+                async with s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    headers={"Seldon-Deadline-Ms": "300"},
+                ) as r:
+                    body = json.loads(await r.text())
+                elapsed = time.monotonic() - t0
+                assert r.status == 504
+                assert "deadline" in body["status"]["info"]
+                assert elapsed < 5.0, elapsed  # budget won, not the 30 s ceiling
+        finally:
+            await runner.cleanup()
+            await engine.close()
+
+    asyncio.run(run())

@@ -1,0 +1,619 @@
+"""Resilience-layer unit tests (runtime/resilience.py): deadline budget
+math, retry policy/budget classification, circuit-breaker state machine
+(fake clock), fault-injection determinism, and the REST/gRPC client retry
+choreography the reference never had (REST retried everything blindly with
+stacking timeouts, gRPC retried nothing)."""
+
+import asyncio
+import random
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import ComponentBinding, PredictiveUnit
+from seldon_core_tpu.messages import (
+    DeadlineExceededError,
+    Feedback,
+    SeldonMessage,
+)
+from seldon_core_tpu.runtime.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    Deadline,
+    RetryBudget,
+    RetryPolicy,
+    clamp_timeout,
+    deadline_ms_header,
+    deadline_scope,
+    is_idempotent,
+    remaining_s,
+)
+
+
+# ---------------------------------------------------------------------------
+# deadline budget
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_scope_clamps_and_expires():
+    assert remaining_s() is None  # no ambient deadline
+    with deadline_scope(10.0):
+        rem = remaining_s()
+        assert rem is not None and 9.0 < rem <= 10.0
+        # a generous per-try timeout is clamped to the remaining budget
+        assert clamp_timeout(60.0) <= 10.0
+        # nested scopes can only tighten, never extend
+        with deadline_scope(2.0):
+            assert remaining_s() <= 2.0
+            with deadline_scope(500.0):
+                assert remaining_s() <= 2.0
+        assert remaining_s() <= 10.0
+    assert remaining_s() is None
+
+
+def test_expired_deadline_raises_before_io():
+    t = {"now": 100.0}
+    dl = Deadline(100.5, clock=lambda: t["now"])
+    assert not dl.expired
+    t["now"] = 101.0
+    assert dl.expired
+    with deadline_scope(-1.0):
+        with pytest.raises(DeadlineExceededError):
+            clamp_timeout(5.0, where="test")
+
+
+def test_deadline_header_parsing_is_lenient():
+    assert deadline_ms_header(None) is None
+    assert deadline_ms_header("") is None
+    assert deadline_ms_header("garbage") is None
+    assert deadline_ms_header("-50") is None
+    assert deadline_ms_header("0") is None
+    assert deadline_ms_header("1500") == pytest.approx(1.5)
+
+
+def test_deadline_inherited_across_task_fanout():
+    """asyncio tasks copy the context at creation — the budget set at the
+    edge is visible inside gather() fan-out without explicit threading."""
+
+    async def child():
+        return remaining_s()
+
+    async def run():
+        with deadline_scope(5.0):
+            rems = await asyncio.gather(child(), child())
+        return rems
+
+    rems = asyncio.run(run())
+    assert all(r is not None and 0 < r <= 5.0 for r in rems)
+
+
+# ---------------------------------------------------------------------------
+# retry policy + budget
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_classification():
+    p = RetryPolicy()
+    for status in (429, 502, 503, 504):
+        assert p.retryable_http(status)
+    for status in (200, 400, 404, 500, 501):
+        assert not p.retryable_http(status)
+    assert p.retryable_grpc("UNAVAILABLE")
+    assert p.retryable_grpc("RESOURCE_EXHAUSTED")
+    assert not p.retryable_grpc("DEADLINE_EXCEEDED")
+    assert not p.retryable_grpc("INVALID_ARGUMENT")
+
+
+def test_retry_policy_backoff_full_jitter():
+    p = RetryPolicy(
+        base_backoff_s=0.1, max_backoff_s=0.4, rng=random.Random(42)
+    )
+    for attempt, cap in [(0, 0.1), (1, 0.2), (2, 0.4), (5, 0.4)]:
+        samples = [p.backoff_s(attempt) for _ in range(200)]
+        assert all(0.0 <= s <= cap for s in samples)
+    # deterministic under a seeded rng
+    a = RetryPolicy(rng=random.Random(7)).backoff_s(1)
+    b = RetryPolicy(rng=random.Random(7)).backoff_s(1)
+    assert a == b
+
+
+def test_method_idempotency_gating():
+    assert is_idempotent("predict")
+    assert is_idempotent("transform_input")
+    assert is_idempotent("transform_output")
+    assert is_idempotent("aggregate")
+    assert not is_idempotent("route")
+    assert not is_idempotent("send_feedback")
+
+
+def test_retry_budget_token_bucket():
+    b = RetryBudget(deposit_per_call=0.5, initial_tokens=2.0, max_tokens=3.0)
+    assert b.withdraw() and b.withdraw()
+    assert not b.withdraw()  # empty
+    assert b.exhausted_total == 1
+    for _ in range(10):
+        b.deposit()
+    assert b.tokens == 3.0  # capped
+    assert b.withdraw()
+    snap = b.snapshot()
+    assert snap["exhausted_total"] == 1 and snap["max_tokens"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (fake clock: fully deterministic)
+# ---------------------------------------------------------------------------
+
+
+def _breaker(**kw):
+    t = {"now": 0.0}
+    br = CircuitBreaker(
+        "node-x",
+        window_s=kw.pop("window_s", 10.0),
+        min_calls=kw.pop("min_calls", 4),
+        failure_ratio=kw.pop("failure_ratio", 0.5),
+        open_s=kw.pop("open_s", 5.0),
+        clock=lambda: t["now"],
+        **kw,
+    )
+    return br, t
+
+
+def test_breaker_opens_on_failure_rate():
+    br, t = _breaker()
+    for _ in range(3):
+        assert br.allow()
+        br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    # 3 ok + 3 fail = 50% over >= min_calls -> open
+    for _ in range(3):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()  # fail-fast while open
+
+
+def test_breaker_half_open_probe_closes_or_reopens():
+    br, t = _breaker(min_calls=2, failure_ratio=0.5)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    t["now"] += 5.1  # cooldown elapses -> half-open admits ONE probe
+    assert br.allow()
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()  # second concurrent probe refused
+    br.record_failure()  # probe fails -> re-open for another cooldown
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    t["now"] += 5.1
+    assert br.allow()
+    br.record_success()  # probe succeeds -> closed, window reset
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.snapshot()["window_calls"] == 0
+
+
+def test_breaker_window_slides():
+    br, t = _breaker(window_s=10.0, min_calls=4)
+    br.record_failure()
+    br.record_failure()
+    t["now"] += 60.0  # old failures age out of the window
+    br.record_success()
+    br.record_success()
+    br.record_success()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # 1/4 < 50%
+
+
+def test_breaker_state_exported_to_flight_recorder():
+    from seldon_core_tpu.utils.telemetry import RECORDER
+
+    br, t = _breaker(min_calls=2)
+    br.trip()
+    snap = RECORDER.snapshot()["resilience"]
+    assert snap["breaker_states"]["node-x"] == "open"
+    assert snap["breaker_transitions"].get("node-x:open", 0) >= 1
+    expo = RECORDER.exposition()
+    if expo:  # prometheus_client installed
+        assert b"seldon_tpu_breaker_state" in expo
+        assert b"seldon_tpu_breaker_transitions_total" in expo
+    br.reset()
+    assert RECORDER.snapshot()["resilience"]["breaker_states"]["node-x"] == "closed"
+
+
+def test_half_open_probe_slot_released_on_pre_call_failure():
+    """An exception BETWEEN the breaker gate and the call (expired
+    deadline before any I/O) must release the half-open probe slot —
+    otherwise the breaker wedges open forever and 'recovery is automatic'
+    becomes a lie."""
+    from aiohttp import web
+
+    async def run():
+        t = {"now": 0.0}
+        br = CircuitBreaker("n", min_calls=2, open_s=5.0,
+                            clock=lambda: t["now"])
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        t["now"] += 5.1  # cooldown over: next allow() admits ONE probe
+
+        app = web.Application()
+        ok_body = SeldonMessage.from_array(np.ones((1, 2))).to_json()
+
+        async def healthy(request):
+            return web.Response(text=ok_body, content_type="application/json")
+
+        app.router.add_post("/predict", healthy)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        rt = _rest_runtime(port, breaker=br)
+        msg = SeldonMessage.from_array(np.ones((1, 2)))
+        try:
+            # probe admitted, then the expired budget aborts BEFORE I/O
+            with deadline_scope(-1.0):
+                with pytest.raises(DeadlineExceededError):
+                    await rt.predict(msg)
+            assert br.state == CircuitBreaker.HALF_OPEN
+            # the slot was released: the recovered node IS probed again,
+            # and the successful probe closes the breaker
+            out = await rt.predict(msg)
+            assert out.data is not None
+            assert br.state == CircuitBreaker.CLOSED
+        finally:
+            await rt.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_deadline_header_value_never_serializes_to_zero():
+    from seldon_core_tpu.runtime.resilience import deadline_header_value
+
+    assert deadline_header_value() is None  # no ambient deadline
+    with deadline_scope(0.0004):  # 0.4 ms left: floors to 1, not "0"
+        v = deadline_header_value()
+        assert v == "1"
+        assert deadline_ms_header(v) is not None  # downstream still bounded
+
+
+# ---------------------------------------------------------------------------
+# fault injection determinism
+# ---------------------------------------------------------------------------
+
+
+def test_faulty_runtime_is_deterministic():
+    from seldon_core_tpu.graph.interpreter import NodeRuntime
+    from seldon_core_tpu.testing.faults import FaultSpec, FaultyNodeRuntime
+
+    class Echo(NodeRuntime):
+        async def predict(self, msg):
+            return msg
+
+    async def outcomes(seed):
+        rt = FaultyNodeRuntime(Echo(), FaultSpec(error_rate=0.5), seed=seed)
+        seq = []
+        for _ in range(20):
+            try:
+                await rt.predict(SeldonMessage.from_array(np.ones((1, 2))))
+                seq.append("ok")
+            except Exception:
+                seq.append("err")
+        return seq
+
+    a = asyncio.run(outcomes(123))
+    b = asyncio.run(outcomes(123))
+    c = asyncio.run(outcomes(124))
+    assert a == b  # same seed -> same fault sequence
+    assert a != c  # different seed -> different sequence (w.h.p.)
+    assert "err" in a and "ok" in a
+
+
+# ---------------------------------------------------------------------------
+# REST client choreography (live loopback server)
+# ---------------------------------------------------------------------------
+
+
+async def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _rest_runtime(port, **kw):
+    node = PredictiveUnit(name="n")
+    binding = ComponentBinding(
+        name="n", runtime="rest", host="127.0.0.1", port=port
+    )
+    kw.setdefault(
+        "retry_policy",
+        RetryPolicy(base_backoff_s=0.001, max_backoff_s=0.002,
+                    rng=random.Random(0)),
+    )
+    from seldon_core_tpu.runtime.client import RestNodeRuntime
+
+    return RestNodeRuntime(node, binding, **kw)
+
+
+def test_rest_client_retries_transient_5xx_not_4xx_or_500():
+    from aiohttp import web
+
+    from seldon_core_tpu.runtime.client import RemoteCallError
+
+    calls = {"flaky": 0, "bad": 0, "buggy": 0}
+    ok_body = SeldonMessage.from_array(np.ones((1, 2))).to_json()
+
+    async def flaky(request):  # 503 twice, then healthy
+        calls["flaky"] += 1
+        if calls["flaky"] < 3:
+            return web.Response(status=503, text="overloaded")
+        return web.Response(text=ok_body, content_type="application/json")
+
+    async def bad(request):  # deterministic client error
+        calls["bad"] += 1
+        return web.Response(status=400, text="bad payload")
+
+    async def buggy(request):  # deterministic handler bug: 500 not retried
+        calls["buggy"] += 1
+        return web.Response(status=500, text="NPE")
+
+    async def run():
+        app = web.Application()
+        app.router.add_post("/predict", flaky)
+        app.router.add_post("/transform-input", bad)
+        app.router.add_post("/transform-output", buggy)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = await _free_port()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        rt = _rest_runtime(port, retry_budget=RetryBudget())
+        msg = SeldonMessage.from_array(np.ones((1, 2)))
+        try:
+            out = await rt.predict(msg)  # survives two 503s
+            assert out.data is not None
+            assert calls["flaky"] == 3
+            with pytest.raises(RemoteCallError):
+                await rt.transform_input(msg)
+            assert calls["bad"] == 1  # 4xx never retried
+            with pytest.raises(RemoteCallError):
+                await rt.transform_output(msg)
+            assert calls["buggy"] == 1  # plain 500 never retried
+        finally:
+            await rt.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_rest_client_never_retries_feedback_or_route():
+    from aiohttp import web
+
+    from seldon_core_tpu.runtime.client import RemoteCallError
+
+    calls = {"fb": 0, "route": 0}
+
+    async def fb(request):
+        calls["fb"] += 1
+        return web.Response(status=503, text="down")
+
+    async def route(request):
+        calls["route"] += 1
+        return web.Response(status=503, text="down")
+
+    async def run():
+        app = web.Application()
+        app.router.add_post("/send-feedback", fb)
+        app.router.add_post("/route", route)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        rt = _rest_runtime(port)
+        try:
+            with pytest.raises(RemoteCallError):
+                await rt.send_feedback(Feedback(), -1)
+            with pytest.raises(RemoteCallError):
+                await rt.route(SeldonMessage.from_array(np.ones((1, 2))))
+            # the satellite fix: exactly ONE attempt each (the reference
+            # retried non-idempotent methods blindly)
+            assert calls == {"fb": 1, "route": 1}
+        finally:
+            await rt.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_rest_client_attempts_share_one_deadline_budget():
+    """The satellite fix for timeout stacking: per-attempt timeouts draw
+    from the shared budget, so 3 attempts x 5 s client timeout under a
+    0.6 s deadline fail in ~0.6 s, not 15 s."""
+    from aiohttp import web
+
+    from seldon_core_tpu.runtime.client import RemoteCallError
+
+    async def hang(request):
+        await asyncio.sleep(30)
+
+    async def run():
+        app = web.Application()
+        app.router.add_post("/predict", hang)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        rt = _rest_runtime(port, timeout_s=5.0)
+        t0 = time.monotonic()
+        try:
+            with deadline_scope(0.6):
+                with pytest.raises((RemoteCallError, DeadlineExceededError)):
+                    await rt.predict(SeldonMessage.from_array(np.ones((1, 2))))
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3.0, f"timeouts stacked: {elapsed:.1f}s"
+        finally:
+            await rt.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_rest_client_breaker_fails_fast_without_io():
+    from seldon_core_tpu.runtime.client import RestNodeRuntime  # noqa: F401
+
+    async def run():
+        br = CircuitBreaker("n", open_s=60.0)
+        br.trip()
+        rt = _rest_runtime(1, breaker=br)  # port 1: would fail if dialed
+        t0 = time.monotonic()
+        with pytest.raises(BreakerOpenError):
+            await rt.predict(SeldonMessage.from_array(np.ones((1, 2))))
+        assert time.monotonic() - t0 < 0.5  # no connect attempt/backoff
+        await rt.close()
+
+    asyncio.run(run())
+
+
+def test_retry_budget_caps_retry_amplification():
+    """Under a 100%-failure node, a drained budget stops retries: total
+    attempts approach 1x offered load instead of max_attempts x."""
+    from aiohttp import web
+
+    from seldon_core_tpu.runtime.client import RemoteCallError
+
+    calls = {"n": 0}
+
+    async def down(request):
+        calls["n"] += 1
+        return web.Response(status=503, text="down")
+
+    async def run():
+        app = web.Application()
+        app.router.add_post("/predict", down)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        budget = RetryBudget(deposit_per_call=0.0, initial_tokens=4.0)
+        rt = _rest_runtime(port, retry_budget=budget)
+        msg = SeldonMessage.from_array(np.ones((1, 2)))
+        try:
+            for _ in range(20):
+                with pytest.raises(RemoteCallError):
+                    await rt.predict(msg)
+            # 4 budget tokens -> at most 20 first attempts + 4 retries
+            assert calls["n"] <= 24
+            assert budget.exhausted_total > 0
+        finally:
+            await rt.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# gRPC client retry parity (the reference's gRPC path had NO retries)
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_client_retries_unavailable():
+    grpc = pytest.importorskip("grpc")
+
+    from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+    from seldon_core_tpu.runtime.client import GrpcNodeRuntime, RemoteCallError
+
+    node = PredictiveUnit(name="g")
+    binding = ComponentBinding(name="g", runtime="grpc", host="127.0.0.1", port=1)
+
+    def _unavailable():
+        return grpc.aio.AioRpcError(
+            grpc.StatusCode.UNAVAILABLE,
+            grpc.aio.Metadata(),
+            grpc.aio.Metadata(),
+            details="connection reset",
+        )
+
+    async def run():
+        rt = GrpcNodeRuntime(
+            node, binding,
+            retry_policy=RetryPolicy(
+                base_backoff_s=0.001, max_backoff_s=0.002,
+                rng=random.Random(0),
+            ),
+            retry_budget=RetryBudget(),
+        )
+        ok = pb.SeldonMessage()
+        ok.data.tensor.shape.extend([1, 1])
+        ok.data.tensor.values.extend([3.0])
+        calls = {"n": 0}
+
+        async def flaky_stub(req, timeout=None):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise _unavailable()
+            return ok
+
+        flaky_stub._method = b"/seldon.protos.Model/Predict"
+        out = await rt._call(flaky_stub, pb.SeldonMessage(), "predict")
+        assert calls["n"] == 3  # two transient UNAVAILABLEs survived
+        assert float(np.asarray(out.array()).ravel()[0]) == 3.0
+
+        # non-idempotent method: one attempt even on UNAVAILABLE
+        calls["n"] = 0
+
+        async def down_stub(req, timeout=None):
+            calls["n"] += 1
+            raise _unavailable()
+
+        down_stub._method = b"/seldon.protos.Router/Route"
+        with pytest.raises(RemoteCallError):
+            await rt._call(down_stub, pb.SeldonMessage(), "route")
+        assert calls["n"] == 1
+
+        # non-retryable code: one attempt
+        calls["n"] = 0
+
+        async def invalid_stub(req, timeout=None):
+            calls["n"] += 1
+            raise grpc.aio.AioRpcError(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                grpc.aio.Metadata(), grpc.aio.Metadata(), details="bad",
+            )
+
+        invalid_stub._method = b"/seldon.protos.Model/Predict"
+        with pytest.raises(RemoteCallError):
+            await rt._call(invalid_stub, pb.SeldonMessage(), "predict")
+        assert calls["n"] == 1
+        await rt.close()
+
+    asyncio.run(run())
+
+
+def test_grpc_client_deadline_clamps_attempt_timeout():
+    pytest.importorskip("grpc")
+
+    from seldon_core_tpu.proto_gen import prediction_pb2 as pb
+    from seldon_core_tpu.runtime.client import GrpcNodeRuntime
+
+    node = PredictiveUnit(name="g")
+    binding = ComponentBinding(name="g", runtime="grpc", host="127.0.0.1", port=1)
+
+    async def run():
+        rt = GrpcNodeRuntime(node, binding, timeout_s=5.0)
+        seen = {}
+
+        async def capture_stub(req, timeout=None):
+            seen["timeout"] = timeout
+            return pb.SeldonMessage()
+
+        capture_stub._method = b"/seldon.protos.Model/Predict"
+        with deadline_scope(0.5):
+            await rt._call(capture_stub, pb.SeldonMessage(), "predict")
+        assert seen["timeout"] <= 0.5  # clamped to the budget, not 5 s
+        await rt.close()
+
+    asyncio.run(run())
