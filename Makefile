@@ -17,6 +17,12 @@ test:
 chaos:
 	python -m pytest tests/ -q -m chaos
 
+# causal-tracing demo: 3-node graph under fault injection, one traced
+# request tree with retries/backoff, exported Perfetto-loadable artifact
+# (trace_demo/trace.json) + critical-path summary (scripts/trace_demo.py)
+trace-demo:
+	python scripts/trace_demo.py --out trace_demo
+
 bench:
 	python bench.py
 
@@ -61,4 +67,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test bench demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo bench demos train-demo stack bundle images publish release-dryrun

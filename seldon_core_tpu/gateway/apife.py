@@ -178,10 +178,19 @@ class ApiGateway:
     async def predict(
         self, msg: SeldonMessage, token: Optional[str] = None
     ) -> SeldonMessage:
+        from seldon_core_tpu.utils.tracing import TRACER
+
         reg = self._resolve(token)
         with self.metrics.time_ingress("predictions", "POST") as code:
             predictor_name, engine = self._pick_engine(reg)
-            resp = await self._dispatch_predict(engine, msg)
+            # the ingress span roots the request tree (or joins the
+            # caller's trace when it sent a traceparent); the engine hop —
+            # in-process or HTTP — becomes its child
+            with TRACER.span(
+                msg.meta.puid, "gateway", kind="request", method="predict",
+                deployment=reg.deployment_id, predictor=predictor_name,
+            ):
+                resp = await self._dispatch_predict(engine, msg)
             # record which predictor served (canary observability; feedback
             # routes back to the same predictor)
             resp.meta.requestPath.setdefault("predictor", predictor_name)
@@ -194,13 +203,20 @@ class ApiGateway:
     async def send_feedback(
         self, feedback: Feedback, token: Optional[str] = None
     ) -> SeldonMessage:
+        from seldon_core_tpu.utils.tracing import TRACER
+
         reg = self._resolve(token)
         with self.metrics.time_ingress("feedback", "POST"):
             predictor = None
             if feedback.response is not None:
                 predictor = feedback.response.meta.requestPath.get("predictor")
+            fb_puid = feedback.puid()
             _, engine = self._pick_engine(reg, predictor)
-            return await self._dispatch_feedback(engine, feedback)
+            with TRACER.span(
+                fb_puid, "gateway", kind="request", method="feedback",
+                deployment=reg.deployment_id,
+            ):
+                return await self._dispatch_feedback(engine, feedback)
 
     async def _dispatch_predict(self, engine, msg: SeldonMessage) -> SeldonMessage:
         if hasattr(engine, "predict"):  # in-process EngineService
@@ -239,7 +255,7 @@ class ApiGateway:
             # deadline set AT the gateway is honored end-to-end instead of
             # resetting per hop (or per connect-retry)
             total = 20.0
-            headers = None
+            headers = {}
             rem = remaining_s()
             if rem is not None:
                 if rem <= 0:
@@ -247,7 +263,18 @@ class ApiGateway:
                         "request deadline exhausted at gateway", code=504
                     )
                 total = min(total, rem)
-                headers = {DEADLINE_HEADER: deadline_header_value()}
+                headers[DEADLINE_HEADER] = deadline_header_value()
+            # trace context rides to the remote engine alongside the
+            # deadline, so its spans join the gateway's tree
+            from seldon_core_tpu.utils.tracing import (
+                TRACEPARENT_HEADER,
+                traceparent_header_value,
+            )
+
+            tp = traceparent_header_value()
+            if tp is not None:
+                headers[TRACEPARENT_HEADER] = tp
+            headers = headers or None
             timeout = aiohttp.ClientTimeout(total=total)
             try:
                 async with session.post(
@@ -332,9 +359,19 @@ def make_gateway_app(gateway: ApiGateway):
             msg = SeldonMessage.from_json(await _payload_text(request))
         except SeldonMessageError as e:
             return _error_response(str(e))
+        from seldon_core_tpu.utils.tracing import (
+            TRACEPARENT_HEADER,
+            parse_traceparent,
+            trace_scope,
+        )
+
         try:
-            # deadline set at the gateway governs the whole request tree
-            with maybe_deadline_scope(
+            # deadline set at the gateway governs the whole request tree;
+            # an incoming traceparent makes the gateway span the caller's
+            # child instead of a fresh root
+            with trace_scope(
+                parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+            ), maybe_deadline_scope(
                 deadline_ms_header(request.headers.get(DEADLINE_HEADER))
             ):
                 resp = await gateway.predict(msg, _bearer(request))
@@ -346,12 +383,22 @@ def make_gateway_app(gateway: ApiGateway):
         return _msg_response(resp, status=status)
 
     async def feedback(request):
+        from seldon_core_tpu.utils.tracing import (
+            TRACEPARENT_HEADER,
+            parse_traceparent,
+            trace_scope,
+        )
+
         try:
             fb = Feedback.from_json(await _payload_text(request))
         except SeldonMessageError as e:
             return _error_response(str(e))
         try:
-            with maybe_deadline_scope(
+            # same adoption contract as the predictions route: a caller's
+            # traceparent makes the feedback spans join its trace
+            with trace_scope(
+                parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
+            ), maybe_deadline_scope(
                 deadline_ms_header(request.headers.get(DEADLINE_HEADER))
             ):
                 ack = await gateway.send_feedback(fb, _bearer(request))

@@ -457,6 +457,11 @@ class GraphExecutor:
                 fb_msg.meta.routing[node.name] = fallback
                 out = await self._get_output(node.children[fallback], fb_msg)
                 RECORDER.record_degraded("fallback")
+                self.tracer.event(
+                    "fallback", node=node.name,
+                    from_branch=routed_branch, to_branch=int(fallback),
+                    reason=f"{type(e).__name__}: {str(e)[:120]}",
+                )
                 msg.meta.routing[node.name] = fallback
                 msg.meta.tags[f"seldon.fallback.{node.name}"] = int(fallback)
                 msg.meta.tags[f"seldon.fallback.{node.name}.reason"] = (
@@ -511,6 +516,9 @@ class GraphExecutor:
         if dropped:
             RECORDER.record_degraded("quorum")
             msg.meta.tags[f"seldon.degraded.{node.name}"] = sorted(dropped)
+            self.tracer.event(
+                "quorum_degraded", node=node.name, dropped=sorted(dropped)
+            )
         return ok_msgs
 
     # -- feedback path ------------------------------------------------------

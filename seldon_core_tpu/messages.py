@@ -547,6 +547,18 @@ class Feedback:
     reward: float = 0.0
     truth: Optional[SeldonMessage] = None
 
+    def puid(self) -> str:
+        """Correlation id of this feedback: the served response's puid
+        when present, else the original request's (a reward-only feedback
+        has no response payload but still belongs to a request).  The ONE
+        rule every lane shares — engine, gateway, unit apps, node
+        clients."""
+        if self.response is not None and self.response.meta.puid:
+            return self.response.meta.puid
+        if self.request is not None and self.request.meta.puid:
+            return self.request.meta.puid
+        return ""
+
     def to_json_dict(self) -> dict:
         out: dict = {"reward": float(self.reward)}
         if self.request is not None:

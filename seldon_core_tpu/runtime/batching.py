@@ -33,6 +33,7 @@ import numpy as np
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
 from seldon_core_tpu.utils.telemetry import RECORDER
+from seldon_core_tpu.utils.tracing import TRACER, current_trace_context
 
 __all__ = ["MicroBatcher", "graph_is_batchable"]
 
@@ -101,8 +102,10 @@ class MicroBatcher:
             x = np.atleast_2d(x)
         key = (x.shape[1:], x.dtype)  # np.dtype hashes fine; str() is ~5us
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        # trace context captured at enqueue: the flush task records each
+        # caller's queue wait as a span parented under ITS request span
         self._buckets.setdefault(key, deque()).append(
-            (x, fut, time.perf_counter())
+            (x, fut, time.perf_counter(), current_trace_context())
         )
         if key not in self._pumps:
             self._pumps[key] = asyncio.create_task(self._pump(key))
@@ -169,15 +172,37 @@ class MicroBatcher:
         xs = [e[0] for e in bucket]
         futs = [e[1] for e in bucket]
         now = time.perf_counter()
-        for _, _, t_enq in bucket:
-            self.recorder.observe_queue_wait(now - t_enq)
+        now_epoch = time.time()
+        for x, _, t_enq, ctx in bucket:
+            wait_s = now - t_enq
+            self.recorder.observe_queue_wait(wait_s)
+            if TRACER.enabled and ctx is not None:
+                # per-caller queue-wait span, parented under the caller's
+                # request span — the "queue" phase of the critical path
+                TRACER.record_span(
+                    "batch_queue", kind="queue", method="wait",
+                    start_s=now_epoch - wait_s,
+                    duration_ms=wait_s * 1e3,
+                    ctx=ctx, rows=len(x),
+                )
         try:
             stacked = np.concatenate(xs, axis=0)
             total = len(stacked)
             # occupancy = real client rows per dispatch (pre-padding: the
             # pad rows are compiler fodder, not served traffic)
             self.recorder.observe_batch(total)
+            t_flush = time.perf_counter()
             ys, aux = await self._dispatch_chunked(stacked)
+            if TRACER.enabled:
+                # one flush span per stacked dispatch; multi-request, so it
+                # stands alone (the per-request dependency is the queue
+                # span above + the engine's dispatch span)
+                TRACER.record_span(
+                    "flush", kind="batch", method="dispatch",
+                    start_s=now_epoch,
+                    duration_ms=(time.perf_counter() - t_flush) * 1e3,
+                    rows=total, requests=len(bucket),
+                )
             ys = np.asarray(ys)[:total]
             # one walk decides whether aux carries per-row arrays at all;
             # the common ({}, {}) routing/tags case then skips N tree walks

@@ -37,6 +37,7 @@ from seldon_core_tpu.messages import (
     SeldonMessageList,
 )
 from seldon_core_tpu.runtime.resilience import (
+    BreakerOpenError,
     CircuitBreaker,
     DEADLINE_HEADER,
     RetryBudget,
@@ -103,6 +104,8 @@ class _ResilientCallMixin:
         the global retry budget grant a token?  (3) sleep.  Checking the
         deadline BEFORE withdrawing means a deadline-doomed call cannot
         drain the shared budget other callers still need."""
+        from seldon_core_tpu.utils.tracing import TRACER
+
         delay = self.retry_policy.backoff_s(attempt)
         rem = remaining_s()
         if rem is not None and delay >= rem:
@@ -111,9 +114,33 @@ class _ResilientCallMixin:
         if self.retry_budget is not None and not self.retry_budget.withdraw():
             RECORDER.record_retry(method, "exhausted")
             return False
+        # the retry attempt (and its backoff sleep) become a span event on
+        # the active client span — the phase decomposition pulls
+        # retry+backoff time out of "network" with exactly this record
+        TRACER.event(
+            "retry",
+            method=method,
+            attempt=attempt + 1,
+            backoff_ms=round(delay * 1e3, 3),
+            deadline_remaining_ms=(
+                None if rem is None else round(rem * 1e3, 1)
+            ),
+        )
         if delay > 0:
             await asyncio.sleep(delay)
         return True
+
+    def _gate_traced(self, guard: "_BreakerGuard") -> None:
+        """Per-attempt breaker admission with the refusal recorded as a
+        span event — an open-breaker short-circuit is otherwise invisible
+        in a trace (no network call ever happens)."""
+        try:
+            guard.gate(self.node.name)
+        except BreakerOpenError:
+            from seldon_core_tpu.utils.tracing import TRACER
+
+            TRACER.event("breaker_open", node=self.node.name)
+            raise
 
 
 class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
@@ -165,11 +192,16 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
     async def _post(
         self, path: str, payload: str, puid: str = "", method: str = "predict"
     ) -> SeldonMessage:
-        from seldon_core_tpu.utils.tracing import TRACER
+        from seldon_core_tpu.utils.tracing import TRACER, current_trace_puid
 
+        rem = remaining_s()
         with TRACER.span(
-            puid, self.node.name, kind="client", method=path.strip("/"),
-            transport="rest",
+            puid or current_trace_puid(), self.node.name, kind="client",
+            method=path.strip("/"), transport="rest",
+            **(
+                {} if rem is None
+                else {"deadline_remaining_ms": round(rem * 1e3, 1)}
+            ),
         ):
             return await self._post_traced(path, payload, method)
 
@@ -177,6 +209,11 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
         self, path: str, payload: str, method: str
     ) -> SeldonMessage:
         import aiohttp
+
+        from seldon_core_tpu.utils.tracing import (
+            TRACEPARENT_HEADER,
+            traceparent_header_value,
+        )
 
         session = await self._get_session()
         policy = self.retry_policy
@@ -186,15 +223,23 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
             while True:
                 # per-attempt admission: a breaker that opened mid-loop
                 # stops the remaining attempts
-                guard.gate(self.node.name)
+                self._gate_traced(guard)
                 # each attempt draws from the ONE request budget; an
                 # exhausted budget raises DeadlineExceededError (504)
                 # before any I/O
                 att_timeout = clamp_timeout(
                     self.timeout_s, where=f"rest:{self.node.name}"
                 )
+                headers = {}
                 hdr = deadline_header_value()
-                headers = {DEADLINE_HEADER: hdr} if hdr is not None else None
+                if hdr is not None:
+                    headers[DEADLINE_HEADER] = hdr
+                # W3C trace context: the client span (active here) becomes
+                # the remote server span's parent
+                tp = traceparent_header_value()
+                if tp is not None:
+                    headers[TRACEPARENT_HEADER] = tp
+                headers = headers or None
                 retryable = False
                 try:
                     async with session.post(
@@ -264,16 +309,22 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
         return _branch_from_msg(self.node.name, resp, "/route")
 
     async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
+        from seldon_core_tpu.utils.tracing import current_trace_puid
+
         payload = SeldonMessageList(messages=msgs).to_json()
-        puid = msgs[0].meta.puid if msgs else ""
+        # the active trace context is authoritative — guessing from
+        # msgs[0] breaks when child branches forked distinct metas
+        puid = current_trace_puid() or (msgs[0].meta.puid if msgs else "")
         return await self._post("/aggregate", payload, puid, "aggregate")
 
     async def send_feedback(self, feedback: Feedback, branch: int) -> None:
+        from seldon_core_tpu.utils.tracing import current_trace_puid
+
         # never retried: a duplicated feedback delivery trains the unit
-        # twice (the reference retried it blindly — satellite fix)
-        puid = (
-            feedback.response.meta.puid if feedback.response is not None else ""
-        )
+        # twice (the reference retried it blindly — satellite fix).  The
+        # span puid falls back request-ward, then to the active trace
+        # (satellite fix: it used to record "" for response-less feedback)
+        puid = feedback.puid() or current_trace_puid()
         await self._post("/send-feedback", feedback.to_json(), puid, "send_feedback")
 
 
@@ -349,22 +400,50 @@ class GrpcNodeRuntime(_ResilientCallMixin, NodeRuntime):
     async def close(self) -> None:
         await self._channel.close()
 
-    async def _call(self, stub, proto_req, method: str = "predict") -> SeldonMessage:
+    async def _call(
+        self, stub, proto_req, method: str = "predict", puid: str = ""
+    ) -> SeldonMessage:
+        from seldon_core_tpu.utils.tracing import TRACER, current_trace_puid
+
+        # retry parity extends to trace parity: the gRPC lane records the
+        # same client spans (and retry/breaker events) REST always did
+        rem = remaining_s()
+        with TRACER.span(
+            puid or current_trace_puid(), self.node.name, kind="client",
+            method=method, transport="grpc",
+            **(
+                {} if rem is None
+                else {"deadline_remaining_ms": round(rem * 1e3, 1)}
+            ),
+        ):
+            return await self._call_traced(stub, proto_req, method)
+
+    async def _call_traced(self, stub, proto_req, method: str) -> SeldonMessage:
         import grpc
 
         from seldon_core_tpu import protoconv
+        from seldon_core_tpu.utils.tracing import (
+            TRACEPARENT_HEADER,
+            traceparent_header_value,
+        )
 
         policy = self.retry_policy
         guard = _BreakerGuard(self.breaker)
         attempt = 0
         try:
             while True:
-                guard.gate(self.node.name)
+                self._gate_traced(guard)
                 att_timeout = clamp_timeout(
                     self.timeout_s, where=f"grpc:{self.node.name}"
                 )
+                # metadata kwarg only when a trace is active: absent-trace
+                # calls stay byte-compatible with bare test stubs
+                kwargs = {"timeout": att_timeout}
+                tp = traceparent_header_value()
+                if tp is not None:
+                    kwargs["metadata"] = ((TRACEPARENT_HEADER, tp),)
                 try:
-                    resp = await stub(proto_req, timeout=att_timeout)
+                    resp = await stub(proto_req, **kwargs)
                 except grpc.aio.AioRpcError as e:
                     code_name = e.code().name
                     guard.record(False)
@@ -393,27 +472,33 @@ class GrpcNodeRuntime(_ResilientCallMixin, NodeRuntime):
         from seldon_core_tpu import protoconv
 
         return await self._call(
-            self._predict, protoconv.msg_to_proto(msg), "predict"
+            self._predict, protoconv.msg_to_proto(msg), "predict",
+            puid=msg.meta.puid,
         )
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:
         from seldon_core_tpu import protoconv
 
         return await self._call(
-            self._transform_input, protoconv.msg_to_proto(msg), "transform_input"
+            self._transform_input, protoconv.msg_to_proto(msg),
+            "transform_input", puid=msg.meta.puid,
         )
 
     async def transform_output(self, msg: SeldonMessage) -> SeldonMessage:
         from seldon_core_tpu import protoconv
 
         return await self._call(
-            self._transform_output, protoconv.msg_to_proto(msg), "transform_output"
+            self._transform_output, protoconv.msg_to_proto(msg),
+            "transform_output", puid=msg.meta.puid,
         )
 
     async def route(self, msg: SeldonMessage) -> int:
         from seldon_core_tpu import protoconv
 
-        resp = await self._call(self._route, protoconv.msg_to_proto(msg), "route")
+        resp = await self._call(
+            self._route, protoconv.msg_to_proto(msg), "route",
+            puid=msg.meta.puid,
+        )
         return _branch_from_msg(self.node.name, resp, "Route")
 
     async def aggregate(self, msgs: List[SeldonMessage]) -> SeldonMessage:
@@ -429,6 +514,7 @@ class GrpcNodeRuntime(_ResilientCallMixin, NodeRuntime):
             self._send_feedback,
             protoconv.feedback_to_proto(feedback),
             "send_feedback",
+            puid=feedback.puid(),
         )
 
 

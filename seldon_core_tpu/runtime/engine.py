@@ -247,6 +247,14 @@ class EngineService:
         logger costs one attribute load."""
         if not self.audit.enabled:
             return
+        from seldon_core_tpu.utils.tracing import current_trace_context
+
+        # stamp the trace id so an audit line links straight to its
+        # /trace tree (sampled requests only — an unsampled trace has no
+        # spans to link to)
+        ctx = current_trace_context()
+        if ctx is not None and ctx.sampled and "trace_id" not in extra:
+            extra["trace_id"] = ctx.trace_id
         self.audit.record(
             puid=puid,
             deployment=self.deployment.name,
@@ -286,7 +294,7 @@ class EngineService:
                 },
             },
             "telemetry": RECORDER.snapshot(),
-            "tracer": {"enabled": TRACER.enabled},
+            "tracer": TRACER.snapshot(),
             "audit": self.audit.snapshot(),
         }
 
@@ -374,10 +382,18 @@ class EngineService:
         ttft_s = None
         tokens = 0
         status = 200
+        audit_extra = {}
         try:
             with self.metrics.time_server("generate-stream", "POST"), \
                     self.tracer.span(puid, "request", kind="request",
                                      method="generate_stream"):
+                # captured while the span is open: the finally-audit runs
+                # after the span context has been reset
+                from seldon_core_tpu.utils.tracing import current_trace_context
+
+                ctx = current_trace_context()
+                if ctx is not None and ctx.sampled:
+                    audit_extra["trace_id"] = ctx.trace_id
                 while True:
                     toks = await loop.run_in_executor(
                         None, next, gen, None
@@ -415,6 +431,7 @@ class EngineService:
                 tokens_per_s=(
                     None if elapsed <= 0 else round(tokens / elapsed, 1)
                 ),
+                **audit_extra,
             )
         yield _json.dumps({"done": True, "meta": {"puid": puid}})
 
@@ -514,9 +531,13 @@ class EngineService:
             )
 
     def _batched_predict_sync(self, stacked, deadline=None):
+        # runs on an executor thread: no request context here by design —
+        # a stacked dispatch serves many requests, so the span stands
+        # alone (per-request causality is the queue-wait span)
+        cc_before = dict(RECORDER.compile_cache_events)
         with self.tracer.span(
             "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
-        ):
+        ) as sp:
             width = stacked.shape[1:]
             # state write-back is vetoed AFTER the device round-trip if the
             # request already timed out (client saw 504; a late update
@@ -545,6 +566,16 @@ class EngineService:
             # the readback belongs inside the span: jax dispatch is async,
             # so the device+relay round-trip is only paid here
             y = np.asarray(y)
+            if isinstance(sp, dict):
+                # compile-cache traffic during this dispatch (fresh shape
+                # -> XLA compile): visible per-span, not just as counters
+                for outcome in ("miss", "hit"):
+                    delta = RECORDER.compile_cache_events.get(
+                        outcome, 0
+                    ) - cc_before.get(outcome, 0)
+                    if delta > 0:
+                        sp["compile_cache"] = outcome
+                        break
         return y, (routing, tags)
 
     # ------------------------------------------------------------------
@@ -858,9 +889,7 @@ class EngineService:
             return resp
 
     async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
-        fb_puid = (
-            feedback.response.meta.puid if feedback.response is not None else ""
-        )
+        fb_puid = feedback.puid()
         with self.metrics.time_server("feedback", "POST") as code, self.tracer.span(
             fb_puid, "request", kind="request", method="feedback",
         ):

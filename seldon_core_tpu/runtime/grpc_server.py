@@ -54,13 +54,51 @@ def _grpc_deadline_scope(context):
     return maybe_deadline_scope(rem if rem is not None and rem > 0 else None)
 
 
-def _wrap(fn):
+def _grpc_trace_scope(context):
+    """The caller's W3C trace context from the ``traceparent`` metadata
+    entry, adopted for this call — gRPC hops join the same tree as REST
+    hops (utils/tracing.py)."""
+    from seldon_core_tpu.utils.tracing import (
+        TRACEPARENT_HEADER,
+        parse_traceparent,
+        trace_scope,
+    )
+
+    raw = None
+    if context is not None:
+        for k, v in context.invocation_metadata() or ():
+            if k == TRACEPARENT_HEADER:
+                raw = v
+                break
+    return trace_scope(parse_traceparent(raw))
+
+
+def _proto_puid(request) -> str:
+    """Correlation id of a request proto: ``meta.puid`` for messages; for
+    Feedback the served response's, else the original request's."""
+    if isinstance(request, pb.Feedback):
+        return request.response.meta.puid or request.request.meta.puid
+    try:
+        return request.meta.puid
+    except AttributeError:
+        return ""
+
+
+def _wrap(fn, span_name: str = "", method: str = ""):
     """Convert typed framework errors into FAILURE SeldonMessages and
-    unexpected ones into INTERNAL grpc errors."""
+    unexpected ones into INTERNAL grpc errors.  When ``span_name`` is
+    given (unit servers), the call is also recorded as a server-side span
+    in the caller's trace."""
 
     async def handler(request, context):
+        from seldon_core_tpu.utils.tracing import TRACER
+
         try:
-            with _grpc_deadline_scope(context):
+            with _grpc_trace_scope(context), _grpc_deadline_scope(context):
+                if span_name:
+                    with TRACER.span(_proto_puid(request), span_name,
+                                     kind="server", method=method):
+                        return await fn(request)
                 return await fn(request)
         except (SeldonMessageError, GraphSpecError) as e:
             return _failure_proto(str(e), code=getattr(e, "http_code", 400))
@@ -70,9 +108,9 @@ def _wrap(fn):
     return handler
 
 
-def _unary(fn, req_cls, resp_cls=pb.SeldonMessage):
+def _unary(fn, req_cls, resp_cls=pb.SeldonMessage, span_name="", method=""):
     return grpc.unary_unary_rpc_method_handler(
-        _wrap(fn),
+        _wrap(fn, span_name=span_name, method=method),
         request_deserializer=req_cls.FromString,
         response_serializer=resp_cls.SerializeToString,
     )
@@ -91,7 +129,7 @@ def make_engine_grpc_server(engine, host: str, port: int) -> grpc.aio.Server:
         # mirrors _wrap: typed errors -> FAILURE message, unimplemented ->
         # UNIMPLEMENTED, anything else propagates as INTERNAL
         try:
-            with _grpc_deadline_scope(context):
+            with _grpc_trace_scope(context), _grpc_deadline_scope(context):
                 return await engine.predict_proto_wire(wire)
         except (SeldonMessageError, GraphSpecError) as e:
             return _failure_proto(
@@ -188,27 +226,38 @@ def make_unit_grpc_server(
         await runtime.send_feedback(fb, branch)
         return protoconv.msg_to_proto(SeldonMessage())
 
+    name = runtime.node.name
+
+    def unary(fn, req_cls, method):
+        return _unary(fn, req_cls, span_name=name, method=method)
+
     services = {
         "seldon.protos.Generic": {
-            "TransformInput": _unary(transform_input, pb.SeldonMessage),
-            "TransformOutput": _unary(transform_output, pb.SeldonMessage),
-            "Route": _unary(route, pb.SeldonMessage),
-            "Aggregate": _unary(aggregate, pb.SeldonMessageList),
-            "SendFeedback": _unary(send_feedback, pb.Feedback),
+            "TransformInput": unary(transform_input, pb.SeldonMessage,
+                                    "transform_input"),
+            "TransformOutput": unary(transform_output, pb.SeldonMessage,
+                                     "transform_output"),
+            "Route": unary(route, pb.SeldonMessage, "route"),
+            "Aggregate": unary(aggregate, pb.SeldonMessageList, "aggregate"),
+            "SendFeedback": unary(send_feedback, pb.Feedback, "send_feedback"),
         },
-        "seldon.protos.Model": {"Predict": _unary(predict, pb.SeldonMessage)},
+        "seldon.protos.Model": {
+            "Predict": unary(predict, pb.SeldonMessage, "predict")
+        },
         "seldon.protos.Router": {
-            "Route": _unary(route, pb.SeldonMessage),
-            "SendFeedback": _unary(send_feedback, pb.Feedback),
+            "Route": unary(route, pb.SeldonMessage, "route"),
+            "SendFeedback": unary(send_feedback, pb.Feedback, "send_feedback"),
         },
         "seldon.protos.Transformer": {
-            "TransformInput": _unary(transform_input, pb.SeldonMessage)
+            "TransformInput": unary(transform_input, pb.SeldonMessage,
+                                    "transform_input")
         },
         "seldon.protos.OutputTransformer": {
-            "TransformOutput": _unary(transform_output, pb.SeldonMessage)
+            "TransformOutput": unary(transform_output, pb.SeldonMessage,
+                                     "transform_output")
         },
         "seldon.protos.Combiner": {
-            "Aggregate": _unary(aggregate, pb.SeldonMessageList)
+            "Aggregate": unary(aggregate, pb.SeldonMessageList, "aggregate")
         },
     }
     server = grpc.aio.server(options=_OPTIONS)

@@ -13,9 +13,11 @@ device dispatch.
 Semantics match ``rest.py:make_engine_app`` route for route:
 
   POST /api/v0.1/predictions   JSON body or form field ``json=``
+  POST /predict                internal-API alias (engine as MODEL leaf)
   POST /api/v0.1/feedback
+  POST /trace/enable /trace/disable (GET aliases deprecated one release)
   GET  /ping /ready /pause /unpause /prometheus /stats
-  GET  /trace /trace/enable /trace/disable
+  GET  /trace /trace/export
 
 Protocol scope (documented contract, tested in tests/test_httpfast.py):
 HTTP/1.1 with keepalive and Content-Length bodies.  Pipelined requests
@@ -42,6 +44,7 @@ from seldon_core_tpu.runtime.resilience import (
     deadline_scope,
 )
 from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
+from seldon_core_tpu.utils.tracing import parse_traceparent, trace_scope
 
 __all__ = ["FastHttpServer", "serve_fast"]
 
@@ -49,7 +52,8 @@ _JSON = "application/json"
 _MAX_BODY = 256 * 1024 * 1024  # matches rest.py client_max_size
 _MAX_HEAD = 64 * 1024
 
-# handler result: (status, body bytes, content-type)
+# handler result: (status, body bytes, content-type) — an optional 4th
+# element carries extra response header lines (bytes, CRLF-terminated)
 Result = Tuple[int, bytes, str]
 Handler = Callable[[bytes, str, str], Awaitable[Result]]
 
@@ -99,9 +103,14 @@ class _EngineRoutes:
         self.engine = engine
         self.post: Dict[bytes, Handler] = {
             b"/api/v0.1/predictions": self._predictions,
+            # internal-API alias: engines compose as MODEL leaves of larger
+            # cross-process graphs (rest.py predict_alias)
+            b"/predict": self._predictions,
             b"/api/v0.1/feedback": self._feedback,
             b"/api/v0.1/generate/stream": self._generate_stream,
             b"/api/v0.1/events": self._events,
+            b"/trace/enable": self._trace_enable,
+            b"/trace/disable": self._trace_disable,
         }
         self.get: Dict[bytes, Handler] = {
             b"/ping": self._ping,
@@ -111,10 +120,21 @@ class _EngineRoutes:
             b"/prometheus": self._prometheus,
             b"/stats": self._stats,
             b"/trace": self._trace,
-            b"/trace/enable": self._trace_enable,
-            b"/trace/disable": self._trace_disable,
+            b"/trace/export": self._trace_export,
+            # deprecated one release: state mutation via GET (answered
+            # with a Deprecation header, same as the aiohttp lane)
+            b"/trace/enable": self._deprecated(self._trace_enable),
+            b"/trace/disable": self._deprecated(self._trace_disable),
             b"/api/v0.1/events": self._events,
         }
+
+    @staticmethod
+    def _deprecated(handler):
+        async def wrapped(body, ctype, query):
+            status, resp, rctype = await handler(body, ctype, query)
+            return status, resp, rctype, b"Deprecation: true\r\n"
+
+        return wrapped
 
     async def _events(self, body, ctype, query) -> Result:
         # stubbed external surface, reference-exact
@@ -195,14 +215,29 @@ class _EngineRoutes:
     async def _trace(self, body, ctype, query) -> Result:
         import json as _json
 
-        from seldon_core_tpu.utils.tracing import TRACER
+        from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
         q = parse_qs(query)
-        puid = q.get("puid", [""])[0]
-        limit = int(q.get("limit", ["100"])[0])
-        spans = TRACER.trace(puid) if puid else TRACER.recent(limit)
-        doc = {"enabled": TRACER.enabled,
-               "spans": [s.to_json_dict() for s in spans]}
+        doc = trace_document(
+            TRACER,
+            puid=q.get("puid", [""])[0],
+            trace_id=q.get("trace_id", [""])[0],
+            limit=int(q.get("limit", ["100"])[0]),
+        )
+        return 200, _json.dumps(doc).encode(), _JSON
+
+    async def _trace_export(self, body, ctype, query) -> Result:
+        import json as _json
+
+        from seldon_core_tpu.utils.tracing import TRACER, export_document
+
+        q = parse_qs(query)
+        doc = export_document(
+            TRACER,
+            puid=q.get("puid", [""])[0],
+            trace_id=q.get("trace_id", [""])[0],
+            limit=int(q.get("limit", ["1000"])[0]),
+        )
         return 200, _json.dumps(doc).encode(), _JSON
 
     async def _trace_enable(self, body, ctype, query) -> Result:
@@ -225,6 +260,13 @@ async def _with_deadline(coro, budget_s: float):
     """Run a route handler under a request deadline budget (the scope must
     be entered INSIDE the handler task so child awaits inherit it)."""
     with deadline_scope(budget_s):
+        return await coro
+
+
+async def _with_trace(coro, ctx):
+    """Run a route handler under an adopted remote trace context (same
+    inside-the-task requirement as ``_with_deadline``)."""
+    with trace_scope(ctx):
         return await coro
 
 
@@ -314,10 +356,14 @@ class _FastHttpProtocol(asyncio.Protocol):
                 if close and self.transport is not None:
                     self.transport.close()
                 continue
-            status, body, ctype = result
+            extra = b""
+            if len(result) == 4:
+                status, body, ctype, extra = result
+            else:
+                status, body, ctype = result
             if not self._can_write.is_set():
                 await self._can_write.wait()  # transport buffer full
-            self._write_response(status, body, ctype, close)
+            self._write_response(status, body, ctype, close, extra)
             if (
                 self.paused_read
                 and self.queue.qsize() <= _MAX_INFLIGHT // 2
@@ -367,16 +413,17 @@ class _FastHttpProtocol(asyncio.Protocol):
         if self.transport is not None and not self.transport.is_closing():
             self.transport.write(b"0\r\n\r\n")
 
-    def _write_response(self, status, body, ctype, close):
+    def _write_response(self, status, body, ctype, close, extra=b""):
         if self.transport is None or self.transport.is_closing():
             return
         head = (
             _STATUS_LINE.get(status) or f"HTTP/1.1 {status} X\r\n".encode()
         ) + (
-            b"Content-Length: %d\r\nContent-Type: %s\r\n%s\r\n"
+            b"Content-Length: %d\r\nContent-Type: %s\r\n%s%s\r\n"
             % (
                 len(body),
                 ctype.encode(),
+                extra,
                 b"Connection: close\r\n" if close else b"",
             )
         )
@@ -497,6 +544,13 @@ class _FastHttpProtocol(asyncio.Protocol):
         )
         if budget_s is not None:
             coro = _with_deadline(coro, budget_s)
+        # W3C trace context: same contract as the aiohttp lane
+        tpv = _header_value(lower, b"traceparent:")
+        trace_ctx = (
+            parse_traceparent(tpv.decode("latin-1")) if tpv is not None else None
+        )
+        if trace_ctx is not None:
+            coro = _with_trace(coro, trace_ctx)
         task = asyncio.get_running_loop().create_task(coro)
         self.queue.put_nowait((task, close))
 

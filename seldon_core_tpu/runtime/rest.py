@@ -41,6 +41,11 @@ from seldon_core_tpu.runtime.resilience import (
     maybe_deadline_scope,
 )
 from seldon_core_tpu.utils.metrics import CONTENT_TYPE_LATEST
+from seldon_core_tpu.utils.tracing import (
+    TRACEPARENT_HEADER,
+    parse_traceparent,
+    trace_scope,
+)
 
 __all__ = ["make_engine_app", "make_unit_app", "serve_app"]
 
@@ -77,6 +82,12 @@ def _request_budget_s(request: web.Request) -> Optional[float]:
     return deadline_ms_header(request.headers.get(DEADLINE_HEADER))
 
 
+def _request_trace_scope(request: web.Request):
+    """Adopt the caller's W3C ``traceparent`` context (None/malformed →
+    fresh trace) so this process's spans join the caller's tree."""
+    return trace_scope(parse_traceparent(request.headers.get(TRACEPARENT_HEADER)))
+
+
 # ---------------------------------------------------------------------------
 # Engine app
 # ---------------------------------------------------------------------------
@@ -87,7 +98,8 @@ def make_engine_app(engine: EngineService) -> web.Application:
 
     async def predictions(request: web.Request) -> web.Response:
         try:
-            with maybe_deadline_scope(_request_budget_s(request)):
+            with _request_trace_scope(request), \
+                    maybe_deadline_scope(_request_budget_s(request)):
                 text, status = await engine.predict_json(
                     await _payload_text(request)
                 )
@@ -97,9 +109,17 @@ def make_engine_app(engine: EngineService) -> web.Application:
             text=text, status=status or 200, content_type="application/json"
         )
 
+    async def predict_alias(request: web.Request) -> web.Response:
+        # internal-API alias: an engine IS a model from a parent graph's
+        # perspective (the gRPC lane's Model/Predict alias, grpc_server.py)
+        # — POST /predict lets a RestNodeRuntime dial an engine as a MODEL
+        # leaf of a larger cross-process graph
+        return await predictions(request)
+
     async def feedback(request: web.Request) -> web.Response:
         try:
-            with maybe_deadline_scope(_request_budget_s(request)):
+            with _request_trace_scope(request), \
+                    maybe_deadline_scope(_request_budget_s(request)):
                 fb = Feedback.from_json(await _payload_text(request))
                 ack = await engine.send_feedback(fb)
         except SeldonMessageError as e:
@@ -143,14 +163,38 @@ def make_engine_app(engine: EngineService) -> web.Application:
         return web.json_response(engine.stats())
 
     async def trace(request: web.Request) -> web.Response:
-        from seldon_core_tpu.utils.tracing import TRACER
+        from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
-        puid = request.query.get("puid", "")
-        limit = int(request.query.get("limit", "100"))
-        spans = TRACER.trace(puid) if puid else TRACER.recent(limit)
-        return web.json_response(
-            {"enabled": TRACER.enabled, "spans": [s.to_json_dict() for s in spans]}
-        )
+        return web.json_response(trace_document(
+            TRACER,
+            puid=request.query.get("puid", ""),
+            trace_id=request.query.get("trace_id", ""),
+            limit=int(request.query.get("limit", "100")),
+        ))
+
+    async def trace_export(request: web.Request) -> web.Response:
+        # Chrome trace-event JSON — load in Perfetto / chrome://tracing
+        from seldon_core_tpu.utils.tracing import TRACER, export_document
+
+        return web.json_response(export_document(
+            TRACER,
+            puid=request.query.get("puid", ""),
+            trace_id=request.query.get("trace_id", ""),
+            limit=int(request.query.get("limit", "1000")),
+        ))
+
+    def _deprecated_get(handler):
+        # state-mutating GETs survive one release as aliases; the POST
+        # routes are the documented admin surface (docs/operations.md)
+        async def wrapped(request: web.Request) -> web.Response:
+            resp = await handler(request)
+            resp.headers["Deprecation"] = "true"
+            resp.headers["Link"] = '<%s>; rel="successor-version"' % (
+                request.path,
+            )
+            return resp
+
+        return wrapped
 
     async def trace_enable(_):
         from seldon_core_tpu.utils.tracing import TRACER
@@ -202,6 +246,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
         return web.Response(text="Not Implemented")
 
     app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/predict", predict_alias)
     app.router.add_post("/api/v0.1/feedback", feedback)
     app.router.add_post("/api/v0.1/generate/stream", generate_stream)
     app.router.add_route("*", "/api/v0.1/events", events)
@@ -212,8 +257,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/prometheus", prometheus)
     app.router.add_get("/stats", stats)
     app.router.add_get("/trace", trace)
-    app.router.add_get("/trace/enable", trace_enable)
-    app.router.add_get("/trace/disable", trace_disable)
+    app.router.add_get("/trace/export", trace_export)
+    app.router.add_post("/trace/enable", trace_enable)
+    app.router.add_post("/trace/disable", trace_disable)
+    # deprecated one release: state mutation via GET (pre-PR-3 surface)
+    app.router.add_get("/trace/enable", _deprecated_get(trace_enable))
+    app.router.add_get("/trace/disable", _deprecated_get(trace_disable))
     return app
 
 
@@ -237,8 +286,11 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
             try:
                 # deadline propagation: the engine's node client forwards the
                 # remaining request budget; nested work in this unit (and a
-                # unit that is itself an engine facade) draws from it
-                with maybe_deadline_scope(_request_budget_s(request)):
+                # unit that is itself an engine facade) draws from it.  The
+                # traceparent metadata makes this unit's spans children of
+                # the engine's client span — one tree across processes
+                with _request_trace_scope(request), \
+                        maybe_deadline_scope(_request_budget_s(request)):
                     dl = current_deadline()
                     if dl is not None and dl.expired:
                         return _error_response(
@@ -257,21 +309,35 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
         return handle
 
     async def _dispatch(method_name: str, request: web.Request) -> web.Response:
+        from seldon_core_tpu.utils.tracing import TRACER, current_trace_puid
+
         text = await _payload_text(request)
         if method_name == "aggregate":
             msgs = SeldonMessageList.from_json(text)
-            resp = await runtime.aggregate(msgs.messages)
+            puid = current_trace_puid() or (
+                msgs.messages[0].meta.puid if msgs.messages else ""
+            )
+            with TRACER.span(puid, runtime.node.name, kind="server",
+                             method=method_name):
+                resp = await runtime.aggregate(msgs.messages)
         elif method_name == "send_feedback":
             fb = Feedback.from_json(text)
             routing = (
                 fb.response.meta.routing if fb.response is not None else {}
             )
             branch = int(routing.get(runtime.node.name, -1))
-            await runtime.send_feedback(fb, branch)
+            with TRACER.span(fb.puid() or current_trace_puid(),
+                             runtime.node.name,
+                             kind="server", method=method_name):
+                await runtime.send_feedback(fb, branch)
             resp = SeldonMessage()
         elif method_name == "route":
             msg = SeldonMessage.from_json(text)
-            branch = await runtime.route(msg)
+            with TRACER.span(msg.meta.puid, runtime.node.name, kind="server",
+                             method=method_name) as sp:
+                branch = await runtime.route(msg)
+                if isinstance(sp, dict):
+                    sp["branch"] = branch
             # branch wrapped as 1x1 tensor like the reference wrapper
             # (wrappers/python/router_microservice.py:39-56)
             import numpy as np
@@ -279,7 +345,9 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
             resp = msg.with_array(np.array([[branch]], dtype=np.float64))
         else:
             msg = SeldonMessage.from_json(text)
-            resp = await getattr(runtime, method_name)(msg)
+            with TRACER.span(msg.meta.puid, runtime.node.name, kind="server",
+                             method=method_name):
+                resp = await getattr(runtime, method_name)(msg)
         return _msg_response(resp)
 
     app.router.add_post("/predict", handler("predict"))

@@ -81,6 +81,9 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_retry_budget_exhausted_total": ("counter", ()),
     "seldon_tpu_deadline_exceeded_total": ("counter", ("where",)),
     "seldon_tpu_degraded_requests_total": ("counter", ("mode",)),
+    # causal tracer (utils/tracing.py): spans recorded per kind — the
+    # signal that says whether sampling keeps trace volume sane under load
+    "seldon_tpu_trace_spans_total": ("counter", ("kind",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -163,6 +166,7 @@ class FlightRecorder:
         self.retry_budget_exhausted = 0
         self.deadline_exceeded: Dict[str, int] = {}
         self.degraded_requests: Dict[str, int] = {}
+        self.trace_spans: Dict[str, int] = {}  # causal tracer, by span kind
         #: per-service rolling request latencies feeding /stats percentiles;
         #: bounded — an exploding label set must not grow memory
         self._latency: Dict[str, Reservoir] = {}
@@ -231,6 +235,10 @@ class FlightRecorder:
                 "seldon_tpu_degraded_requests_total",
                 "Requests served degraded (combiner quorum / router "
                 "fallback)", ["mode"], registry=self.registry)
+            self._p_trace_spans = Counter(
+                "seldon_tpu_trace_spans_total",
+                "Causal-tracer spans recorded, by span kind",
+                ["kind"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -328,6 +336,12 @@ class FlightRecorder:
         if self.registry is not None:
             self._p_deadline.labels(where=where).inc()
 
+    def record_trace_span(self, kind: str) -> None:
+        with self._lock:
+            self.trace_spans[kind] = self.trace_spans.get(kind, 0) + 1
+        if self.registry is not None:
+            self._p_trace_spans.labels(kind=kind).inc()
+
     def record_degraded(self, mode: str) -> None:
         """mode: 'quorum' (combiner served a subset) or 'fallback' (router
         served the fallback branch)."""
@@ -366,6 +380,7 @@ class FlightRecorder:
                 "deadline_exceeded": dict(self.deadline_exceeded),
                 "degraded_requests": dict(self.degraded_requests),
             }
+            trace_spans = dict(self.trace_spans)
         return {
             "resilience": resilience,
             "batch": {
@@ -380,6 +395,7 @@ class FlightRecorder:
                 "kv_cache_slots": kv,
             },
             "compile_cache_events": cc,
+            "trace_spans": trace_spans,
             "request_latency_s": {
                 k: self._latency[k].snapshot() for k in latency_keys
             },
@@ -409,6 +425,7 @@ class FlightRecorder:
             self.retry_budget_exhausted = 0
             self.deadline_exceeded = {}
             self.degraded_requests = {}
+            self.trace_spans = {}
 
 
 RECORDER = FlightRecorder()

@@ -1,49 +1,208 @@
-"""Request tracing — puid-correlated spans + TPU device profiling.
+"""Causal distributed tracing — span trees, W3C context propagation,
+critical-path analysis, trace export + TPU device profiling.
 
 The reference has no distributed tracing: it logs per-hop call durations
 (engine InternalPredictionService.java:267-268) and threads ``puid``
-through every hop and the Kafka firehose as the correlation id
-(engine PredictionService.java:52-58).  This module makes that design
-first-class:
+through every hop as a flat correlation id (PredictionService.java:52-58).
+PR 1's flight recorder inherited that shape — a flat ring of spans.  This
+module promotes it to a *causal* tracer:
 
-  * ``Tracer`` records bounded in-memory spans — one per node call in host
-    mode, one per device dispatch in compiled mode, one per request at the
-    engine edge — each tagged with the request ``puid`` so a trace can be
-    reassembled across the graph (and across processes, since the puid rides
-    the wire in ``meta``).
-  * The engine exposes ``GET /trace?puid=`` and enable/disable admin
-    endpoints (runtime/rest.py).
+  * Every span carries ``trace_id`` / ``span_id`` / ``parent_span_id``.
+    The active span lives in a contextvar (``TRACE_VAR``, parallel to the
+    deadline budget of runtime/resilience.py), so nesting is automatic:
+    a span opened inside another becomes its child, across ``await`` and
+    ``asyncio.gather`` fan-out (tasks inherit a context copy).
+  * Trace context rides every hop as a W3C ``traceparent`` header (REST)
+    / metadata entry (gRPC), so a multi-process graph — gateway → engine
+    → unit microservices — reassembles into ONE tree, queryable at any
+    participant's ``GET /trace?puid=`` (or ``trace_id=``).
+  * ``critical_path`` walks the assembled tree and attributes the root
+    span's wall clock to the chain of spans that actually gated it;
+    ``phase_decomposition`` buckets those segments into
+    queue / retry+backoff / network / dispatch / decode — the per-phase
+    latency data ROADMAP's perf work steers by.
+  * ``chrome_trace`` emits Chrome trace-event JSON (``GET /trace/export``)
+    loadable in Perfetto / chrome://tracing.
+  * Head sampling: ``SELDON_TPU_TRACE_SAMPLE=0.01`` decides ONCE at the
+    trace root; the decision propagates in the traceparent flags byte, so
+    tracing can stay on under production load.  ``sample=0`` records
+    nothing anywhere in the tree.
   * ``device_profile`` wraps ``jax.profiler`` tracing for XLA/TPU-level
-    timelines (the compiled graph is ONE XLA program, so intra-graph timing
-    lives in the device profile, not host spans — that's the TPU-native
-    analogue of the reference's per-microservice-hop latencies).
+    timelines (the compiled graph is ONE XLA program, so intra-graph
+    timing lives in the device profile, not host spans).  Re-entrancy
+    safe: a nested/concurrent profile request becomes a span event, not
+    a ``jax.profiler`` exception.
 
-Tracing is off by default (`SELDON_TPU_TRACE=1` or ``TRACER.enable()``);
+Tracing is off by default (``SELDON_TPU_TRACE=1`` or ``TRACER.enable()``);
 disabled spans cost one attribute load and return a shared null context.
+Lookups (``trace()`` / ``by_trace()``) are O(result) via bounded
+secondary indexes kept in lockstep with the span ring — they never scan
+the full ring under the lock the hot-path ``add()`` needs.
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
+import random
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["Span", "Tracer", "TRACER", "device_profile"]
+__all__ = [
+    "Span",
+    "SpanHandle",
+    "Tracer",
+    "TRACER",
+    "TraceContext",
+    "TRACE_VAR",
+    "TRACEPARENT_HEADER",
+    "current_trace_context",
+    "current_trace_puid",
+    "new_trace_id",
+    "new_span_id",
+    "parse_traceparent",
+    "traceparent_header_value",
+    "trace_scope",
+    "assemble_tree",
+    "critical_path",
+    "phase_decomposition",
+    "chrome_trace",
+    "trace_document",
+    "export_document",
+    "device_profile",
+]
+
+#: wire name of the trace context (W3C Trace Context, level 1).  The same
+#: name is used as the gRPC metadata key — gRPC metadata keys are
+#: lowercase by spec, and W3C defines the header name case-insensitively.
+TRACEPARENT_HEADER = "traceparent"
+
+
+def new_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars (W3C trace-id)."""
+    return f"{random.getrandbits(128):032x}"
+
+
+def new_span_id() -> str:
+    """64-bit random span id, 16 lowercase hex chars (W3C parent-id)."""
+    return f"{random.getrandbits(64):016x}"
+
+
+@dataclass
+class TraceContext:
+    """The active span's identity — what a child span needs to link to its
+    parent, and what rides the wire to the next process.  ``puid`` tags
+    along so spans opened without an explicit puid (client aggregate hops,
+    feedback with a bare payload) inherit the request's correlation id
+    instead of guessing from message payloads."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+    puid: str = ""
+
+    def child(self, puid: str = "") -> "TraceContext":
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=new_span_id(),
+            sampled=self.sampled,
+            puid=puid or self.puid,
+        )
+
+
+TRACE_VAR: contextvars.ContextVar[Optional[TraceContext]] = contextvars.ContextVar(
+    "seldon_tpu_trace", default=None
+)
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    return TRACE_VAR.get()
+
+
+def current_trace_puid() -> str:
+    """The active trace's puid ('' when no trace is active) — the
+    authoritative correlation id for hops whose payload doesn't carry
+    one (aggregate lists, response-less feedback)."""
+    ctx = TRACE_VAR.get()
+    return ctx.puid if ctx is not None else ""
+
+
+def traceparent_header_value() -> Optional[str]:
+    """The active context serialized per W3C Trace Context
+    (``00-<trace-id>-<parent-id>-<flags>``); None when no trace is
+    active.  The sampled bit propagates the root's head-sampling decision
+    so a sampled-out request records nothing in ANY process."""
+    ctx = TRACE_VAR.get()
+    if ctx is None or not ctx.trace_id or not ctx.span_id:
+        return None
+    return "00-%s-%s-%s" % (ctx.trace_id, ctx.span_id, "01" if ctx.sampled else "00")
+
+
+def parse_traceparent(raw: Optional[str]) -> Optional[TraceContext]:
+    """Parse an incoming ``traceparent`` value; lenient — absent or
+    malformed context means "start a fresh trace" (a bad header must not
+    fail a request that would otherwise serve)."""
+    if not raw:
+        return None
+    parts = raw.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or version == "ff":
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+            return None
+        sampled = bool(int(flags[:2], 16) & 0x01)
+    except ValueError:
+        return None
+    return TraceContext(trace_id=trace_id, span_id=span_id, sampled=sampled)
+
+
+def trace_scope(ctx: Optional[TraceContext]):
+    """Adopt a remote trace context for the enclosed block (server edges:
+    the next span opened becomes the remote caller's child).  No-op when
+    ctx is None — the first span then roots a fresh trace."""
+    if ctx is None:
+        return nullcontext()
+    return _ctx_scope(ctx)
+
+
+@contextmanager
+def _ctx_scope(ctx: TraceContext):
+    token = TRACE_VAR.set(ctx)
+    try:
+        yield ctx
+    finally:
+        TRACE_VAR.reset(token)
 
 
 @dataclass
 class Span:
     puid: str
-    name: str  # node name, or "request" / "dispatch"
-    kind: str  # "request" | "node" | "dispatch" | "client"
+    name: str  # node name, or "request" / "dispatch" / "batch_queue"
+    kind: str  # "request" | "node" | "dispatch" | "client" | "server" | "queue" | "batch"
     method: str  # predict / route / aggregate / ...
     start_s: float  # epoch seconds
     duration_ms: float
     attrs: Dict[str, Any] = field(default_factory=dict)
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
+    #: point-in-time occurrences inside the span: retry attempts, backoff
+    #: sleeps, breaker-open short-circuits, degradation fallbacks —
+    #: [{"name": ..., "ts": epoch_s, "attrs": {...}}]
+    events: List[Dict[str, Any]] = field(default_factory=list)
+
+    @property
+    def end_s(self) -> float:
+        return self.start_s + self.duration_ms / 1e3
 
     def to_json_dict(self) -> dict:
         out = {
@@ -54,22 +213,72 @@ class Span:
             "start_s": round(self.start_s, 6),
             "duration_ms": round(self.duration_ms, 3),
         }
+        if self.trace_id:
+            out["trace_id"] = self.trace_id
+        if self.span_id:
+            out["span_id"] = self.span_id
+        if self.parent_span_id:
+            out["parent_span_id"] = self.parent_span_id
         if self.attrs:
             out["attrs"] = self.attrs
+        if self.events:
+            out["events"] = self.events
         return out
 
 
-class Tracer:
-    """Bounded ring of recent spans, queryable by puid.  Thread-safe: spans
-    arrive from the event loop and from device-dispatch executor threads."""
+class SpanHandle(dict):
+    """What an open ``tracer.span(...)`` yields.  IS the span's attrs dict
+    (``sp["rows"] = 4`` keeps working, and ``isinstance(sp, dict)`` call
+    sites stay valid) plus ``event()`` for point-in-time records."""
 
-    def __init__(self, capacity: int = 8192, enabled: Optional[bool] = None):
+    def __init__(self, attrs: Optional[dict] = None):
+        super().__init__(attrs or {})
+        self.events: List[Dict[str, Any]] = []
+
+    def event(self, name: str, **attrs: Any) -> None:
+        ev: Dict[str, Any] = {"name": name, "ts": round(time.time(), 6)}
+        if attrs:
+            ev["attrs"] = attrs
+        self.events.append(ev)
+
+
+class Tracer:
+    """Bounded ring of recent spans with puid / trace_id secondary
+    indexes.  Thread-safe: spans arrive from the event loop and from
+    device-dispatch executor threads."""
+
+    def __init__(
+        self,
+        capacity: int = 8192,
+        enabled: Optional[bool] = None,
+        sample: Optional[float] = None,
+    ):
         if enabled is None:
             enabled = os.environ.get("SELDON_TPU_TRACE", "") not in ("", "0")
+        if sample is None:
+            try:
+                sample = float(os.environ.get("SELDON_TPU_TRACE_SAMPLE", "1.0"))
+            except ValueError:
+                sample = 1.0
         self.enabled = bool(enabled)
-        self._spans: deque = deque(maxlen=int(capacity))
+        self.sample = min(max(float(sample), 0.0), 1.0)
+        self.capacity = int(capacity)
+        self._spans: deque = deque()
+        # secondary indexes share the ring's insertion order, so eviction
+        # is popleft on both sides — trace()/by_trace() never scan the
+        # ring under the hot-path lock (satellite: the old O(capacity)
+        # linear scan serialized queries against add() at volume)
+        self._by_puid: Dict[str, deque] = {}
+        self._by_trace: Dict[str, deque] = {}
+        #: open spans by span_id — event() targets the active one
+        self._open: Dict[str, SpanHandle] = {}
         self._lock = threading.Lock()
         self._null = nullcontext()
+        self._rng = random  # tests may inject random.Random(seed)
+        self.recorded_total = 0
+        self.sampled_out_total = 0
+
+    # -- admin -------------------------------------------------------------
 
     def enable(self) -> None:
         self.enabled = True
@@ -80,20 +289,77 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._by_puid.clear()
+            self._by_trace.clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Tracer health for ``/stats``."""
+        with self._lock:
+            spans = len(self._spans)
+            traces = len(self._by_trace)
+        return {
+            "enabled": self.enabled,
+            "sample": self.sample,
+            "spans": spans,
+            "traces_indexed": traces,
+            "capacity": self.capacity,
+            "recorded_total": self.recorded_total,
+            "sampled_out_total": self.sampled_out_total,
+        }
+
+    # -- recording ---------------------------------------------------------
 
     def span(self, puid: str, name: str, kind: str = "node",
              method: str = "", **attrs):
         if not self.enabled:
             return self._null
-        return self._record(puid, name, kind, method, attrs)
+        parent = TRACE_VAR.get()
+        if parent is not None:
+            if not parent.sampled:
+                return self._null  # the root's head decision governs
+            ctx = parent.child(puid)
+            parent_id = parent.span_id
+        else:
+            # head sampling: decided ONCE here, at the trace root; the
+            # bit rides the traceparent flags to every other process
+            if self.sample < 1.0 and self._rng.random() >= self.sample:
+                self.sampled_out_total += 1
+                return self._unsampled(puid)
+            ctx = TraceContext(
+                trace_id=new_trace_id(), span_id=new_span_id(),
+                sampled=True, puid=puid,
+            )
+            parent_id = ""
+        return self._record(puid or ctx.puid, name, kind, method, attrs,
+                            ctx, parent_id)
 
     @contextmanager
-    def _record(self, puid, name, kind, method, attrs):
+    def _unsampled(self, puid: str):
+        """A sampled-out root still sets a (not-sampled) context with real
+        ids, so child hops — local and remote — inherit the decision
+        instead of re-drawing it and recording orphan subtrees."""
+        ctx = TraceContext(
+            trace_id=new_trace_id(), span_id=new_span_id(),
+            sampled=False, puid=puid,
+        )
+        token = TRACE_VAR.set(ctx)
+        try:
+            yield None
+        finally:
+            TRACE_VAR.reset(token)
+
+    @contextmanager
+    def _record(self, puid, name, kind, method, attrs, ctx, parent_id):
+        handle = SpanHandle(attrs)
+        token = TRACE_VAR.set(ctx)
+        self._open[ctx.span_id] = handle
         t0 = time.perf_counter()
         start = time.time()
         try:
-            yield attrs  # callers may add attrs while the span is open
+            yield handle  # callers may add attrs / events while open
         finally:
+            TRACE_VAR.reset(token)
+            self._open.pop(ctx.span_id, None)
             self.add(
                 Span(
                     puid=puid,
@@ -102,18 +368,105 @@ class Tracer:
                     method=method,
                     start_s=start,
                     duration_ms=(time.perf_counter() - t0) * 1e3,
-                    attrs=attrs,
+                    attrs=dict(handle),
+                    trace_id=ctx.trace_id,
+                    span_id=ctx.span_id,
+                    parent_span_id=parent_id,
+                    events=handle.events,
                 )
             )
+
+    def event(self, name: str, **attrs: Any) -> bool:
+        """Attach a point-in-time event to the ACTIVE span (retry attempt,
+        backoff sleep, breaker-open short-circuit, fallback).  Returns
+        False (and records nothing) when tracing is off, the trace is
+        sampled out, or no span is open."""
+        if not self.enabled:
+            return False
+        ctx = TRACE_VAR.get()
+        if ctx is None or not ctx.sampled:
+            return False
+        handle = self._open.get(ctx.span_id)
+        if handle is None:
+            return False
+        handle.event(name, **attrs)
+        return True
+
+    def record_span(
+        self,
+        name: str,
+        kind: str,
+        method: str = "",
+        start_s: float = 0.0,
+        duration_ms: float = 0.0,
+        ctx: Optional[TraceContext] = None,
+        puid: str = "",
+        **attrs: Any,
+    ) -> None:
+        """Record an already-measured span — for phases whose start and
+        end are observed from outside a ``with`` block (micro-batch queue
+        wait: enqueue in one task, dequeue in the flush task).  ``ctx``
+        (captured at the causal start) parents the span; a not-sampled
+        ctx records nothing."""
+        if not self.enabled:
+            return
+        if ctx is not None:
+            if not ctx.sampled:
+                return
+            trace_id, parent_id = ctx.trace_id, ctx.span_id
+            puid = puid or ctx.puid
+        else:
+            if self.sample < 1.0 and self._rng.random() >= self.sample:
+                return
+            trace_id, parent_id = "", ""
+        self.add(
+            Span(
+                puid=puid, name=name, kind=kind, method=method,
+                start_s=start_s, duration_ms=duration_ms, attrs=attrs,
+                trace_id=trace_id, span_id=new_span_id(),
+                parent_span_id=parent_id,
+            )
+        )
 
     def add(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
+            if span.puid:
+                self._by_puid.setdefault(span.puid, deque()).append(span)
+            if span.trace_id:
+                self._by_trace.setdefault(span.trace_id, deque()).append(span)
+            while len(self._spans) > self.capacity:
+                old = self._spans.popleft()
+                # index deques share insertion order with the ring, so the
+                # evictee is the head of its index entries
+                for index, key in (
+                    (self._by_puid, old.puid), (self._by_trace, old.trace_id)
+                ):
+                    if not key:
+                        continue
+                    entries = index.get(key)
+                    if entries:
+                        entries.popleft()
+                        if not entries:
+                            del index[key]
+            self.recorded_total += 1
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        RECORDER.record_trace_span(span.kind or "span")
+
+    # -- queries -----------------------------------------------------------
 
     def trace(self, puid: str) -> List[Span]:
-        """All recorded spans of one request, in start order."""
+        """All recorded spans of one request, in start order — O(result)
+        via the puid index."""
         with self._lock:
-            found = [s for s in self._spans if s.puid == puid]
+            found = list(self._by_puid.get(puid, ()))
+        return sorted(found, key=lambda s: s.start_s)
+
+    def by_trace(self, trace_id: str) -> List[Span]:
+        """All recorded spans of one trace, in start order — O(result)."""
+        with self._lock:
+            found = list(self._by_trace.get(trace_id, ()))
         return sorted(found, key=lambda s: s.start_s)
 
     def recent(self, n: int = 100) -> List[Span]:
@@ -124,16 +477,267 @@ class Tracer:
 TRACER = Tracer()
 
 
+# ---------------------------------------------------------------------------
+# Trace assembly: span tree, critical path, phase decomposition, export
+# ---------------------------------------------------------------------------
+
+
+def _links(spans: List[Span]) -> Tuple[List[Span], Dict[str, List[Span]]]:
+    """(roots, children-by-parent-span-id).  A span whose parent is not in
+    the set is a root (the parent lives in a process we can't see, or the
+    span predates the causal tracer)."""
+    by_id = {s.span_id: s for s in spans if s.span_id}
+    kids: Dict[str, List[Span]] = {}
+    roots: List[Span] = []
+    for s in spans:
+        if s.parent_span_id and s.parent_span_id in by_id:
+            kids.setdefault(s.parent_span_id, []).append(s)
+        else:
+            roots.append(s)
+    for lst in kids.values():
+        lst.sort(key=lambda s: s.start_s)
+    return roots, kids
+
+
+def assemble_tree(spans: List[Span]) -> List[dict]:
+    """Nested JSON span tree(s) — one entry per root, children ordered by
+    start time."""
+    roots, kids = _links(spans)
+
+    def node(s: Span) -> dict:
+        out = s.to_json_dict()
+        out["children"] = [node(c) for c in kids.get(s.span_id, [])]
+        return out
+
+    return [node(r) for r in sorted(roots, key=lambda s: s.start_s)]
+
+
+def critical_path(spans: List[Span]) -> Tuple[Optional[Span], List[Tuple[Span, float]]]:
+    """(root, segments): the chain of spans that gated the root's wall
+    clock, as ``(span, self_ms)`` contributions.  Walks backward from the
+    root's end, descending into the latest-ending child each time — the
+    standard span-tree critical path.  Segment self-times sum to the root
+    duration exactly (children are clipped to their parent's window), so
+    the decomposition accounts for 100% of observed latency."""
+    roots, kids = _links(spans)
+    if not roots:
+        return None, []
+    # prefer the request-edge span; fall back to the longest root
+    root = max(roots, key=lambda s: (s.kind == "request", s.duration_ms))
+    segments: List[Tuple[Span, float]] = []
+
+    def visit(sp: Span, cutoff: float, floor: float) -> None:
+        # both bounds clip to the parent's window: cross-process clocks
+        # skew, and reconstructed spans (queue waits) mix time.time() with
+        # perf_counter deltas — without the floor a child that "starts"
+        # before its parent would leak time outside the root's duration
+        # and break the sums-exactly invariant
+        start = max(sp.start_s, floor)
+        cursor = min(sp.end_s, cutoff)
+        children = sorted(kids.get(sp.span_id, []), key=lambda c: c.end_s)
+        while children and cursor > start:
+            c = children.pop()  # latest-ending child gates the parent
+            c_end = min(c.end_s, cursor)
+            c_start = max(c.start_s, start)
+            if c_end <= c_start or c_start >= cursor:
+                continue
+            if cursor > c_end:
+                segments.append((sp, (cursor - c_end) * 1e3))
+            visit(c, c_end, c_start)
+            cursor = c_start
+        if cursor > start:
+            segments.append((sp, (cursor - start) * 1e3))
+
+    visit(root, root.end_s, root.start_s)
+    return root, segments
+
+
+#: span kind -> latency phase of the per-phase decomposition
+_PHASE_BY_KIND = {
+    "queue": "queue_ms",
+    "client": "network_ms",
+    "dispatch": "dispatch_ms",
+    "batch": "dispatch_ms",
+}
+
+
+def phase_decomposition(segments: List[Tuple[Span, float]]) -> Dict[str, float]:
+    """Bucket critical-path segments into the phases perf work steers by:
+    queue (micro-batch wait) / retry+backoff (sleeps between attempts) /
+    network (client-span self time: wire + remote queueing we can't see) /
+    dispatch (device) / decode (token generation) / other (host logic).
+    Sums to the root duration."""
+    phases = {
+        "queue_ms": 0.0, "retry_backoff_ms": 0.0, "network_ms": 0.0,
+        "dispatch_ms": 0.0, "decode_ms": 0.0, "other_ms": 0.0,
+    }
+    for sp, self_ms in segments:
+        if sp.method in ("generate_stream", "decode"):
+            key = "decode_ms"
+        else:
+            key = _PHASE_BY_KIND.get(sp.kind, "other_ms")
+        if sp.kind == "client" and sp.events:
+            # backoff sleeps happen inside the client span's wall time but
+            # are retry cost, not network cost
+            backoff = sum(
+                float((e.get("attrs") or {}).get("backoff_ms", 0.0))
+                for e in sp.events
+                if e.get("name") == "retry"
+            )
+            take = min(backoff, self_ms)
+            phases["retry_backoff_ms"] += take
+            self_ms -= take
+        phases[key] += self_ms
+    phases["total_ms"] = round(sum(phases.values()), 3)
+    for k in list(phases):
+        phases[k] = round(phases[k], 3)
+    return phases
+
+
+def chrome_trace(spans: List[Span]) -> dict:
+    """Chrome trace-event JSON (the ``{"traceEvents": [...]}`` object
+    format) — loadable in Perfetto / chrome://tracing.  Spans become
+    complete ('X') events on one lane per (kind, name); span events become
+    instant ('i') marks on the owner's lane."""
+    events: List[dict] = []
+    if not spans:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    base = min(s.start_s for s in spans)
+    lanes: Dict[Tuple[str, str], int] = {}
+    for s in sorted(spans, key=lambda x: x.start_s):
+        tid = lanes.setdefault((s.kind, s.name), len(lanes) + 1)
+        args: Dict[str, Any] = dict(s.attrs)
+        if s.puid:
+            args["puid"] = s.puid
+        if s.span_id:
+            args["span_id"] = s.span_id
+        if s.parent_span_id:
+            args["parent_span_id"] = s.parent_span_id
+        events.append({
+            "name": f"{s.name}:{s.method}" if s.method else s.name,
+            "cat": s.kind or "span",
+            "ph": "X",
+            "ts": round((s.start_s - base) * 1e6, 1),
+            "dur": round(s.duration_ms * 1e3, 1),
+            "pid": 0,
+            "tid": tid,
+            "args": args,
+        })
+        for ev in s.events:
+            events.append({
+                "name": ev.get("name", "event"),
+                "cat": "event",
+                "ph": "i",
+                "s": "t",
+                "ts": round((float(ev.get("ts", s.start_s)) - base) * 1e6, 1),
+                "pid": 0,
+                "tid": tid,
+                "args": ev.get("attrs", {}),
+            })
+    for (kind, name), tid in lanes.items():
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+            "args": {"name": f"{kind}:{name}"},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _select_spans(
+    tracer: Tracer, puid: str = "", trace_id: str = "", limit: int = 100
+) -> List[Span]:
+    """Spans for one request: by trace_id directly, or by puid widened to
+    every trace the puid participates in (picks up same-trace spans that
+    carry no puid, e.g. flush/dispatch internals)."""
+    if trace_id:
+        return tracer.by_trace(trace_id)
+    if not puid:
+        return tracer.recent(limit)
+    spans = list(tracer.trace(puid))
+    seen = {id(s) for s in spans}
+    for tid in {s.trace_id for s in spans if s.trace_id}:
+        for s in tracer.by_trace(tid):
+            if id(s) not in seen:
+                seen.add(id(s))
+                spans.append(s)
+    return sorted(spans, key=lambda s: s.start_s)
+
+
+def trace_document(
+    tracer: Tracer, puid: str = "", trace_id: str = "", limit: int = 100
+) -> dict:
+    """The ``GET /trace`` body: flat spans (back-compat) plus the
+    assembled tree, critical path, and per-phase decomposition when a
+    specific request is named."""
+    spans = _select_spans(tracer, puid, trace_id, limit)
+    doc: Dict[str, Any] = {
+        "enabled": tracer.enabled,
+        "sample": tracer.sample,
+        "spans": [s.to_json_dict() for s in spans],
+    }
+    if puid or trace_id:
+        doc["tree"] = assemble_tree(spans)
+        root, segments = critical_path(spans)
+        doc["critical_path"] = [
+            {
+                "span_id": sp.span_id,
+                "name": sp.name,
+                "kind": sp.kind,
+                "method": sp.method,
+                "self_ms": round(self_ms, 3),
+            }
+            for sp, self_ms in segments
+        ]
+        doc["phases"] = phase_decomposition(segments)
+        if root is not None:
+            doc["root_span_id"] = root.span_id
+            doc["root_duration_ms"] = round(root.duration_ms, 3)
+    return doc
+
+
+def export_document(
+    tracer: Tracer, puid: str = "", trace_id: str = "", limit: int = 1000
+) -> dict:
+    """The ``GET /trace/export`` body — Chrome trace-event JSON."""
+    return chrome_trace(_select_spans(tracer, puid, trace_id, limit))
+
+
+# ---------------------------------------------------------------------------
+# Device profiling
+# ---------------------------------------------------------------------------
+
+_PROFILE_LOCK = threading.Lock()
+
+
 @contextmanager
 def device_profile(logdir: str):
     """Capture a jax.profiler trace (XLA op timeline, TPU utilisation) for
     the enclosed block; view with TensorBoard/xprof.  This is the
     device-level complement to host spans: inside one compiled graph the
-    per-op timing only exists here."""
+    per-op timing only exists here.
+
+    Re-entrancy safe: ``jax.profiler.start_trace`` raises when a trace is
+    already active, so a nested or concurrent profile request records a
+    ``device_profile_skipped`` span event (or a zero-length span when no
+    span is open) and the block runs unprofiled."""
     import jax
 
-    jax.profiler.start_trace(logdir)
-    try:
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        if not TRACER.event(
+            "device_profile_skipped", logdir=str(logdir),
+            reason="profiler already active",
+        ):
+            TRACER.record_span(
+                "device_profile_skipped", kind="profile",
+                start_s=time.time(), duration_ms=0.0,
+                ctx=current_trace_context(), logdir=str(logdir),
+            )
         yield
+        return
+    try:
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
     finally:
-        jax.profiler.stop_trace()
+        _PROFILE_LOCK.release()

@@ -102,5 +102,6 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_speculative_accept_ratio",
         "seldon_tpu_compile_cache_events_total",
         "seldon_tpu_kv_cache_slots",
+        "seldon_tpu_trace_spans_total",
     ):
         assert family in text, f"{family} missing from every dashboard"
