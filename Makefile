@@ -23,6 +23,12 @@ chaos:
 trace-demo:
 	python scripts/trace_demo.py --out trace_demo
 
+# performance-observatory demo: a 3-node compiled ensemble under a batch
+# mix, the GET /perf per-executable cost/MFU/roofline table dumped as an
+# artifact (perf_demo/perf.json) + printed (scripts/perf_demo.py)
+perf-demo:
+	python scripts/perf_demo.py --out perf_demo
+
 bench:
 	python bench.py
 
@@ -67,4 +73,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo bench demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo bench demos train-demo stack bundle images publish release-dryrun

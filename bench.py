@@ -47,6 +47,10 @@ import sys
 import tempfile
 import time
 
+from seldon_core_tpu.utils.chips import (
+    PEAK_BF16_TFLOPS as _PEAK_BF16_TFLOPS,  # noqa: F401 - spec table re-export
+    chip_peak_tflops as _chip_peak_tflops,
+)
 from seldon_core_tpu.utils.fence import fetch_sync
 
 REFERENCE_REST_QPS = 12088.95  # docs/benchmarking.md:44
@@ -231,25 +235,12 @@ def probe_mfu(smoke: bool) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
-# advertised peak dense bf16 matmul throughput per chip, TFLOP/s (public
-# spec sheets; device_kind substring -> peak).  MFU here divides by the
-# bf16 peak even for the int8 path, so int8 "MFU" can legitimately exceed
+# the per-chip advertised-peak table lives in the shared chip table
+# (utils/chips.py, imported above) so bench MFU and the runtime
+# performance observatory (utils/perf.py, GET /perf) normalize against
+# the SAME peaks and can never disagree.  MFU here divides by the bf16
+# peak even for the int8 path, so int8 "MFU" can legitimately exceed
 # the bf16-normalized number — the ratio key is the honest comparison.
-_PEAK_BF16_TFLOPS = (
-    ("v6 lite", 918.0), ("v6e", 918.0),
-    ("v5p", 459.0),
-    ("v5 lite", 197.0), ("v5e", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0), ("v2", 46.0),
-)
-
-
-def _chip_peak_tflops(device_kind: str):
-    dk = device_kind.lower()
-    for frag, peak in _PEAK_BF16_TFLOPS:
-        if frag in dk:
-            return peak, False
-    return 197.0, True  # conservative default, flagged as assumed
 
 
 def _probe_mfu_main(smoke: bool) -> None:

@@ -27,6 +27,8 @@ Structure conventions inside the traced program:
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -164,6 +166,20 @@ class CompiledGraph:
         self.feedback_fn = run_fb
         self._jit_predict = jax.jit(run)
         self._jit_feedback = jax.jit(run_fb)
+        # performance observatory (utils/perf.py): per-shape AOT-compiled
+        # executables, keyed by executable_key.  The explicit
+        # lower().compile() path measures the compile wall time and owns
+        # the executable whose cost_analysis() yields the static FLOP /
+        # byte features — None marks a shape where AOT failed and
+        # dispatch stays on _jit_predict
+        self._aot: Dict[str, Optional[Any]] = {}
+        self._aot_building: set = set()
+        self._aot_lock = threading.Lock()
+        # bounded like the observatory's executable table: an exploding
+        # shape set (including adversarial bad widths, which cache a
+        # failed None) must not grow memory — past the cap novel shapes
+        # ride the jit path uncaptured
+        self._aot_cap = 128
 
     # ------------------------------------------------------------------
     # trace-time builders
@@ -296,6 +312,67 @@ class CompiledGraph:
     # execution
     # ------------------------------------------------------------------
 
+    def executable_key(self, X) -> str:
+        """Stable per-shape executable identity (perf observatory key) —
+        reads only ``.shape``/``.dtype`` metadata, so naming a device
+        array's executable never forces a device-to-host transfer."""
+        from seldon_core_tpu.utils.perf import executable_key
+
+        dtype = getattr(X, "dtype", None)
+        if dtype is None:  # plain lists etc. — cold paths only
+            dtype = np.asarray(X).dtype
+        return executable_key("predict", np.shape(X), dtype)
+
+    def _ensure_executable(self, X):
+        """AOT-compile this shape once (measuring true compile wall time
+        and capturing ``compile().cost_analysis()`` features into the
+        observatory); returns (key, executable-or-None).  None means a
+        concurrent build is in flight or AOT failed — the caller
+        dispatches through ``_jit_predict`` with identical semantics."""
+        from seldon_core_tpu.utils.perf import (
+            OBSERVATORY,
+            extract_cost_features,
+        )
+
+        if not OBSERVATORY.enabled:
+            return "", None
+        key = self.executable_key(X)
+        with self._aot_lock:
+            if key in self._aot:
+                return key, self._aot[key]
+            if key in self._aot_building or len(self._aot) >= self._aot_cap:
+                # first dispatch of this shape is mid-compile in another
+                # thread (ride the jit path rather than wait), or the
+                # bounded cache is full (novel shapes go uncaptured)
+                return key, None
+            self._aot_building.add(key)
+        compiled = None
+        features = None
+        compile_s = None
+        try:
+            t0 = time.perf_counter()
+            lowered = self._jit_predict.lower(self.states, X)
+            compiled = lowered.compile()
+            compile_s = time.perf_counter() - t0
+            try:
+                features = extract_cost_features(compiled.cost_analysis())
+            except Exception:  # noqa: BLE001 - backend without the API
+                features = None
+            if features is None:
+                # pre-optimization HLO features beat no features at all
+                try:
+                    features = extract_cost_features(lowered.cost_analysis())
+                except Exception:  # noqa: BLE001
+                    features = None
+        except Exception:  # noqa: BLE001 - AOT unsupported: jit path serves
+            compiled = None
+        finally:
+            with self._aot_lock:
+                self._aot[key] = compiled
+                self._aot_building.discard(key)
+        OBSERVATORY.record_compile(key, features, compile_s)
+        return key, compiled
+
     def predict_arrays(
         self, X, update_states=True
     ) -> Tuple[Any, Dict[str, int], Dict[str, Any]]:
@@ -310,7 +387,21 @@ class CompiledGraph:
         device round-trip, letting the engine veto a write-back whose
         request already timed out (the client saw a 504 — a late state
         update would double-apply on retry)."""
-        y, new_states, routing, tags = self._jit_predict(self.states, jnp.asarray(X))
+        X = jnp.asarray(X)
+        key, executable = self._ensure_executable(X)
+        if executable is not None:
+            try:
+                y, new_states, routing, tags = executable(self.states, X)
+            except Exception:  # noqa: BLE001 - aval drift (e.g. weak-typed
+                # state leaves strengthened by an update): permanently fall
+                # back to the jit path for this shape, same program
+                with self._aot_lock:
+                    self._aot[key] = None
+                y, new_states, routing, tags = self._jit_predict(
+                    self.states, X
+                )
+        else:
+            y, new_states, routing, tags = self._jit_predict(self.states, X)
         routing_py = {
             k: int(v) for k, v in routing.items() if int(v) != NOT_ROUTED
         }

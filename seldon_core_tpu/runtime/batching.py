@@ -32,6 +32,7 @@ import numpy as np
 
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
+from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
 from seldon_core_tpu.utils.tracing import TRACER, current_trace_context
 
@@ -242,6 +243,9 @@ class MicroBatcher:
                 if target > n:
                     pad = np.repeat(chunk[-1:], target - n, axis=0)
                     chunk = np.concatenate([chunk, pad], axis=0)
+            # perf observatory: pad rows burn device FLOPs without serving
+            # traffic — /perf reports the aggregate pad-overhead share
+            OBSERVATORY.note_padding(n, len(chunk))
             if self.dispatch_timeout_s > 0:
                 try:
                     ys, chunk_aux = await asyncio.wait_for(

@@ -20,7 +20,10 @@ def enable_compile_cache() -> bool:
     Outcomes land in ``seldon_tpu_compile_cache_events_total{outcome}``
     (utils/telemetry.py): enabled/disabled/error at boot, then hit/miss
     per compile via the jax.monitoring listener — the signal that says
-    whether a restart re-pays XLA compiles or rides the cache."""
+    whether a restart re-pays XLA compiles or rides the cache.  The same
+    listener maps backend-compile durations into the
+    ``seldon_tpu_compile_seconds`` histogram, so hit/miss says WHETHER a
+    compile was paid and the histogram says how much it cost."""
     from seldon_core_tpu.utils.telemetry import (
         RECORDER,
         install_compile_cache_listener,

@@ -49,6 +49,7 @@ from seldon_core_tpu.runtime.resilience import (
     remaining_s,
 )
 from seldon_core_tpu.utils.metrics import MetricsRegistry
+from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER, AuditLog
 
 __all__ = ["EngineService"]
@@ -294,8 +295,22 @@ class EngineService:
                 },
             },
             "telemetry": RECORDER.snapshot(),
+            "perf": OBSERVATORY.snapshot(),
             "tracer": TRACER.snapshot(),
             "audit": self.audit.snapshot(),
+        }
+
+    def perf_document(self) -> dict:
+        """The ``GET /perf`` body: the process-global performance
+        observatory (per-executable cost/MFU/roofline table + HBM
+        watermarks, utils/perf.py) under this engine's identity."""
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            **OBSERVATORY.document(),
         }
 
     def open_breakers(self) -> "list[str]":
@@ -535,6 +550,7 @@ class EngineService:
         # a stacked dispatch serves many requests, so the span stands
         # alone (per-request causality is the queue-wait span)
         cc_before = dict(RECORDER.compile_cache_events)
+        t_dispatch = time.perf_counter()
         with self.tracer.span(
             "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
         ) as sp:
@@ -566,6 +582,17 @@ class EngineService:
             # the readback belongs inside the span: jax dispatch is async,
             # so the device+relay round-trip is only paid here
             y = np.asarray(y)
+            # performance observatory: measured wall (enqueue + device +
+            # relay + readback) against this executable's static cost
+            # features -> achieved MFU / roofline bound stamped onto the
+            # span; the sampled dispatch-trace id rides the latency
+            # histogram as an OpenMetrics exemplar
+            if OBSERVATORY.enabled:
+                OBSERVATORY.observe_and_stamp(
+                    self.compiled.executable_key(stacked),
+                    time.perf_counter() - t_dispatch,
+                    rows=len(stacked), span=sp,
+                )
             if isinstance(sp, dict):
                 # compile-cache traffic during this dispatch (fresh shape
                 # -> XLA compile): visible per-span, not just as counters

@@ -15,9 +15,15 @@ Semantics match ``rest.py:make_engine_app`` route for route:
   POST /api/v0.1/predictions   JSON body or form field ``json=``
   POST /predict                internal-API alias (engine as MODEL leaf)
   POST /api/v0.1/feedback
-  POST /trace/enable /trace/disable (GET aliases deprecated one release)
+  POST /trace/enable /trace/disable (POST-only: the PR-3 GET-alias
+       deprecation window is closed; GET now answers 404)
   GET  /ping /ready /pause /unpause /prometheus /stats
+  GET  /perf                   performance observatory (utils/perf.py)
   GET  /trace /trace/export
+
+``GET /prometheus?format=openmetrics`` serves the OpenMetrics exposition
+(trace_id exemplars on ``seldon_tpu_dispatch_seconds`` buckets) — query
+negotiation, because fast-lane handlers don't see request headers.
 
 Protocol scope (documented contract, tested in tests/test_httpfast.py):
 HTTP/1.1 with keepalive and Content-Length bodies.  Pipelined requests
@@ -119,22 +125,13 @@ class _EngineRoutes:
             b"/unpause": self._unpause,
             b"/prometheus": self._prometheus,
             b"/stats": self._stats,
+            b"/perf": self._perf,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
-            # deprecated one release: state mutation via GET (answered
-            # with a Deprecation header, same as the aiohttp lane)
-            b"/trace/enable": self._deprecated(self._trace_enable),
-            b"/trace/disable": self._deprecated(self._trace_disable),
+            # NB: no GET /trace/enable|disable — the PR-3 deprecation
+            # window for mutation-via-GET is closed (POST-only now)
             b"/api/v0.1/events": self._events,
         }
-
-    @staticmethod
-    def _deprecated(handler):
-        async def wrapped(body, ctype, query):
-            status, resp, rctype = await handler(body, ctype, query)
-            return status, resp, rctype, b"Deprecation: true\r\n"
-
-        return wrapped
 
     async def _events(self, body, ctype, query) -> Result:
         # stubbed external surface, reference-exact
@@ -205,12 +202,27 @@ class _EngineRoutes:
         return 200, b"unpaused", "text/plain"
 
     async def _prometheus(self, body, ctype, query) -> Result:
+        # ?format=openmetrics serves the exemplar-carrying OpenMetrics
+        # exposition (fast-lane handlers don't see Accept headers)
+        if parse_qs(query).get("format", [""])[0] == "openmetrics":
+            from seldon_core_tpu.utils.metrics import OPENMETRICS_CONTENT_TYPE
+
+            return (
+                200,
+                self.engine.metrics.exposition(openmetrics=True),
+                OPENMETRICS_CONTENT_TYPE,
+            )
         return 200, self.engine.metrics.exposition(), CONTENT_TYPE_LATEST
 
     async def _stats(self, body, ctype, query) -> Result:
         import json as _json
 
         return 200, _json.dumps(self.engine.stats()).encode(), _JSON
+
+    async def _perf(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return 200, _json.dumps(self.engine.perf_document()).encode(), _JSON
 
     async def _trace(self, body, ctype, query) -> Result:
         import json as _json

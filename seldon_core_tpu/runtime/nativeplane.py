@@ -33,9 +33,12 @@ import logging
 import os
 import subprocess
 import threading
+import time
 from typing import Optional
 
 import numpy as np
+
+from seldon_core_tpu.utils.perf import OBSERVATORY
 
 __all__ = ["NativeDataPlane", "native_plane_available"]
 
@@ -318,13 +321,28 @@ class NativeDataPlane:
                     "", "plane_batch", kind="plane", rows=rows
                 ):
                     padded = _pad_rows(x, self.max_batch)
+                    # pad rows burn device FLOPs without serving traffic —
+                    # same accounting as the Python batcher's lane
+                    OBSERVATORY.note_padding(rows, len(padded))
+                    t_dispatch = time.perf_counter()
                     with engine.tracer.span(
                         "", "dispatch", kind="dispatch", method="native",
                         rows=rows,
-                    ):
+                    ) as sp:
                         y, routing, tags = engine.compiled.predict_arrays(
                             padded, update_states=False
                         )
+                        # force the readback inside the span (jax dispatch
+                        # is async — device+relay time is only paid here)
+                        # and feed the perf observatory the same measured
+                        # wall the engine lane records
+                        y = np.asarray(y)
+                        if OBSERVATORY.enabled:
+                            OBSERVATORY.observe_and_stamp(
+                                engine.compiled.executable_key(padded),
+                                time.perf_counter() - t_dispatch,
+                                rows=rows, span=sp,
+                            )
                     if routing or tags:
                         # data-dependent tags slipped past the static
                         # checks: the C++ composer cannot merge them into

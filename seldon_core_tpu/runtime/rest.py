@@ -149,18 +149,34 @@ def make_engine_app(engine: EngineService) -> web.Application:
         engine.unpause()
         return web.Response(text="unpaused")
 
-    async def prometheus(_):
+    async def prometheus(request: web.Request):
         # CONTENT_TYPE_LATEST carries the exposition-format version parameter;
-        # aiohttp's content_type= kwarg rejects parameters, so set the header
+        # aiohttp's content_type= kwarg rejects parameters, so set the header.
+        # OpenMetrics (Accept-negotiated, or ?format=openmetrics for lane
+        # parity with httpfast) carries the trace_id exemplars on
+        # seldon_tpu_dispatch_seconds buckets
+        openmetrics = (
+            "application/openmetrics-text" in request.headers.get("Accept", "")
+            or request.query.get("format") == "openmetrics"
+        )
+        from seldon_core_tpu.utils.metrics import OPENMETRICS_CONTENT_TYPE
+
         return web.Response(
-            body=engine.metrics.exposition(),
-            headers={"Content-Type": CONTENT_TYPE_LATEST},
+            body=engine.metrics.exposition(openmetrics=openmetrics),
+            headers={"Content-Type": (
+                OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE_LATEST
+            )},
         )
 
     async def stats(_):
         # flight-recorder snapshot: batcher/bucket state, latency
         # percentiles, generation SLO telemetry — zero-dependency JSON
         return web.json_response(engine.stats())
+
+    async def perf(_):
+        # performance observatory: per-executable cost/MFU/roofline table
+        # + HBM watermarks (utils/perf.py; docs/operations.md runbook)
+        return web.json_response(engine.perf_document())
 
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
@@ -182,19 +198,6 @@ def make_engine_app(engine: EngineService) -> web.Application:
             trace_id=request.query.get("trace_id", ""),
             limit=int(request.query.get("limit", "1000")),
         ))
-
-    def _deprecated_get(handler):
-        # state-mutating GETs survive one release as aliases; the POST
-        # routes are the documented admin surface (docs/operations.md)
-        async def wrapped(request: web.Request) -> web.Response:
-            resp = await handler(request)
-            resp.headers["Deprecation"] = "true"
-            resp.headers["Link"] = '<%s>; rel="successor-version"' % (
-                request.path,
-            )
-            return resp
-
-        return wrapped
 
     async def trace_enable(_):
         from seldon_core_tpu.utils.tracing import TRACER
@@ -256,13 +259,13 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/unpause", unpause)
     app.router.add_get("/prometheus", prometheus)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/perf", perf)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)
+    # POST-only: the PR-3 deprecation window for the GET mutation aliases
+    # is closed — GET /trace/enable|disable now answers 405
     app.router.add_post("/trace/enable", trace_enable)
     app.router.add_post("/trace/disable", trace_disable)
-    # deprecated one release: state mutation via GET (pre-PR-3 surface)
-    app.router.add_get("/trace/enable", _deprecated_get(trace_enable))
-    app.router.add_get("/trace/disable", _deprecated_get(trace_disable))
     return app
 
 
@@ -370,8 +373,20 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
             "telemetry": RECORDER.snapshot(),
         })
 
+    async def perf(_):
+        # unit pods own a TPU runtime too: whatever this process compiled
+        # and dispatched shows up in its process-global observatory
+        from seldon_core_tpu.utils.perf import OBSERVATORY
+
+        return web.json_response({
+            "unit": {"name": runtime.node.name,
+                     "type": getattr(runtime.node.type, "name", None)},
+            **OBSERVATORY.document(),
+        })
+
     app.router.add_get("/ping", ping)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/perf", perf)
     return app
 
 

@@ -1,0 +1,69 @@
+"""Chip spec table — advertised per-chip peaks, shared by bench and runtime.
+
+One table, two consumers: ``bench.py`` normalizes its measured MFU against
+these peaks, and the runtime performance observatory (``utils/perf.py``)
+normalizes live per-dispatch MFU/roofline figures against the SAME
+numbers — extracting the table here is what guarantees bench MFU and
+serving MFU can never disagree about what "peak" means.
+
+Values are public spec-sheet figures; matching is by substring of
+``device.device_kind`` (e.g. "TPU v5 lite").  Unknown device kinds (CPU
+backend, future chips) fall back to a conservative default flagged
+``assumed`` so downstream figures are labelled honest rather than wrong.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+__all__ = [
+    "PEAK_BF16_TFLOPS",
+    "PEAK_HBM_GBS",
+    "chip_peak_tflops",
+    "chip_peak_hbm_gbs",
+]
+
+#: advertised peak dense bf16 matmul throughput per chip, TFLOP/s (public
+#: spec sheets; device_kind substring -> peak).  MFU divides by the bf16
+#: peak even for int8 paths, so int8 "MFU" can legitimately exceed the
+#: bf16-normalized number — ratio keys are the honest comparison.
+PEAK_BF16_TFLOPS = (
+    ("v6 lite", 918.0), ("v6e", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0), ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0), ("v2", 46.0),
+)
+
+#: advertised HBM bandwidth per chip, GB/s — the memory side of the
+#: roofline.  Decode-shaped dispatches are bound by this, not by FLOPs.
+PEAK_HBM_GBS = (
+    ("v6 lite", 1640.0), ("v6e", 1640.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0), ("v5e", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0), ("v2", 700.0),
+)
+
+#: conservative defaults (v5e-class) used when the device kind matches no
+#: table row — flagged assumed by the lookup helpers
+_DEFAULT_TFLOPS = 197.0
+_DEFAULT_HBM_GBS = 819.0
+
+
+def _lookup(table, device_kind: str, default: float) -> Tuple[float, bool]:
+    dk = (device_kind or "").lower()
+    for frag, peak in table:
+        if frag in dk:
+            return peak, False
+    return default, True  # conservative default, flagged as assumed
+
+
+def chip_peak_tflops(device_kind: str) -> Tuple[float, bool]:
+    """(peak dense bf16 TFLOP/s, assumed?) for a device kind string."""
+    return _lookup(PEAK_BF16_TFLOPS, device_kind, _DEFAULT_TFLOPS)
+
+
+def chip_peak_hbm_gbs(device_kind: str) -> Tuple[float, bool]:
+    """(peak HBM GB/s, assumed?) for a device kind string."""
+    return _lookup(PEAK_HBM_GBS, device_kind, _DEFAULT_HBM_GBS)

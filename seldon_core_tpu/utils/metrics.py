@@ -42,7 +42,18 @@ except ImportError:  # pragma: no cover
     HAVE_PROMETHEUS = False
     CONTENT_TYPE_LATEST = "text/plain"
 
-__all__ = ["MetricsRegistry", "CONTENT_TYPE_LATEST"]
+#: OpenMetrics exposition content type — the format that carries the
+#: trace_id exemplars on seldon_tpu_dispatch_seconds buckets (served by
+#: /prometheus under Accept negotiation or ?format=openmetrics)
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "CONTENT_TYPE_LATEST",
+    "OPENMETRICS_CONTENT_TYPE",
+]
 
 _BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
@@ -187,10 +198,22 @@ class MetricsRegistry:
         recorder's ``seldon_tpu_*`` set."""
         return frozenset(_OWN_FAMILIES) | frozenset(TPU_METRIC_FAMILIES)
 
-    def exposition(self) -> bytes:
+    def exposition(self, openmetrics: bool = False) -> bytes:
         """Own (deployment-labelled) families + the process-level
         ``seldon_tpu_*`` families — one scrape target per serving process
-        carries both layers."""
+        carries both layers.  ``openmetrics=True`` renders the OpenMetrics
+        format (exemplar-carrying); the two registries' outputs merge with
+        a single trailing ``# EOF`` terminator."""
         if self.registry is None:
-            return RECORDER.exposition()
+            return RECORDER.exposition(openmetrics=openmetrics)
+        if openmetrics:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_generate_latest,
+            )
+
+            own = om_generate_latest(self.registry)
+            eof = b"# EOF\n"
+            if own.endswith(eof):
+                own = own[: -len(eof)]
+            return own + RECORDER.exposition(openmetrics=True)
         return generate_latest(self.registry) + RECORDER.exposition()

@@ -84,6 +84,19 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     # causal tracer (utils/tracing.py): spans recorded per kind — the
     # signal that says whether sampling keeps trace volume sane under load
     "seldon_tpu_trace_spans_total": ("counter", ("kind",)),
+    # performance observatory (utils/perf.py): per-executable dispatch
+    # latency (bucket observations carry trace_id exemplars in the
+    # OpenMetrics exposition), achieved MFU, roofline-drift anomalies,
+    # HBM watermarks, XLA compile durations, and the per-service request
+    # latency promoted from the /stats reservoir to a real histogram
+    "seldon_tpu_dispatch_seconds": ("histogram", ("executable",)),
+    "seldon_tpu_mfu": ("gauge", ("executable",)),
+    "seldon_tpu_perf_anomaly_total": ("counter", ("kind",)),
+    "seldon_tpu_hbm_bytes_in_use": ("gauge", ("device",)),
+    "seldon_tpu_hbm_peak_bytes_in_use": ("gauge", ("device",)),
+    "seldon_tpu_hbm_bytes_limit": ("gauge", ("device",)),
+    "seldon_tpu_compile_seconds": ("histogram", ()),
+    "seldon_tpu_request_latency_seconds": ("histogram", ("service",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -94,6 +107,14 @@ _TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
 _RATE_BUCKETS = (1, 10, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
                  50000, 100000)
 _RATIO_BUCKETS = (0.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+# device dispatch spans ~1ms (tiny graphs) to tens of seconds (cold
+# compile riding a dispatch); request latency matches metrics.py _BUCKETS
+_DISPATCH_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
+                    40.0, 80.0, 160.0)
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class Reservoir:
@@ -167,6 +188,11 @@ class FlightRecorder:
         self.deadline_exceeded: Dict[str, int] = {}
         self.degraded_requests: Dict[str, int] = {}
         self.trace_spans: Dict[str, int] = {}  # causal tracer, by span kind
+        # performance observatory mirrors (utils/perf.py feeds these; the
+        # per-executable tables live in OBSERVATORY, not here)
+        self.perf_anomalies: Dict[str, int] = {}
+        self.compile_seconds = Reservoir()
+        self.hbm: Dict[str, Dict[str, int]] = {}
         #: per-service rolling request latencies feeding /stats percentiles;
         #: bounded — an exploding label set must not grow memory
         self._latency: Dict[str, Reservoir] = {}
@@ -239,6 +265,51 @@ class FlightRecorder:
                 "seldon_tpu_trace_spans_total",
                 "Causal-tracer spans recorded, by span kind",
                 ["kind"], registry=self.registry)
+            self._p_dispatch = Histogram(
+                "seldon_tpu_dispatch_seconds",
+                "Measured device-dispatch wall time per compiled "
+                "executable (bucket observations carry trace_id exemplars "
+                "in the OpenMetrics exposition)",
+                ["executable"], registry=self.registry,
+                buckets=_DISPATCH_BUCKETS)
+            self._p_mfu = Gauge(
+                "seldon_tpu_mfu",
+                "Most recent achieved MFU per executable (fraction of the "
+                "device-kind-matched advertised bf16 peak, utils/chips.py)",
+                ["executable"], registry=self.registry)
+            self._p_perf_anomaly = Counter(
+                "seldon_tpu_perf_anomaly_total",
+                "Dispatches drifting past the per-executable baseline "
+                "(slow_dispatch: vs rolling p50; ratio_drift: vs rolling "
+                "measured/predicted)",
+                ["kind"], registry=self.registry)
+            self._p_hbm = {
+                "bytes_in_use": Gauge(
+                    "seldon_tpu_hbm_bytes_in_use",
+                    "Device HBM bytes currently in use "
+                    "(device.memory_stats)", ["device"],
+                    registry=self.registry),
+                "peak_bytes_in_use": Gauge(
+                    "seldon_tpu_hbm_peak_bytes_in_use",
+                    "Device HBM high-watermark bytes "
+                    "(device.memory_stats)", ["device"],
+                    registry=self.registry),
+                "bytes_limit": Gauge(
+                    "seldon_tpu_hbm_bytes_limit",
+                    "Device HBM capacity bytes (device.memory_stats)",
+                    ["device"], registry=self.registry),
+            }
+            self._p_compile_seconds = Histogram(
+                "seldon_tpu_compile_seconds",
+                "XLA compile wall time per compiled executable "
+                "(AOT captures + jax.monitoring backend_compile events)",
+                registry=self.registry, buckets=_COMPILE_BUCKETS)
+            self._p_request_latency = Histogram(
+                "seldon_tpu_request_latency_seconds",
+                "Per-service request latency (the Prometheus face of the "
+                "/stats request_latency_s reservoirs)",
+                ["service"], registry=self.registry,
+                buckets=_LATENCY_BUCKETS)
 
     # -- batcher ---------------------------------------------------------
 
@@ -350,8 +421,56 @@ class FlightRecorder:
         if self.registry is not None:
             self._p_degraded.labels(mode=mode).inc()
 
-    # -- request latencies (feeds /stats; Prometheus side is the existing
-    # -- seldon_api_* histograms in MetricsRegistry) ---------------------
+    # -- performance observatory (utils/perf.py) --------------------------
+
+    def observe_dispatch(self, executable: str, seconds: float,
+                         mfu: Optional[float] = None,
+                         trace_id: Optional[str] = None) -> None:
+        """Per-executable dispatch latency (+ most recent MFU).  A sampled
+        trace id rides the histogram observation as an OpenMetrics
+        exemplar so a slow bucket links straight to its trace."""
+        if self.registry is None:
+            return
+        child = self._p_dispatch.labels(executable=executable)
+        try:
+            child.observe(
+                seconds,
+                exemplar={"trace_id": trace_id} if trace_id else None,
+            )
+        except (TypeError, ValueError):  # pragma: no cover - old client
+            child.observe(seconds)
+        if mfu is not None:
+            self._p_mfu.labels(executable=executable).set(mfu)
+
+    def record_perf_anomaly(self, kind: str) -> None:
+        with self._lock:
+            self.perf_anomalies[kind] = self.perf_anomalies.get(kind, 0) + 1
+        if self.registry is not None:
+            self._p_perf_anomaly.labels(kind=kind).inc()
+
+    def set_hbm(self, device: str, **stats: int) -> None:
+        """HBM watermark gauges for one device (bytes_in_use /
+        peak_bytes_in_use / bytes_limit — utils/perf.py polls
+        ``device.memory_stats()``)."""
+        with self._lock:
+            self.hbm.setdefault(device, {}).update(
+                {k: int(v) for k, v in stats.items()}
+            )
+        if self.registry is not None:
+            for k, v in stats.items():
+                gauge = self._p_hbm.get(k)
+                if gauge is not None:
+                    gauge.labels(device=device).set(v)
+
+    def record_compile_seconds(self, seconds: float) -> None:
+        """One XLA compile's wall time — fed by the AOT capture
+        (graph/compiled.py) and the jax.monitoring duration listener."""
+        self.compile_seconds.observe(seconds)
+        if self.registry is not None:
+            self._p_compile_seconds.observe(seconds)
+
+    # -- request latencies (feeds /stats percentiles + the
+    # -- seldon_tpu_request_latency_seconds histogram) --------------------
 
     def request_latency(self, service: str, seconds: float) -> None:
         res = self._latency.get(service)
@@ -363,6 +482,8 @@ class FlightRecorder:
                         return  # bounded label space; drop novel keys
                     res = self._latency[service] = Reservoir()
         res.observe(seconds)
+        if self.registry is not None:
+            self._p_request_latency.labels(service=service).observe(seconds)
 
     # -- snapshots -------------------------------------------------------
 
@@ -381,8 +502,14 @@ class FlightRecorder:
                 "degraded_requests": dict(self.degraded_requests),
             }
             trace_spans = dict(self.trace_spans)
+            perf = {
+                "anomalies": dict(self.perf_anomalies),
+                "hbm": {d: dict(v) for d, v in self.hbm.items()},
+            }
+        perf["compile_s"] = self.compile_seconds.snapshot()
         return {
             "resilience": resilience,
+            "perf": perf,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -401,9 +528,29 @@ class FlightRecorder:
             },
         }
 
-    def exposition(self) -> bytes:
+    def exposition(self, openmetrics: bool = False) -> bytes:
+        """Prometheus text exposition.  ``openmetrics=True`` renders the
+        OpenMetrics format instead — the only exposition that carries the
+        trace_id exemplars on ``seldon_tpu_dispatch_seconds`` buckets.
+
+        Scrapes are the natural HBM-watermark poll point: refresh the
+        ``seldon_tpu_hbm_*`` gauges (throttled inside the observatory) so
+        a Prometheus-only deployment — nobody polling ``/perf`` — still
+        sees live watermarks and the HBM-pressure alert can fire."""
         if self.registry is None:
             return b""
+        try:
+            from seldon_core_tpu.utils.perf import OBSERVATORY
+
+            OBSERVATORY.hbm_watermarks()
+        except Exception:  # noqa: BLE001 - scrape must never fail on polling
+            pass
+        if openmetrics:
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_generate_latest,
+            )
+
+            return om_generate_latest(self.registry)
         return generate_latest(self.registry)
 
     def reset(self) -> None:
@@ -414,6 +561,7 @@ class FlightRecorder:
         self.ttft = Reservoir()
         self.decode_rate = Reservoir()
         self.accept_ratio = Reservoir()
+        self.compile_seconds = Reservoir()
         self.inflight = 0
         with self._lock:
             self.kv_slots = {}
@@ -426,6 +574,8 @@ class FlightRecorder:
             self.deadline_exceeded = {}
             self.degraded_requests = {}
             self.trace_spans = {}
+            self.perf_anomalies = {}
+            self.hbm = {}
 
 
 RECORDER = FlightRecorder()
@@ -581,15 +731,26 @@ class AuditLog:
 # ---------------------------------------------------------------------------
 
 _compile_listener_installed = False
+#: set only when the jax.monitoring DURATION listener registered — older
+#: jax builds have the count-event API but not the duration one, and the
+#: AOT compile capture (utils/perf.py) must keep recording durations
+#: itself in that case
+_compile_duration_listener_installed = False
 
 
 def install_compile_cache_listener() -> bool:
-    """Map jax.monitoring compilation-cache events onto
-    ``seldon_tpu_compile_cache_events_total{outcome=hit|miss}``.  Event
-    names vary across jax versions; anything compilation-cache-flavoured
-    is classified by substring, everything else ignored.  Idempotent;
-    returns True when a listener is registered."""
-    global _compile_listener_installed
+    """Map jax.monitoring compilation events onto the flight recorder:
+    compilation-cache events become
+    ``seldon_tpu_compile_cache_events_total{outcome=hit|miss}`` counts,
+    and backend-compile durations (``/jax/core/compile/
+    backend_compile_duration``-shaped events) land in the
+    ``seldon_tpu_compile_seconds`` histogram — hit/miss says WHETHER a
+    restart re-pays XLA compiles, the durations say how much each one
+    cost.  Event names vary across jax versions; classification is by
+    substring, everything else ignored.  Degrades cleanly (returns False,
+    nothing registered) when jax.monitoring is absent.  Idempotent;
+    returns True when listeners are registered."""
+    global _compile_listener_installed, _compile_duration_listener_installed
     if _compile_listener_installed:
         return True
     try:
@@ -603,7 +764,19 @@ def install_compile_cache_listener() -> bool:
             elif "miss" in name:
                 RECORDER.record_compile_cache("miss")
 
+        def _on_duration(name: str, duration_secs: float, **kw) -> None:
+            if "backend_compile" in name:
+                RECORDER.record_compile_seconds(float(duration_secs))
+
         _mon.register_event_listener(_on_event)
+        # older jax builds may lack the duration-listener API; the count
+        # listener alone is still worth keeping
+        register_duration = getattr(
+            _mon, "register_event_duration_secs_listener", None
+        )
+        if register_duration is not None:
+            register_duration(_on_duration)
+            _compile_duration_listener_installed = True
         _compile_listener_installed = True
         return True
     except Exception:

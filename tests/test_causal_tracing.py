@@ -505,7 +505,10 @@ def test_client_feedback_and_aggregate_puid_correlation():
 # ---------------------------------------------------------------------------
 
 
-def test_trace_admin_post_with_deprecated_get_aliases():
+def test_trace_admin_is_post_only():
+    """The PR-3 GET-alias deprecation window is closed: mutation via GET
+    now answers 405 (the POST route exists, the GET does not) and flips
+    nothing."""
     from seldon_core_tpu.runtime.rest import make_engine_app
 
     spec = deployment(
@@ -523,14 +526,15 @@ def test_trace_admin_post_with_deprecated_get_aliases():
             r = await client.post("/trace/disable")
             assert r.status == 200
             assert not TRACER.enabled
-            # GET aliases still work but are marked deprecated
+            # deprecation window closed: GET mutation is gone
             r = await client.get("/trace/enable")
-            assert r.status == 200
-            assert r.headers.get("Deprecation") == "true"
-            assert TRACER.enabled
-            r = await client.get("/trace/disable")
-            assert r.headers.get("Deprecation") == "true"
+            assert r.status in (404, 405)
             assert not TRACER.enabled
+            await client.post("/trace/enable")
+            r = await client.get("/trace/disable")
+            assert r.status in (404, 405)
+            assert TRACER.enabled
+            await client.post("/trace/disable")
 
     asyncio.run(run())
 
@@ -610,12 +614,13 @@ def test_httpfast_trace_routes_and_post_admin():
                 async with sess.post(base + "/trace/disable") as r:
                     assert r.status == 200
                     assert "Deprecation" not in r.headers
-                # GET aliases still work, marked deprecated (lane parity)
+                # deprecation window closed: GET mutation gone (lane
+                # parity with the aiohttp app's 405)
                 async with sess.get(base + "/trace/enable") as r:
-                    assert r.status == 200
-                    assert r.headers.get("Deprecation") == "true"
+                    assert r.status in (404, 405)
+                assert not TRACER.enabled
                 async with sess.get(base + "/trace/disable") as r:
-                    assert r.headers.get("Deprecation") == "true"
+                    assert r.status in (404, 405)
         finally:
             await server.stop()
 

@@ -103,5 +103,14 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_compile_cache_events_total",
         "seldon_tpu_kv_cache_slots",
         "seldon_tpu_trace_spans_total",
+        # performance observatory (utils/perf.py)
+        "seldon_tpu_dispatch_seconds",
+        "seldon_tpu_mfu",
+        "seldon_tpu_perf_anomaly_total",
+        "seldon_tpu_hbm_bytes_in_use",
+        "seldon_tpu_hbm_peak_bytes_in_use",
+        "seldon_tpu_hbm_bytes_limit",
+        "seldon_tpu_compile_seconds",
+        "seldon_tpu_request_latency_seconds",
     ):
         assert family in text, f"{family} missing from every dashboard"

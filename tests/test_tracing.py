@@ -157,7 +157,7 @@ def test_rest_trace_endpoints():
         engine = EngineService(spec)
         app = make_engine_app(engine)
         async with TestClient(TestServer(app)) as client:
-            r = await client.get("/trace/enable")
+            r = await client.post("/trace/enable")
             assert r.status == 200
             body = json.dumps({"meta": {"puid": "restpuid"},
                                "data": {"ndarray": [[1.0, 2.0, 3.0]]}})
@@ -168,7 +168,7 @@ def test_rest_trace_endpoints():
             doc = await r.json()
             assert doc["enabled"] is True
             assert any(s["puid"] == "restpuid" for s in doc["spans"])
-            r = await client.get("/trace/disable")
+            r = await client.post("/trace/disable")
             assert r.status == 200
 
     asyncio.run(run())
