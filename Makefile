@@ -29,6 +29,13 @@ trace-demo:
 perf-demo:
 	python scripts/perf_demo.py --out perf_demo
 
+# prediction-quality demo: a 3-node graph served through a mid-run input
+# distribution shift, the GET /quality drift/feedback/SLO table dumped as
+# an artifact (quality_demo/quality.json) + printed
+# (scripts/quality_demo.py)
+quality-demo:
+	python scripts/quality_demo.py --out quality_demo
+
 bench:
 	python bench.py
 
@@ -73,4 +80,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo bench demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo bench demos train-demo stack bundle images publish release-dryrun

@@ -147,6 +147,14 @@ class ApiGateway:
         self.metrics = MetricsRegistry(deployment_name="gateway")
         self._rng = np.random.default_rng(seed)
         self._session = None  # lazy shared aiohttp session (remote engines)
+        # feedback ingress accounting: engines may live in other
+        # processes, so the gateway keeps its own view of the reward
+        # stream it routed (surfaced in /stats; the process-global
+        # seldon_tpu_feedback_* families are fed engine-side where
+        # truth-vs-prediction agreement is computed)
+        self.feedback_count = 0
+        self.feedback_reward_sum = 0.0
+        self.feedback_truth_count = 0
 
     # -- principal resolution ----------------------------------------------
 
@@ -212,6 +220,10 @@ class ApiGateway:
                 predictor = feedback.response.meta.requestPath.get("predictor")
             fb_puid = feedback.puid()
             _, engine = self._pick_engine(reg, predictor)
+            self.feedback_count += 1
+            self.feedback_reward_sum += float(feedback.reward)
+            if feedback.truth is not None:
+                self.feedback_truth_count += 1
             with TRACER.span(
                 fb_puid, "gateway", kind="request", method="feedback",
                 deployment=reg.deployment_id,
@@ -300,6 +312,13 @@ class ApiGateway:
                 "require_auth": self.require_auth,
                 "deployments": self.store.deployments(),
                 "active_tokens": self.store.active_token_count(),
+            },
+            "feedback": {
+                "count": self.feedback_count,
+                "mean_reward": round(
+                    self.feedback_reward_sum / self.feedback_count, 6
+                ) if self.feedback_count else 0.0,
+                "truth_provided": self.feedback_truth_count,
             },
             "firehose": (
                 None if self.firehose is None else self.firehose.snapshot()

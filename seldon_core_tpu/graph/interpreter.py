@@ -34,6 +34,7 @@ from seldon_core_tpu.messages import (
     Status,
 )
 from seldon_core_tpu.runtime.resilience import current_deadline
+from seldon_core_tpu.utils.quality import QUALITY
 from seldon_core_tpu.utils.telemetry import RECORDER
 from seldon_core_tpu.graph.spec import (
     ComponentBinding,
@@ -169,6 +170,10 @@ class InProcessNodeRuntime(NodeRuntime):
         resp = req.with_array(y, names=names)
         all_tags = dict(self.unit.static_tags or {})
         all_tags.update(pythonize_tags(tags))
+        # outlier TRANSFORMER scores (models/outlier.py) bridge out of the
+        # response tags into the seldon_tpu_outlier_score family here —
+        # every unit method's tags pass through this one spot
+        QUALITY.record_outlier_tags(all_tags)
         if all_tags:
             resp.meta = Meta(
                 puid=req.meta.puid,
@@ -192,8 +197,15 @@ class InProcessNodeRuntime(NodeRuntime):
     # -- NodeRuntime API ----------------------------------------------------
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
-        out = self._call("predict", msg, self._input_array(msg))
+        X = self._input_array(msg)
+        out = self._call("predict", msg, X)
         y, self.state, tags = normalize_output(out, self.state)
+        # per-node quality identity: host-mode engines and unit pods see
+        # each MODEL node's own inputs/predictions, so the drift table
+        # resolves to the node that drifted (the compiled lane, one fused
+        # program, keys on the graph root instead)
+        if QUALITY.enabled:
+            QUALITY.observe_batch(self.node.name, np.atleast_2d(X), y)
         return self._respond(msg, y, tags)
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:

@@ -559,6 +559,21 @@ class Feedback:
             return self.request.meta.puid
         return ""
 
+    def prediction_array(self) -> Optional[np.ndarray]:
+        """The served prediction tensor (``response.data``) as numpy, or
+        None — the ONE truth-vs-prediction plumbing rule every feedback
+        consumer shares (engine quality accounting, gateway ingress,
+        unit runtimes)."""
+        if self.response is not None and self.response.data is not None:
+            return np.asarray(self.response.array())
+        return None
+
+    def truth_array(self) -> Optional[np.ndarray]:
+        """The ground-truth tensor (``truth.data``) as numpy, or None."""
+        if self.truth is not None and self.truth.data is not None:
+            return np.asarray(self.truth.array())
+        return None
+
     def to_json_dict(self) -> dict:
         out: dict = {"reward": float(self.reward)}
         if self.request is not None:

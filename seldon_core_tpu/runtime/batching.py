@@ -72,6 +72,13 @@ class MicroBatcher:
         atomic_chunks: bool = False,
     ):
         self.batch_fn = batch_fn
+        # dispatch sites that accept real_rows get the pre-padding row
+        # count alongside the padded chunk — pad rows must not enter
+        # per-row statistics (quality observatory) even though they ride
+        # the same compiled shape.  Cached per function object: tests (and
+        # fault harnesses) swap batch_fn after construction
+        self._rows_fn_cached = None
+        self._fn_takes_real_rows = False
         # >0: abandon a dispatch after this long so its in-flight slot frees
         # (a wedged device must not wedge the whole queue); the engine's
         # state-write gate separately vetoes the late write-back
@@ -246,10 +253,25 @@ class MicroBatcher:
             # perf observatory: pad rows burn device FLOPs without serving
             # traffic — /perf reports the aggregate pad-overhead share
             OBSERVATORY.note_padding(n, len(chunk))
+            fn = self.batch_fn
+            if fn is not self._rows_fn_cached:
+                import inspect
+
+                self._rows_fn_cached = fn
+                try:
+                    self._fn_takes_real_rows = (
+                        "real_rows" in inspect.signature(fn).parameters
+                    )
+                except (TypeError, ValueError):
+                    self._fn_takes_real_rows = False
+            dispatch = (
+                fn(chunk, real_rows=n) if self._fn_takes_real_rows
+                else fn(chunk)
+            )
             if self.dispatch_timeout_s > 0:
                 try:
                     ys, chunk_aux = await asyncio.wait_for(
-                        self.batch_fn(chunk), self.dispatch_timeout_s
+                        dispatch, self.dispatch_timeout_s
                     )
                 except asyncio.TimeoutError:
                     from seldon_core_tpu.messages import DispatchTimeoutError
@@ -259,7 +281,7 @@ class MicroBatcher:
                         f"{self.dispatch_timeout_s:.1f}s"
                     ) from None
             else:
-                ys, chunk_aux = await self.batch_fn(chunk)
+                ys, chunk_aux = await dispatch
             ys_parts.append(np.asarray(ys)[:n])
             # per-row aux re-based to the unpadded chunk, then accumulated
             chunk_aux = _slice_aux(chunk_aux, slice(0, n), len(chunk))

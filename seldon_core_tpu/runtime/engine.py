@@ -50,6 +50,7 @@ from seldon_core_tpu.runtime.resilience import (
 )
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 from seldon_core_tpu.utils.perf import OBSERVATORY
+from seldon_core_tpu.utils.quality import QUALITY, router_quality
 from seldon_core_tpu.utils.telemetry import RECORDER, AuditLog
 
 __all__ = ["EngineService"]
@@ -111,6 +112,10 @@ class EngineService:
         self._graph_path = "/".join(
             n.name for n in self.predictor.graph.walk()
         )
+        # quality observatory identity: the compiled lane dispatches the
+        # WHOLE graph as one program, so its drift windows key on the
+        # graph root (host mode / unit pods record per node instead)
+        self._quality_node = self.predictor.graph.name
         self.paused = False
         # compiled-mode state advances via read-modify-write of
         # CompiledGraph.states; serialize device dispatches so concurrent
@@ -256,6 +261,12 @@ class EngineService:
         ctx = current_trace_context()
         if ctx is not None and ctx.sampled and "trace_id" not in extra:
             extra["trace_id"] = ctx.trace_id
+        # quality state inline: an audit line shows the drift score the
+        # same way its dispatch span does (utils/quality.py)
+        if method == "predict" and "drift" not in extra:
+            drift = QUALITY.last_drift(self._quality_node)
+            if drift is not None:
+                extra["drift"] = drift
         self.audit.record(
             puid=puid,
             deployment=self.deployment.name,
@@ -296,6 +307,10 @@ class EngineService:
             },
             "telemetry": RECORDER.snapshot(),
             "perf": OBSERVATORY.snapshot(),
+            "quality": QUALITY.snapshot(),
+            # MAB router state read back out of the pytree (per-branch
+            # success/tries — utils/quality.py router_quality)
+            "routers": router_quality(self.states()),
             "tracer": TRACER.snapshot(),
             "audit": self.audit.snapshot(),
         }
@@ -311,6 +326,22 @@ class EngineService:
                 "mode": self.mode,
             },
             **OBSERVATORY.document(),
+        }
+
+    def quality_document(self) -> dict:
+        """The ``GET /quality`` body: the process-global quality
+        observatory (per-node drift table, feedback reward/accuracy,
+        outlier bridge, SLO burn rates — utils/quality.py) under this
+        engine's identity, plus per-branch MAB router state read out of
+        the graph's pytrees."""
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            "routers": router_quality(self.states()),
+            **QUALITY.document(),
         }
 
     def open_breakers(self) -> "list[str]":
@@ -533,19 +564,21 @@ class EngineService:
                 f"device dispatch exceeded {self.dispatch_timeout_s:.0f}s"
             ) from None
 
-    async def _batched_predict(self, stacked):
+    async def _batched_predict(self, stacked, real_rows=None):
         deadline = time.monotonic() + self.dispatch_timeout_s
         if self._pipelined:
             # concurrency is bounded by the batcher's in-flight slots
             return await asyncio.get_running_loop().run_in_executor(
-                None, self._batched_predict_sync, stacked, deadline
+                None, self._batched_predict_sync, stacked, deadline,
+                real_rows,
             )
         async with self._device_lock:
             return await asyncio.get_running_loop().run_in_executor(
-                None, self._batched_predict_sync, stacked, deadline
+                None, self._batched_predict_sync, stacked, deadline,
+                real_rows,
             )
 
-    def _batched_predict_sync(self, stacked, deadline=None):
+    def _batched_predict_sync(self, stacked, deadline=None, real_rows=None):
         # runs on an executor thread: no request context here by design —
         # a stacked dispatch serves many requests, so the span stands
         # alone (per-request causality is the queue-wait span)
@@ -593,6 +626,19 @@ class EngineService:
                     time.perf_counter() - t_dispatch,
                     rows=len(stacked), span=sp,
                 )
+            # quality observatory: the same stacked batch + its readback
+            # feed the drift windows (one fused summarize kernel per
+            # sampled batch; real_rows masks the batcher's pad rows out of
+            # every statistic) and the outlier-score bridge; the current
+            # drift score rides the dispatch span like MFU does
+            if QUALITY.enabled:
+                n_real = real_rows if real_rows is not None else len(stacked)
+                QUALITY.record_outlier_tags(tags, real_rows=n_real)
+                drift = QUALITY.observe_batch(
+                    self._quality_node, stacked, y, real_rows=n_real
+                )
+                if drift is not None and isinstance(sp, dict):
+                    sp["drift"] = round(drift, 4)
             if isinstance(sp, dict):
                 # compile-cache traffic during this dispatch (fresh shape
                 # -> XLA compile): visible per-span, not just as counters
@@ -917,6 +963,8 @@ class EngineService:
 
     async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
         fb_puid = feedback.puid()
+        t0 = time.perf_counter()
+        truth_arr = feedback.truth_array()
         with self.metrics.time_server("feedback", "POST") as code, self.tracer.span(
             fb_puid, "request", kind="request", method="feedback",
         ):
@@ -930,14 +978,11 @@ class EngineService:
                     X = None
                     if feedback.request is not None and feedback.request.data is not None:
                         X = feedback.request.array()
-                    truth = None
-                    if feedback.truth is not None and feedback.truth.data is not None:
-                        truth = feedback.truth.array()
                     async with self._device_lock:
                         await asyncio.get_running_loop().run_in_executor(
                             None,
                             lambda: self.compiled.feedback_arrays(
-                                X, routing, feedback.reward, truth
+                                X, routing, feedback.reward, truth_arr
                             ),
                         )
                     ack = SeldonMessage()
@@ -947,8 +992,25 @@ class EngineService:
                     ack = await self.executor.send_feedback(feedback)
             except (SeldonMessageError, GraphSpecError) as e:
                 code["code"] = "400"
+                # feedback requests consumed work and must leave a
+                # telemetry trace like unary prediction errors do
+                self._audit_request(
+                    fb_puid, "feedback", 400, t0,
+                    reward=float(feedback.reward),
+                )
                 return SeldonMessage.failure(str(e), code=400)
         self.metrics.record_feedback(feedback.reward)
+        # quality observatory: rolling per-predictor reward + truth-vs-
+        # prediction accuracy (+ the seldon_tpu_feedback_* families)
+        QUALITY.record_feedback(
+            self.predictor.name, feedback.reward,
+            truth=truth_arr, prediction=feedback.prediction_array(),
+        )
+        self._audit_request(
+            fb_puid, "feedback", 200, t0,
+            reward=float(feedback.reward),
+            truth_provided=truth_arr is not None,
+        )
         return ack
 
     async def close(self) -> None:

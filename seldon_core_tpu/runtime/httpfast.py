@@ -17,8 +17,11 @@ Semantics match ``rest.py:make_engine_app`` route for route:
   POST /api/v0.1/feedback
   POST /trace/enable /trace/disable (POST-only: the PR-3 GET-alias
        deprecation window is closed; GET now answers 404)
+  POST /quality/reference      freeze/reset the drift reference window
   GET  /ping /ready /pause /unpause /prometheus /stats
   GET  /perf                   performance observatory (utils/perf.py)
+  GET  /quality                prediction-quality observatory
+                               (utils/quality.py)
   GET  /trace /trace/export
 
 ``GET /prometheus?format=openmetrics`` serves the OpenMetrics exposition
@@ -117,6 +120,7 @@ class _EngineRoutes:
             b"/api/v0.1/events": self._events,
             b"/trace/enable": self._trace_enable,
             b"/trace/disable": self._trace_disable,
+            b"/quality/reference": self._quality_reference,
         }
         self.get: Dict[bytes, Handler] = {
             b"/ping": self._ping,
@@ -126,6 +130,7 @@ class _EngineRoutes:
             b"/prometheus": self._prometheus,
             b"/stats": self._stats,
             b"/perf": self._perf,
+            b"/quality": self._quality,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
@@ -223,6 +228,32 @@ class _EngineRoutes:
         import json as _json
 
         return 200, _json.dumps(self.engine.perf_document()).encode(), _JSON
+
+    async def _quality(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return 200, _json.dumps(self.engine.quality_document()).encode(), _JSON
+
+    async def _quality_reference(self, body, ctype, query) -> Result:
+        import json as _json
+
+        from seldon_core_tpu.utils.quality import (
+            QUALITY,
+            parse_reference_action,
+        )
+
+        q = parse_qs(query)
+        try:
+            action, node = parse_reference_action(
+                body, q.get("action", [None])[0], q.get("node", [None])[0]
+            )
+        except ValueError as e:
+            return 400, SeldonMessage.failure(str(e)).to_json().encode(), _JSON
+        return (
+            200,
+            _json.dumps(QUALITY.reference_control(action, node=node)).encode(),
+            _JSON,
+        )
 
     async def _trace(self, body, ctype, query) -> Result:
         import json as _json

@@ -39,6 +39,7 @@ from typing import Optional
 import numpy as np
 
 from seldon_core_tpu.utils.perf import OBSERVATORY
+from seldon_core_tpu.utils.quality import QUALITY
 
 __all__ = ["NativeDataPlane", "native_plane_available"]
 
@@ -343,6 +344,17 @@ class NativeDataPlane:
                                 time.perf_counter() - t_dispatch,
                                 rows=rows, span=sp,
                             )
+                        # quality observatory: the native lane feeds the
+                        # same drift windows the Python lane does — one
+                        # fused summarize over the padded stack, pad rows
+                        # masked out via real_rows (engine lane parity)
+                        if QUALITY.enabled:
+                            drift = QUALITY.observe_batch(
+                                engine._quality_node, padded, y,
+                                real_rows=rows,
+                            )
+                            if drift is not None and isinstance(sp, dict):
+                                sp["drift"] = round(drift, 4)
                     if routing or tags:
                         # data-dependent tags slipped past the static
                         # checks: the C++ composer cannot merge them into

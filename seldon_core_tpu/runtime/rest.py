@@ -88,6 +88,22 @@ def _request_trace_scope(request: web.Request):
     return trace_scope(parse_traceparent(request.headers.get(TRACEPARENT_HEADER)))
 
 
+async def _quality_reference(request: web.Request) -> web.Response:
+    """POST /quality/reference — freeze/reset the drift reference window
+    (one handler shared by the engine and unit apps; the fast lane
+    adapts the same parse in httpfast.py)."""
+    from seldon_core_tpu.utils.quality import QUALITY, parse_reference_action
+
+    try:
+        action, node = parse_reference_action(
+            await request.read(),
+            request.query.get("action"), request.query.get("node"),
+        )
+    except ValueError as e:
+        return _error_response(str(e))
+    return web.json_response(QUALITY.reference_control(action, node=node))
+
+
 # ---------------------------------------------------------------------------
 # Engine app
 # ---------------------------------------------------------------------------
@@ -178,6 +194,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # + HBM watermarks (utils/perf.py; docs/operations.md runbook)
         return web.json_response(engine.perf_document())
 
+    async def quality(_):
+        # prediction-quality observatory: per-node drift table, feedback
+        # reward/accuracy, outlier bridge, SLO burn rates
+        # (utils/quality.py; docs/operations.md runbook)
+        return web.json_response(engine.quality_document())
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
@@ -260,6 +282,8 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/prometheus", prometheus)
     app.router.add_get("/stats", stats)
     app.router.add_get("/perf", perf)
+    app.router.add_get("/quality", quality)
+    app.router.add_post("/quality/reference", _quality_reference)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)
     # POST-only: the PR-3 deprecation window for the GET mutation aliases
@@ -384,9 +408,22 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
             **OBSERVATORY.document(),
         })
 
+    async def quality(_):
+        # per-node drift windows recorded by InProcessNodeRuntime.predict
+        # land in the process-global quality observatory
+        from seldon_core_tpu.utils.quality import QUALITY
+
+        return web.json_response({
+            "unit": {"name": runtime.node.name,
+                     "type": getattr(runtime.node.type, "name", None)},
+            **QUALITY.document(),
+        })
+
     app.router.add_get("/ping", ping)
     app.router.add_get("/stats", stats)
     app.router.add_get("/perf", perf)
+    app.router.add_get("/quality", quality)
+    app.router.add_post("/quality/reference", _quality_reference)
     return app
 
 

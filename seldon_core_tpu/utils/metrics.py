@@ -26,6 +26,7 @@ import time
 from contextlib import contextmanager
 from typing import FrozenSet, Optional
 
+from seldon_core_tpu.utils.quality import QUALITY
 from seldon_core_tpu.utils.telemetry import RECORDER, TPU_METRIC_FAMILIES
 
 try:
@@ -151,6 +152,14 @@ class MetricsRegistry:
             dt = time.perf_counter() - start
             # /stats percentile reservoirs run even without prometheus_client
             RECORDER.request_latency(f"server:{service}", dt)
+            if service == "predictions":
+                # SLO engine (utils/quality.py): burn rates ride the same
+                # request stream this histogram observes; 5xx burns the
+                # error budget, anything over SELDON_TPU_SLO_P99_MS burns
+                # the latency budget
+                QUALITY.record_request(
+                    dt, error=code_holder["code"].startswith("5")
+                )
             if self.registry is not None:
                 self._server_child(service, method, code_holder["code"]).observe(dt)
 

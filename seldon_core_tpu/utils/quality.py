@@ -1,0 +1,1064 @@
+"""Prediction-quality observatory — on-device drift detection, feedback/
+reward accounting, and SLO burn-rate tracking.
+
+The flight recorder (utils/telemetry.py) says how many requests flow, the
+causal tracer (utils/tracing.py) says where time goes, and the perf
+observatory (utils/perf.py) says whether the TPU is used well — but none
+of them watches whether the PREDICTIONS themselves are still good.
+Drifting inputs and silently degrading models are the dominant production
+failure mode a serving mesh must surface (the reference platform's
+signature concern: outlier TRANSFORMERs, ``/api/v0.1/feedback`` reward
+routing, per-predictor metrics).  This module closes that loop with three
+instruments:
+
+  * **Drift detection**: per graph node, a frozen **reference window**
+    plus a rolling **live window** of sampled inputs and predictions.
+    The per-batch reservoir update (per-feature bin counts against
+    reference-quantile edges, mean/var accumulators, a prediction
+    histogram) is computed as ONE batched ``jnp`` program riding the
+    dispatch batch — ``engine._batched_predict_sync`` and the native
+    plane's dispatch loop hand the already-stacked batch over, so quality
+    monitoring costs one small fused kernel per sampled batch, never a
+    per-row Python loop.  Live-vs-reference distance is scored as **PSI**
+    and a **KS statistic** per feature plus a prediction-distribution
+    shift score.  The learned-cost-model literature (TpuGraphs, arxiv
+    2308.13490; A Learned Performance Model for TPUs, arxiv 2008.01040)
+    shows cheap static graph features predict runtime well; the dual
+    insight here is that cheap batched statistics piggybacked on dispatch
+    predict model-quality decay without a separate monitoring fleet.
+  * **Feedback accounting**: ``send_feedback`` rewards and
+    truth-vs-prediction agreement fold into rolling per-predictor
+    reward/accuracy; MAB ROUTER pytree state (success/tries counters)
+    is read back out into per-branch reward, routing share, and regret
+    (``router_quality``) instead of staying opaque on device.
+  * **SLO engine**: per-graph latency/error objectives
+    (``SELDON_TPU_SLO_P99_MS``, ``SELDON_TPU_SLO_ERROR_RATE``) tracked
+    as multi-window (5m/1h) burn rates over the request stream the
+    existing latency histograms already observe.
+
+Surfaces: ``GET /quality`` (engine rest + fast + native misc lanes, unit
+pods), ``POST /quality/reference`` (freeze/reset the reference window),
+the ``seldon_tpu_drift_score`` / ``seldon_tpu_prediction_quantile`` /
+``seldon_tpu_feedback_*`` / ``seldon_tpu_outlier_*`` /
+``seldon_tpu_slo_burn_rate`` / ``seldon_tpu_quality_sampled_total``
+Prometheus families, drift stamped onto dispatch spans and audit-firehose
+lines.
+
+Everything is process-global (module global ``QUALITY``, the
+``OBSERVATORY``/``TRACER``/``RECORDER`` pattern) and never raises into
+the hot path.  ``SELDON_TPU_QUALITY=0`` disables the subsystem entirely;
+``SELDON_TPU_QUALITY_SAMPLE`` (0..1, decided once per batch) bounds its
+cost under load.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
+
+__all__ = [
+    "QualityObservatory",
+    "QUALITY",
+    "SloTracker",
+    "router_quality",
+    "psi",
+    "ks_statistic",
+    "parse_reference_action",
+]
+
+logger = logging.getLogger(__name__)
+
+#: proportion floor for PSI's log ratio — the standard smoothing that
+#: keeps an empty bin from yielding an infinite score
+_EPS_P = 1e-6
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# score math (plain numpy on the small aggregated count vectors — the
+# per-batch heavy lifting happened on device already)
+# ---------------------------------------------------------------------------
+
+
+def _proportions(counts) -> np.ndarray:
+    counts = np.asarray(counts, dtype=np.float64)
+    total = counts.sum(axis=-1, keepdims=True)
+    return counts / np.maximum(total, 1.0)
+
+
+def psi(ref_counts, live_counts) -> np.ndarray:
+    """Population Stability Index between binned distributions (last axis
+    = bins; leading axes broadcast, e.g. per-feature rows).  Proportions
+    are floored at 1e-6 so empty bins score finitely — the convention the
+    hand-computed tests and the docs runbook both state."""
+    p = np.clip(_proportions(ref_counts), _EPS_P, None)
+    q = np.clip(_proportions(live_counts), _EPS_P, None)
+    return ((q - p) * np.log(q / p)).sum(axis=-1)
+
+
+def ks_statistic(ref_counts, live_counts) -> np.ndarray:
+    """Kolmogorov–Smirnov distance between binned distributions: the max
+    absolute CDF gap across bin boundaries (exact proportions, no
+    smoothing needed)."""
+    p = _proportions(ref_counts).cumsum(axis=-1)
+    q = _proportions(live_counts).cumsum(axis=-1)
+    return np.abs(q - p).max(axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# batched summarizer — the one fused kernel riding the dispatch batch
+# ---------------------------------------------------------------------------
+
+_jit_summarize = None
+_jit_failed = False
+
+
+def _get_jit_summarizer():
+    """Lazily built jitted summarizer shared by every node (shapes trace
+    per (batch, width, bins) combination — bounded on the engine lane by
+    the batcher's power-of-two buckets).  None when jax is unavailable;
+    the numpy fallback then owns the math with identical outputs."""
+    global _jit_summarize, _jit_failed
+    if _jit_summarize is not None or _jit_failed:
+        return _jit_summarize
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        def summarize(X, Y, x_thr, y_thr, n):
+            # X [N,F] f32, Y [N,C] f32, x_thr [F,Bx-1], y_thr [By-1],
+            # n = real (unpadded) rows.  Bin counts come from cumulative
+            # >=-threshold counts (bin b = count(>=thr[b-1]) -
+            # count(>=thr[b])) — no [N,F,B] one-hot materialization.
+            w = (jnp.arange(X.shape[0]) < n).astype(jnp.float32)
+            n_eff = w.sum()
+            geq = (X[:, :, None] >= x_thr[None, :, :]).astype(jnp.float32)
+            gcounts = (geq * w[:, None, None]).sum(0)  # [F, Bx-1]
+            full = jnp.full((X.shape[1], 1), 0.0) + n_eff
+            x_counts = jnp.concatenate([full, gcounts], axis=1) - \
+                jnp.concatenate([gcounts, jnp.zeros((X.shape[1], 1))], axis=1)
+            x_sum = (X * w[:, None]).sum(0)
+            x_sumsq = (X * X * w[:, None]).sum(0)
+            ygeq = (Y[:, :, None] >= y_thr[None, None, :]).astype(jnp.float32)
+            ygc = (ygeq * w[:, None, None]).sum((0, 1))  # [By-1]
+            ny = n_eff * Y.shape[1]
+            y_counts = jnp.concatenate([ny[None], ygc]) - \
+                jnp.concatenate([ygc, jnp.zeros((1,))])
+            y_sum = (Y * w[:, None]).sum()
+            y_sumsq = (Y * Y * w[:, None]).sum()
+            return x_counts, x_sum, x_sumsq, y_counts, y_sum, y_sumsq
+
+        _jit_summarize = jax.jit(summarize)
+    except Exception:  # noqa: BLE001 - no jax backend: numpy fallback
+        _jit_failed = True
+        _jit_summarize = None
+    return _jit_summarize
+
+
+def _summarize_np(X, Y, x_thr, y_thr, n):
+    """Numpy twin of the jitted summarizer — CPU degradation path and the
+    cross-check oracle in tests.  Identical outputs by construction."""
+    X = np.asarray(X, dtype=np.float32)[:n]
+    Y = np.asarray(Y, dtype=np.float32).reshape(len(Y), -1)[:n]
+    F = X.shape[1]
+    gcounts = (X[:, :, None] >= x_thr[None, :, :]).sum(0).astype(np.float64)
+    lower = np.concatenate([np.full((F, 1), float(len(X))), gcounts], axis=1)
+    upper = np.concatenate([gcounts, np.zeros((F, 1))], axis=1)
+    x_counts = lower - upper
+    ygc = (Y[:, :, None] >= y_thr[None, None, :]).sum((0, 1)).astype(np.float64)
+    ny = float(len(Y) * Y.shape[1])
+    y_counts = np.concatenate([[ny], ygc]) - np.concatenate([ygc, [0.0]])
+    return (
+        x_counts, X.sum(0), (X * X).sum(0),
+        y_counts, float(Y.sum()), float((Y * Y).sum()),
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-node windows
+# ---------------------------------------------------------------------------
+
+
+class _NodeQuality:
+    """Reference + rolling live window for one graph node."""
+
+    def __init__(self, node: str, n_bins: int, ref_target: int,
+                 live_window: int):
+        self.node = node
+        self.n_bins = int(n_bins)
+        self.ref_target = int(ref_target)
+        self.live_window = int(live_window)  # live batches retained
+        self.lock = threading.Lock()
+        #: bumped on every clear/freeze — an in-flight observation that
+        #: summarized against superseded thresholds must not land in the
+        #: new window (the summarize happens outside the lock by design)
+        self.generation = 0
+        self._clear()
+
+    def _clear(self) -> None:
+        self.generation += 1
+        self.frozen = False
+        self._ref_x: List[np.ndarray] = []
+        self._ref_y: List[np.ndarray] = []
+        self._ref_width: Optional[int] = None
+        self._ref_y_width: Optional[int] = None
+        self.ref_rows = 0
+        self.x_thr: Optional[np.ndarray] = None   # [F, B-1]
+        self.y_thr: Optional[np.ndarray] = None   # [B-1]
+        self.ref_x_counts: Optional[np.ndarray] = None  # [F, B]
+        self.ref_y_counts: Optional[np.ndarray] = None  # [B]
+        self.ref_x_mean: Optional[np.ndarray] = None
+        self.ref_x_std: Optional[np.ndarray] = None
+        self.sampled_batches = 0
+        self.sampled_rows = 0
+        self.width_mismatches = 0
+        self._blocks: deque = deque()
+        self.live_x_counts: Optional[np.ndarray] = None
+        self.live_x_sum: Optional[np.ndarray] = None
+        self.live_x_sumsq: Optional[np.ndarray] = None
+        self.live_y_counts: Optional[np.ndarray] = None
+        self.live_rows = 0
+        self.last_scores: Dict[str, float] = {}
+
+    # -- reference ---------------------------------------------------------
+
+    def _collect_reference(self, X: np.ndarray, Y: np.ndarray) -> None:
+        # a node serving several feature widths can only reference ONE of
+        # them (the windows are per-feature arrays): first width seen
+        # wins, others are counted and skipped — without this guard a
+        # mixed-width node would hoard raw rows forever and never freeze
+        if self._ref_width is None:
+            self._ref_width = X.shape[1]
+            self._ref_y_width = Y.shape[1]
+        elif (X.shape[1] != self._ref_width
+              or Y.shape[1] != self._ref_y_width):
+            self.width_mismatches += 1
+            return
+        self._ref_x.append(np.asarray(X, dtype=np.float64))
+        self._ref_y.append(np.asarray(Y, dtype=np.float64).reshape(len(Y), -1))
+        self.ref_rows += len(X)
+        if self.ref_rows >= self.ref_target:
+            self._freeze()
+
+    def _freeze(self) -> bool:
+        """Fix the collected rows as the reference: per-feature bin edges
+        at reference quantiles (the classic PSI construction), reference
+        counts/mean/std, empty live window.  False when nothing was
+        collected yet."""
+        if not self._ref_x:
+            return False
+        self.generation += 1
+        ref = np.concatenate(self._ref_x, axis=0)
+        ref_y = np.concatenate(self._ref_y, axis=0).reshape(-1)
+        B = self.n_bins
+        qs = np.arange(1, B) / B
+        # inner thresholds: bin index of x = #(x >= thr) in [0, B-1]
+        self.x_thr = np.quantile(ref, qs, axis=0).T.astype(np.float32)
+        self.y_thr = np.quantile(ref_y, qs).astype(np.float32)
+        F = ref.shape[1]
+        counts, _, _, yc, _, _ = _summarize_np(
+            ref, np.concatenate(self._ref_y, axis=0),
+            self.x_thr, self.y_thr, len(ref),
+        )
+        self.ref_x_counts = counts
+        self.ref_y_counts = yc
+        self.ref_x_mean = ref.mean(axis=0)
+        self.ref_x_std = ref.std(axis=0) + 1e-12
+        self.ref_rows = len(ref)
+        self._ref_x = []
+        self._ref_y = []
+        self.frozen = True
+        self._blocks = deque()
+        self.live_x_counts = np.zeros((F, self.n_bins))
+        self.live_x_sum = np.zeros(F)
+        self.live_x_sumsq = np.zeros(F)
+        self.live_y_counts = np.zeros(self.n_bins)
+        self.live_rows = 0
+        self.last_scores = {}
+        return True
+
+    # -- live --------------------------------------------------------------
+
+    def _push_block(self, x_counts, x_sum, x_sumsq, y_counts, rows) -> None:
+        block = (x_counts, x_sum, x_sumsq, y_counts, rows)
+        self._blocks.append(block)
+        self.live_x_counts += x_counts
+        self.live_x_sum += x_sum
+        self.live_x_sumsq += x_sumsq
+        self.live_y_counts += y_counts
+        self.live_rows += rows
+        while len(self._blocks) > self.live_window:
+            oc, osum, osq, oyc, orows = self._blocks.popleft()
+            self.live_x_counts -= oc
+            self.live_x_sum -= osum
+            self.live_x_sumsq -= osq
+            self.live_y_counts -= oyc
+            self.live_rows -= orows
+
+    def _score(self) -> Dict[str, float]:
+        if not self.frozen or self.live_rows <= 0:
+            return {}
+        x_psi = psi(self.ref_x_counts, self.live_x_counts)
+        x_ks = ks_statistic(self.ref_x_counts, self.live_x_counts)
+        y_psi = float(psi(self.ref_y_counts, self.live_y_counts))
+        self._x_psi = x_psi
+        self._x_ks = x_ks
+        self.last_scores = {
+            "psi_max": float(x_psi.max()),
+            "psi_mean": float(x_psi.mean()),
+            "ks_max": float(x_ks.max()),
+            "prediction_psi": y_psi,
+        }
+        return self.last_scores
+
+    def prediction_quantiles(self) -> Dict[str, float]:
+        """Approximate live prediction quantiles off the binned CDF —
+        quantile value = the upper bin threshold where the CDF crosses q
+        (a B-bin sketch, not an exact order statistic)."""
+        if not self.frozen or self.live_rows <= 0 or self.y_thr is None \
+                or len(self.y_thr) == 0:
+            return {}
+        cdf = _proportions(self.live_y_counts).cumsum()
+        out = {}
+        for q in (0.5, 0.9, 0.99):
+            j = int(np.searchsorted(cdf, q))
+            out[str(q)] = float(self.y_thr[min(j, len(self.y_thr) - 1)])
+        return out
+
+    def document_row(self, top_k: int = 16) -> Dict[str, Any]:
+        row: Dict[str, Any] = {
+            "node": self.node,
+            "status": "live" if self.frozen else "collecting_reference",
+            "sampled_batches": self.sampled_batches,
+            "sampled_rows": self.sampled_rows,
+            "ref_rows": self.ref_rows,
+            "live_rows": int(self.live_rows),
+        }
+        if self.width_mismatches:
+            row["width_mismatches"] = self.width_mismatches
+        if self.frozen and self.last_scores:
+            row["drift"] = {
+                k: round(v, 6) for k, v in self.last_scores.items()
+            }
+            live_n = max(self.live_rows, 1)
+            live_mean = self.live_x_sum / live_n
+            order = np.argsort(self._x_psi)[::-1][:top_k]
+            row["top_features"] = [
+                {
+                    "feature": int(i),
+                    "psi": round(float(self._x_psi[i]), 6),
+                    "ks": round(float(self._x_ks[i]), 6),
+                    "ref_mean": round(float(self.ref_x_mean[i]), 6),
+                    "live_mean": round(float(live_mean[i]), 6),
+                }
+                for i in order
+            ]
+            pq = self.prediction_quantiles()
+            if pq:
+                row["prediction_quantiles"] = {
+                    k: round(v, 6) for k, v in pq.items()
+                }
+        return row
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+class SloTracker:
+    """Multi-window SLO burn rates over the request stream.
+
+    Objectives come from ``SELDON_TPU_SLO_P99_MS`` (latency: at most 1% of
+    requests may exceed the target — the definition of a p99 objective, so
+    the latency error budget is 0.01) and ``SELDON_TPU_SLO_ERROR_RATE``
+    (allowed 5xx fraction).  Burn rate per window = observed bad fraction
+    over the budget: 1.0 burns the budget exactly as fast as allowed,
+    14.4x over 5m / 6x over 1h are the classic fast/slow-burn page
+    thresholds.  Events land in per-second slots of a fixed one-hour
+    ring — ``record()`` is O(1); window sums happen on read."""
+
+    WINDOWS = (("5m", 300), ("1h", 3600))
+    HORIZON = 3600
+    LATENCY_BUDGET = 0.01
+    #: finite stand-in for "infinite burn" (a zero error budget with any
+    #: error flowing) — JSON-safe where float('inf') is not
+    BURN_CAP = 1e6
+
+    def __init__(self, p99_ms: Optional[float] = None,
+                 error_rate: Optional[float] = None):
+        self.p99_ms = (
+            p99_ms if p99_ms is not None
+            else _env_float("SELDON_TPU_SLO_P99_MS")
+        )
+        self.error_rate = (
+            error_rate if error_rate is not None
+            else _env_float("SELDON_TPU_SLO_ERROR_RATE")
+        )
+        self._lock = threading.Lock()
+        self._sec = np.zeros(self.HORIZON, dtype=np.int64)
+        self._counts = np.zeros((self.HORIZON, 3), dtype=np.int64)
+
+    @property
+    def configured(self) -> bool:
+        return self.p99_ms is not None or self.error_rate is not None
+
+    def record(self, latency_s: float, error: bool = False,
+               now: Optional[float] = None) -> None:
+        ts = int(now if now is not None else time.time())
+        i = ts % self.HORIZON
+        with self._lock:
+            if self._sec[i] != ts:
+                self._sec[i] = ts
+                self._counts[i] = 0
+            self._counts[i, 0] += 1
+            if self.p99_ms is not None and latency_s * 1e3 > self.p99_ms:
+                self._counts[i, 1] += 1
+            if error:
+                self._counts[i, 2] += 1
+
+    def burn_rates(self, now: Optional[float] = None) -> Dict[str, Any]:
+        ts = int(now if now is not None else time.time())
+        with self._lock:
+            sec = self._sec.copy()
+            counts = self._counts.copy()
+        out: Dict[str, Any] = {}
+        for name, w in self.WINDOWS:
+            mask = (sec > ts - w) & (sec <= ts)
+            total, slow, errors = (int(v) for v in counts[mask].sum(axis=0))
+            entry: Dict[str, Any] = {"requests": total}
+            burns = []
+            if self.p99_ms is not None:
+                lb = (slow / total) / self.LATENCY_BUDGET if total else 0.0
+                entry["latency_burn"] = round(lb, 4)
+                burns.append(lb)
+            if self.error_rate is not None:
+                # an explicit zero budget means zero tolerance: any error
+                # at all burns at the cap, not "error tracking disabled"
+                if not total:
+                    eb = 0.0
+                elif self.error_rate > 0:
+                    eb = min((errors / total) / self.error_rate,
+                             self.BURN_CAP)
+                else:
+                    eb = 0.0 if errors == 0 else self.BURN_CAP
+                entry["error_burn"] = round(eb, 4)
+                burns.append(eb)
+            rate = max(burns) if burns else 0.0
+            entry["burn_rate"] = round(rate, 4)
+            entry["budget_remaining"] = round(max(0.0, 1.0 - rate), 4)
+            out[name] = entry
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "p99_ms": self.p99_ms,
+            "error_rate": self.error_rate,
+            "configured": self.configured,
+            "windows": self.burn_rates(),
+        }
+
+    def reset_events(self) -> None:
+        with self._lock:
+            self._sec[:] = 0
+            self._counts[:] = 0
+
+
+# ---------------------------------------------------------------------------
+# MAB router read-back
+# ---------------------------------------------------------------------------
+
+
+def router_quality(states: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-branch reward/share/regret read out of bandit pytree state.
+
+    Any node state shaped like the MAB router's (``success``/``tries``
+    1-D arrays, models/mab.py) yields a row; reward rate uses the same
+    Laplace smoothing as the router's own ``_best`` so the reported best
+    branch matches what route() exploits.  Regret per branch = tries x
+    (best rate − branch rate): the reward given up by the exploration
+    that landed there."""
+    out: Dict[str, Any] = {}
+    for name, st in (states or {}).items():
+        try:
+            if not isinstance(st, dict) or "success" not in st \
+                    or "tries" not in st:
+                continue
+            s = np.asarray(st["success"], dtype=np.float64)
+            t = np.asarray(st["tries"], dtype=np.float64)
+            if s.shape != t.shape or s.ndim != 1:
+                continue
+        except Exception:  # noqa: BLE001 - odd pytree leaf: not a bandit
+            continue
+        ratio = (s + 1.0) / (t + 1.0)
+        best = float(ratio.max())
+        total = float(t.sum())
+        out[name] = {
+            "best_branch": int(np.argmax(ratio)),
+            "total_tries": total,
+            "total_regret": round(float((t * (best - ratio)).sum()), 4),
+            "branches": [
+                {
+                    "branch": i,
+                    "tries": float(t[i]),
+                    "success": float(s[i]),
+                    "reward_rate": round(float(ratio[i]), 4),
+                    "share": round(float(t[i] / total), 4) if total else 0.0,
+                    "regret": round(float(t[i] * (best - ratio[i])), 4),
+                }
+                for i in range(len(t))
+            ],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# feedback accounting helpers
+# ---------------------------------------------------------------------------
+
+
+def _agreement(prediction, truth) -> Optional[float]:
+    """Truth-vs-prediction agreement fraction.  Multi-column outputs
+    compare per-row argmax (classification); everything else compares
+    values within a relative tolerance.  None when the shapes cannot be
+    compared."""
+    if prediction is None or truth is None:
+        return None
+    try:
+        p = np.atleast_2d(np.asarray(prediction, dtype=np.float64))
+        t = np.atleast_2d(np.asarray(truth, dtype=np.float64))
+        if p.ndim == 2 and t.ndim == 2 and p.shape == t.shape \
+                and p.shape[-1] > 1:
+            return float((p.argmax(axis=-1) == t.argmax(axis=-1)).mean())
+        pf, tf = p.reshape(-1), t.reshape(-1)
+        if pf.size != tf.size or pf.size == 0:
+            return None
+        return float((np.abs(pf - tf) <= 1e-6 + 1e-3 * np.abs(tf)).mean())
+    except Exception:  # noqa: BLE001 - uncomparable payloads
+        return None
+
+
+class _FeedbackStats:
+    __slots__ = ("count", "reward", "truth_count", "agree_rows",
+                 "truth_rows")
+
+    def __init__(self):
+        self.count = 0
+        self.reward = Reservoir(2048)
+        self.truth_count = 0
+        self.agree_rows = 0.0
+        self.truth_rows = 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        r = self.reward.snapshot()
+        out = {
+            "count": self.count,
+            "mean_reward": round(r["mean"], 6),
+            "truth_provided": self.truth_count,
+        }
+        if self.truth_rows > 0:
+            out["accuracy"] = round(self.agree_rows / self.truth_rows, 6)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the observatory
+# ---------------------------------------------------------------------------
+
+
+class QualityObservatory:
+    """Process-global prediction-quality accounting.  All record methods
+    are cheap and never raise — quality instrumentation must not grow
+    failure modes on the dispatch hot path."""
+
+    #: bounded node table — an exploding node-name set must not grow memory
+    MAX_NODES = 64
+
+    def __init__(
+        self,
+        enabled: Optional[bool] = None,
+        sample: Optional[float] = None,
+        n_bins: int = 10,
+        ref_target: Optional[int] = None,
+        live_window: int = 64,
+        outlier_threshold: Optional[float] = None,
+        use_numpy: bool = False,
+    ):
+        if enabled is None:
+            enabled = os.environ.get("SELDON_TPU_QUALITY", "1") != "0"
+        self.enabled = bool(enabled)
+        if sample is None:
+            sample = _env_float("SELDON_TPU_QUALITY_SAMPLE")
+            sample = 1.0 if sample is None else sample
+        self.sample = min(max(float(sample), 0.0), 1.0)
+        self.n_bins = int(n_bins)
+        if ref_target is None:
+            rt = _env_float("SELDON_TPU_QUALITY_REF_ROWS")
+            ref_target = 256 if rt is None else int(rt)
+        self.ref_target = max(int(ref_target), 2)
+        self.live_window = int(live_window)
+        self.outlier_threshold = (
+            outlier_threshold if outlier_threshold is not None
+            else _env_float("SELDON_TPU_OUTLIER_THRESHOLD")
+        )
+        self.use_numpy = bool(use_numpy)
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, _NodeQuality] = {}
+        self._feedback: Dict[str, _FeedbackStats] = {}
+        # summarizer shapes whose XLA executable is compiled and safe to
+        # call from the dispatch path; until a shape is warm the numpy
+        # twin serves it (a synchronous jit compile inside the dispatch
+        # span — possibly under the engine's device lock — would stall
+        # every concurrent request and misattribute the cost to the
+        # device)
+        self._jit_ready: set = set()
+        self._jit_warming: set = set()
+        self._rng = random.Random(0xC0FFEE)
+        self.slo = SloTracker()
+        self.outlier = Reservoir(2048)
+        self.outlier_total = 0
+        self.outlier_exceeded = 0
+        self.errors = 0
+
+    def _bump_errors(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    # -- node windows ------------------------------------------------------
+
+    def _node(self, name: str) -> Optional[_NodeQuality]:
+        ent = self._nodes.get(name)
+        if ent is None:
+            with self._lock:
+                ent = self._nodes.get(name)
+                if ent is None:
+                    if len(self._nodes) >= self.MAX_NODES:
+                        return None
+                    ent = self._nodes[name] = _NodeQuality(
+                        name, self.n_bins, self.ref_target, self.live_window
+                    )
+        return ent
+
+    def observe_batch(self, node: str, X, Y,
+                      real_rows: Optional[int] = None) -> Optional[float]:
+        """One dispatched batch's inputs + predictions.  ``real_rows``
+        masks batcher pad rows out of every statistic (pad rows are
+        compiler fodder, not traffic).  Returns the node's current PSI
+        max for span stamping, or None when nothing was recorded.
+
+        The per-batch decision (``SELDON_TPU_QUALITY_SAMPLE``) happens
+        here, once; a sampled batch costs one fused summarize kernel."""
+        if not self.enabled or self.sample <= 0.0:
+            return None
+        if self.sample < 1.0 and self._rng.random() >= self.sample:
+            return None
+        try:
+            return self._observe(node, X, Y, real_rows)
+        except Exception:  # noqa: BLE001 - never raise into dispatch
+            self._bump_errors()
+            logger.debug("quality observe failed", exc_info=True)
+            return None
+
+    def _observe(self, node: str, X, Y,
+                 real_rows: Optional[int]) -> Optional[float]:
+        ent = self._node(node)
+        if ent is None:
+            return None
+        n = int(real_rows) if real_rows is not None else int(np.shape(X)[0])
+        if n <= 0:
+            return None
+        RECORDER.record_quality_sampled(node)
+        with ent.lock:
+            ent.sampled_batches += 1
+            ent.sampled_rows += n
+            if not ent.frozen:
+                Xn = np.asarray(X, dtype=np.float64)[:n]
+                Xn = Xn.reshape(n, -1)
+                Yn = np.asarray(Y, dtype=np.float64)[:n].reshape(n, -1)
+                ent._collect_reference(Xn, Yn)
+                return None
+            # capture the window's identity + thresholds under the lock:
+            # the summarize below runs lock-free and must not mix state
+            # from a reference swapped mid-flight
+            gen = ent.generation
+            x_thr, y_thr = ent.x_thr, ent.y_thr
+            F, y_width = x_thr.shape[0], ent._ref_y_width
+        # frozen: batched summarize OUTSIDE the lock (pure function of the
+        # batch + the captured thresholds)
+        Xa = np.asarray(X)
+        Xa = Xa.reshape(Xa.shape[0], -1)
+        Ya = np.asarray(Y)
+        Ya = Ya.reshape(Ya.shape[0], -1)
+        # both widths must match the frozen reference — a swapped model
+        # emitting a new output width would otherwise silently pollute
+        # the prediction histogram against stale edges
+        if Xa.shape[1] != F or Ya.shape[1] != y_width:
+            with ent.lock:
+                ent.width_mismatches += 1
+            return None
+        fn = None if self.use_numpy else _get_jit_summarizer()
+        # the batch axis pads to a power of two before the jitted
+        # summarize — callers with arbitrary batch sizes (unit pods,
+        # host mode) must not retrace per row count; the row mask (n)
+        # keeps the pad rows out of every statistic
+        target = 1 << max(len(Xa) - 1, 0).bit_length()
+        if fn is not None:
+            key = (target, Xa.shape[1], Ya.shape[1], self.n_bins)
+            if key not in self._jit_ready:
+                # not compiled yet: warm in the background, numpy serves
+                # this observation (identical outputs by construction)
+                self._warm_summarizer(fn, key, ent)
+                fn = None
+        if fn is not None:
+            if target > len(Xa):
+                Xa = np.concatenate(
+                    [Xa, np.zeros((target - len(Xa), Xa.shape[1]),
+                                  dtype=Xa.dtype)], axis=0)
+                Ya = np.concatenate(
+                    [Ya, np.zeros((target - len(Ya), Ya.shape[1]),
+                                  dtype=Ya.dtype)], axis=0)
+            import jax.numpy as jnp
+
+            parts = fn(
+                jnp.asarray(Xa, jnp.float32), jnp.asarray(Ya, jnp.float32),
+                jnp.asarray(x_thr), jnp.asarray(y_thr), n,
+            )
+            x_counts, x_sum, x_sumsq, y_counts, _, _ = (
+                np.asarray(p, dtype=np.float64) for p in parts
+            )
+        else:
+            x_counts, x_sum, x_sumsq, y_counts, _, _ = _summarize_np(
+                Xa, Ya, x_thr, y_thr, n
+            )
+        with ent.lock:
+            if not ent.frozen or ent.generation != gen:
+                # the reference was reset/refrozen while this batch was
+                # being summarized: counts binned against the old edges
+                # must not enter the new window
+                return None
+            ent._push_block(x_counts, x_sum, x_sumsq, y_counts, n)
+            scores = ent._score()
+            pq = ent.prediction_quantiles()
+        if scores:
+            RECORDER.set_drift(node, "psi", scores["psi_max"])
+            RECORDER.set_drift(node, "ks", scores["ks_max"])
+            RECORDER.set_drift(node, "prediction", scores["prediction_psi"])
+        for q, v in pq.items():
+            RECORDER.set_prediction_quantile(node, q, v)
+        return scores.get("psi_max")
+
+    def _warm_summarizer(self, fn, key, ent: _NodeQuality) -> None:
+        """Compile the summarizer for one (batch, widths, bins) shape on
+        a daemon thread; the shape joins ``_jit_ready`` only once its
+        executable exists.  Idempotent per shape."""
+        with self._lock:
+            if key in self._jit_warming or key in self._jit_ready:
+                return
+            self._jit_warming.add(key)
+        x_thr, y_thr = ent.x_thr, ent.y_thr
+
+        def _warm():
+            try:
+                import jax.numpy as jnp
+
+                n_rows, f, c, _ = key
+                parts = fn(
+                    jnp.zeros((n_rows, f), jnp.float32),
+                    jnp.zeros((n_rows, c), jnp.float32),
+                    jnp.asarray(x_thr), jnp.asarray(y_thr), 1,
+                )
+                for p in parts:  # block until the executable is real
+                    np.asarray(p)
+                with self._lock:
+                    self._jit_ready.add(key)
+            except Exception:  # noqa: BLE001 - numpy keeps serving it
+                self._bump_errors()
+                logger.debug("summarizer warm failed", exc_info=True)
+            finally:
+                with self._lock:
+                    self._jit_warming.discard(key)
+
+        threading.Thread(
+            target=_warm, name="quality-jit-warm", daemon=True
+        ).start()
+
+    def last_drift(self, node: str) -> Optional[float]:
+        """Most recent PSI max for a node — stamped onto audit lines.
+        When the named node has no window (host-mode engines record per
+        MODEL node, not under the graph root this is usually called
+        with), fall back to the worst live node in the process so the
+        audit trail still shows drift."""
+        ent = self._nodes.get(node)
+        v = ent.last_scores.get("psi_max") if ent is not None else None
+        if v is None:
+            with self._lock:
+                scores = [
+                    e.last_scores["psi_max"]
+                    for e in self._nodes.values()
+                    if "psi_max" in e.last_scores
+                ]
+            v = max(scores) if scores else None
+        return None if v is None else round(v, 4)
+
+    # -- reference control -------------------------------------------------
+
+    def reference_control(self, action: str,
+                          node: Optional[str] = None) -> Dict[str, Any]:
+        """``freeze``: promote the live/collected window of every (or one)
+        node to the new reference; ``reset``: drop reference + live and
+        start collecting afresh."""
+        if action not in ("freeze", "reset"):
+            raise ValueError(f"unknown reference action {action!r} "
+                             f"(expected freeze|reset)")
+        done: Dict[str, str] = {}
+        with self._lock:
+            if node:
+                # a named node must resolve — falling back to "all nodes"
+                # on a typo would silently reset every reference
+                targets = [self._nodes[node]] if node in self._nodes else []
+            else:
+                targets = list(self._nodes.values())
+        if node and not targets:
+            return {"action": action, "nodes": {node: "unknown_node"},
+                    "enabled": self.enabled}
+        for ent in targets:
+            with ent.lock:
+                if action == "reset":
+                    ent._clear()
+                    done[ent.node] = "reset"
+                else:
+                    if ent.frozen:
+                        # re-freeze onto current traffic requires fresh raw
+                        # rows: restart collection (documented semantics)
+                        ent._clear()
+                        done[ent.node] = "recollecting"
+                    else:
+                        done[ent.node] = (
+                            "frozen" if ent._freeze() else "no_rows"
+                        )
+            # the published gauges must not outlive the window they
+            # scored — a stale PSI would keep SeldonTPUDriftDetected
+            # firing through the entire recollection
+            if done[ent.node] in ("reset", "recollecting"):
+                RECORDER.clear_drift(ent.node)
+        return {"action": action, "nodes": done, "enabled": self.enabled}
+
+    # -- feedback ----------------------------------------------------------
+
+    def record_feedback(self, predictor: str, reward: float,
+                        truth=None, prediction=None) -> None:
+        """Fold one send_feedback into rolling per-predictor reward and
+        truth-vs-prediction accuracy (+ the seldon_tpu_feedback_*
+        families)."""
+        if not self.enabled:
+            return
+        try:
+            agreement = _agreement(prediction, truth)
+            with self._lock:
+                ent = self._feedback.get(predictor)
+                if ent is None:
+                    if len(self._feedback) >= self.MAX_NODES:
+                        return
+                    ent = self._feedback[predictor] = _FeedbackStats()
+            rows = (
+                max(int(np.atleast_2d(np.asarray(truth)).shape[0]), 1)
+                if agreement is not None else 0
+            )
+            with self._lock:
+                ent.count += 1
+                if truth is not None:
+                    ent.truth_count += 1
+                if agreement is not None:
+                    ent.truth_rows += rows
+                    ent.agree_rows += agreement * rows
+            ent.reward.observe(float(reward))
+            RECORDER.record_feedback_event(
+                float(reward),
+                truth_provided=truth is not None,
+                agreement=agreement,
+            )
+        except Exception:  # noqa: BLE001
+            self._bump_errors()
+            logger.debug("quality feedback failed", exc_info=True)
+
+    # -- outlier bridge ----------------------------------------------------
+
+    def record_outlier_tags(self, tags: Optional[Dict[str, Any]],
+                            real_rows: Optional[int] = None) -> None:
+        """Bridge MahalanobisOutlier scores out of
+        ``meta.tags['outlierScore']`` (models/outlier.py) into the
+        ``seldon_tpu_outlier_score`` family + the /quality block — until
+        now the scores were per-response tags only, invisible to
+        Prometheus.  ``SELDON_TPU_OUTLIER_THRESHOLD`` exceedances count
+        separately for alerting."""
+        if not self.enabled or not tags or "outlierScore" not in tags:
+            return
+        try:
+            scores = np.asarray(tags["outlierScore"], dtype=np.float64)
+            scores = scores.reshape(-1)
+            if real_rows is not None:
+                scores = scores[: int(real_rows)]
+            if scores.size == 0:
+                return
+            n = (
+                int((scores > self.outlier_threshold).sum())
+                if self.outlier_threshold is not None else 0
+            )
+            with self._lock:
+                self.outlier_total += int(scores.size)
+                self.outlier_exceeded += n
+            self.outlier.observe_many(scores)
+            RECORDER.record_outlier_scores(scores)
+            if n:
+                RECORDER.record_outlier_exceeded(n)
+        except Exception:  # noqa: BLE001
+            self._bump_errors()
+            logger.debug("outlier bridge failed", exc_info=True)
+
+    # -- SLO ---------------------------------------------------------------
+
+    def record_request(self, latency_s: float, error: bool = False,
+                       now: Optional[float] = None) -> None:
+        """One served request's latency/outcome into the SLO engine (fed
+        by MetricsRegistry.time_server on the predictions services)."""
+        if not self.enabled:
+            return
+        self.slo.record(latency_s, error=error, now=now)
+
+    def refresh_gauges(self) -> None:
+        """Recompute the seldon_tpu_slo_burn_rate gauges — called from
+        the Prometheus exposition path so a scrape-only deployment sees
+        live burn rates without anyone polling /quality."""
+        if not self.enabled:
+            return
+        try:
+            for window, entry in self.slo.burn_rates().items():
+                RECORDER.set_slo_burn(window, entry["burn_rate"])
+        except Exception:  # noqa: BLE001 - scrape must never fail here
+            self._bump_errors()
+
+    # -- snapshots ---------------------------------------------------------
+
+    def outlier_block(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "scores": self.outlier.snapshot(),
+            "total": self.outlier_total,
+            "threshold": self.outlier_threshold,
+        }
+        if self.outlier_threshold is not None:
+            out["exceeded"] = self.outlier_exceeded
+        return out
+
+    def document(self) -> Dict[str, Any]:
+        """The ``GET /quality`` body: per-node drift table, feedback
+        reward/accuracy trends, outlier bridge, SLO burn rates."""
+        self.refresh_gauges()
+        with self._lock:
+            nodes = list(self._nodes.values())
+            fb = {k: v.snapshot() for k, v in self._feedback.items()}
+        rows = []
+        for ent in nodes:
+            with ent.lock:
+                rows.append(ent.document_row())
+        return {
+            "enabled": self.enabled,
+            "sample": self.sample,
+            "n_bins": self.n_bins,
+            "ref_target": self.ref_target,
+            "nodes": sorted(rows, key=lambda r: r["node"]),
+            "feedback": fb,
+            "outliers": self.outlier_block(),
+            "slo": self.slo.snapshot(),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Compact health block for ``/stats`` — the full table lives on
+        ``/quality``."""
+        with self._lock:
+            nodes = {
+                name: {
+                    "status": (
+                        "live" if ent.frozen else "collecting_reference"
+                    ),
+                    "sampled_rows": ent.sampled_rows,
+                    **{k: round(v, 6)
+                       for k, v in ent.last_scores.items()},
+                }
+                for name, ent in self._nodes.items()
+            }
+            fb_count = sum(v.count for v in self._feedback.values())
+        return {
+            "enabled": self.enabled,
+            "sample": self.sample,
+            "nodes": nodes,
+            "feedback_count": fb_count,
+            "outliers_scored": self.outlier_total,
+            "slo_configured": self.slo.configured,
+            "errors": self.errors,
+        }
+
+    def reset(self) -> None:
+        """Fresh state — tests only (config survives)."""
+        with self._lock:
+            self._nodes = {}
+            self._feedback = {}
+            self._rng = random.Random(0xC0FFEE)
+            self.outlier = Reservoir(2048)
+            self.outlier_total = 0
+            self.outlier_exceeded = 0
+            self.errors = 0
+        self.slo.reset_events()
+
+
+def parse_reference_action(body, action: Optional[str] = None,
+                           node: Optional[str] = None):
+    """POST /quality/reference payload → ``(action, node)``.  Query
+    ``?action=`` / ``?node=`` win; else a JSON body ``{"action":
+    "freeze"|"reset", "node": "<name>"}``; action defaults to freeze,
+    node to all nodes.  Raises ValueError on anything else (the lanes
+    answer 400)."""
+    candidate = action or None
+    if (candidate is None or node is None) and body:
+        text = body.decode("utf-8", "replace") \
+            if isinstance(body, bytes) else str(body)
+        text = text.strip()
+        if text:
+            try:
+                doc = json.loads(text)
+            except ValueError:
+                raise ValueError("reference body must be JSON")
+            if isinstance(doc, dict):
+                if candidate is None and "action" in doc:
+                    candidate = str(doc["action"])
+                if node is None and "node" in doc:
+                    node = str(doc["node"])
+            elif isinstance(doc, str) and candidate is None:
+                candidate = doc
+    candidate = candidate or "freeze"
+    if candidate not in ("freeze", "reset"):
+        raise ValueError(
+            f"unknown reference action {candidate!r} (expected freeze|reset)"
+        )
+    return candidate, node
+
+
+QUALITY = QualityObservatory()

@@ -97,6 +97,18 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_hbm_bytes_limit": ("gauge", ("device",)),
     "seldon_tpu_compile_seconds": ("histogram", ()),
     "seldon_tpu_request_latency_seconds": ("histogram", ("service",)),
+    # prediction-quality observatory (utils/quality.py): live-vs-reference
+    # input/prediction drift, feedback reward + truth-agreement
+    # accounting, the Mahalanobis outlier-score bridge, and multi-window
+    # SLO burn rates
+    "seldon_tpu_drift_score": ("gauge", ("node", "method")),
+    "seldon_tpu_prediction_quantile": ("gauge", ("node", "q")),
+    "seldon_tpu_feedback_reward": ("histogram", ()),
+    "seldon_tpu_feedback_total": ("counter", ("outcome",)),
+    "seldon_tpu_outlier_score": ("histogram", ()),
+    "seldon_tpu_outlier_exceedances_total": ("counter", ()),
+    "seldon_tpu_slo_burn_rate": ("gauge", ("window",)),
+    "seldon_tpu_quality_sampled_total": ("counter", ("node",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -115,6 +127,12 @@ _COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 20.0,
                     40.0, 80.0, 160.0)
 _LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# rewards are nominally [0,1] (models/mab.py) but the wire allows any
+# scalar; outlier scores are Mahalanobis distances (chi2-ish tails)
+_REWARD_BUCKETS = (0.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0,
+                   2.5, 10.0)
+_OUTLIER_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                    1000.0)
 
 
 class Reservoir:
@@ -136,6 +154,18 @@ class Reservoir:
             self._samples.append(float(value))
             self._count += 1
             self._total += float(value)
+
+    def observe_many(self, values) -> None:
+        """Batch observe under ONE lock acquisition — per-row call sites
+        on the dispatch path (outlier-score bridging) must not pay a
+        lock per row."""
+        vals = [float(v) for v in values]
+        if not vals:
+            return
+        with self._lock:
+            self._samples.extend(vals)
+            self._count += len(vals)
+            self._total += sum(vals)
 
     def __len__(self) -> int:
         return len(self._samples)
@@ -197,6 +227,19 @@ class FlightRecorder:
         #: bounded — an exploding label set must not grow memory
         self._latency: Dict[str, Reservoir] = {}
         self._latency_cap = 64
+        # prediction-quality observatory mirrors (utils/quality.py feeds
+        # these; the per-node windows live in QUALITY, not here)
+        self.drift_scores: Dict[str, float] = {}       # "node:method" -> v
+        self.prediction_quantiles: Dict[str, float] = {}  # "node:q" -> v
+        self.feedback_count = 0
+        self.feedback_reward = Reservoir()
+        self.feedback_truth = 0
+        self.feedback_agree = 0
+        self.feedback_disagree = 0
+        self.outlier_scores = Reservoir()
+        self.outlier_exceeded = 0
+        self.slo_burn: Dict[str, float] = {}           # window -> rate
+        self.quality_sampled: Dict[str, int] = {}      # node -> batches
         self.registry = None
         if HAVE_PROMETHEUS:
             self.registry = CollectorRegistry()
@@ -310,6 +353,47 @@ class FlightRecorder:
                 "/stats request_latency_s reservoirs)",
                 ["service"], registry=self.registry,
                 buckets=_LATENCY_BUCKETS)
+            self._p_drift = Gauge(
+                "seldon_tpu_drift_score",
+                "Live-vs-reference drift per graph node (method=psi: max "
+                "per-feature PSI; ks: max per-feature KS distance; "
+                "prediction: PSI of the prediction distribution — "
+                "utils/quality.py)",
+                ["node", "method"], registry=self.registry)
+            self._p_pred_quantile = Gauge(
+                "seldon_tpu_prediction_quantile",
+                "Approximate live prediction-distribution quantiles per "
+                "graph node (binned sketch over reference edges)",
+                ["node", "q"], registry=self.registry)
+            self._p_feedback_reward = Histogram(
+                "seldon_tpu_feedback_reward",
+                "Reward value per send_feedback call",
+                registry=self.registry, buckets=_REWARD_BUCKETS)
+            self._p_feedback = Counter(
+                "seldon_tpu_feedback_total",
+                "Feedback calls by outcome (received / truth_provided / "
+                "agree / disagree)", ["outcome"], registry=self.registry)
+            self._p_outlier = Histogram(
+                "seldon_tpu_outlier_score",
+                "Mahalanobis outlier scores bridged out of "
+                "meta.tags['outlierScore'] (models/outlier.py)",
+                registry=self.registry, buckets=_OUTLIER_BUCKETS)
+            self._p_outlier_exceeded = Counter(
+                "seldon_tpu_outlier_exceedances_total",
+                "Rows whose outlier score exceeded "
+                "SELDON_TPU_OUTLIER_THRESHOLD",
+                registry=self.registry)
+            self._p_slo_burn = Gauge(
+                "seldon_tpu_slo_burn_rate",
+                "SLO error-budget burn rate per window (1.0 = burning "
+                "exactly at budget; 14.4x/5m and 6x/1h are the classic "
+                "page thresholds — utils/quality.py SloTracker)",
+                ["window"], registry=self.registry)
+            self._p_quality_sampled = Counter(
+                "seldon_tpu_quality_sampled_total",
+                "Dispatch batches sampled into the quality observatory "
+                "(SELDON_TPU_QUALITY_SAMPLE gates the rate)",
+                ["node"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -469,6 +553,95 @@ class FlightRecorder:
         if self.registry is not None:
             self._p_compile_seconds.observe(seconds)
 
+    # -- prediction-quality observatory (utils/quality.py) ----------------
+
+    def set_drift(self, node: str, method: str, score: float) -> None:
+        """Aggregate drift score for one node (method: psi|ks|prediction)."""
+        with self._lock:
+            self.drift_scores[f"{node}:{method}"] = float(score)
+        if self.registry is not None:
+            self._p_drift.labels(node=node, method=method).set(score)
+
+    def set_prediction_quantile(self, node: str, q: str,
+                                value: float) -> None:
+        with self._lock:
+            self.prediction_quantiles[f"{node}:{q}"] = float(value)
+        if self.registry is not None:
+            self._p_pred_quantile.labels(node=node, q=q).set(value)
+
+    def clear_drift(self, node: str) -> None:
+        """Drop one node's published drift scores + prediction quantiles
+        — called when its reference window is reset/refrozen, so a stale
+        score can't keep an alert firing through the recollection."""
+        with self._lock:
+            for method in ("psi", "ks", "prediction"):
+                self.drift_scores.pop(f"{node}:{method}", None)
+            for q in ("0.5", "0.9", "0.99"):
+                self.prediction_quantiles.pop(f"{node}:{q}", None)
+        if self.registry is not None:
+            for method in ("psi", "ks", "prediction"):
+                try:
+                    self._p_drift.remove(node, method)
+                except KeyError:
+                    pass
+            for q in ("0.5", "0.9", "0.99"):
+                try:
+                    self._p_pred_quantile.remove(node, q)
+                except KeyError:
+                    pass
+
+    def record_feedback_event(self, reward: float,
+                              truth_provided: bool = False,
+                              agreement: Optional[float] = None) -> None:
+        """One send_feedback call: reward into the histogram, outcome
+        counters (agree/disagree judged by majority row agreement when
+        truth was comparable to the served prediction)."""
+        self.feedback_reward.observe(reward)
+        with self._lock:
+            self.feedback_count += 1
+            if truth_provided:
+                self.feedback_truth += 1
+            if agreement is not None:
+                if agreement >= 0.5:
+                    self.feedback_agree += 1
+                else:
+                    self.feedback_disagree += 1
+        if self.registry is not None:
+            self._p_feedback_reward.observe(reward)
+            self._p_feedback.labels(outcome="received").inc()
+            if truth_provided:
+                self._p_feedback.labels(outcome="truth_provided").inc()
+            if agreement is not None:
+                self._p_feedback.labels(
+                    outcome="agree" if agreement >= 0.5 else "disagree"
+                ).inc()
+
+    def record_outlier_scores(self, scores) -> None:
+        self.outlier_scores.observe_many(scores)
+        if self.registry is not None:
+            # prometheus_client has no batch observe; this remaining
+            # per-value loop is lock-light (histogram child increments)
+            for v in scores:
+                self._p_outlier.observe(float(v))
+
+    def record_outlier_exceeded(self, n: int = 1) -> None:
+        with self._lock:
+            self.outlier_exceeded += int(n)
+        if self.registry is not None:
+            self._p_outlier_exceeded.inc(n)
+
+    def set_slo_burn(self, window: str, rate: float) -> None:
+        with self._lock:
+            self.slo_burn[window] = float(rate)
+        if self.registry is not None:
+            self._p_slo_burn.labels(window=window).set(rate)
+
+    def record_quality_sampled(self, node: str) -> None:
+        with self._lock:
+            self.quality_sampled[node] = self.quality_sampled.get(node, 0) + 1
+        if self.registry is not None:
+            self._p_quality_sampled.labels(node=node).inc()
+
     # -- request latencies (feeds /stats percentiles + the
     # -- seldon_tpu_request_latency_seconds histogram) --------------------
 
@@ -506,10 +679,30 @@ class FlightRecorder:
                 "anomalies": dict(self.perf_anomalies),
                 "hbm": {d: dict(v) for d, v in self.hbm.items()},
             }
+            feedback = {
+                "count": self.feedback_count,
+                "truth_provided": self.feedback_truth,
+                "agree": self.feedback_agree,
+                "disagree": self.feedback_disagree,
+            }
+            quality = {
+                "drift": dict(self.drift_scores),
+                "slo_burn": dict(self.slo_burn),
+                "sampled": dict(self.quality_sampled),
+                "outliers": {
+                    "count": self.outlier_scores.snapshot()["count"],
+                    "exceeded": self.outlier_exceeded,
+                },
+            }
         perf["compile_s"] = self.compile_seconds.snapshot()
+        feedback["mean_reward"] = round(
+            self.feedback_reward.snapshot()["mean"], 6
+        )
         return {
             "resilience": resilience,
             "perf": perf,
+            "feedback": feedback,
+            "quality": quality,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -545,6 +738,14 @@ class FlightRecorder:
             OBSERVATORY.hbm_watermarks()
         except Exception:  # noqa: BLE001 - scrape must never fail on polling
             pass
+        try:
+            # same rationale for the SLO burn gauges: a Prometheus-only
+            # deployment must see live burn rates at scrape time
+            from seldon_core_tpu.utils.quality import QUALITY
+
+            QUALITY.refresh_gauges()
+        except Exception:  # noqa: BLE001
+            pass
         if openmetrics:
             from prometheus_client.openmetrics.exposition import (
                 generate_latest as om_generate_latest,
@@ -576,6 +777,17 @@ class FlightRecorder:
             self.trace_spans = {}
             self.perf_anomalies = {}
             self.hbm = {}
+            self.drift_scores = {}
+            self.prediction_quantiles = {}
+            self.feedback_count = 0
+            self.feedback_reward = Reservoir()
+            self.feedback_truth = 0
+            self.feedback_agree = 0
+            self.feedback_disagree = 0
+            self.outlier_scores = Reservoir()
+            self.outlier_exceeded = 0
+            self.slo_burn = {}
+            self.quality_sampled = {}
 
 
 RECORDER = FlightRecorder()

@@ -112,5 +112,14 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_hbm_bytes_limit",
         "seldon_tpu_compile_seconds",
         "seldon_tpu_request_latency_seconds",
+        # prediction-quality observatory (utils/quality.py)
+        "seldon_tpu_drift_score",
+        "seldon_tpu_prediction_quantile",
+        "seldon_tpu_feedback_reward",
+        "seldon_tpu_feedback_total",
+        "seldon_tpu_outlier_score",
+        "seldon_tpu_outlier_exceedances_total",
+        "seldon_tpu_slo_burn_rate",
+        "seldon_tpu_quality_sampled_total",
     ):
         assert family in text, f"{family} missing from every dashboard"

@@ -1,0 +1,837 @@
+"""Prediction-quality observatory (utils/quality.py): hand-computed
+PSI/KS drift scores on synthetic drifted workloads, reference
+freeze/reset, GET /quality on both engine REST lanes + the unit pod,
+sampling gates, numpy/CPU degradation, SLO burn-rate math against an
+injected latency spike, the MAB router read-back (including the
+branch == -1 feedback no-op), the Mahalanobis outlier-score bridge, and
+the feedback telemetry block."""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.graph.units import Unit, register_unit
+from seldon_core_tpu.messages import DefaultData, Feedback, SeldonMessage
+from seldon_core_tpu.models.mab import EpsilonGreedyRouter
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.utils.quality import (
+    QUALITY,
+    QualityObservatory,
+    SloTracker,
+    parse_reference_action,
+    router_quality,
+)
+from seldon_core_tpu.utils.telemetry import RECORDER, AuditLog
+
+
+@register_unit("test.QualityMatmul")
+class QualityMatmulUnit(Unit):
+    """Pure matmul model: width K in, 2 columns out."""
+
+    K = 6
+
+    def __init__(self):
+        self.w = jnp.arange(self.K * 2, dtype=jnp.float32).reshape(
+            self.K, 2
+        ) / (self.K * 2)
+
+    def predict(self, state, X):
+        return X @ self.w
+
+
+def matmul_deployment():
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "q-dep", "predictors": [{
+            "name": "p",
+            "graph": {"name": "qm", "type": "MODEL"},
+            "components": [{
+                "name": "qm", "runtime": "inprocess",
+                "class_path": "test.QualityMatmul",
+            }],
+        }]}
+    })
+
+
+def router_deployment():
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "r-dep", "predictors": [{
+            "name": "p",
+            "graph": {
+                "name": "eg", "type": "ROUTER",
+                "children": [{"name": "m0", "type": "MODEL"},
+                             {"name": "m1", "type": "MODEL"}],
+            },
+            "components": [
+                {"name": "eg", "runtime": "inprocess",
+                 "class_path": "EpsilonGreedyRouter",
+                 "parameters": [{"name": "n_branches", "value": "2",
+                                 "type": "INT"}]},
+                {"name": "m0", "runtime": "inprocess",
+                 "class_path": "test.QualityMatmul"},
+                {"name": "m1", "runtime": "inprocess",
+                 "class_path": "test.QualityMatmul"},
+            ],
+        }]}
+    })
+
+
+def outlier_deployment():
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {"name": "o-dep", "predictors": [{
+            "name": "p",
+            "graph": {"name": "mah", "type": "TRANSFORMER"},
+            "components": [{
+                "name": "mah", "runtime": "inprocess",
+                "class_path": "MahalanobisOutlier",
+                "parameters": [{"name": "n_features", "value": "4",
+                                "type": "INT"}],
+            }],
+        }]}
+    })
+
+
+@pytest.fixture
+def fresh_quality():
+    """Clean process-global observatory; config restored afterwards."""
+    saved = (QUALITY.enabled, QUALITY.sample, QUALITY.ref_target,
+             QUALITY.outlier_threshold, QUALITY.slo)
+    QUALITY.reset()
+    QUALITY.enabled = True
+    QUALITY.sample = 1.0
+    yield QUALITY
+    (QUALITY.enabled, QUALITY.sample, QUALITY.ref_target,
+     QUALITY.outlier_threshold, QUALITY.slo) = saved
+    QUALITY.reset()
+
+
+def drive(engine, mat, rows_per_request=4):
+    async def run():
+        for i in range(0, len(mat), rows_per_request):
+            payload = json.dumps(
+                {"data": {"ndarray": mat[i:i + rows_per_request].tolist()}}
+            )
+            text, status = await engine.predict_json(payload)
+            assert status == 200, text
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# independent (hand-computed) drift math — deliberately NOT reusing the
+# implementation's psi/ks helpers
+# ---------------------------------------------------------------------------
+
+
+def hand_counts(rows, thr):
+    """Bin counts with the documented convention: bin(x) = #(thresholds
+    <= x), thresholds in float32 like the on-device summarizer."""
+    x = np.asarray(rows, dtype=np.float32)
+    idx = (x[:, :, None] >= thr[None, :, :]).sum(-1)
+    B = thr.shape[1] + 1
+    return np.stack(
+        [(idx == b).sum(0) for b in range(B)], axis=1
+    ).astype(np.float64)
+
+
+def hand_psi(p_counts, q_counts):
+    p = np.clip(p_counts / p_counts.sum(-1, keepdims=True), 1e-6, None)
+    q = np.clip(q_counts / q_counts.sum(-1, keepdims=True), 1e-6, None)
+    return ((q - p) * np.log(q / p)).sum(-1)
+
+
+def hand_ks(p_counts, q_counts):
+    p = (p_counts / p_counts.sum(-1, keepdims=True)).cumsum(-1)
+    q = (q_counts / q_counts.sum(-1, keepdims=True)).cumsum(-1)
+    return np.abs(q - p).max(-1)
+
+
+# ---------------------------------------------------------------------------
+# PSI/KS math on synthetic drifted vs undrifted batches
+# ---------------------------------------------------------------------------
+
+
+def test_psi_ks_hand_computed_on_drifted_batches():
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=5,
+                             ref_target=64)
+    rng = np.random.default_rng(0)
+    ref = rng.normal(0, 1, (64, 3))
+    ref_y = ref.sum(1, keepdims=True)
+    for i in range(0, 64, 16):
+        obs.observe_batch("n", ref[i:i + 16], ref_y[i:i + 16])
+    live = rng.normal(2, 1, (32, 3))
+    live_y = live.sum(1, keepdims=True)
+    for i in range(0, 32, 16):
+        obs.observe_batch("n", live[i:i + 16], live_y[i:i + 16])
+
+    ent = obs._nodes["n"]
+    # thresholds are the reference quantiles (the classic PSI setup)
+    expected_thr = np.quantile(
+        ref, np.arange(1, 5) / 5, axis=0
+    ).T.astype(np.float32)
+    np.testing.assert_allclose(ent.x_thr, expected_thr)
+
+    ref_counts = hand_counts(ref, expected_thr)
+    live_counts = hand_counts(live, expected_thr)
+    want_psi = hand_psi(ref_counts, live_counts)
+    want_ks = hand_ks(ref_counts, live_counts)
+    row = [r for r in obs.document()["nodes"] if r["node"] == "n"][0]
+    assert row["status"] == "live"
+    assert row["drift"]["psi_max"] == pytest.approx(want_psi.max(), abs=1e-5)
+    assert row["drift"]["psi_mean"] == pytest.approx(want_psi.mean(),
+                                                     abs=1e-5)
+    assert row["drift"]["ks_max"] == pytest.approx(want_ks.max(), abs=1e-5)
+
+    # prediction-distribution shift, same construction over flattened y
+    y_thr = np.quantile(ref_y.reshape(-1), np.arange(1, 5) / 5).astype(
+        np.float32
+    ).reshape(1, -1)
+    want_y_psi = hand_psi(
+        hand_counts(ref_y.reshape(-1, 1), y_thr),
+        hand_counts(live_y.reshape(-1, 1), y_thr),
+    )[0]
+    assert row["drift"]["prediction_psi"] == pytest.approx(want_y_psi,
+                                                           abs=1e-5)
+    # the drifted feature ranks in the table with its per-feature scores
+    top = {f["feature"]: f for f in row["top_features"]}
+    for f in range(3):
+        assert top[f]["psi"] == pytest.approx(want_psi[f], abs=1e-5)
+
+
+def test_undrifted_batches_score_near_zero():
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=5,
+                             ref_target=128)
+    rng = np.random.default_rng(1)
+    ref = rng.normal(0, 1, (128, 2))
+    for i in range(0, 128, 32):
+        obs.observe_batch("n", ref[i:i + 32], ref[i:i + 32, :1])
+    live = rng.normal(0, 1, (128, 2))  # same distribution
+    for i in range(0, 128, 32):
+        obs.observe_batch("n", live[i:i + 32], live[i:i + 32, :1])
+    row = obs.document()["nodes"][0]
+    assert row["drift"]["psi_max"] < 0.25  # no significant shift
+    assert row["drift"]["ks_max"] < 0.25
+
+
+def test_numpy_twin_matches_jit_path():
+    """CPU degradation: with jax out of the picture the numpy summarizer
+    owns the math and produces identical windows/scores."""
+    rng = np.random.default_rng(2)
+    ref = rng.normal(0, 1, (64, 3))
+    live = rng.normal(1.5, 1, (32, 3))
+
+    def build(use_numpy):
+        obs = QualityObservatory(enabled=True, sample=1.0, n_bins=5,
+                                 ref_target=64, use_numpy=use_numpy)
+        for i in range(0, 64, 16):
+            obs.observe_batch("n", ref[i:i + 16], ref[i:i + 16, :1])
+        for i in range(0, 32, 16):
+            obs.observe_batch("n", live[i:i + 16], live[i:i + 16, :1])
+        return obs.document()["nodes"][0]["drift"]
+
+    a, b = build(False), build(True)
+    for k in a:
+        assert a[k] == pytest.approx(b[k], abs=1e-4), (k, a, b)
+
+    # and the raw summarizers agree output-for-output (the jitted kernel
+    # is only swapped in after a background warm-up, so force both here)
+    from seldon_core_tpu.utils.quality import (
+        _get_jit_summarizer,
+        _summarize_np,
+    )
+
+    fn = _get_jit_summarizer()
+    assert fn is not None
+    thr_x = np.quantile(ref, np.arange(1, 5) / 5, axis=0).T.astype(
+        np.float32)
+    thr_y = np.quantile(ref[:, 0], np.arange(1, 5) / 5).astype(np.float32)
+    got = fn(np.asarray(live, np.float32), np.asarray(live[:, :1],
+                                                      np.float32),
+             thr_x, thr_y, 24)  # mask the tail: only 24 real rows
+    want = _summarize_np(live, live[:, :1], thr_x, thr_y, 24)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g, np.float64), w,
+                                   rtol=1e-5, atol=1e-4)
+
+
+def test_post_freeze_y_width_change_is_rejected():
+    """A model swap that changes the OUTPUT width after the reference
+    froze must not pollute the prediction histogram against stale
+    edges — it counts as a width mismatch like an input change does."""
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=4,
+                             ref_target=16)
+    rng = np.random.default_rng(11)
+    for _ in range(2):
+        obs.observe_batch("n", rng.normal(size=(8, 2)),
+                          rng.normal(size=(8, 2)))
+    ent = obs._nodes["n"]
+    assert ent.frozen
+    before = ent.live_rows
+    obs.observe_batch("n", rng.normal(size=(8, 2)),
+                      rng.normal(size=(8, 3)))  # new output width
+    assert ent.live_rows == before
+    assert ent.width_mismatches == 1
+
+
+def test_mixed_width_reference_collection_does_not_wedge():
+    """A node serving several feature widths references the FIRST width
+    seen; other widths are counted and skipped — they must not block the
+    freeze or hoard raw rows forever."""
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=4,
+                             ref_target=32)
+    rng = np.random.default_rng(8)
+    for _ in range(4):
+        obs.observe_batch("n", rng.normal(size=(8, 3)),
+                          rng.normal(size=(8, 1)))
+        obs.observe_batch("n", rng.normal(size=(8, 5)),  # other width
+                          rng.normal(size=(8, 1)))
+    ent = obs._nodes["n"]
+    assert ent.frozen is True  # 32 rows of width 3 froze on schedule
+    assert ent.ref_rows == 32
+    assert ent.width_mismatches >= 1
+    assert ent._ref_x == []  # raw reference rows released at freeze
+    # live phase keeps rejecting the other width without error
+    obs.observe_batch("n", rng.normal(size=(8, 5)), rng.normal(size=(8, 1)))
+    obs.observe_batch("n", rng.normal(size=(8, 3)), rng.normal(size=(8, 1)))
+    assert obs.document()["nodes"][0]["status"] == "live"
+    assert obs.errors == 0
+
+
+def test_zero_error_budget_burns_on_any_error():
+    """SELDON_TPU_SLO_ERROR_RATE=0 means zero tolerance, not 'error
+    tracking off': any 5xx burns at the cap."""
+    slo = SloTracker(p99_ms=None, error_rate=0.0)
+    t0 = 1_700_000_000
+    for i in range(10):
+        slo.record(0.001, error=False, now=t0 + i)
+    assert slo.burn_rates(now=t0 + 10)["5m"]["error_burn"] == 0.0
+    slo.record(0.001, error=True, now=t0 + 10)
+    rates = slo.burn_rates(now=t0 + 10)
+    assert rates["5m"]["error_burn"] == SloTracker.BURN_CAP
+    assert rates["5m"]["budget_remaining"] == 0.0
+
+
+def test_last_drift_falls_back_to_worst_node():
+    """Host-mode engines audit under the graph-root name while quality
+    records per MODEL node — the audit stamp falls back to the worst
+    live node so drift still reaches the firehose."""
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=4,
+                             ref_target=16)
+    rng = np.random.default_rng(9)
+    for _ in range(2):
+        obs.observe_batch("m0", rng.normal(0, 1, (8, 2)),
+                          rng.normal(size=(8, 1)))
+    for _ in range(2):
+        obs.observe_batch("m0", rng.normal(4, 1, (8, 2)),
+                          rng.normal(size=(8, 1)))
+    assert obs.last_drift("m0") is not None
+    # the graph-root name has no window of its own: fallback kicks in
+    assert obs.last_drift("graph-root") == obs.last_drift("m0")
+    # no live node at all -> None
+    assert QualityObservatory(enabled=True).last_drift("x") is None
+
+
+def test_sampling_zero_records_nothing():
+    obs = QualityObservatory(enabled=True, sample=0.0)
+    assert obs.observe_batch("n", np.ones((4, 2)), np.ones((4, 1))) is None
+    assert obs.document()["nodes"] == []
+    assert obs.snapshot()["nodes"] == {}
+
+
+def test_disabled_subsystem_is_inert(fresh_quality):
+    """SELDON_TPU_QUALITY=0 semantics: nothing observed, recorded, or
+    surfaced — the engine serves identically."""
+    fresh_quality.enabled = False
+    engine = EngineService(matmul_deployment())
+    drive(engine, np.random.default_rng(0).normal(
+        size=(16, QualityMatmulUnit.K)))
+    doc = engine.quality_document()
+    assert doc["enabled"] is False
+    assert doc["nodes"] == []
+    fresh_quality.record_feedback("p", 1.0)
+    assert fresh_quality.document()["feedback"] == {}
+
+
+def test_env_kill_switch_and_sample_parsing(monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_QUALITY", "0")
+    assert QualityObservatory().enabled is False
+    monkeypatch.setenv("SELDON_TPU_QUALITY", "1")
+    monkeypatch.setenv("SELDON_TPU_QUALITY_SAMPLE", "0.25")
+    obs = QualityObservatory()
+    assert obs.enabled is True and obs.sample == 0.25
+
+
+# ---------------------------------------------------------------------------
+# reference freeze / reset
+# ---------------------------------------------------------------------------
+
+
+def test_reference_freeze_and_reset():
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=4,
+                             ref_target=1000)  # never auto-freezes
+    rng = np.random.default_rng(3)
+    obs.observe_batch("n", rng.normal(size=(32, 2)), rng.normal(size=(32, 1)))
+    assert obs.document()["nodes"][0]["status"] == "collecting_reference"
+    # freeze promotes whatever was collected
+    got = obs.reference_control("freeze")
+    assert got["nodes"] == {"n": "frozen"}
+    obs.observe_batch("n", rng.normal(size=(16, 2)), rng.normal(size=(16, 1)))
+    assert obs.document()["nodes"][0]["status"] == "live"
+    # freezing an already-live node restarts collection (documented)
+    assert obs.reference_control("freeze")["nodes"] == {"n": "recollecting"}
+    assert obs.document()["nodes"][0]["status"] == "collecting_reference"
+    # reset drops everything
+    assert obs.reference_control("reset")["nodes"] == {"n": "reset"}
+    assert obs.document()["nodes"][0]["ref_rows"] == 0
+    with pytest.raises(ValueError):
+        obs.reference_control("explode")
+
+
+def test_parse_reference_action():
+    assert parse_reference_action(b"") == ("freeze", None)
+    assert parse_reference_action(None, action="reset") == ("reset", None)
+    assert parse_reference_action(b'{"action": "reset"}') == ("reset", None)
+    assert parse_reference_action(
+        b'{"action": "reset", "node": "m1"}'
+    ) == ("reset", "m1")
+    # query params win over the body
+    assert parse_reference_action(
+        b'{"action": "reset"}', action="freeze", node="m0"
+    ) == ("freeze", "m0")
+    with pytest.raises(ValueError):
+        parse_reference_action(b'{"action": "nuke"}')
+    with pytest.raises(ValueError):
+        parse_reference_action(b"not json")
+
+
+def test_reset_clears_published_drift_scores():
+    """POST /quality/reference reset must retract the node's published
+    drift gauges — a stale PSI would keep SeldonTPUDriftDetected firing
+    through the whole recollection."""
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=4,
+                             ref_target=16)
+    rng = np.random.default_rng(12)
+    for _ in range(2):
+        obs.observe_batch("nr", rng.normal(0, 1, (8, 2)),
+                          rng.normal(size=(8, 1)))
+    obs.observe_batch("nr", rng.normal(5, 1, (8, 2)),
+                      rng.normal(size=(8, 1)))
+    assert RECORDER.drift_scores.get("nr:psi", 0) > 0.5
+    obs.reference_control("reset", node="nr")
+    assert "nr:psi" not in RECORDER.drift_scores
+    assert obs.last_drift("nr") is None
+
+
+def test_reference_control_named_node():
+    obs = QualityObservatory(enabled=True, sample=1.0, ref_target=1000)
+    rng = np.random.default_rng(10)
+    for name in ("a", "b"):
+        obs.observe_batch(name, rng.normal(size=(8, 2)),
+                          rng.normal(size=(8, 1)))
+    got = obs.reference_control("freeze", node="a")
+    assert got["nodes"] == {"a": "frozen"}
+    assert obs._nodes["b"].frozen is False  # untouched
+    # a typo'd node name must NOT fall back to "all nodes"
+    got = obs.reference_control("reset", node="typo")
+    assert got["nodes"] == {"typo": "unknown_node"}
+    assert obs._nodes["a"].frozen is True
+
+
+# ---------------------------------------------------------------------------
+# GET /quality on both engine REST lanes + the unit pod
+# ---------------------------------------------------------------------------
+
+
+def _hand_engine_psi(ref, live):
+    """Hand-compute the engine-lane drift from the exact driven rows."""
+    thr = np.quantile(
+        ref, np.arange(1, 10) / 10, axis=0
+    ).T.astype(np.float32)
+    return hand_psi(hand_counts(ref, thr), hand_counts(live, thr))
+
+
+def test_quality_endpoint_aiohttp_lane(fresh_quality):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.runtime.rest import make_engine_app
+
+    engine = EngineService(matmul_deployment())
+    rng = np.random.default_rng(4)
+    ref = rng.normal(0, 1, (64, QualityMatmulUnit.K))
+    live = rng.normal(3, 1, (32, QualityMatmulUnit.K))
+
+    async def run():
+        app = make_engine_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            for i in range(0, 64, 4):
+                r = await client.post(
+                    "/api/v0.1/predictions",
+                    data=json.dumps(
+                        {"data": {"ndarray": ref[i:i + 4].tolist()}}),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert r.status == 200
+            # freeze the reference over the wire
+            r = await client.post("/quality/reference",
+                                  data='{"action": "freeze"}')
+            assert r.status == 200
+            assert (await r.json())["nodes"] == {"qm": "frozen"}
+            for i in range(0, 32, 4):
+                r = await client.post(
+                    "/api/v0.1/predictions",
+                    data=json.dumps(
+                        {"data": {"ndarray": live[i:i + 4].tolist()}}),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert r.status == 200
+            # feedback feeds the reward/accuracy block
+            fb = {
+                "reward": 0.8,
+                "response": {"data": {"ndarray": [[0.1, 0.9]]}},
+                "truth": {"data": {"ndarray": [[0.0, 1.0]]}},
+            }
+            r = await client.post("/api/v0.1/feedback", data=json.dumps(fb))
+            assert r.status == 200
+
+            r = await client.get("/quality")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["engine"]["deployment"] == "q-dep"
+            row = [n for n in doc["nodes"] if n["node"] == "qm"][0]
+            assert row["status"] == "live"
+            # the served drift scores match the hand-computed values on
+            # the exact driven rows (acceptance criterion)
+            want = _hand_engine_psi(ref, live)
+            assert row["drift"]["psi_max"] == pytest.approx(want.max(),
+                                                            abs=1e-4)
+            assert row["drift"]["prediction_psi"] > 0.5
+            fb_block = doc["feedback"]["p"]
+            assert fb_block["count"] == 1
+            assert fb_block["mean_reward"] == pytest.approx(0.8)
+            assert fb_block["accuracy"] == 1.0
+            assert "windows" in doc["slo"]
+            # /stats carries the compact block + the telemetry feedback
+            r = await client.get("/stats")
+            stats = await r.json()
+            assert stats["quality"]["nodes"]["qm"]["status"] == "live"
+            assert stats["telemetry"]["feedback"]["count"] >= 1
+            # new families render in the exposition
+            r = await client.get("/prometheus")
+            text = await r.text()
+            for fam in ("seldon_tpu_drift_score",
+                        "seldon_tpu_feedback_reward",
+                        "seldon_tpu_slo_burn_rate",
+                        "seldon_tpu_quality_sampled_total"):
+                assert fam in text, fam
+            # bad action answers 400
+            r = await client.post("/quality/reference?action=nuke")
+            assert r.status == 400
+
+    asyncio.run(run())
+
+
+def test_quality_endpoint_fast_lane(fresh_quality):
+    import aiohttp
+
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+
+    engine = EngineService(matmul_deployment())
+    rng = np.random.default_rng(5)
+    ref = rng.normal(0, 1, (32, QualityMatmulUnit.K))
+    live = rng.normal(3, 1, (32, QualityMatmulUnit.K))
+
+    async def run():
+        server = await serve_fast(engine, "127.0.0.1", 0)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async def post_rows(mat):
+                    for i in range(0, len(mat), 4):
+                        async with sess.post(
+                            base + "/api/v0.1/predictions",
+                            data=json.dumps(
+                                {"data": {"ndarray": mat[i:i + 4].tolist()}}
+                            ),
+                        ) as r:
+                            assert r.status == 200
+
+                await post_rows(ref)
+                async with sess.post(
+                    base + "/quality/reference?action=freeze"
+                ) as r:
+                    assert r.status == 200
+                    assert (await r.json())["nodes"] == {"qm": "frozen"}
+                await post_rows(live)
+                async with sess.get(base + "/quality") as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                row = [n for n in doc["nodes"] if n["node"] == "qm"][0]
+                assert row["status"] == "live"
+                want = _hand_engine_psi(ref, live)
+                assert row["drift"]["psi_max"] == pytest.approx(
+                    want.max(), abs=1e-4)
+                # bad action answers 400 on the fast lane too
+                async with sess.post(
+                    base + "/quality/reference", data='{"action": "nuke"}'
+                ) as r:
+                    assert r.status == 400
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_quality_endpoint_on_unit_pod(fresh_quality):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.runtime.microservice import build_runtime
+    from seldon_core_tpu.runtime.rest import make_unit_app
+
+    runtime = build_runtime("SIMPLE_MODEL", "MODEL", unit_name="u")
+
+    async def run():
+        async with TestClient(TestServer(make_unit_app(runtime))) as client:
+            payload = json.dumps({"data": {"ndarray": [[0.5, 1.5]]}})
+            for _ in range(3):
+                r = await client.post("/predict", data=payload)
+                assert r.status == 200
+            r = await client.get("/quality")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["unit"]["name"] == "u"
+            row = [n for n in doc["nodes"] if n["node"] == "u"]
+            assert row and row[0]["sampled_rows"] == 3
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+
+
+def test_slo_burn_rate_hand_computed():
+    slo = SloTracker(p99_ms=100.0, error_rate=0.01)
+    t0 = 1_700_000_000
+    # an hour of healthy traffic: 10 req/s, all fast, no errors
+    for s in range(0, 3600, 60):
+        for _ in range(10):
+            slo.record(0.01, now=t0 + s)
+    # latency spike in the last 2 minutes: 30 slow requests
+    for i in range(30):
+        slo.record(0.5, now=t0 + 3540 + (i % 120) // 2)
+    now = t0 + 3599
+    rates = slo.burn_rates(now=now)
+    # 5m window: 50 fast (5 slots of 10) + 30 slow
+    frac_5m = 30 / (50 + 30)
+    assert rates["5m"]["latency_burn"] == pytest.approx(frac_5m / 0.01,
+                                                        abs=1e-3)
+    # 1h window dilutes the same spike
+    frac_1h = 30 / (600 + 30)
+    assert rates["1h"]["latency_burn"] == pytest.approx(frac_1h / 0.01,
+                                                        abs=1e-3)
+    assert rates["5m"]["burn_rate"] > rates["1h"]["burn_rate"]
+    assert rates["5m"]["budget_remaining"] == 0.0  # burn >> 1
+
+
+def test_slo_error_burn_and_unconfigured():
+    slo = SloTracker(p99_ms=None, error_rate=0.05)
+    t0 = 1_700_000_000
+    for i in range(90):
+        slo.record(0.001, error=i < 9, now=t0 + i)  # 10% errors
+    rates = slo.burn_rates(now=t0 + 100)
+    assert rates["5m"]["error_burn"] == pytest.approx((9 / 90) / 0.05,
+                                                      abs=1e-3)
+    assert "latency_burn" not in rates["5m"]
+    # no objectives configured -> burn 0, marked unconfigured
+    empty = SloTracker(p99_ms=None, error_rate=None)
+    assert empty.configured is False
+    assert empty.burn_rates()["5m"]["burn_rate"] == 0.0
+
+
+def test_slo_burn_against_injected_latency_spike(fresh_quality):
+    """End to end: a FaultyNodeRuntime delay (testing/faults.py) makes
+    every request blow the 1ms p99 objective — the 5m burn rate pins at
+    frac/budget = 1/0.01 = 100."""
+    from seldon_core_tpu.graph.interpreter import InProcessNodeRuntime
+    from seldon_core_tpu.graph.units import UNIT_REGISTRY
+    from seldon_core_tpu.testing.faults import FaultSpec, FaultyNodeRuntime
+
+    fresh_quality.slo = SloTracker(p99_ms=1.0, error_rate=None)
+    spec = matmul_deployment()
+    node = spec.predictor().graph
+    inner = InProcessNodeRuntime(node, UNIT_REGISTRY["test.QualityMatmul"]())
+    engine = EngineService(
+        spec, force_host=True,
+        extra_runtimes={
+            "qm": FaultyNodeRuntime(inner, FaultSpec(delay_s=0.02))
+        },
+    )
+
+    async def run():
+        msg = SeldonMessage(data=DefaultData(
+            array=np.ones((1, QualityMatmulUnit.K))))
+        for _ in range(5):
+            resp = await engine.predict(msg)
+            assert resp.status is None or resp.status.status == "SUCCESS"
+
+    asyncio.run(run())
+    rates = fresh_quality.slo.burn_rates()
+    assert rates["5m"]["requests"] == 5
+    assert rates["5m"]["latency_burn"] == pytest.approx(100.0)
+    # the exposition path refreshes the burn gauges for scrape-only users
+    RECORDER.exposition()
+    assert RECORDER.slo_burn["5m"] == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# MAB router read-back
+# ---------------------------------------------------------------------------
+
+
+def test_mab_feedback_branch_minus_one_is_noop():
+    """Feedback without recorded routing (branch == -1) must leave the
+    bandit counters untouched (models/mab.py's valid-gate)."""
+    unit = EpsilonGreedyRouter(n_branches=3, seed=0)
+    state = unit.init_state(None)
+    X = np.ones((4, 2))
+    new = unit.send_feedback(state, X, -1, 1.0, None)
+    np.testing.assert_allclose(np.asarray(new["success"]), np.zeros(3))
+    np.testing.assert_allclose(np.asarray(new["tries"]), np.zeros(3))
+    # a recorded branch trains exactly that branch
+    new = unit.send_feedback(state, X, 1, 1.0, None)
+    np.testing.assert_allclose(np.asarray(new["success"]), [0.0, 4.0, 0.0])
+    np.testing.assert_allclose(np.asarray(new["tries"]), [0.0, 4.0, 0.0])
+
+
+def test_router_quality_readback():
+    states = {
+        "eg": {"success": jnp.asarray([8.0, 1.0]),
+               "tries": jnp.asarray([10.0, 5.0]), "key": None},
+        "not_a_bandit": {"w": jnp.zeros((2, 2))},
+    }
+    out = router_quality(states)
+    assert list(out) == ["eg"]
+    row = out["eg"]
+    assert row["best_branch"] == 0
+    b0, b1 = row["branches"]
+    assert b0["reward_rate"] == pytest.approx(9 / 11, abs=1e-4)
+    assert b0["share"] == pytest.approx(10 / 15, abs=1e-4)
+    assert b0["regret"] == 0.0
+    want_regret = 5 * (9 / 11 - 2 / 6)
+    assert b1["regret"] == pytest.approx(want_regret, abs=1e-3)
+    assert row["total_regret"] == pytest.approx(want_regret, abs=1e-3)
+
+
+def test_router_state_surfaces_in_stats_and_quality(fresh_quality):
+    engine = EngineService(router_deployment())
+
+    async def run():
+        msg = SeldonMessage(data=DefaultData(
+            array=np.ones((2, QualityMatmulUnit.K))))
+        resp = await engine.predict(msg)
+        assert "eg" in resp.meta.routing
+        fb = Feedback(request=msg, response=resp, reward=1.0)
+        await engine.send_feedback(fb)
+
+    asyncio.run(run())
+    for doc in (engine.stats(), engine.quality_document()):
+        routers = doc["routers"]
+        assert "eg" in routers
+        assert routers["eg"]["total_tries"] == 2.0  # 2 rows, one branch
+        assert len(routers["eg"]["branches"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# outlier bridge
+# ---------------------------------------------------------------------------
+
+
+def test_outlier_scores_bridge_to_metrics_and_quality(fresh_quality):
+    fresh_quality.outlier_threshold = 0.0  # every positive score exceeds
+    before = RECORDER.outlier_scores.snapshot()["count"]
+    engine = EngineService(outlier_deployment())
+    drive(engine, np.random.default_rng(6).normal(size=(16, 4)),
+          rows_per_request=4)
+    after = RECORDER.outlier_scores.snapshot()["count"]
+    assert after - before == 16  # one score per served row
+    assert fresh_quality.outlier_exceeded > 0
+    block = engine.quality_document()["outliers"]
+    assert block["total"] == 16
+    assert block["exceeded"] == fresh_quality.outlier_exceeded
+    assert block["scores"]["count"] == 16
+    expo = engine.metrics.exposition().decode()
+    assert "seldon_tpu_outlier_score" in expo
+    assert "seldon_tpu_outlier_exceedances_total" in expo
+
+
+def test_outlier_bridge_ignores_missing_threshold(fresh_quality):
+    fresh_quality.outlier_threshold = None
+    exceeded_before = RECORDER.outlier_exceeded
+    fresh_quality.record_outlier_tags({"outlierScore": [5.0, 7.0]})
+    assert fresh_quality.outlier_total == 2
+    assert fresh_quality.outlier_exceeded == 0
+    assert RECORDER.outlier_exceeded == exceeded_before
+
+
+# ---------------------------------------------------------------------------
+# feedback telemetry (audit firehose + /stats block)
+# ---------------------------------------------------------------------------
+
+
+def test_feedback_leaves_audit_and_stats_trace(fresh_quality):
+    events = []
+    engine = EngineService(
+        matmul_deployment(),
+        audit=AuditLog(sink=events.append, enabled=True),
+    )
+
+    async def run():
+        fb = Feedback(
+            request=SeldonMessage(data=DefaultData(
+                array=np.ones((1, QualityMatmulUnit.K)))),
+            response=SeldonMessage(data=DefaultData(
+                array=np.asarray([[0.9, 0.1]]))),
+            reward=0.5,
+            truth=SeldonMessage(data=DefaultData(
+                array=np.asarray([[0.0, 1.0]]))),
+        )
+        await engine.send_feedback(fb)
+        await engine.audit.flush()
+
+    asyncio.run(run())
+    fb_lines = [e for e in events if e["method"] == "feedback"]
+    assert len(fb_lines) == 1
+    assert fb_lines[0]["reward"] == 0.5
+    assert fb_lines[0]["truth_provided"] is True
+    assert fb_lines[0]["status"] == 200
+    # /stats telemetry block: count, mean reward, truth-provided count
+    snap = RECORDER.snapshot()["feedback"]
+    assert snap["count"] >= 1
+    assert snap["truth_provided"] >= 1
+    assert snap["disagree"] >= 1  # argmax 0 vs truth argmax 1
+    # per-predictor accuracy: the served argmax disagreed with truth
+    assert engine.quality_document()["feedback"]["p"]["accuracy"] == 0.0
+
+
+def test_drift_stamped_on_audit_lines(fresh_quality):
+    fresh_quality.ref_target = 16
+    events = []
+    engine = EngineService(
+        matmul_deployment(),
+        audit=AuditLog(sink=events.append, enabled=True),
+    )
+    rng = np.random.default_rng(7)
+    drive(engine, rng.normal(0, 1, (16, QualityMatmulUnit.K)))  # freezes
+    drive(engine, rng.normal(3, 1, (8, QualityMatmulUnit.K)))
+
+    async def flush():
+        await engine.audit.flush()
+
+    asyncio.run(flush())
+    drifted = [e for e in events if "drift" in e]
+    assert drifted, "no audit line carried the drift score"
+    assert drifted[-1]["drift"] > 0.5
