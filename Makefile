@@ -39,6 +39,14 @@ quality-demo:
 bench:
 	python bench.py
 
+# telemetry overhead budget gate: the span probe with EVERY observatory
+# enabled must keep span_framework_p50_ms within
+# SELDON_TPU_OVERHEAD_BUDGET_MS (default 1.0).  Fails loudly on breach;
+# prove it gates with SELDON_TPU_TELEMETRY_TEST_DELAY_MS=2.
+# CPU-friendly — no TPU required (docs/operations.md runbook).
+overhead-gate:
+	JAX_PLATFORMS=cpu python bench.py --overhead-gate
+
 # regenerate every artifact-quoted doc figure from the committed round
 # snapshot / fail when the docs drift from it (CI runs docs-check)
 docs-sync:
@@ -80,4 +88,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo bench demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo bench overhead-gate demos train-demo stack bundle images publish release-dryrun

@@ -1022,6 +1022,142 @@ def _probe_spec_main(smoke: bool) -> None:
     print(json.dumps(doc))
 
 
+def _span_probe(n: int = 100) -> dict:
+    """Python-lane span breakdown with EVERY observatory enabled —
+    tracer, perf, quality, flight recorder — driven through the real
+    engine predict path.  Returns the ``span_*`` keys plus the
+    per-subsystem overhead decomposition the telemetry spine observed
+    about itself (utils/hotrecord.py), i.e. exactly what
+    ``GET /overhead`` serves in production.
+
+    ``span_framework_p50_ms`` = request-span p50 minus dispatch-span p50:
+    the framework-added latency excluding the device/relay hop — the
+    defensible proxy for the reference's <5 ms p50 north star in an
+    environment whose relay alone costs ~100 ms.  The telemetry overhead
+    budget (``SELDON_TPU_OVERHEAD_BUDGET_MS``, default 1.0) is judged on
+    this figure with all observatories on."""
+    import asyncio
+
+    import numpy as np
+
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.perf import OBSERVATORY
+    from seldon_core_tpu.utils.quality import QUALITY
+    from seldon_core_tpu.utils.tracing import TRACER
+
+    spec = SeldonDeploymentSpec.from_json_dict(mnist_deployment(1))
+    engine = EngineService(spec, max_batch=64, max_wait_ms=1.0,
+                           pipeline_depth=4)
+    engine.prewarm([784])
+    saved = (TRACER.enabled, TRACER.sample, OBSERVATORY.enabled,
+             QUALITY.enabled, QUALITY.sample, SPINE.telemetry_enabled)
+    TRACER.enable()
+    TRACER.sample = 1.0
+    OBSERVATORY.enabled = True
+    QUALITY.enabled = True
+    QUALITY.sample = 1.0
+    SPINE.telemetry_enabled = True
+    payload = json.dumps(
+        {"data": {"ndarray": np.zeros((1, 784)).tolist()}}
+    )
+
+    async def drive(k):
+        for _ in range(k):
+            await engine.predict_json(payload)
+
+    try:
+        # warm first, then measure: the first requests pay one-time costs
+        # (prometheus child creation, codec warm, quality reference rows)
+        # that a steady-state budget must not charge to the framework.
+        # SPINE.reset() drops the warm-up's (and, under _probe_main, every
+        # earlier probe section's) hop/fold reservoirs so the reported
+        # breakdown is steady-state only.
+        asyncio.run(drive(max(n // 2, 20)))
+        SPINE.drain()
+        SPINE.reset()
+        TRACER.clear()
+        asyncio.run(drive(n))
+        spans = TRACER.recent(100000)  # drains the spine first
+        overhead = SPINE.overhead_document()  # while all-on is in effect
+    finally:
+        # the probe must not leak its all-on observatory config into
+        # whatever the caller measures next (ensemble section, gate exit)
+        (TRACER.enabled, TRACER.sample, OBSERVATORY.enabled,
+         QUALITY.enabled, QUALITY.sample, SPINE.telemetry_enabled) = saved
+    req = [s.duration_ms for s in spans if s.kind == "request"]
+    disp = [s.duration_ms for s in spans if s.kind == "dispatch"]
+    doc = {}
+    if req and disp:
+        span_request_ms = float(np.percentile(req, 50))
+        span_dispatch_ms = float(np.percentile(disp, 50))
+        doc["span_request_p50_ms"] = round(span_request_ms, 2)
+        doc["span_dispatch_p50_ms"] = round(span_dispatch_ms, 2)
+        doc["span_framework_p50_ms"] = round(
+            span_request_ms - span_dispatch_ms, 2
+        )
+    doc["overhead_budget_ms"] = overhead["budget_ms"]
+    doc["overhead_breakdown"] = {
+        # per-record off-path fold p50 by consumer + on-path ring write
+        **{
+            k: v["p50_us"] / 1e3
+            for k, v in overhead["off_path_fold"].items()
+        },
+        "ring": overhead["ring"]["write_cost"]["p50_us"] / 1e3,
+    }
+    doc["overhead_ring_dropped"] = overhead["ring"]["dropped_total"]
+    if "span_framework_p50_ms" in doc:
+        doc["overhead_within_budget"] = (
+            doc["span_framework_p50_ms"] <= doc["overhead_budget_ms"]
+        )
+    return doc
+
+
+def _overhead_gate_main(smoke: bool) -> None:
+    """`bench.py --overhead-gate` / `make overhead-gate`: the gated
+    regression check behind ROADMAP item 4.  Runs the span probe with
+    all observatories enabled and FAILS (exit 2) when the framework-added
+    p50 with full instrumentation exceeds SELDON_TPU_OVERHEAD_BUDGET_MS
+    (default 1.0).  Inject SELDON_TPU_TELEMETRY_TEST_DELAY_MS=2 to prove
+    the gate trips (docs/operations.md)."""
+    # best-of-3: a regression gate must not flake on host scheduling
+    # noise (shared CI runners, loaded laptops) — a REAL instrumentation
+    # regression shifts the floor and fails every attempt, while one
+    # noisy block must not turn a clean PR red
+    doc = None
+    for attempt in range(3):
+        doc = _span_probe(n=40 if smoke else 200)
+        if doc.get("overhead_within_budget"):
+            break
+        print(
+            f"overhead-gate: attempt {attempt + 1} measured "
+            f"{doc.get('span_framework_p50_ms')} ms (budget "
+            f"{doc['overhead_budget_ms']}); retrying",
+            file=sys.stderr,
+        )
+    print(json.dumps(doc, indent=1))
+    framework = doc.get("span_framework_p50_ms")
+    budget = doc["overhead_budget_ms"]
+    if framework is None:
+        print("overhead-gate: FAIL — no spans recorded", file=sys.stderr)
+        raise SystemExit(2)
+    if framework > budget:
+        print(
+            f"overhead-gate: FAIL — span_framework_p50_ms {framework} > "
+            f"budget {budget} ms on every attempt (decomposition above; "
+            f"see GET /overhead and docs/operations.md 'telemetry "
+            f"overhead budget')",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    print(
+        f"overhead-gate: OK — span_framework_p50_ms {framework} <= "
+        f"budget {budget} ms",
+        file=sys.stderr,
+    )
+
+
 def _probe_main(smoke: bool) -> None:
     import asyncio
 
@@ -1079,34 +1215,21 @@ def _probe_main(smoke: bool) -> None:
     stream_total = time.perf_counter() - t0
 
     # Python-lane span breakdown: where a request's time goes with the
-    # relay in the loop (dispatch span) vs framework work (the rest)
-    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
-    from seldon_core_tpu.runtime.engine import EngineService
-    from seldon_core_tpu.utils.tracing import TRACER
-
-    spec = SeldonDeploymentSpec.from_json_dict(mnist_deployment(1))
-    engine = EngineService(spec, max_batch=64, max_wait_ms=1.0,
-                           pipeline_depth=4)
-    engine.prewarm([784])
-    TRACER.enable()
-    payload = json.dumps(
-        {"data": {"ndarray": np.zeros((1, 784)).tolist()}}
-    )
-
-    async def drive():
-        for _ in range(20 if smoke else 100):
-            await engine.predict_json(payload)
-
-    asyncio.run(drive())
-    spans = TRACER.recent(100000)
-    req = [s.duration_ms for s in spans if s.kind == "request"]
-    disp = [s.duration_ms for s in spans if s.kind == "dispatch"]
+    # relay in the loop (dispatch span) vs framework work (the rest).
+    # Run with EVERY observatory enabled — span_framework_p50_ms is the
+    # figure the telemetry overhead budget (SELDON_TPU_OVERHEAD_BUDGET_MS,
+    # GET /overhead, `make overhead-gate`) is judged on, so it must price
+    # the fully-instrumented path, not a stripped one.
+    span_doc = _span_probe(n=20 if smoke else 100)
 
     # ensemble flat-scaling control (BASELINE.md north star), isolated
     # from socket/load-gen noise: a 1024-row dispatch through 1-member vs
     # 8-member AVERAGE_COMBINER graphs — the fan-out runs inside one XLA
     # program, so the ratio should be ~1.0 regardless of what the
     # socketed series shows on a loaded host core
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+
     ens_ms = {}
     ens_rows = 64 if smoke else 1024
     ens_series = (1, 2) if smoke else (1, 2, 4, 8)
@@ -1154,17 +1277,7 @@ def _probe_main(smoke: bool) -> None:
             str(m): round(v, 1) for m, v in sorted(ens_ms.items())
         },
     }
-    if req and disp:
-        span_request_ms = float(np.percentile(req, 50))
-        span_dispatch_ms = float(np.percentile(disp, 50))
-        doc["span_request_p50_ms"] = round(span_request_ms, 2)
-        doc["span_dispatch_p50_ms"] = round(span_dispatch_ms, 2)
-        # framework-added latency excluding the device/relay hop: the
-        # defensible proxy for the reference's <5 ms p50 north star in an
-        # environment whose relay alone costs ~100 ms
-        doc["span_framework_p50_ms"] = round(
-            span_request_ms - span_dispatch_ms, 2
-        )
+    doc.update(span_doc)
     print(json.dumps(doc))
 
 
@@ -1336,8 +1449,17 @@ def main() -> None:
     parser.add_argument("--_probe", action="store_true")
     parser.add_argument("--_probe_mfu", action="store_true")
     parser.add_argument("--_probe_spec", action="store_true")
+    parser.add_argument(
+        "--overhead-gate", action="store_true",
+        help="run only the telemetry overhead budget check (all "
+             "observatories on; fails when span_framework_p50_ms exceeds "
+             "SELDON_TPU_OVERHEAD_BUDGET_MS) — CPU-friendly, no TPU needed",
+    )
     parser.add_argument("--duration", type=float, default=None)
     args = parser.parse_args()
+    if args.overhead_gate:
+        _overhead_gate_main(args.smoke)
+        return
     if args._probe:
         _probe_main(args.smoke)
         return
@@ -1417,6 +1539,7 @@ def main() -> None:
         gen_tokens_per_s=probe.get("gen_tokens_per_s"),
         ensemble_dispatch_8v1_x=probe.get("ensemble_dispatch_8v1_x"),
         span_framework_p50_ms=probe.get("span_framework_p50_ms"),
+        overhead_within_budget=probe.get("overhead_within_budget"),
     )
 
     # ---- compute-bound evidence: real-size LM MFU + kernel deltas --------
@@ -1571,8 +1694,8 @@ def main() -> None:
         "spec_vs_plain_x", "spec_accept_len",
         "flash_vs_xla_x", "ensemble_dispatch_8v1_x",
         "e2e_gen_tok_s", "served_gen_tok_s",
-        "span_framework_p50_ms", "relay_floor_ms",
-        "model_params_m", "lm_config",
+        "span_framework_p50_ms", "overhead_within_budget",
+        "relay_floor_ms", "model_params_m", "lm_config",
     ]
     compact = {k: result[k] for k in compact_keys if k in result}
     compact["full_artifact"] = "BENCH_FULL.json"

@@ -33,6 +33,11 @@ import numpy as np
 from seldon_core_tpu.gateway.firehose import Firehose
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.messages import Feedback, SeldonMessage, SeldonMessageError
+# importing the spine at module load wires the global TRACER's ring sink
+# BEFORE the gateway serves its first request — a gateway-only process
+# must not flip span routing mid-serving when someone first polls
+# /overhead (the ingress hop's request spans are its fused records)
+from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.runtime.resilience import (
     DEADLINE_HEADER,
     deadline_header_value,
@@ -515,6 +520,15 @@ def make_gateway_app(gateway: ApiGateway):
     async def stats(_):
         return web.json_response(gateway.stats())
 
+    async def overhead(_):
+        # the ingress hop writes fused telemetry records too (its request
+        # spans route through the per-thread ring): the gateway's
+        # /overhead page reports this process's framework-time budget
+        return web.json_response({
+            "gateway": {"deployments": gateway.store.deployments()},
+            **SPINE.overhead_document(),
+        })
+
     app.router.add_post("/oauth/token", token)
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
@@ -523,6 +537,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/ready", ready)
     app.router.add_get("/prometheus", prometheus)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/overhead", overhead)
 
     async def _cleanup(_app):
         await gateway.close()  # pooled upstream session/connector

@@ -203,9 +203,14 @@ class InProcessNodeRuntime(NodeRuntime):
         # per-node quality identity: host-mode engines and unit pods see
         # each MODEL node's own inputs/predictions, so the drift table
         # resolves to the node that drifted (the compiled lane, one fused
-        # program, keys on the graph root instead)
+        # program, keys on the graph root instead).  One telemetry-spine
+        # record per sampled batch (the unified verdict decides here);
+        # the device->host conversion and the fused summarize both run in
+        # the drainer, off the serving coroutine (utils/hotrecord.py)
         if QUALITY.enabled:
-            QUALITY.observe_batch(self.node.name, np.atleast_2d(X), y)
+            from seldon_core_tpu.utils.hotrecord import SPINE
+
+            SPINE.record_quality(self.node.name, X, y)
         return self._respond(msg, y, tags)
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:

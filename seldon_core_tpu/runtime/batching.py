@@ -32,9 +32,10 @@ import numpy as np
 
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
+from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
-from seldon_core_tpu.utils.tracing import TRACER, current_trace_context
+from seldon_core_tpu.utils.tracing import current_trace_context
 
 __all__ = ["MicroBatcher", "graph_is_batchable"]
 
@@ -146,7 +147,19 @@ class MicroBatcher:
             while self._buckets.get(key):
                 await self._sem.acquire()
                 if self.coalesce_s > 0:
-                    await asyncio.sleep(self.coalesce_s)
+                    # the coalesce timer exists to merge a BURST: skip it
+                    # when the device is idle and exactly one request is
+                    # waiting (a lone request at light load would pay the
+                    # full window as pure added latency — half the old
+                    # span_framework_p50_ms).  One zero-sleep yield still
+                    # lets same-tick submitters land in the stack; under
+                    # load (any dispatch in flight, or >1 queued request)
+                    # the timed window behaves exactly as before.
+                    waiting = self._buckets.get(key)
+                    if self._inflight or (waiting and len(waiting) > 1):
+                        await asyncio.sleep(self.coalesce_s)
+                    else:
+                        await asyncio.sleep(0)
                 bucket = self._buckets.get(key)
                 take, rows = [], 0
                 while bucket and rows < self.max_batch:
@@ -182,34 +195,30 @@ class MicroBatcher:
         now = time.perf_counter()
         now_epoch = time.time()
         for x, _, t_enq, ctx in bucket:
-            wait_s = now - t_enq
-            self.recorder.observe_queue_wait(wait_s)
-            if TRACER.enabled and ctx is not None:
-                # per-caller queue-wait span, parented under the caller's
-                # request span — the "queue" phase of the critical path
-                TRACER.record_span(
-                    "batch_queue", kind="queue", method="wait",
-                    start_s=now_epoch - wait_s,
-                    duration_ms=wait_s * 1e3,
-                    ctx=ctx, rows=len(x),
-                )
+            # ONE fused ring record per caller: the queue-wait reservoir
+            # observation AND the per-caller queue span (parented under
+            # the caller's request span — the "queue" phase of the
+            # critical path) fold off-path from the same write
+            SPINE.record_queue(
+                now - t_enq, ctx=ctx, rows=len(x),
+                start_s=now_epoch - (now - t_enq),
+            )
         try:
             stacked = np.concatenate(xs, axis=0)
             total = len(stacked)
-            # occupancy = real client rows per dispatch (pre-padding: the
-            # pad rows are compiler fodder, not served traffic)
-            self.recorder.observe_batch(total)
             t_flush = time.perf_counter()
-            ys, aux = await self._dispatch_chunked(stacked)
-            if TRACER.enabled:
-                # one flush span per stacked dispatch; multi-request, so it
-                # stands alone (the per-request dependency is the queue
-                # span above + the engine's dispatch span)
-                TRACER.record_span(
-                    "flush", kind="batch", method="dispatch",
-                    start_s=now_epoch,
-                    duration_ms=(time.perf_counter() - t_flush) * 1e3,
-                    rows=total, requests=len(bucket),
+            try:
+                ys, aux = await self._dispatch_chunked(stacked)
+            finally:
+                # one fused record per stacked flush: batch occupancy
+                # (real client rows, pre-padding — pad rows are compiler
+                # fodder, not served traffic) + the standalone flush
+                # span.  In a finally so FAILED dispatches still count —
+                # occupancy must not diverge from real traffic exactly
+                # during the incidents operators read it for
+                SPINE.record_flush(
+                    rows=total, requests=len(bucket), start_s=now_epoch,
+                    duration_s=time.perf_counter() - t_flush,
                 )
             ys = np.asarray(ys)[:total]
             # one walk decides whether aux carries per-row arrays at all;

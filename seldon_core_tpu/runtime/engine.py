@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Dict, Optional
 
@@ -48,6 +49,7 @@ from seldon_core_tpu.runtime.resilience import (
     RetryBudget,
     remaining_s,
 )
+from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.quality import QUALITY, router_quality
@@ -112,6 +114,15 @@ class EngineService:
         self._graph_path = "/".join(
             n.name for n in self.predictor.graph.walk()
         )
+        # /stats assembly cache (see stats()): the four observatory walks
+        # are rebuilt only when the folded state actually moved
+        self._stats_cache = None
+        try:
+            self._stats_ttl_s = float(
+                os.environ.get("SELDON_TPU_STATS_TTL_S", "") or 1.0
+            )
+        except ValueError:
+            self._stats_ttl_s = 1.0
         # quality observatory identity: the compiled lane dispatches the
         # WHOLE graph as one program, so its drift windows key on the
         # graph root (host mode / unit pods record per node instead)
@@ -283,9 +294,42 @@ class EngineService:
     def stats(self) -> dict:
         """Zero-dependency JSON snapshot behind ``GET /stats`` — batcher
         occupancy/bucket state, in-flight dispatch slots, rolling latency
-        percentiles, generation SLO telemetry, tracer and audit status."""
+        percentiles, generation SLO telemetry, tracer and audit status.
+
+        The four observatory walks (telemetry / perf / quality / tracer)
+        are served from a cached assembly built off the drainer's folded
+        state: after draining pending records, the cache is reused while
+        nothing underneath it moved (spine fold generation + recorder
+        mutation generation unchanged) and it is younger than
+        ``SELDON_TPU_STATS_TTL_S``.  ``staleness_s`` reports the cache
+        age so scrapers can see exactly how fresh the walks are.  The
+        live engine/batcher/breaker blocks are always current — they are
+        cheap and must never lag a pause or a breaker flip."""
         from seldon_core_tpu.utils.tracing import TRACER
 
+        SPINE.drain()
+        now = time.monotonic()
+        key = (
+            SPINE.fold_generation, RECORDER._gen,
+            TRACER.enabled, TRACER.sample,
+            OBSERVATORY.enabled, QUALITY.enabled,
+        )
+        cached = self._stats_cache
+        if (
+            cached is not None
+            and cached[0] == key
+            and now - cached[1] < self._stats_ttl_s
+        ):
+            walks, staleness = cached[2], now - cached[1]
+        else:
+            walks = {
+                "telemetry": RECORDER.snapshot(),
+                "perf": OBSERVATORY.snapshot(),
+                "quality": QUALITY.snapshot(),
+                "tracer": TRACER.snapshot(),
+            }
+            self._stats_cache = (key, now, walks)
+            staleness = 0.0
         return {
             "engine": {
                 "deployment": self.deployment.name,
@@ -305,14 +349,26 @@ class EngineService:
                     name: br.snapshot() for name, br in self.breakers.items()
                 },
             },
-            "telemetry": RECORDER.snapshot(),
-            "perf": OBSERVATORY.snapshot(),
-            "quality": QUALITY.snapshot(),
+            **walks,
             # MAB router state read back out of the pytree (per-branch
             # success/tries — utils/quality.py router_quality)
             "routers": router_quality(self.states()),
-            "tracer": TRACER.snapshot(),
             "audit": self.audit.snapshot(),
+            "staleness_s": round(staleness, 3),
+        }
+
+    def overhead_document(self) -> dict:
+        """The ``GET /overhead`` body: the telemetry overhead budget as a
+        self-observed SLO — per-subsystem framework-time decomposition
+        derived from the fused hop records themselves
+        (utils/hotrecord.py; docs/operations.md runbook)."""
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            **SPINE.overhead_document(),
         }
 
     def perf_document(self) -> dict:
@@ -580,75 +636,91 @@ class EngineService:
 
     def _batched_predict_sync(self, stacked, deadline=None, real_rows=None):
         # runs on an executor thread: no request context here by design —
-        # a stacked dispatch serves many requests, so the span stands
-        # alone (per-request causality is the queue-wait span)
-        cc_before = dict(RECORDER.compile_cache_events)
+        # a stacked dispatch serves many requests, so its span stands
+        # alone (per-request causality is the queue-wait span).
+        #
+        # Observability is ONE fused telemetry record per dispatch hop
+        # (utils/hotrecord.py): the unified per-batch sample verdict is
+        # decided once, the record carries span identity + measured wall +
+        # executable key + references to the stacked batch and its
+        # readback, and the TRACER/OBSERVATORY/QUALITY folds — span
+        # append, MFU/roofline derivation, the one fused drift summarize —
+        # all happen in the drainer, off this path.
+        wants = SPINE.dispatch_wants()
+        cc_before = (
+            dict(RECORDER.compile_cache_events) if wants.trace else None
+        )
         t_dispatch = time.perf_counter()
-        with self.tracer.span(
-            "", "dispatch", kind="dispatch", method="predict", rows=len(stacked)
-        ) as sp:
-            width = stacked.shape[1:]
-            # state write-back is vetoed AFTER the device round-trip if the
-            # request already timed out (client saw 504; a late update
-            # would double-apply on retry) — evaluated post-dispatch via
-            # the callable form of update_states
-            gate = (
-                (lambda: time.monotonic() < deadline)
-                if (not self._pipelined and deadline is not None)
-                else (not self._pipelined)
+        start_s = time.time()
+        width = stacked.shape[1:]
+        # state write-back is vetoed AFTER the device round-trip if the
+        # request already timed out (client saw 504; a late update
+        # would double-apply on retry) — evaluated post-dispatch via
+        # the callable form of update_states
+        gate = (
+            (lambda: time.monotonic() < deadline)
+            if (not self._pipelined and deadline is not None)
+            else (not self._pipelined)
+        )
+        try:
+            y, routing, tags = self.compiled.predict_arrays(
+                stacked, update_states=gate
             )
-            try:
-                y, routing, tags = self.compiled.predict_arrays(
-                    stacked, update_states=gate
+        except BaseException as e:
+            if wants.trace:
+                SPINE.record_failed_dispatch(
+                    executable=self.compiled.executable_key(stacked),
+                    seconds=time.perf_counter() - t_dispatch,
+                    start_s=start_s, rows=len(stacked),
+                    method="predict", error=type(e).__name__,
                 )
-            except (TypeError, ValueError) as e:
+            if isinstance(e, (TypeError, ValueError)):
                 if width in self._known_good_widths:
-                    # this feature width has served before: the failure is a
-                    # server-side defect, not bad client input — surface it
+                    # this feature width has served before: the failure
+                    # is a server-side defect, not bad client input —
+                    # surface it
                     raise
                 # never-seen width failing at trace time = wrong feature
                 # width from the client: typed 400
                 raise SeldonMessageError(
                     f"graph rejected input of shape {stacked.shape}: {e}"
                 ) from e
-            self._known_good_widths.add(width)
-            # the readback belongs inside the span: jax dispatch is async,
-            # so the device+relay round-trip is only paid here
-            y = np.asarray(y)
-            # performance observatory: measured wall (enqueue + device +
-            # relay + readback) against this executable's static cost
-            # features -> achieved MFU / roofline bound stamped onto the
-            # span; the sampled dispatch-trace id rides the latency
-            # histogram as an OpenMetrics exemplar
-            if OBSERVATORY.enabled:
-                OBSERVATORY.observe_and_stamp(
-                    self.compiled.executable_key(stacked),
-                    time.perf_counter() - t_dispatch,
-                    rows=len(stacked), span=sp,
-                )
-            # quality observatory: the same stacked batch + its readback
-            # feed the drift windows (one fused summarize kernel per
-            # sampled batch; real_rows masks the batcher's pad rows out of
-            # every statistic) and the outlier-score bridge; the current
-            # drift score rides the dispatch span like MFU does
-            if QUALITY.enabled:
-                n_real = real_rows if real_rows is not None else len(stacked)
-                QUALITY.record_outlier_tags(tags, real_rows=n_real)
-                drift = QUALITY.observe_batch(
-                    self._quality_node, stacked, y, real_rows=n_real
-                )
-                if drift is not None and isinstance(sp, dict):
-                    sp["drift"] = round(drift, 4)
-            if isinstance(sp, dict):
+            raise
+        self._known_good_widths.add(width)
+        # the readback is the serving path's own need (jax dispatch is
+        # async; the device+relay round-trip is paid here) — and the ONLY
+        # array touch observability requires: the record holds references,
+        # the summarize runs in the drainer
+        y = np.asarray(y)
+        seconds = time.perf_counter() - t_dispatch
+        n_real = real_rows if real_rows is not None else len(stacked)
+        # outlier-score bridge stays inline: a dict-key check when absent,
+        # and the scores are per-response tags the caller slices anyway
+        if QUALITY.enabled and tags:
+            QUALITY.record_outlier_tags(tags, real_rows=n_real)
+        if wants.any:
+            cc = None
+            if cc_before is not None:
                 # compile-cache traffic during this dispatch (fresh shape
                 # -> XLA compile): visible per-span, not just as counters
                 for outcome in ("miss", "hit"):
-                    delta = RECORDER.compile_cache_events.get(
+                    if RECORDER.compile_cache_events.get(
                         outcome, 0
-                    ) - cc_before.get(outcome, 0)
-                    if delta > 0:
-                        sp["compile_cache"] = outcome
+                    ) > cc_before.get(outcome, 0):
+                        cc = outcome
                         break
+            SPINE.record_dispatch(
+                wants,
+                executable=self.compiled.executable_key(stacked),
+                seconds=seconds, start_s=start_s,
+                rows=len(stacked), real_rows=n_real, method="predict",
+                quality_node=self._quality_node, X=stacked, Y=y,
+                deadline_remaining_s=(
+                    deadline - time.monotonic()
+                    if deadline is not None else None
+                ),
+                compile_cache=cc,
+            )
         return y, (routing, tags)
 
     # ------------------------------------------------------------------

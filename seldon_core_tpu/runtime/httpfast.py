@@ -22,6 +22,8 @@ Semantics match ``rest.py:make_engine_app`` route for route:
   GET  /perf                   performance observatory (utils/perf.py)
   GET  /quality                prediction-quality observatory
                                (utils/quality.py)
+  GET  /overhead               telemetry overhead budget
+                               (utils/hotrecord.py)
   GET  /trace /trace/export
 
 ``GET /prometheus?format=openmetrics`` serves the OpenMetrics exposition
@@ -131,6 +133,7 @@ class _EngineRoutes:
             b"/stats": self._stats,
             b"/perf": self._perf,
             b"/quality": self._quality,
+            b"/overhead": self._overhead,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
@@ -233,6 +236,15 @@ class _EngineRoutes:
         import json as _json
 
         return 200, _json.dumps(self.engine.quality_document()).encode(), _JSON
+
+    async def _overhead(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return (
+            200,
+            _json.dumps(self.engine.overhead_document()).encode(),
+            _JSON,
+        )
 
     async def _quality_reference(self, body, ctype, query) -> Result:
         import json as _json

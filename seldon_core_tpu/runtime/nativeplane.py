@@ -38,8 +38,8 @@ from typing import Optional
 
 import numpy as np
 
+from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
-from seldon_core_tpu.utils.quality import QUALITY
 
 __all__ = ["NativeDataPlane", "native_plane_available"]
 
@@ -314,8 +314,8 @@ class NativeDataPlane:
             try:
                 # spans (when tracing is enabled): "plane_batch" covers
                 # the Python side of one native batch — pad, device
-                # dispatch, output marshalling — and the nested
-                # "dispatch" isolates the device round-trip, so a served
+                # dispatch, output marshalling — and the fused dispatch
+                # record isolates the device round-trip, so a served
                 # request decomposes into C++ parse/queue (total minus
                 # plane) + framework (plane minus dispatch) + device+relay
                 with engine.tracer.span(
@@ -325,36 +325,54 @@ class NativeDataPlane:
                     # pad rows burn device FLOPs without serving traffic —
                     # same accounting as the Python batcher's lane
                     OBSERVATORY.note_padding(rows, len(padded))
+                    # ONE fused telemetry record per dispatch hop (engine
+                    # lane parity, utils/hotrecord.py): the unified
+                    # verdict rides the plane span's head decision, and
+                    # the perf/quality/span folds all happen off-path
+                    wants = SPINE.dispatch_wants()
                     t_dispatch = time.perf_counter()
-                    with engine.tracer.span(
-                        "", "dispatch", kind="dispatch", method="native",
-                        rows=rows,
-                    ) as sp:
+                    start_s = time.time()
+                    try:
                         y, routing, tags = engine.compiled.predict_arrays(
                             padded, update_states=False
                         )
-                        # force the readback inside the span (jax dispatch
-                        # is async — device+relay time is only paid here)
-                        # and feed the perf observatory the same measured
-                        # wall the engine lane records
-                        y = np.asarray(y)
-                        if OBSERVATORY.enabled:
-                            OBSERVATORY.observe_and_stamp(
-                                engine.compiled.executable_key(padded),
-                                time.perf_counter() - t_dispatch,
-                                rows=rows, span=sp,
+                    except BaseException as e:
+                        # failed dispatches keep their span too (engine
+                        # lane parity): the incident trace must show the
+                        # device hop that died
+                        if wants.trace:
+                            SPINE.record_failed_dispatch(
+                                executable=engine.compiled.executable_key(
+                                    padded
+                                ),
+                                seconds=time.perf_counter() - t_dispatch,
+                                start_s=start_s, rows=rows,
+                                method="native", error=type(e).__name__,
                             )
-                        # quality observatory: the native lane feeds the
-                        # same drift windows the Python lane does — one
-                        # fused summarize over the padded stack, pad rows
-                        # masked out via real_rows (engine lane parity)
-                        if QUALITY.enabled:
-                            drift = QUALITY.observe_batch(
-                                engine._quality_node, padded, y,
-                                real_rows=rows,
-                            )
-                            if drift is not None and isinstance(sp, dict):
-                                sp["drift"] = round(drift, 4)
+                        raise
+                    # force the readback here (jax dispatch is async —
+                    # device+relay time is only paid at the readback);
+                    # it is also the only array touch observability needs
+                    y = np.asarray(y)
+                    if wants.any:
+                        # `padded is x` means it is a VIEW into the C++
+                        # plane's request buffer, which is recycled the
+                        # moment the batch completes — a deferred quality
+                        # fold must hold its own copy
+                        xq = None
+                        if wants.quality:
+                            xq = np.array(x) if padded is x else padded
+                        SPINE.record_dispatch(
+                            wants,
+                            executable=engine.compiled.executable_key(
+                                padded
+                            ),
+                            seconds=time.perf_counter() - t_dispatch,
+                            start_s=start_s,
+                            rows=rows, real_rows=rows, method="native",
+                            quality_node=engine._quality_node,
+                            X=xq, Y=y,
+                        )
                     if routing or tags:
                         # data-dependent tags slipped past the static
                         # checks: the C++ composer cannot merge them into

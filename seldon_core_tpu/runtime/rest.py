@@ -200,6 +200,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # (utils/quality.py; docs/operations.md runbook)
         return web.json_response(engine.quality_document())
 
+    async def overhead(_):
+        # telemetry overhead budget: per-subsystem framework-time
+        # decomposition from the fused hop records (utils/hotrecord.py;
+        # docs/operations.md "telemetry overhead budget" runbook)
+        return web.json_response(engine.overhead_document())
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
@@ -283,6 +289,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/stats", stats)
     app.router.add_get("/perf", perf)
     app.router.add_get("/quality", quality)
+    app.router.add_get("/overhead", overhead)
     app.router.add_post("/quality/reference", _quality_reference)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)
@@ -419,10 +426,21 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
             **QUALITY.document(),
         })
 
+    async def overhead(_):
+        # unit pods carry the process-global telemetry spine too
+        from seldon_core_tpu.utils.hotrecord import SPINE
+
+        return web.json_response({
+            "unit": {"name": runtime.node.name,
+                     "type": getattr(runtime.node.type, "name", None)},
+            **SPINE.overhead_document(),
+        })
+
     app.router.add_get("/ping", ping)
     app.router.add_get("/stats", stats)
     app.router.add_get("/perf", perf)
     app.router.add_get("/quality", quality)
+    app.router.add_get("/overhead", overhead)
     app.router.add_post("/quality/reference", _quality_reference)
     return app
 

@@ -188,6 +188,14 @@ class PerfObservatory:
         self._peaks: Optional[Dict[str, Any]] = None
         self._hbm_last_poll = 0.0
         self._hbm_last: List[Dict[str, Any]] = []
+        #: telemetry-spine wiring (utils/hotrecord.py), set on the global
+        #: OBSERVATORY only: dispatch observations arrive via the fused
+        #: per-hop record, so query surfaces fold pending records first
+        self.drain_hook = None
+
+    def _drain(self) -> None:
+        if self.drain_hook is not None:
+            self.drain_hook()
 
     # -- device peaks ------------------------------------------------------
 
@@ -355,29 +363,6 @@ class PerfObservatory:
             ent.last = dict(derived)
         return derived
 
-    def observe_and_stamp(
-        self, key: str, seconds: float, rows: int, span: Any
-    ) -> Dict[str, Any]:
-        """The dispatch-site contract, shared by the engine's batched
-        lane and the native plane's dispatch loop: observe the measured
-        wall (exemplared with the active sampled trace id) and stamp
-        flops/mfu/bound onto the open dispatch-span handle so /trace
-        critical paths show hardware efficiency inline."""
-        from seldon_core_tpu.utils.tracing import current_trace_context
-
-        ctx = current_trace_context()
-        derived = self.observe_dispatch(
-            key, seconds, rows=rows,
-            trace_id=(
-                ctx.trace_id if ctx is not None and ctx.sampled else None
-            ),
-        )
-        if derived and isinstance(span, dict):
-            for k in ("flops", "mfu", "bound"):
-                if k in derived:
-                    span[k] = derived[k]
-        return derived
-
     def note_padding(self, real_rows: int, padded_rows: int) -> None:
         """Micro-batcher padding accounting: pad rows burn FLOPs without
         serving traffic (runtime/batching.py reports each padded chunk)."""
@@ -480,6 +465,7 @@ class PerfObservatory:
         table (calls, latency percentiles, MFU, arithmetic intensity,
         predicted-vs-measured, compile time), batching pad overhead, and
         HBM watermarks."""
+        self._drain()
         with self._lock:
             entries = list(self._execs.values())
             real, pad = self.real_rows_total, self.pad_rows_total
@@ -506,6 +492,7 @@ class PerfObservatory:
     def snapshot(self) -> Dict[str, Any]:
         """Compact health block for ``/stats`` — the full table lives on
         ``/perf``."""
+        self._drain()
         with self._lock:
             n = len(self._execs)
             calls = sum(e.calls for e in self._execs.values())
@@ -519,6 +506,7 @@ class PerfObservatory:
 
     def reset(self) -> None:
         """Fresh state — tests only."""
+        self._drain()  # pending records fold into the pre-reset state
         with self._lock:
             self._execs = {}
             self.real_rows_total = 0

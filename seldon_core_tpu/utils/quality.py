@@ -202,11 +202,19 @@ class _NodeQuality:
     """Reference + rolling live window for one graph node."""
 
     def __init__(self, node: str, n_bins: int, ref_target: int,
-                 live_window: int):
+                 live_window: int, score_interval_s: float = 0.25):
         self.node = node
         self.n_bins = int(n_bins)
         self.ref_target = int(ref_target)
         self.live_window = int(live_window)  # live batches retained
+        #: PSI/KS rescore throttle: scoring walks (F, B) count arrays and
+        #: publishes six gauges — per-BATCH that dominated the fold cost,
+        #: while the scores are only read at human timescales.  The first
+        #: live batch always scores (alerts must not wait), then at most
+        #: once per interval; every read surface (document/quality page)
+        #: forces a fresh score.
+        self.score_interval_s = float(score_interval_s)
+        self._scored_at = 0.0
         self.lock = threading.Lock()
         #: bumped on every clear/freeze — an in-flight observation that
         #: summarized against superseded thresholds must not land in the
@@ -314,6 +322,16 @@ class _NodeQuality:
             self.live_y_counts -= oyc
             self.live_rows -= orows
 
+    def _maybe_score(self) -> Dict[str, float]:
+        """Throttled rescore for the per-batch fold path: {} when the
+        current scores are still fresh (callers then reuse
+        ``last_scores``)."""
+        now = time.monotonic()
+        if self.last_scores and now - self._scored_at < self.score_interval_s:
+            return {}
+        self._scored_at = now
+        return self._score()
+
     def _score(self) -> Dict[str, float]:
         if not self.frozen or self.live_rows <= 0:
             return {}
@@ -345,6 +363,11 @@ class _NodeQuality:
         return out
 
     def document_row(self, top_k: int = 16) -> Dict[str, Any]:
+        if self.frozen and self.live_rows > 0:
+            # read surfaces always serve a fresh score, whatever the
+            # per-batch throttle last left behind
+            self._scored_at = time.monotonic()
+            self._score()
         row: Dict[str, Any] = {
             "node": self.node,
             "status": "live" if self.frozen else "collecting_reference",
@@ -621,6 +644,12 @@ class QualityObservatory:
             else _env_float("SELDON_TPU_OUTLIER_THRESHOLD")
         )
         self.use_numpy = bool(use_numpy)
+        interval_ms = _env_float("SELDON_TPU_QUALITY_SCORE_MS")
+        self.score_interval_s = (
+            0.25 if interval_ms is None else max(interval_ms, 0.0) / 1e3
+        )
+        jit_min = _env_float("SELDON_TPU_QUALITY_JIT_MIN_ROWS")
+        self.jit_min_rows = 32 if jit_min is None else int(jit_min)
         self._lock = threading.Lock()
         self._nodes: Dict[str, _NodeQuality] = {}
         self._feedback: Dict[str, _FeedbackStats] = {}
@@ -638,6 +667,15 @@ class QualityObservatory:
         self.outlier_total = 0
         self.outlier_exceeded = 0
         self.errors = 0
+        #: telemetry-spine wiring (utils/hotrecord.py), set on the global
+        #: QUALITY only: query/control surfaces fold pending dispatch
+        #: records before reading, so deferred (off-path) quality folds
+        #: are always current by the time anyone looks
+        self.drain_hook = None
+
+    def _drain(self) -> None:
+        if self.drain_hook is not None:
+            self.drain_hook()
 
     def _bump_errors(self) -> None:
         with self._lock:
@@ -654,7 +692,9 @@ class QualityObservatory:
                     if len(self._nodes) >= self.MAX_NODES:
                         return None
                     ent = self._nodes[name] = _NodeQuality(
-                        name, self.n_bins, self.ref_target, self.live_window
+                        name, self.n_bins, self.ref_target,
+                        self.live_window,
+                        score_interval_s=self.score_interval_s,
                     )
         return ent
 
@@ -676,6 +716,23 @@ class QualityObservatory:
         except Exception:  # noqa: BLE001 - never raise into dispatch
             self._bump_errors()
             logger.debug("quality observe failed", exc_info=True)
+            return None
+
+    def fold_batch(self, node: str, X, Y,
+                   real_rows: Optional[int] = None) -> Optional[float]:
+        """Pre-sampled observe — the telemetry-spine drainer's entry
+        point (utils/hotrecord.py).  The unified per-batch sample verdict
+        was already decided at the dispatch site and carried in the
+        record, so no second coin flip happens here; everything else is
+        identical to :meth:`observe_batch`.  Off the hot path by
+        construction: the fused summarize runs on the drainer thread."""
+        if not self.enabled:
+            return None
+        try:
+            return self._observe(node, X, Y, real_rows)
+        except Exception:  # noqa: BLE001 - never raise into the drainer
+            self._bump_errors()
+            logger.debug("quality fold failed", exc_info=True)
             return None
 
     def _observe(self, node: str, X, Y,
@@ -715,7 +772,15 @@ class QualityObservatory:
             with ent.lock:
                 ent.width_mismatches += 1
             return None
-        fn = None if self.use_numpy else _get_jit_summarizer()
+        # the fused summarize now runs off-path on HOST arrays (the
+        # telemetry-spine drainer hands over the batch readback): below
+        # jit_min_rows the jax call overhead dwarfs the kernel, so the
+        # numpy twin — identical outputs by construction — serves small
+        # batches and the jitted kernel serves real stacks
+        small = len(Xa) < self.jit_min_rows
+        fn = (
+            None if (self.use_numpy or small) else _get_jit_summarizer()
+        )
         # the batch axis pads to a power of two before the jitted
         # summarize — callers with arbitrary batch sizes (unit pods,
         # host mode) must not retrace per row count; the row mask (n)
@@ -756,15 +821,19 @@ class QualityObservatory:
                 # must not enter the new window
                 return None
             ent._push_block(x_counts, x_sum, x_sumsq, y_counts, n)
-            scores = ent._score()
-            pq = ent.prediction_quantiles()
+            # throttled: scoring + gauge publication happen on the first
+            # live batch and then at most once per score interval — the
+            # per-batch fold cost is the summarize + an O(F*B) window add
+            scores = ent._maybe_score()
+            pq = ent.prediction_quantiles() if scores else {}
+            drift = ent.last_scores.get("psi_max")
         if scores:
             RECORDER.set_drift(node, "psi", scores["psi_max"])
             RECORDER.set_drift(node, "ks", scores["ks_max"])
             RECORDER.set_drift(node, "prediction", scores["prediction_psi"])
         for q, v in pq.items():
             RECORDER.set_prediction_quantile(node, q, v)
-        return scores.get("psi_max")
+        return drift
 
     def _warm_summarizer(self, fn, key, ent: _NodeQuality) -> None:
         """Compile the summarizer for one (batch, widths, bins) shape on
@@ -807,6 +876,7 @@ class QualityObservatory:
         MODEL node, not under the graph root this is usually called
         with), fall back to the worst live node in the process so the
         audit trail still shows drift."""
+        self._drain()
         ent = self._nodes.get(node)
         v = ent.last_scores.get("psi_max") if ent is not None else None
         if v is None:
@@ -829,6 +899,9 @@ class QualityObservatory:
         if action not in ("freeze", "reset"):
             raise ValueError(f"unknown reference action {action!r} "
                              f"(expected freeze|reset)")
+        # fold pending dispatch records first: rows already served must
+        # land in the window this control call is about to freeze/reset
+        self._drain()
         done: Dict[str, str] = {}
         with self._lock:
             if node:
@@ -945,14 +1018,35 @@ class QualityObservatory:
         self.slo.record(latency_s, error=error, now=now)
 
     def refresh_gauges(self) -> None:
-        """Recompute the seldon_tpu_slo_burn_rate gauges — called from
-        the Prometheus exposition path so a scrape-only deployment sees
-        live burn rates without anyone polling /quality."""
+        """Recompute the seldon_tpu_slo_burn_rate and drift gauges —
+        called from the Prometheus exposition path so a scrape-only
+        deployment sees live scores.  Drift is force-rescored here (same
+        rule as the /quality page): batches folded inside the last
+        throttle window before a traffic pause would otherwise never
+        reach the gauges, leaving SeldonTPUDriftDetected reading a
+        pre-shift score while /quality shows the drifted one."""
         if not self.enabled:
             return
         try:
             for window, entry in self.slo.burn_rates().items():
                 RECORDER.set_slo_burn(window, entry["burn_rate"])
+            with self._lock:
+                nodes = list(self._nodes.values())
+            for ent in nodes:
+                with ent.lock:
+                    if not ent.frozen or ent.live_rows <= 0:
+                        continue
+                    ent._scored_at = time.monotonic()
+                    scores = ent._score()
+                    pq = ent.prediction_quantiles()
+                if scores:
+                    RECORDER.set_drift(ent.node, "psi", scores["psi_max"])
+                    RECORDER.set_drift(ent.node, "ks", scores["ks_max"])
+                    RECORDER.set_drift(
+                        ent.node, "prediction", scores["prediction_psi"]
+                    )
+                for q, v in pq.items():
+                    RECORDER.set_prediction_quantile(ent.node, q, v)
         except Exception:  # noqa: BLE001 - scrape must never fail here
             self._bump_errors()
 
@@ -971,6 +1065,7 @@ class QualityObservatory:
     def document(self) -> Dict[str, Any]:
         """The ``GET /quality`` body: per-node drift table, feedback
         reward/accuracy trends, outlier bridge, SLO burn rates."""
+        self._drain()
         self.refresh_gauges()
         with self._lock:
             nodes = list(self._nodes.values())
@@ -993,6 +1088,7 @@ class QualityObservatory:
     def snapshot(self) -> Dict[str, Any]:
         """Compact health block for ``/stats`` — the full table lives on
         ``/quality``."""
+        self._drain()
         with self._lock:
             nodes = {
                 name: {
@@ -1018,6 +1114,7 @@ class QualityObservatory:
 
     def reset(self) -> None:
         """Fresh state — tests only (config survives)."""
+        self._drain()  # pending records fold into the pre-reset state
         with self._lock:
             self._nodes = {}
             self._feedback = {}

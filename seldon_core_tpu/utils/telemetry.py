@@ -109,6 +109,11 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_outlier_exceedances_total": ("counter", ()),
     "seldon_tpu_slo_burn_rate": ("gauge", ("window",)),
     "seldon_tpu_quality_sampled_total": ("counter", ("node",)),
+    # fused telemetry spine (utils/hotrecord.py): hot-path ring health and
+    # the self-observed per-subsystem overhead budget behind GET /overhead
+    "seldon_tpu_telemetry_ring_dropped_total": ("counter", ()),
+    "seldon_tpu_telemetry_records_total": ("counter", ("hop",)),
+    "seldon_tpu_framework_overhead_ms": ("gauge", ("subsystem",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -240,6 +245,30 @@ class FlightRecorder:
         self.outlier_exceeded = 0
         self.slo_burn: Dict[str, float] = {}           # window -> rate
         self.quality_sampled: Dict[str, int] = {}      # node -> batches
+        # telemetry-spine mirrors (utils/hotrecord.py feeds these from the
+        # drainer: ring drops, folded records per hop, per-subsystem
+        # framework-overhead p50s behind GET /overhead)
+        self.telemetry_ring_dropped = 0
+        self.telemetry_records: Dict[str, int] = {}    # hop -> folded
+        # Prometheus high-water mark per hop: the counter is advanced by
+        # deltas against THIS, not the snapshot mirror above — reset()
+        # clears the mirror but must not rewind the monotone counter's
+        # baseline (it would re-add the whole lifetime total on next fold)
+        self._telemetry_records_published: Dict[str, int] = {}
+        self.framework_overhead: Dict[str, float] = {}  # subsystem -> ms
+        #: set on the process singleton by utils/hotrecord.py — snapshots
+        #: and expositions fold pending ring records before reading
+        self.drain_hook = None
+        #: mutation generation — bumped by state-ish recording methods
+        #: (breakers, drift, kv, hbm, feedback, spine mirrors...) so
+        #: Engine.stats() can serve its cached document while nothing
+        #: underneath it moved.  Pure per-request reservoir observes
+        #: (latency, occupancy, ttft...) deliberately do NOT bump it:
+        #: under traffic the telemetry-spine fold generation invalidates
+        #: the cache anyway, and the kill-switched case is bounded by
+        #: SELDON_TPU_STATS_TTL_S — bumping here would defeat the cache
+        #: under exactly the load it exists for
+        self._gen = 0
         self.registry = None
         if HAVE_PROMETHEUS:
             self.registry = CollectorRegistry()
@@ -394,6 +423,24 @@ class FlightRecorder:
                 "Dispatch batches sampled into the quality observatory "
                 "(SELDON_TPU_QUALITY_SAMPLE gates the rate)",
                 ["node"], registry=self.registry)
+            self._p_ring_dropped = Counter(
+                "seldon_tpu_telemetry_ring_dropped_total",
+                "Hot-path telemetry records dropped because a per-thread "
+                "ring was full (utils/hotrecord.py — raise "
+                "SELDON_TPU_TELEMETRY_RING or lower the drain interval)",
+                registry=self.registry)
+            self._p_telemetry_records = Counter(
+                "seldon_tpu_telemetry_records_total",
+                "Telemetry-spine records folded off-path, by hop kind",
+                ["hop"], registry=self.registry)
+            self._p_framework_overhead = Gauge(
+                "seldon_tpu_framework_overhead_ms",
+                "Self-observed framework overhead, milliseconds p50: "
+                "per-record off-path fold cost by consumer subsystem "
+                "(tracer/perf/quality/recorder), the on-path ring write "
+                "(ring), and the per-request framework estimate (total) "
+                "judged against SELDON_TPU_OVERHEAD_BUDGET_MS",
+                ["subsystem"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -436,6 +483,7 @@ class FlightRecorder:
         """e.g. set_kv_slots(active=1040, reserved=256) — slot counts of
         the most recent generation dispatch (a point-in-time gauge, not an
         aggregate: TPU HBM pressure is about the current resident cache)."""
+        self._gen += 1
         with self._lock:
             self.kv_slots.update({k: int(v) for k, v in states.items()})
         if self.registry is not None:
@@ -445,6 +493,7 @@ class FlightRecorder:
     # -- compile cache / audit accounting -------------------------------
 
     def record_compile_cache(self, outcome: str, n: int = 1) -> None:
+        self._gen += 1
         with self._lock:
             self.compile_cache_events[outcome] = (
                 self.compile_cache_events.get(outcome, 0) + n)
@@ -458,12 +507,14 @@ class FlightRecorder:
     # -- resilience layer (runtime/resilience.py) ------------------------
 
     def set_breaker_state(self, node: str, state: str, gauge: float) -> None:
+        self._gen += 1
         with self._lock:
             self.breaker_states[node] = state
         if self.registry is not None:
             self._p_breaker_state.labels(node=node).set(gauge)
 
     def record_breaker_transition(self, node: str, to: str) -> None:
+        self._gen += 1
         key = f"{node}:{to}"
         with self._lock:
             self.breaker_transitions[key] = self.breaker_transitions.get(key, 0) + 1
@@ -473,6 +524,7 @@ class FlightRecorder:
     def record_retry(self, method: str, outcome: str) -> None:
         """outcome: 'retry' (another attempt is being made) or 'exhausted'
         (attempts/budget ran out and the failure surfaced)."""
+        self._gen += 1
         key = f"{method}:{outcome}"
         with self._lock:
             self.retry_attempts[key] = self.retry_attempts.get(key, 0) + 1
@@ -480,18 +532,21 @@ class FlightRecorder:
             self._p_retry.labels(method=method, outcome=outcome).inc()
 
     def record_retry_budget_exhausted(self) -> None:
+        self._gen += 1
         with self._lock:
             self.retry_budget_exhausted += 1
         if self.registry is not None:
             self._p_retry_budget.inc()
 
     def record_deadline_exceeded(self, where: str) -> None:
+        self._gen += 1
         with self._lock:
             self.deadline_exceeded[where] = self.deadline_exceeded.get(where, 0) + 1
         if self.registry is not None:
             self._p_deadline.labels(where=where).inc()
 
     def record_trace_span(self, kind: str) -> None:
+        self._gen += 1
         with self._lock:
             self.trace_spans[kind] = self.trace_spans.get(kind, 0) + 1
         if self.registry is not None:
@@ -500,6 +555,7 @@ class FlightRecorder:
     def record_degraded(self, mode: str) -> None:
         """mode: 'quorum' (combiner served a subset) or 'fallback' (router
         served the fallback branch)."""
+        self._gen += 1
         with self._lock:
             self.degraded_requests[mode] = self.degraded_requests.get(mode, 0) + 1
         if self.registry is not None:
@@ -527,6 +583,7 @@ class FlightRecorder:
             self._p_mfu.labels(executable=executable).set(mfu)
 
     def record_perf_anomaly(self, kind: str) -> None:
+        self._gen += 1
         with self._lock:
             self.perf_anomalies[kind] = self.perf_anomalies.get(kind, 0) + 1
         if self.registry is not None:
@@ -536,6 +593,7 @@ class FlightRecorder:
         """HBM watermark gauges for one device (bytes_in_use /
         peak_bytes_in_use / bytes_limit — utils/perf.py polls
         ``device.memory_stats()``)."""
+        self._gen += 1
         with self._lock:
             self.hbm.setdefault(device, {}).update(
                 {k: int(v) for k, v in stats.items()}
@@ -557,6 +615,7 @@ class FlightRecorder:
 
     def set_drift(self, node: str, method: str, score: float) -> None:
         """Aggregate drift score for one node (method: psi|ks|prediction)."""
+        self._gen += 1
         with self._lock:
             self.drift_scores[f"{node}:{method}"] = float(score)
         if self.registry is not None:
@@ -564,6 +623,7 @@ class FlightRecorder:
 
     def set_prediction_quantile(self, node: str, q: str,
                                 value: float) -> None:
+        self._gen += 1
         with self._lock:
             self.prediction_quantiles[f"{node}:{q}"] = float(value)
         if self.registry is not None:
@@ -573,6 +633,7 @@ class FlightRecorder:
         """Drop one node's published drift scores + prediction quantiles
         — called when its reference window is reset/refrozen, so a stale
         score can't keep an alert firing through the recollection."""
+        self._gen += 1
         with self._lock:
             for method in ("psi", "ks", "prediction"):
                 self.drift_scores.pop(f"{node}:{method}", None)
@@ -596,6 +657,7 @@ class FlightRecorder:
         """One send_feedback call: reward into the histogram, outcome
         counters (agree/disagree judged by majority row agreement when
         truth was comparable to the served prediction)."""
+        self._gen += 1
         self.feedback_reward.observe(reward)
         with self._lock:
             self.feedback_count += 1
@@ -617,6 +679,7 @@ class FlightRecorder:
                 ).inc()
 
     def record_outlier_scores(self, scores) -> None:
+        self._gen += 1
         self.outlier_scores.observe_many(scores)
         if self.registry is not None:
             # prometheus_client has no batch observe; this remaining
@@ -625,22 +688,53 @@ class FlightRecorder:
                 self._p_outlier.observe(float(v))
 
     def record_outlier_exceeded(self, n: int = 1) -> None:
+        self._gen += 1
         with self._lock:
             self.outlier_exceeded += int(n)
         if self.registry is not None:
             self._p_outlier_exceeded.inc(n)
 
     def set_slo_burn(self, window: str, rate: float) -> None:
+        self._gen += 1
         with self._lock:
             self.slo_burn[window] = float(rate)
         if self.registry is not None:
             self._p_slo_burn.labels(window=window).set(rate)
 
     def record_quality_sampled(self, node: str) -> None:
+        self._gen += 1
         with self._lock:
             self.quality_sampled[node] = self.quality_sampled.get(node, 0) + 1
         if self.registry is not None:
             self._p_quality_sampled.labels(node=node).inc()
+
+    # -- telemetry spine (utils/hotrecord.py drainer feeds these) ---------
+
+    def record_ring_dropped(self, n: int = 1) -> None:
+        self._gen += 1
+        with self._lock:
+            self.telemetry_ring_dropped += int(n)
+        if self.registry is not None:
+            self._p_ring_dropped.inc(n)
+
+    def set_telemetry_records(self, hop: str, total: int) -> None:
+        """Lifetime folded-record count per hop kind; the Prometheus
+        counter is advanced by the delta so it stays monotone."""
+        self._gen += 1
+        with self._lock:
+            self.telemetry_records[hop] = int(total)
+            prev = self._telemetry_records_published.get(hop, 0)
+            if total > prev:
+                self._telemetry_records_published[hop] = int(total)
+        if self.registry is not None and total > prev:
+            self._p_telemetry_records.labels(hop=hop).inc(total - prev)
+
+    def set_framework_overhead(self, subsystem: str, ms: float) -> None:
+        self._gen += 1
+        with self._lock:
+            self.framework_overhead[subsystem] = round(float(ms), 4)
+        if self.registry is not None:
+            self._p_framework_overhead.labels(subsystem=subsystem).set(ms)
 
     # -- request latencies (feeds /stats percentiles + the
     # -- seldon_tpu_request_latency_seconds histogram) --------------------
@@ -662,6 +756,10 @@ class FlightRecorder:
 
     def snapshot(self) -> Dict[str, Any]:
         """The zero-dependency JSON body behind ``GET /stats``."""
+        if self.drain_hook is not None:
+            # fold pending telemetry-spine records first so the snapshot
+            # reflects every hop that already served
+            self.drain_hook()
         with self._lock:
             kv = dict(self.kv_slots)
             cc = dict(self.compile_cache_events)
@@ -675,6 +773,11 @@ class FlightRecorder:
                 "degraded_requests": dict(self.degraded_requests),
             }
             trace_spans = dict(self.trace_spans)
+            spine = {
+                "ring_dropped": self.telemetry_ring_dropped,
+                "records": dict(self.telemetry_records),
+                "overhead_ms": dict(self.framework_overhead),
+            }
             perf = {
                 "anomalies": dict(self.perf_anomalies),
                 "hbm": {d: dict(v) for d, v in self.hbm.items()},
@@ -716,6 +819,7 @@ class FlightRecorder:
             },
             "compile_cache_events": cc,
             "trace_spans": trace_spans,
+            "telemetry_spine": spine,
             "request_latency_s": {
                 k: self._latency[k].snapshot() for k in latency_keys
             },
@@ -730,6 +834,10 @@ class FlightRecorder:
         ``seldon_tpu_hbm_*`` gauges (throttled inside the observatory) so
         a Prometheus-only deployment — nobody polling ``/perf`` — still
         sees live watermarks and the HBM-pressure alert can fire."""
+        if self.drain_hook is not None:
+            # scrape-only deployments must see every folded hop too —
+            # the exposition is a query surface like /stats
+            self.drain_hook()
         if self.registry is None:
             return b""
         try:
@@ -757,6 +865,11 @@ class FlightRecorder:
     def reset(self) -> None:
         """Fresh distributions/counters — tests only (Prometheus counters
         are monotone by design and are left alone)."""
+        if self.drain_hook is not None:
+            # stale ring records from earlier traffic must fold BEFORE the
+            # reset, not leak into the fresh state afterwards
+            self.drain_hook()
+        self._gen += 1
         self.batch_occupancy = Reservoir()
         self.batch_queue_wait = Reservoir()
         self.ttft = Reservoir()
@@ -788,6 +901,9 @@ class FlightRecorder:
             self.outlier_exceeded = 0
             self.slo_burn = {}
             self.quality_sampled = {}
+            self.telemetry_ring_dropped = 0
+            self.telemetry_records = {}
+            self.framework_overhead = {}
 
 
 RECORDER = FlightRecorder()

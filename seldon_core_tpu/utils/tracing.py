@@ -277,6 +277,13 @@ class Tracer:
         self._rng = random  # tests may inject random.Random(seed)
         self.recorded_total = 0
         self.sampled_out_total = 0
+        #: telemetry-spine wiring (utils/hotrecord.py), set on the global
+        #: TRACER only: ``sink`` routes finished spans into the per-thread
+        #: ring (one write per hop, folded off-path); ``drain_hook`` folds
+        #: pending records before any query reads.  Local instances keep
+        #: the inline synchronous path (both default None).
+        self.sink = None
+        self.drain_hook = None
 
     # -- admin -------------------------------------------------------------
 
@@ -286,7 +293,14 @@ class Tracer:
     def disable(self) -> None:
         self.enabled = False
 
+    def _drain(self) -> None:
+        """Fold any ring-pending spans before a read — queries stay
+        exactly as current as the old inline path made them."""
+        if self.drain_hook is not None:
+            self.drain_hook()
+
     def clear(self) -> None:
+        self._drain()  # pending records must not resurrect after clear
         with self._lock:
             self._spans.clear()
             self._by_puid.clear()
@@ -294,6 +308,7 @@ class Tracer:
 
     def snapshot(self) -> Dict[str, Any]:
         """Tracer health for ``/stats``."""
+        self._drain()
         with self._lock:
             spans = len(self._spans)
             traces = len(self._by_trace)
@@ -429,6 +444,17 @@ class Tracer:
         )
 
     def add(self, span: Span) -> None:
+        """Record one finished span.  With a telemetry-spine sink wired
+        (the process-global TRACER) this is ONE lock-free ring write; the
+        drainer folds the span into the ring/indexes off-path via
+        ``_fold``.  Without a sink (local tracers, spine disabled) it
+        folds inline — identical end state either way."""
+        if self.sink is not None:
+            self.sink(span)
+            return
+        self._fold(span)
+
+    def _fold(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
             if span.puid:
@@ -459,17 +485,20 @@ class Tracer:
     def trace(self, puid: str) -> List[Span]:
         """All recorded spans of one request, in start order — O(result)
         via the puid index."""
+        self._drain()
         with self._lock:
             found = list(self._by_puid.get(puid, ()))
         return sorted(found, key=lambda s: s.start_s)
 
     def by_trace(self, trace_id: str) -> List[Span]:
         """All recorded spans of one trace, in start order — O(result)."""
+        self._drain()
         with self._lock:
             found = list(self._by_trace.get(trace_id, ()))
         return sorted(found, key=lambda s: s.start_s)
 
     def recent(self, n: int = 100) -> List[Span]:
+        self._drain()
         with self._lock:
             return list(self._spans)[-int(n):]
 

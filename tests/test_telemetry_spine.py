@@ -1,0 +1,483 @@
+"""Fused hot-path telemetry spine (utils/hotrecord.py): one ring write
+per hop, off-path folding into the existing observatories, unified
+sampling, kill-switch completeness (all four subsystems off => ZERO ring
+writes and zero observatory calls on the dispatch path), independent
+degradation per subsystem, ring-overflow drop accounting, and the
+GET /overhead budget surface."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.utils.hotrecord import (
+    SPINE,
+    HotRecord,
+    TelemetrySpine,
+    ThreadRing,
+)
+from seldon_core_tpu.utils.perf import OBSERVATORY
+from seldon_core_tpu.utils.quality import QUALITY
+from seldon_core_tpu.utils.telemetry import RECORDER
+from seldon_core_tpu.utils.tracing import TRACER
+
+
+def deployment():
+    return SeldonDeploymentSpec.from_json_dict(
+        {"spec": {"name": "spine-dep", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "implementation": "SIMPLE_MODEL",
+                      "type": "MODEL"},
+        }]}}
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    SPINE.drain()
+    SPINE.reset()
+    TRACER.clear()
+    yield
+    SPINE.drain()
+    SPINE.reset()
+    TRACER.clear()
+
+
+def drive(engine, n=3, rows=2):
+    payload = json.dumps({"data": {"ndarray": np.ones((rows, 2)).tolist()}})
+
+    async def run():
+        for _ in range(n):
+            text, status = await engine.predict_json(payload)
+            assert status == 200, text
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# kill-switch completeness + independent degradation
+# ---------------------------------------------------------------------------
+
+
+def _counted(monkeypatch):
+    """Count every ring write and every observatory fold entry point."""
+    counts = {"ring": 0, "perf": 0, "quality": 0, "tracer": 0}
+
+    real_append = SPINE._append
+
+    def counting_append(rec):
+        counts["ring"] += 1
+        return real_append(rec)
+
+    monkeypatch.setattr(SPINE, "_append", counting_append)
+
+    real_perf = OBSERVATORY.observe_dispatch
+
+    def counting_perf(*a, **k):
+        counts["perf"] += 1
+        return real_perf(*a, **k)
+
+    monkeypatch.setattr(OBSERVATORY, "observe_dispatch", counting_perf)
+
+    real_quality = QUALITY.fold_batch
+
+    def counting_quality(*a, **k):
+        counts["quality"] += 1
+        return real_quality(*a, **k)
+
+    monkeypatch.setattr(QUALITY, "fold_batch", counting_quality)
+
+    real_fold = TRACER._fold
+
+    def counting_fold(span):
+        counts["tracer"] += 1
+        return real_fold(span)
+
+    monkeypatch.setattr(TRACER, "_fold", counting_fold)
+    return counts
+
+
+def _switch(monkeypatch, telemetry, trace, perf, quality):
+    monkeypatch.setattr(SPINE, "telemetry_enabled", telemetry)
+    monkeypatch.setattr(TRACER, "enabled", trace)
+    monkeypatch.setattr(OBSERVATORY, "enabled", perf)
+    monkeypatch.setattr(QUALITY, "enabled", quality)
+
+
+def test_all_kill_switches_mean_zero_ring_writes(monkeypatch):
+    """SELDON_TPU_TELEMETRY=0 SELDON_TPU_TRACE=0 SELDON_TPU_PERF=0
+    SELDON_TPU_QUALITY=0 semantics: the dispatch path performs ZERO ring
+    writes and ZERO observatory calls — serving pays nothing for the
+    telemetry layer it turned off."""
+    engine = EngineService(deployment())
+    _switch(monkeypatch, False, False, False, False)
+    counts = _counted(monkeypatch)
+    drive(engine)
+    SPINE.drain()
+    assert counts == {"ring": 0, "perf": 0, "quality": 0, "tracer": 0}
+
+
+def test_env_kill_switch_parses():
+    assert TelemetrySpine(telemetry_enabled=False).telemetry_enabled is False
+    assert TelemetrySpine().telemetry_enabled is True
+
+
+def test_perf_alone_degrades_independently(monkeypatch):
+    engine = EngineService(deployment())
+    _switch(monkeypatch, False, False, True, False)
+    counts = _counted(monkeypatch)
+    drive(engine)
+    SPINE.drain()
+    assert counts["perf"] >= 3
+    assert counts["quality"] == 0
+    assert counts["tracer"] == 0
+    assert counts["ring"] >= 3  # the dispatch records themselves
+
+
+def test_quality_alone_degrades_independently(monkeypatch):
+    engine = EngineService(deployment())
+    _switch(monkeypatch, False, False, False, True)
+    monkeypatch.setattr(QUALITY, "sample", 1.0)
+    counts = _counted(monkeypatch)
+    drive(engine)
+    SPINE.drain()
+    assert counts["quality"] >= 3
+    assert counts["perf"] == 0
+    assert counts["tracer"] == 0
+
+
+def test_tracer_alone_degrades_independently(monkeypatch):
+    engine = EngineService(deployment())
+    _switch(monkeypatch, False, True, False, False)
+    monkeypatch.setattr(TRACER, "sample", 1.0)
+    counts = _counted(monkeypatch)
+    drive(engine)
+    spans = TRACER.recent(500)  # drains
+    assert counts["tracer"] >= 3
+    assert counts["perf"] == 0
+    assert counts["quality"] == 0
+    kinds = {s.kind for s in spans}
+    # the fused record still reconstructs the full span family
+    assert {"request", "queue", "dispatch"} <= kinds
+
+
+def test_recorder_alone_still_counts_batches(monkeypatch):
+    RECORDER.reset()
+    engine = EngineService(deployment())
+    _switch(monkeypatch, True, False, False, False)
+    drive(engine)
+    snap = RECORDER.snapshot()  # drains first
+    assert snap["batch"]["occupancy"]["count"] >= 3
+    assert snap["batch"]["queue_wait_s"]["count"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# fused record semantics
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_span_carries_perf_and_quality_attrs(monkeypatch):
+    """One record per dispatch hop feeds ALL consumers: the folded span
+    carries the MFU/bound attrs the perf observatory derives AND the
+    drift score the quality fold computes — proof the same write feeds
+    the same trees/tables the inline calls used to."""
+    QUALITY.reset()
+    monkeypatch.setattr(QUALITY, "enabled", True)
+    monkeypatch.setattr(QUALITY, "sample", 1.0)
+    monkeypatch.setattr(QUALITY, "ref_target", 8)
+    TRACER.enable()
+    try:
+        engine = EngineService(deployment())
+        rng = np.random.default_rng(0)
+        payload = lambda m: json.dumps(  # noqa: E731
+            {"data": {"ndarray": m.tolist()}})
+
+        async def run(mat):
+            for i in range(0, len(mat), 4):
+                await engine.predict_json(payload(mat[i:i + 4]))
+
+        asyncio.run(run(rng.normal(0, 1, (8, 2))))    # freezes reference
+        asyncio.run(run(rng.normal(3, 1, (8, 2))))    # drifted live rows
+        spans = [s for s in TRACER.recent(500) if s.kind == "dispatch"]
+        assert spans, "no dispatch spans folded"
+        assert any("drift" in s.attrs for s in spans), \
+            "drift did not ride the fused dispatch record"
+        assert all(s.attrs.get("rows") for s in spans)
+    finally:
+        TRACER.disable()
+        QUALITY.reset()
+
+
+def test_unified_sampling_nests_quality_inside_trace(monkeypatch):
+    """ONE uniform draw decides every subsystem: with equal rates the
+    quality-sampled set is exactly the trace-sampled set (records are
+    complete across subsystems), which three independent coin flips
+    would only achieve by luck."""
+    monkeypatch.setattr(TRACER, "enabled", True)
+    monkeypatch.setattr(TRACER, "sample", 0.5)
+    monkeypatch.setattr(QUALITY, "enabled", True)
+    monkeypatch.setattr(QUALITY, "sample", 0.5)
+    monkeypatch.setattr(OBSERVATORY, "enabled", True)
+    agree = 0
+    for _ in range(400):
+        w = SPINE.dispatch_wants()
+        assert w.perf is True
+        if w.trace == w.quality:
+            agree += 1
+    assert agree == 400  # same u, same rate => identical verdicts
+
+
+def test_failed_dispatch_still_records_its_span(monkeypatch):
+    """A dispatch that raises must still leave a dispatch span with the
+    failure named (old context-manager-finally parity): incident traces
+    have to show the device hop that died."""
+    engine = EngineService(deployment())
+    drive(engine, n=1)  # prewarm the width so the failure is a 500 path
+    TRACER.enable()
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("injected device failure")
+
+        monkeypatch.setattr(engine.compiled, "predict_arrays", boom)
+
+        async def run():
+            payload = json.dumps(
+                {"data": {"ndarray": np.ones((2, 2)).tolist()}})
+            # a non-typed failure propagates (the HTTP lanes map it to
+            # their generic 500); the span must exist regardless
+            with pytest.raises(RuntimeError):
+                await engine.predict_json(payload)
+
+        asyncio.run(run())
+        spans = [s for s in TRACER.recent(200) if s.kind == "dispatch"]
+        assert spans, "failed dispatch left no span"
+        assert spans[-1].attrs.get("error") == "RuntimeError"
+    finally:
+        TRACER.disable()
+
+
+def test_dead_thread_rings_are_retired():
+    """Thread churn must not grow the ring list forever: a fully-drained
+    ring whose owning thread died is removed on the next drain, with its
+    drop accounting carried over."""
+    import threading
+
+    before = len(SPINE._rings)
+
+    def writer():
+        SPINE.record_flush(rows=1, requests=1, start_s=0.0,
+                           duration_s=0.001)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    SPINE.drain()   # folds the records; threads are dead
+    SPINE.drain()   # retires the drained dead-thread rings
+    after = len(SPINE._rings)
+    assert after <= before + 1, (
+        f"dead-thread rings not retired: {before} -> {after}"
+    )
+
+
+def test_ring_overflow_drops_and_counts():
+    ring = ThreadRing(4)
+    for i in range(7):
+        ring.push(HotRecord("span", 0))
+    assert ring.dropped == 3
+    out = []
+    ring.pop_into(out)
+    assert len(out) == 4
+    # after draining there is room again
+    assert ring.push(HotRecord("span", 0)) is True
+
+
+def test_spine_drop_accounting_reaches_recorder(monkeypatch):
+    spine = TelemetrySpine(ring_capacity=2)
+    monkeypatch.setattr(spine, "_ensure_drainer", lambda: None)
+    before = RECORDER.telemetry_ring_dropped
+    for _ in range(10):
+        spine.record_flush(rows=1, requests=1, start_s=0.0,
+                           duration_s=0.001)
+    spine.drain()
+    assert RECORDER.telemetry_ring_dropped - before == 8
+    text = RECORDER.exposition().decode()
+    assert "seldon_tpu_telemetry_ring_dropped_total" in text
+
+
+def test_scrape_refresh_rescores_drift_after_throttled_fold():
+    # batches folded inside the throttle window just before a traffic
+    # pause must still reach the seldon_tpu_drift_score gauges at the
+    # next scrape: refresh_gauges() force-rescored (same rule as the
+    # /quality page), else the alert reads a pre-shift score forever
+    from seldon_core_tpu.utils.quality import QualityObservatory
+
+    obs = QualityObservatory(enabled=True, sample=1.0, n_bins=5,
+                             ref_target=64)
+    rng = np.random.default_rng(7)
+    ref = rng.normal(0, 1, (64, 3))
+    for i in range(0, 64, 16):
+        obs.observe_batch("spine-drift", ref[i:i + 16],
+                          ref[i:i + 16, :1])  # freezes the reference
+    # first live batch scores immediately (same distribution: small
+    # psi — a 16-row batch over 5 bins is noisy, so no tight bound)...
+    obs.observe_batch("spine-drift", rng.normal(0, 1, (16, 3)),
+                      np.zeros((16, 1)))
+    stale = RECORDER.drift_scores.get("spine-drift:psi")
+    assert stale is not None and stale < 1.0
+    # ...then a hard shift lands entirely inside the throttle window
+    # and traffic stops — the per-batch path publishes nothing new
+    shifted = rng.normal(4, 1, (64, 3))
+    for i in range(0, 64, 16):
+        obs.observe_batch("spine-drift", shifted[i:i + 16],
+                          np.ones((16, 1)) * 4)
+    obs.refresh_gauges()  # the exposition path (scrape)
+    assert RECORDER.drift_scores["spine-drift:psi"] > 1.0
+
+
+def test_recorder_reset_does_not_double_count_records_counter():
+    # reset() clears the snapshot mirror but the monotone Prometheus
+    # counter must keep its baseline: re-publishing the same lifetime
+    # total after a reset must NOT re-add it (double count)
+    hop = "reset-regression-hop"
+
+    def counter_value():
+        for line in RECORDER.exposition().decode().splitlines():
+            if line.startswith("seldon_tpu_telemetry_records_total{") \
+                    and f'hop="{hop}"' in line:
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    RECORDER.set_telemetry_records(hop, 5)
+    assert counter_value() == 5.0
+    RECORDER.reset()
+    assert RECORDER.telemetry_records.get(hop) is None
+    RECORDER.set_telemetry_records(hop, 7)  # lifetime total, not fresh
+    assert counter_value() == 7.0  # +2 delta, not +7 re-add
+
+
+def test_queue_record_folds_into_wait_reservoir_and_span():
+    RECORDER.reset()
+    TRACER.enable()
+    try:
+        from seldon_core_tpu.utils.tracing import TraceContext
+
+        ctx = TraceContext(trace_id="a" * 32, span_id="b" * 16,
+                           sampled=True, puid="q-puid")
+        SPINE.record_queue(0.004, ctx=ctx, rows=3, start_s=1000.0)
+        SPINE.drain()
+        assert RECORDER.batch_queue_wait.snapshot()["count"] == 1
+        (span,) = TRACER.trace("q-puid")
+        assert span.kind == "queue"
+        assert span.parent_span_id == "b" * 16
+        assert span.attrs["rows"] == 3
+    finally:
+        TRACER.disable()
+
+
+# ---------------------------------------------------------------------------
+# /overhead surface + /stats assembly cache
+# ---------------------------------------------------------------------------
+
+
+def test_overhead_document_decomposes_subsystems():
+    TRACER.enable()
+    try:
+        engine = EngineService(deployment())
+        drive(engine, n=5)
+        doc = engine.overhead_document()
+    finally:
+        TRACER.disable()
+    assert doc["budget_ms"] == SPINE.budget_ms
+    assert set(doc["off_path_fold"]) == {
+        "tracer", "perf", "quality", "recorder"}
+    assert doc["ring"]["writes"] > 0
+    assert doc["ring"]["dropped_total"] == 0
+    assert doc["records_folded"].get("dispatch", 0) >= 5
+    # request + dispatch hops both folded => the framework estimate exists
+    assert doc["framework_p50_ms"] is not None
+    assert doc["within_budget"] in (True, False)
+    json.dumps(doc)  # the endpoint body must be JSON-safe
+
+
+def test_overhead_endpoint_on_both_lanes():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.runtime.rest import make_engine_app
+
+    engine = EngineService(deployment())
+
+    async def run():
+        async with TestClient(TestServer(make_engine_app(engine))) as client:
+            r = await client.get("/overhead")
+            assert r.status == 200
+            doc = await r.json()
+            assert "budget_ms" in doc and "ring" in doc
+            assert doc["engine"]["deployment"] == "spine-dep"
+
+    asyncio.run(run())
+
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+
+    async def run_fast():
+        import aiohttp
+
+        server = await serve_fast(engine, "127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{server.port}/overhead"
+                ) as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert "budget_ms" in doc
+        finally:
+            await server.stop()
+
+    asyncio.run(run_fast())
+
+
+def test_stats_served_from_folded_state_with_staleness():
+    """Engine.stats() rebuilds the four observatory walks only when the
+    folded state moved; an unchanged second scrape serves the cached
+    assembly with a non-zero staleness_s."""
+    engine = EngineService(deployment())
+    drive(engine, n=2)
+    first = engine.stats()
+    assert first["staleness_s"] == 0.0
+    assert first["telemetry"]["batch"]["occupancy"]["count"] >= 2
+    second = engine.stats()
+    # nothing folded in between: the cached walks are reused and aged
+    assert second["staleness_s"] >= 0.0
+    assert second["telemetry"] == first["telemetry"]
+    # new traffic invalidates the cache (fold generation moved)
+    drive(engine, n=1)
+    third = engine.stats()
+    assert third["staleness_s"] == 0.0
+    assert (
+        third["telemetry"]["batch"]["occupancy"]["count"]
+        > first["telemetry"]["batch"]["occupancy"]["count"]
+    )
+
+
+def test_test_delay_hook_inflates_ring_writes(monkeypatch):
+    """SELDON_TPU_TELEMETRY_TEST_DELAY_MS is the documented way to prove
+    the overhead gate gates: with a 2 ms injected write delay the
+    framework estimate must blow past any 1 ms budget."""
+    monkeypatch.setattr(SPINE, "test_delay_s", 0.002)
+    TRACER.enable()
+    try:
+        engine = EngineService(deployment())
+        drive(engine, n=5)
+        doc = engine.overhead_document()
+    finally:
+        TRACER.disable()
+        monkeypatch.setattr(SPINE, "test_delay_s", 0.0)
+    assert doc["ring"]["test_delay_ms"] == 2.0
+    assert doc["framework_p50_ms"] is not None
+    assert doc["framework_p50_ms"] > 1.0
+    assert doc["within_budget"] is False
