@@ -47,6 +47,14 @@ bench:
 overhead-gate:
 	JAX_PLATFORMS=cpu python bench.py --overhead-gate
 
+# continuous-batching TTFT gate: the concurrent-stream probe (staggered
+# arrivals into an already-decoding batch) must keep TTFT p50 within
+# SELDON_TPU_TTFT_BUDGET_MS (default 400).  A scheduler change that lets
+# prefill block co-batched decode — the r05 regression (305 -> 2012 ms)
+# — turns this lane red.  CPU-friendly (docs/operations.md runbook).
+ttft-gate:
+	JAX_PLATFORMS=cpu python bench.py --ttft-gate --smoke
+
 # regenerate every artifact-quoted doc figure from the committed round
 # snapshot / fail when the docs drift from it (CI runs docs-check)
 docs-sync:
@@ -88,4 +96,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo bench overhead-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun

@@ -54,20 +54,27 @@ from seldon_core_tpu.models.transformer import (
 _warned_prefix_flash = False  # one-time flash-vs-prefix warning latch
 
 
-def _warn_prefix_flash() -> None:
-    """One-time notice that the shared-prefix path runs the XLA segment
-    attention for the suffix prefill: the flash kernel has no causal-
-    SEGMENT variant (mid-sequence offsets + cache-wide attention), so a
-    deployment that opted into flash pays unfused O((P+S)*S) attention
-    there.  Decode is unaffected (two-tier path has no flash either way)."""
+def _resolve_prefix_flash(prefix, use_flash: bool) -> bool:
+    """The shared-prefix path has no flash kernel: the suffix prefill is a
+    causal SEGMENT (mid-sequence offsets + cache-wide attention) the fused
+    kernel cannot mask.  Rather than warning and letting the caller think
+    flash applied, resolve the EFFECTIVE flash setting here: with a prefix
+    active, warn once and return False — the safe unfused segment path —
+    so every downstream site (plain prefill included) branches on one
+    answer instead of re-deriving the hazard.  Decode is unaffected either
+    way (the two-tier/paged paths never use flash)."""
+    if prefix is None or not use_flash:
+        return use_flash
     global _warned_prefix_flash
     if not _warned_prefix_flash:
         _warned_prefix_flash = True
         logger.warning(
-            "prefix cache active with use_flash=True: the suffix prefill "
-            "runs unfused segment attention (no flash kernel for causal "
-            "segments); long suffixes pay O((P+S)*S) unfused attention"
+            "prefix cache active with use_flash=True: falling back to the "
+            "unfused causal-segment suffix prefill (no flash kernel for "
+            "causal segments); long suffixes pay O((P+S)*S) unfused "
+            "attention"
         )
+    return False
 
 
 def _eager(x) -> bool:
@@ -80,6 +87,9 @@ def _eager(x) -> bool:
 __all__ = ["init_cache", "init_chunk", "prefill", "decode_step",
            "generate", "stream_chunks", "sample_token", "mask_after_eos",
            "build_prefix_main",
+           "init_block_pool", "paged_forward", "paged_decode_round",
+           "paged_spec_round", "paged_write_prefix_blocks",
+           "paged_write_prefix_tail",
            "TransformerGenerator"]
 
 
@@ -655,8 +665,7 @@ def generate(
     P = 0 if prefix is None else prefix["l0"]["k"].shape[2]
     eager = _eager(prompt)
     t0 = time.perf_counter() if eager else 0.0
-    if prefix is not None and use_flash:
-        _warn_prefix_flash()
+    use_flash = _resolve_prefix_flash(prefix, use_flash)
     chunked = max_new_tokens - 1 > GEN_CHUNK_CAP
     # single-chunk generations never merge, so main holds ONLY the prompt
     # — decode then streams P+S cache slots, not P+S+max_new masked ones
@@ -879,8 +888,7 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
     # it is exactly full at every decode step — long streams never pay
     # the mostly-empty-buffer QK dot + validity select
     P = 0 if prefix is None else prefix["l0"]["k"].shape[2]
-    if prefix is not None and use_flash:
-        _warn_prefix_flash()
+    use_flash = _resolve_prefix_flash(prefix, use_flash)
     if prefix is None:
         main = init_cache(cfg, B, S)
         logits, main = prefill(params, prompt, main, cfg, use_flash)
@@ -958,6 +966,389 @@ def stream_chunks(params, prompt, cfg: LMConfig, max_new_tokens: int,
         # rate counts only device-decoded tokens — an early-stopped
         # stream's host-padded filler must not inflate the SLO histogram
         RECORDER.observe_decode_rate(B * decoded / elapsed)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV-block cache — the continuous-batching serving lane
+# (runtime/genserver.py drives these; see docs/operations.md "tuning the
+# generation scheduler")
+# ---------------------------------------------------------------------------
+#
+# The dense caches above are per-REQUEST: one [B, KV, L, hd] buffer sized
+# for one request's batch and lifetime.  Continuous batching co-schedules
+# sequences of different ages in one decode batch, so the cache becomes a
+# process-wide POOL of fixed-size blocks ([num_blocks, block_size, KV, hd]
+# per layer) and each sequence carries a BLOCK TABLE mapping its logical
+# block i to a physical pool block.  Allocation/free/eviction and
+# occupancy accounting are host-side (runtime/genserver.py BlockAllocator);
+# the device side below is three programs:
+#
+#   * paged_forward      — W tokens of one-or-more rows at per-row offsets
+#                          (chunked prefill AND the speculative verify pass)
+#   * paged_decode_round — `span` single-token steps for the whole
+#                          in-flight batch as ONE lax.scan (per-row
+#                          positions, per-row sampling keys, on-device
+#                          after-eos latch)
+#   * paged_spec_round   — draft k+1 paged steps + one (k+1)-wide target
+#                          verify + greedy acceptance (speculative decoding
+#                          on the serving path)
+#
+# Reads GATHER the row's blocks into a position-ordered dense view
+# (pool[tables] — the pure-XLA formulation of paged attention; a Pallas
+# block-table kernel is future work, and the repo's flash-decode precedent
+# says measure before fusing).  Writes SCATTER fresh K/V at
+# (table[pos // bs], pos % bs) — the vLLM reshape_and_cache shape.  Block 0
+# is a reserved SCRATCH block: masked rows and pad positions write there,
+# so inactive slots never need a branch.
+
+
+def init_block_pool(cfg: LMConfig, num_blocks: int, block_size: int
+                    ) -> Dict[str, Any]:
+    """Per-layer {k, v[, k_s, v_s]} pools shaped
+    ``[num_blocks, block_size, KV, hd]``.  Block 0 is the scratch block —
+    the allocator (runtime/genserver.py) hands out ids >= 1.  int8 pools
+    carry per-position scale planes exactly like init_cache."""
+    hd = cfg.d_model // cfg.n_heads
+    kv = cfg.kv_heads
+    # XLA:CPU has no native bf16 scatter: a bf16 pool pays TWO whole-pool
+    # converts (bf16 -> f32 scatter -> bf16) around EVERY write, which
+    # scales step cost with POOL size instead of batch size (measured:
+    # 211 ms vs 6 ms per decode round at 1024 blocks).  CPU backends
+    # store the pool f32; TPU/GPU keep the configured dtype (bf16 native,
+    # half the HBM) — same degradation pattern as the quality observatory.
+    dtype = cfg.dtype
+    if dtype == jnp.bfloat16 and jax.default_backend() == "cpu":
+        dtype = jnp.float32
+
+    def layer():
+        if cfg.kv_quant == "int8":
+            return {
+                "k": jnp.zeros((num_blocks, block_size, kv, hd), jnp.int8),
+                "v": jnp.zeros((num_blocks, block_size, kv, hd), jnp.int8),
+                "k_s": jnp.zeros((num_blocks, block_size, kv), jnp.float32),
+                "v_s": jnp.zeros((num_blocks, block_size, kv), jnp.float32),
+            }
+        return {
+            "k": jnp.zeros((num_blocks, block_size, kv, hd), dtype),
+            "v": jnp.zeros((num_blocks, block_size, kv, hd), dtype),
+        }
+
+    return {f"l{i}": layer() for i in range(cfg.n_layers)}
+
+
+def _paged_view(layer, tables):
+    """Gather one layer's blocks into a dense position-ordered cache view:
+    pool [N, bs, KV, hd] + tables [B, nblk] -> {k, v[, k_s, v_s]} with k/v
+    [B, KV, nblk*bs, hd] — the _grouped_qk/_grouped_pv layout, so paged
+    attention reuses the exact dot formulations the dense caches use."""
+    out = {}
+    for name in ("k", "v"):
+        g = layer[name][tables]  # [B, nblk, bs, KV, hd]
+        B, nblk, bs, KV, hd = g.shape
+        out[name] = g.transpose(0, 3, 1, 2, 4).reshape(B, KV, nblk * bs, hd)
+    for name in ("k_s", "v_s"):
+        if name in layer:
+            g = layer[name][tables]  # [B, nblk, bs, KV]
+            B, nblk, bs, KV = g.shape
+            out[name] = g.transpose(0, 3, 1, 2).reshape(B, KV, nblk * bs)
+    return out
+
+
+def _paged_write(layer, tables, pos, valid, k_new, v_new):
+    """Scatter fresh K/V (``[B, KV, W, hd]``) into the pool at per-token
+    (block, offset) targets: ``pos`` [B, W] global positions, resolved
+    through each row's table.  ``valid`` [B, W] False routes the write to
+    the scratch block 0 (masked rows / pad positions) — garbage lands in
+    scratch, never in a live sequence's blocks.  int8 pools quantize here
+    (per-token absmax, _quantize_kv) and scatter the scale planes too."""
+    bs = layer["k"].shape[1]
+    nblk = tables.shape[1]
+    idx = jnp.clip(pos // bs, 0, nblk - 1)
+    blk = jnp.take_along_axis(tables, idx, axis=1)  # [B, W]
+    blk = jnp.where(valid, blk, 0)
+    off = pos % bs
+    out = dict(layer)
+    if layer["k"].dtype == jnp.int8:
+        k_q, k_s = _quantize_kv(k_new)
+        v_q, v_s = _quantize_kv(v_new)
+        out["k"] = layer["k"].at[blk, off].set(k_q.transpose(0, 2, 1, 3))
+        out["v"] = layer["v"].at[blk, off].set(v_q.transpose(0, 2, 1, 3))
+        out["k_s"] = layer["k_s"].at[blk, off].set(k_s.transpose(0, 2, 1))
+        out["v_s"] = layer["v_s"].at[blk, off].set(v_s.transpose(0, 2, 1))
+    else:
+        out["k"] = layer["k"].at[blk, off].set(
+            k_new.transpose(0, 2, 1, 3).astype(layer["k"].dtype))
+        out["v"] = layer["v"].at[blk, off].set(
+            v_new.transpose(0, 2, 1, 3).astype(layer["v"].dtype))
+    return out
+
+
+def _attend_paged(q, view, start):
+    """q [B, H, W, hd] over a dense paged view; query i of row b sees
+    positions <= start[b] + i (its own fresh K/V is already in the pool).
+    Per-row ``start`` is what separates this from _attend_cached_causal:
+    co-scheduled rows sit at different sequence lengths.  W == 1 with
+    start == n_valid is exactly the cached decode mask (kpos <= n_valid)."""
+    s = _grouped_qk(q, view["k"], view.get("k_s"))  # [B, KV, g, W, L]
+    L = view["k"].shape[2]
+    W = q.shape[2]
+    qpos = start[:, None] + jnp.arange(W)[None, :]          # [B, W]
+    allowed = jnp.arange(L)[None, None, :] <= qpos[:, :, None]  # [B, W, L]
+    s = jnp.where(allowed[:, None, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return _grouped_pv(p, view["v"], q.shape, q.dtype, view.get("v_s"))
+
+
+def _paged_block(lp, x, pool_layer, tables, start, valid, cfg: LMConfig):
+    """One decoder block over the paged pool: K/V written at per-row
+    positions start[b] + i (scratch-routed where ``valid`` is False),
+    attention over each row's own blocks.  x [B, W, D]."""
+    from seldon_core_tpu.ops.quant import lm_matmul
+
+    B, W, D = x.shape
+    hd = cfg.d_model // cfg.n_heads
+    kv_h = cfg.kv_heads
+    h = _rmsnorm(x, lp["ln1"])
+    qkv = lm_matmul(lp, "wqkv", h, out_dtype=x.dtype)
+    q, k, v = jnp.split(qkv, [D, D + kv_h * hd], axis=-1)
+    q = _heads(q, B, W, cfg.n_heads, hd)
+    k = _heads(k, B, W, kv_h, hd)
+    v = _heads(v, B, W, kv_h, hd)
+    positions = start[:, None] + jnp.arange(W)[None, :]  # [B, W] per-row
+    if cfg.rope:
+        q = apply_rope(q, positions, cfg.rope_base)
+        k = apply_rope(k, positions, cfg.rope_base)
+    pool_layer = _paged_write(pool_layer, tables, positions, valid, k, v)
+    view = _paged_view(pool_layer, tables)
+    a = _attend_paged(q, view, start)
+    a = a.transpose(0, 2, 1, 3).reshape(B, W, D)
+    x = x + lm_matmul(lp, "wo", a, out_dtype=x.dtype)
+    h = _rmsnorm(x, lp["ln2"])
+    y, _lb = _ffn(lp, h, cfg, mesh=None)
+    return x + y, pool_layer
+
+
+def paged_forward(params, tokens, pool, tables, start, width,
+                  cfg: LMConfig, last_only: bool = True):
+    """Forward W tokens per row at per-row offsets over the paged pool —
+    chunked prefill (one prompt chunk at a time, decode never stalls for
+    the whole prompt) and the speculative verify pass share this program.
+
+    tokens [B, W] int32; start [B] per-row global offset of token 0;
+    width [B] valid token count per row (positions past it are pad: their
+    K/V go to scratch, their logits are garbage nobody reads).  Returns
+    (logits, pool'): logits [B, V] at each row's LAST valid position when
+    ``last_only`` (prefill needs only the next-token distribution — the
+    unembed is ~20% of prefill FLOPs at real vocab sizes), else [B, W, V]
+    for every position (the verify pass scores all of them)."""
+    B, W = tokens.shape
+    valid = jnp.arange(W)[None, :] < width[:, None]  # [B, W]
+    x = params["embed"][tokens]
+    for i in range(cfg.n_layers):
+        x, pool[f"l{i}"] = _paged_block(
+            params[f"l{i}"], x, pool[f"l{i}"], tables, start, valid, cfg
+        )
+    if last_only:
+        idx = jnp.clip(width - 1, 0, W - 1)
+        x = jnp.take_along_axis(
+            x, jnp.broadcast_to(idx[:, None, None], (B, 1, x.shape[2])),
+            axis=1,
+        )  # [B, 1, D] — before the (positionwise) norm: same numerics
+    x = _rmsnorm(x, params["ln_f"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return (logits[:, 0, :] if last_only else logits), pool
+
+
+def paged_decode_round(params, pool, tables, token, n_valid, active,
+                       seen_eos, keys, cfg: LMConfig, *, span: int,
+                       temperature: float, top_k: int, top_p: float,
+                       eos_token: int):
+    """``span`` cached decode steps for the whole in-flight batch as ONE
+    lax.scan — the scheduler's unit of work between admission points.
+
+    token [B] pending tokens; n_valid [B] per-row cache length; active [B]
+    masks empty slots (their writes go to scratch, their samples are
+    forced to 0); seen_eos [B] is the device-side after-eos latch (rows
+    past their stop keep riding the scan but emit eos — the generate()
+    output contract — until the host retires them at the round boundary);
+    keys [B] per-ROW PRNG keys (sampled decoding must not couple co-batched
+    requests the way a shared batch key does).  Returns
+    (toks [B, span], pool', token', n_valid', seen_eos', keys')."""
+
+    def step(carry, _):
+        pool, token, n_valid, seen_eos, keys = carry
+        x = params["embed"][token][:, None, :]
+        for i in range(cfg.n_layers):
+            x, pool[f"l{i}"] = _paged_block(
+                params[f"l{i}"], x, pool[f"l{i}"], tables, n_valid,
+                active[:, None], cfg,
+            )
+        x = _rmsnorm(x, params["ln_f"])
+        logits = (x[:, 0, :] @ params["embed"].T).astype(jnp.float32)
+        if temperature <= 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            split = jax.vmap(jax.random.split)(keys)  # [B, 2] keys
+            keys = split[:, 0]
+            nxt = jax.vmap(
+                lambda lg, kk: sample_token(
+                    lg[None, :], kk, temperature, top_k, top_p
+                )[0]
+            )(logits, split[:, 1])
+        if eos_token >= 0:
+            nxt = jnp.where(seen_eos, jnp.int32(eos_token), nxt)
+            seen_eos = seen_eos | (nxt == eos_token)
+        nxt = jnp.where(active, nxt, 0)
+        n_valid = n_valid + active.astype(jnp.int32)
+        return (pool, nxt, n_valid, seen_eos, keys), nxt
+
+    (pool, token, n_valid, seen_eos, keys), toks = jax.lax.scan(
+        step, (pool, token, n_valid, seen_eos, keys), None, length=span
+    )
+    return toks.T, pool, token, n_valid, seen_eos, keys
+
+
+def paged_spec_round(t_params, d_params, t_pool, d_pool, t_tables,
+                     d_tables, token, n_valid, active, t_cfg: LMConfig,
+                     d_cfg: LMConfig, *, k: int):
+    """One speculative draft/verify round over paged pools — speculative
+    decoding composed with continuous batching (greedy, float KV, the
+    speculative.py constraints).
+
+    The paged layout makes this SIMPLER than speculative.py's round-
+    aligned holes: pools are mutable buffers donated across rounds, so
+    rejected candidates' K/V are just stale slots past ``n_valid`` that
+    the next round overwrites before anything can attend them (attention
+    masks at n_valid).  Draft runs k+1 single-token paged steps (the +1
+    writes the last proposal's K/V so a fully-accepted round leaves no
+    draft-cache hole — same trick as speculative.py), target verifies all
+    k+1 positions in one paged_forward, and greedy acceptance takes the
+    longest matched prefix plus the corrected token.  Returns
+    (new_toks [B, k+1], gained [B], corrected [B], t_pool', d_pool'):
+    row b's round output is new_toks[b, :gained[b]], its next pending
+    token is corrected[b]."""
+    B = token.shape[0]
+    W = k + 1
+
+    def dstep(carry, _):
+        d_pool, tok, nv = carry
+        x = d_params["embed"][tok][:, None, :]
+        for i in range(d_cfg.n_layers):
+            x, d_pool[f"l{i}"] = _paged_block(
+                d_params[f"l{i}"], x, d_pool[f"l{i}"], d_tables, nv,
+                active[:, None], d_cfg,
+            )
+        x = _rmsnorm(x, d_params["ln_f"])
+        logits = (x[:, 0, :] @ d_params["embed"].T).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (d_pool, nxt, nv + 1), tok
+
+    (d_pool, _, _), seg = jax.lax.scan(
+        dstep, (d_pool, token, n_valid), None, length=W
+    )
+    seg = seg.transpose(1, 0)  # [B, W] = [pending, d1 .. dk]
+    widths = jnp.where(active, jnp.int32(W), jnp.int32(0))
+    t_logits, t_pool = paged_forward(
+        t_params, seg, t_pool, t_tables, n_valid, widths, t_cfg,
+        last_only=False,
+    )
+    t_argmax = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B, W]
+    draft = seg[:, 1:]  # [B, k]
+    match = draft == t_argmax[:, :k]
+    a = jnp.argmin(
+        jnp.concatenate([match, jnp.zeros((B, 1), bool)], axis=1), axis=1
+    )  # first mismatch; k if all matched
+    corrected = jnp.take_along_axis(t_argmax, a[:, None], axis=1)[:, 0]
+    padded = jnp.concatenate([draft, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    new_toks = jnp.where(
+        jnp.arange(W)[None, :] < a[:, None], padded, corrected[:, None]
+    )
+    gained = jnp.where(active, a + 1, 0).astype(jnp.int32)
+    return new_toks, gained, corrected, t_pool, d_pool
+
+
+def paged_write_prefix_tail(pool, prefix, blk, cfg: LMConfig, *, p0: int):
+    """Copy the shared-prefix TAIL (positions p0..P-1, the part that does
+    not fill a whole block) into one private pool block ``blk`` at offsets
+    0..r-1.  Full prefix blocks are written once and SHARED by block-table
+    reference across every sequence (pinned in the allocator); the
+    partially-filled boundary block must be private because the sequence's
+    own tokens continue into it."""
+    out = {}
+    for li, layer in pool.items():
+        pl = prefix[li]
+        new = dict(layer)
+        r = pl["k"].shape[2] - p0
+        new["k"] = layer["k"].at[blk, 0:r].set(
+            pl["k"][0, :, p0:, :].transpose(1, 0, 2).astype(
+                layer["k"].dtype))
+        new["v"] = layer["v"].at[blk, 0:r].set(
+            pl["v"][0, :, p0:, :].transpose(1, 0, 2).astype(
+                layer["v"].dtype))
+        if "k_s" in layer:
+            new["k_s"] = layer["k_s"].at[blk, 0:r].set(
+                pl["k_s"][0, :, p0:].transpose(1, 0))
+            new["v_s"] = layer["v_s"].at[blk, 0:r].set(
+                pl["v_s"][0, :, p0:].transpose(1, 0))
+        out[li] = new
+    return out
+
+
+def paged_write_prefix_blocks(pool, prefix, blocks, cfg: LMConfig):
+    """Write the full-block part of a shared prefix into pool blocks
+    ``blocks`` (a python list of block ids, len = P // block_size) — run
+    ONCE per deployment; every admitted sequence then references these
+    blocks through its table without copying."""
+    bs = pool["l0"]["k"].shape[1]
+    out = pool
+    for j, blk in enumerate(blocks):
+        seg = {}
+        for li, layer in out.items():
+            pl = prefix[li]
+            new = dict(layer)
+            lo = j * bs
+            new["k"] = layer["k"].at[blk, 0:bs].set(
+                pl["k"][0, :, lo:lo + bs, :].transpose(1, 0, 2).astype(
+                    layer["k"].dtype))
+            new["v"] = layer["v"].at[blk, 0:bs].set(
+                pl["v"][0, :, lo:lo + bs, :].transpose(1, 0, 2).astype(
+                    layer["v"].dtype))
+            if "k_s" in layer:
+                new["k_s"] = layer["k_s"].at[blk, 0:bs].set(
+                    pl["k_s"][0, :, lo:lo + bs].transpose(1, 0))
+                new["v_s"] = layer["v_s"].at[blk, 0:bs].set(
+                    pl["v_s"][0, :, lo:lo + bs].transpose(1, 0))
+            seg[li] = new
+        out = seg
+    return out
+
+
+# pools are DONATED through every paged program: the scheduler owns exactly
+# one live pool pytree per model and rebinds it after each dispatch, so XLA
+# mutates the blocks in place instead of copying the whole pool per step
+paged_forward_jit = jax.jit(
+    paged_forward, static_argnames=("cfg", "last_only"), donate_argnums=(2,)
+)
+paged_decode_round_jit = jax.jit(
+    paged_decode_round,
+    static_argnames=("cfg", "span", "temperature", "top_k", "top_p",
+                     "eos_token"),
+    donate_argnums=(1,),
+)
+paged_spec_round_jit = jax.jit(
+    paged_spec_round, static_argnames=("t_cfg", "d_cfg", "k"),
+    donate_argnums=(2, 3),
+)
+paged_write_prefix_tail_jit = jax.jit(
+    paged_write_prefix_tail, static_argnames=("cfg", "p0"),
+    donate_argnums=(0,),
+)
+# blocks is a STATIC tuple: the loop unrolls into one fused scatter program
+# compiled once per deployment (the prefix is written exactly once)
+paged_write_prefix_blocks_jit = jax.jit(
+    paged_write_prefix_blocks, static_argnames=("cfg", "blocks"),
+    donate_argnums=(0,),
+)
 
 
 @register_unit("TransformerGenerator")
@@ -1086,6 +1477,28 @@ class TransformerGenerator(Unit):
             new_state = {**state, "requests": state["requests"] + 1}
             return y, UnitAux(state=new_state)
         return y
+
+    def continuous_spec(self, state):
+        """Scheduler contract for the continuous-batching generation lane
+        (runtime/genserver.py): everything the per-step scheduler needs to
+        run this unit's decoding — params, config, sampling knobs, the
+        shared-prefix cache.  Returns None when the unit cannot be
+        continuously scheduled: MoE capacity routing couples co-batched
+        rows through the shared expert-capacity reduction, so co-scheduling
+        other requests' rows would change this request's answer."""
+        if self.cfg.moe_every > 0:
+            return None
+        return {
+            "params": state["params"],
+            "cfg": self.cfg,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "eos_token": self.eos_token,
+            "max_new_tokens": self.max_new_tokens,
+            "prefix_cache": state.get("prefix_cache"),
+            "seed": self.seed,
+        }
 
     def stream_tokens(self, state, X, chunk: int = 8):
         """Incremental serving: yields [B, <=chunk] int32 arrays; the

@@ -364,6 +364,27 @@ class SpeculativeGenerator(Unit):
         return {"target": lm_init(kt, self.target_cfg),
                 "draft": lm_init(kd, self.draft_cfg)}
 
+    def continuous_spec(self, state):
+        """Scheduler contract for the continuous-batching lane
+        (runtime/genserver.py): the draft params/config put the scheduler
+        in SPECULATIVE mode — per-step draft/verify rounds over paged
+        pools, so the 2.42x trained-draft win composes with continuous
+        admission instead of living only in the isolated bench arm.
+        Greedy/float-KV only, matching speculative_generate's guards."""
+        return {
+            "params": state["target"],
+            "cfg": self.target_cfg,
+            "temperature": 0.0,
+            "top_k": 0,
+            "top_p": 0.0,
+            "eos_token": -1,
+            "max_new_tokens": self.max_new_tokens,
+            "draft_params": state["draft"],
+            "draft_cfg": self.draft_cfg,
+            "spec_k": self.k,
+            "seed": self.seed,
+        }
+
     def predict(self, state, X):
         prompt = sanitize_prompt(X, self.target_cfg.vocab)
         toks, _rounds = speculative_generate(

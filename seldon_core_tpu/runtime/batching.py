@@ -37,7 +37,7 @@ from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
 from seldon_core_tpu.utils.tracing import current_trace_context
 
-__all__ = ["MicroBatcher", "graph_is_batchable"]
+__all__ = ["MicroBatcher", "GenLane", "graph_is_batchable"]
 
 
 def graph_is_batchable(graph: PredictiveUnit) -> bool:
@@ -296,6 +296,53 @@ class MicroBatcher:
             chunk_aux = _slice_aux(chunk_aux, slice(0, n), len(chunk))
             aux = chunk_aux if aux is None else _concat_aux(aux, chunk_aux)
         return np.concatenate(ys_parts, axis=0), aux
+
+
+class GenLane:
+    """Generation-lane bypass of the MicroBatcher.
+
+    The MicroBatcher's unit of work is one stacked DISPATCH: requests
+    coalesce into a batch, the batch owns the device until every row's
+    full generation finishes, then everyone's rows come back.  For
+    autoregressive generation that shape is exactly wrong — rows finish
+    at different times, late arrivals wait for the whole current batch,
+    and a long prefill stalls every co-batched stream.  When the engine
+    runs a continuous-batching scheduler (runtime/genserver.py), unary
+    predict traffic takes this lane instead: each request's rows become
+    individually-scheduled sequences, admitted into the in-flight decode
+    batch at the next scheduler step and retired row-by-row.  Same
+    ``submit(rows) -> (y_rows, aux)`` contract the engine's fast paths
+    already speak, so predict_json / the proto lanes need no changes."""
+
+    #: duck-typed MicroBatcher surface the engine reads
+    pad_to_buckets = False
+    atomic_chunks = False
+
+    def __init__(self, genserver, max_batch: int = 1024):
+        self.genserver = genserver
+        self.max_batch = int(max_batch)
+        self.recorder = RECORDER
+
+    async def submit(self, x: np.ndarray):
+        import asyncio
+
+        x = np.asarray(x)
+        if x.ndim < 2:
+            x = np.atleast_2d(x)
+        req = self.genserver.submit(x)
+        try:
+            y = await asyncio.wrap_future(req.future)
+        except asyncio.CancelledError:
+            # deadline/timeout fired in the engine: stop generating for
+            # this request so its sequences free their KV blocks
+            req.cancel()
+            raise
+        return y.astype(np.float64), ({}, {})
+
+    def snapshot(self) -> dict:
+        # the canonical scheduler block lives under stats()["genserver"];
+        # duplicating it here would serialize (and race) it twice a scrape
+        return {"mode": "genserver"}
 
 
 def _concat_aux(a, b):

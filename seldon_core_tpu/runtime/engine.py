@@ -29,7 +29,11 @@ import numpy as np
 
 from seldon_core_tpu.graph.compiled import CompiledGraph
 from seldon_core_tpu.graph.interpreter import GraphExecutor, NodeRuntime, pythonize_tags
-from seldon_core_tpu.runtime.batching import MicroBatcher, graph_is_batchable
+from seldon_core_tpu.runtime.batching import (
+    GenLane,
+    MicroBatcher,
+    graph_is_batchable,
+)
 from seldon_core_tpu.graph.spec import (
     GraphSpecError,
     PredictorSpec,
@@ -184,11 +188,45 @@ class EngineService:
             self.executor = GraphExecutor(
                 self.predictor, extra_runtimes=runtimes, rng=rng
             )
+        # continuous-batching generation lane (runtime/genserver.py): a
+        # single-generator graph serves through a paged-KV per-step
+        # scheduler instead of per-request generate() — streams admit into
+        # the in-flight decode batch, prompts prefill in chunks, and the
+        # int8-KV/prefix/speculative levers ride the actual serving path.
+        # SELDON_TPU_GEN_CONTINUOUS=0 is the kill switch (static path).
+        self.genserver = None
+        if (
+            self.compiled is not None
+            and len(self.compiled.units) == 1
+            and os.environ.get("SELDON_TPU_GEN_CONTINUOUS", "1") != "0"
+        ):
+            uname, unit = next(iter(self.compiled.units.items()))
+            spec_fn = getattr(unit, "continuous_spec", None)
+            if spec_fn is not None:
+                try:
+                    cs = spec_fn(self.compiled.states[uname])
+                    if cs is not None:
+                        from seldon_core_tpu.runtime.genserver import (
+                            GenServer,
+                        )
+
+                        self.genserver = GenServer(**cs)
+                except Exception:  # noqa: BLE001 - fall back to static path
+                    logger.exception(
+                        "continuous generation lane disabled "
+                        "(static per-request path kept)"
+                    )
         # micro-batching: coalesce concurrent requests into one device
         # dispatch (router-free compiled graphs only — routing is a
-        # per-request decision in the reference semantics)
+        # per-request decision in the reference semantics).  Generator
+        # graphs with a scheduler take the GenLane bypass instead: the
+        # MicroBatcher's whole-batch dispatch unit is exactly what
+        # continuous batching replaces.
         self.batcher = None
-        if (
+        use_gen_lane = self.genserver is not None and batching
+        if use_gen_lane:
+            self.batcher = GenLane(self.genserver, max_batch=max_batch)
+        if self.batcher is None and (
             self.compiled is not None
             and batching
             and graph_is_batchable(self.predictor.graph)
@@ -225,6 +263,7 @@ class EngineService:
                 # stateful graphs must apply state atomically per request
                 atomic_chunks=not pad_ok,
             )
+        if self.batcher is not None:
             # batchable graphs have no routers, so the executed path — and
             # therefore the output names — never varies per request
             self._static_names = self.compiled._output_names(
@@ -343,6 +382,11 @@ class EngineService:
                 ),
             },
             "batcher": None if self.batcher is None else self.batcher.snapshot(),
+            # continuous-batching generation scheduler: in-flight/waiting
+            # sequences, paged-KV-pool occupancy, admission/retirement flow
+            "genserver": (
+                None if self.genserver is None else self.genserver.snapshot()
+            ),
             "resilience": {
                 "retry_budget": self.retry_budget.snapshot(),
                 "breakers": {
@@ -413,8 +457,12 @@ class EngineService:
     # -- streaming generation ------------------------------------------
 
     def can_stream(self) -> bool:
-        """True when the graph is a single streaming-capable unit (a
-        generator exposing ``stream_tokens``)."""
+        """True when the graph is a single streaming-capable unit: a
+        generator exposing ``stream_tokens``, or any unit the continuous
+        scheduler runs (the scheduler streams natively — speculative
+        graphs gain SSE this way)."""
+        if self.genserver is not None:
+            return True
         return (
             self.compiled is not None
             and len(self.compiled.units) == 1
@@ -476,10 +524,16 @@ class EngineService:
         if rows.ndim < 2:
             rows = rows.reshape(1, -1)
         puid = msg.meta.puid or new_puid()
-        name, unit = next(iter(self.compiled.units.items()))
-        state = self.compiled.states[name]
         loop = asyncio.get_running_loop()
-        gen = unit.stream_tokens(state, rows, chunk=chunk)
+        if self.genserver is not None:
+            # continuous lane: the stream joins the in-flight decode
+            # batch at the next scheduler step (chunked prefill first),
+            # instead of holding the device for a private generate()
+            gen = self.genserver.stream(rows, chunk=chunk)
+        else:
+            name, unit = next(iter(self.compiled.units.items()))
+            state = self.compiled.states[name]
+            gen = unit.stream_tokens(state, rows, chunk=chunk)
         t0 = time.perf_counter()
         ttft_s = None
         tokens = 0
@@ -552,7 +606,16 @@ class EngineService:
         enumerated — for those only the single-row shape is compiled and
         first-burst compiles may still occur.  Returns the number of shapes
         compiled."""
-        if self.compiled is None or self.batcher is None:
+        if self.compiled is None:
+            return 0
+        if self.genserver is not None:
+            # the continuous lane's serving shapes are the scheduler's
+            # (prefill-chunk + decode-round executables), not generate()'s
+            # — probe requests through the scheduler compile those.
+            # Checked before the batcher: streams serve through the
+            # scheduler even when unary batching is disabled
+            return self.genserver.prewarm(widths)
+        if self.batcher is None:
             return 0
         import numpy as _np
 
@@ -1088,6 +1151,8 @@ class EngineService:
     async def close(self) -> None:
         """Release pooled remote-node clients (host mode) and flush the
         request-audit firehose."""
+        if self.genserver is not None:
+            self.genserver.stop()
         if self.executor is not None:
             for rt in self.executor.runtimes.values():
                 closer = getattr(rt, "close", None)

@@ -1,0 +1,1083 @@
+"""Continuous-batching generation server — paged KV blocks, per-step
+admission, chunked prefill.
+
+The static serving path runs ``generate()`` once per request: a request's
+batch owns the device for its whole lifetime, a long prefill stalls every
+co-batched decode (BENCH_r05: stream TTFT 2012 ms while the isolated
+decode arm does 75k tok/s), and the int8-KV / shared-prefix / speculative
+wins only exist in bench arms because nothing on the serving path
+composes them.  This module is the scheduler shape production TPU serving
+stacks use instead (Orca/vLLM-style):
+
+  * **Paged KV pool** — one process-wide per-layer block pool
+    (``models/generate.py init_block_pool``: ``[num_blocks, block_size,
+    KV, hd]``); sequences hold block tables, the :class:`BlockAllocator`
+    does alloc/free/eviction (preempt-youngest recompute) and occupancy
+    accounting.  Shared prefixes are written once and PINNED: every
+    sequence's table references the same physical blocks.
+  * **Per-step admission** — each scheduler iteration admits newly
+    arrived sequences into the in-flight decode batch, runs one decode
+    ROUND (``span`` single-token steps as one ``lax.scan`` — one device
+    program, one host sync), retires finished rows (the device-side
+    after-eos latch composing with the ``mask_after_eos`` output
+    contract), and hands tokens to the per-request streams.
+  * **Chunked prefill** — prompts are consumed ``prefill_chunk`` tokens
+    at a time, interleaved between decode rounds, so a 512-token prompt
+    stalls in-flight streams for at most one chunk instead of a full
+    prefill.
+  * **Composition** — int8 KV pools, shared-prefix block reuse, and
+    speculative draft/verify rounds (``paged_spec_round``) all run
+    through the same admission/retirement machinery, so their bench-arm
+    wins apply to actual served traffic.
+
+Greedy scheduler output is token-identical to one-shot ``generate()``
+(tests/test_genserver.py pins it); sampled decoding uses per-SEQUENCE
+PRNG keys, so co-batched requests cannot couple through a shared batch
+key (a deliberate improvement over the static path's batch-coupled
+sampling — same quality, decoupled streams).
+
+Tuning knobs (docs/operations.md "tuning the generation scheduler"):
+``SELDON_TPU_GEN_BLOCK_SIZE`` (16), ``SELDON_TPU_GEN_POOL_BLOCKS``
+(1024), ``SELDON_TPU_GEN_SLOTS`` (64), ``SELDON_TPU_GEN_SPAN`` (8),
+``SELDON_TPU_GEN_PREFILL_CHUNK`` (128, the interleave floor),
+``SELDON_TPU_GEN_PREFILL_CHUNK_MAX`` (512, the adaptive-chunk
+ceiling).  Kill switch:
+``SELDON_TPU_GEN_CONTINUOUS=0`` restores the static per-request path
+(runtime/engine.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.utils.hotrecord import SPINE
+from seldon_core_tpu.utils.perf import OBSERVATORY
+from seldon_core_tpu.utils.telemetry import RECORDER
+
+__all__ = ["BlockAllocator", "GenRequest", "GenServer"]
+
+logger = logging.getLogger(__name__)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the device block pool.
+
+    Block 0 is the scratch block (masked/pad writes) and is never handed
+    out.  ``pin`` marks shared-prefix blocks permanent: they count toward
+    occupancy once and ``free`` refuses them, so a retiring sequence can
+    never return a block every other sequence's table still references.
+    Freed ids go back on the free list FIFO — fragmentation cannot exist
+    by construction (any free block serves any sequence; the table adds
+    the indirection), which is the point of paging."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("pool needs at least 2 blocks (1 is scratch)")
+        self.num_blocks = int(num_blocks)
+        self._free: deque = deque(range(1, self.num_blocks))
+        self._pinned: set = set()
+        self.high_water = 0
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks - 1  # scratch excluded
+
+    @property
+    def used(self) -> int:
+        return self.capacity - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks or None — the caller queues (never crashes) on a full
+        pool."""
+        if n < 0 or len(self._free) < n:
+            return None
+        out = [self._free.popleft() for _ in range(n)]
+        self.high_water = max(self.high_water, self.used)
+        return out
+
+    def pin(self, blocks: List[int]) -> None:
+        self._pinned.update(blocks)
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b not in self._pinned:
+                self._free.append(b)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "total": self.capacity,
+            "used": self.used,
+            "pinned": len(self._pinned),
+            "high_water": self.high_water,
+        }
+
+
+class _Sequence:
+    """One row of one request riding the scheduler."""
+
+    __slots__ = (
+        "sid", "request", "row", "prompt", "prompt0", "max_new", "state",
+        "n_valid", "blocks", "draft_blocks", "pending", "prefill_pos",
+        "emitted", "done", "key_data", "admit_order", "retire_reason",
+    )
+    WAITING, PREFILL, RUNNING, DONE = range(4)
+
+    def __init__(self, sid: int, request: "GenRequest", row: int,
+                 prompt: np.ndarray, max_new: int):
+        self.sid = sid
+        self.request = request
+        self.row = row
+        self.prompt = prompt            # int32 [S] (suffix when prefixed)
+        self.prompt0 = prompt           # as submitted: preempt rebuild base
+        self.max_new = int(max_new)
+        self.state = self.WAITING
+        self.n_valid = 0                # cache positions written (global)
+        self.blocks: List[int] = []     # PRIVATE blocks only
+        self.draft_blocks: List[int] = []   # speculative mode
+        self.pending: Optional[int] = None  # sampled, not yet in cache
+        self.prefill_pos = 0            # prompt tokens consumed
+        self.emitted: List[int] = []
+        self.done = False
+        self.key_data: Optional[np.ndarray] = None  # per-seq PRNG key
+        self.admit_order = -1
+        self.retire_reason = ""
+
+
+class GenRequest:
+    """One client request: N sequences plus the delivery surface — a
+    Future holding the assembled ``[B, max_new]`` token array (unary) or
+    a bounded queue of ``[B, <=chunk]`` arrays (streaming)."""
+
+    def __init__(self, rows: int, chunk: Optional[int], max_new: int):
+        self.rows = rows
+        self.chunk = chunk              # None = unary
+        self.max_new = int(max_new)
+        self.seqs: List[_Sequence] = []
+        self.future: concurrent.futures.Future = concurrent.futures.Future()
+        # unbounded on purpose: a stream buffers at most max_new tokens
+        # per row, so the natural bound is the generation length — a
+        # bounded queue could deadlock a slow consumer against the
+        # scheduler thread
+        self.queue: "queue.Queue" = queue.Queue()
+        self.delivered = 0              # stream tokens handed out per row
+        self.cancelled = False
+        self.t_submit = time.perf_counter()
+        self.ttft_recorded = False
+        self.admit_recorded = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class GenServer:
+    """The continuous-batching scheduler for one generator deployment.
+
+    Device work and all bookkeeping run on ONE daemon worker thread
+    (started lazily at the first submit; jax dispatch from a single
+    thread, callers bridge through thread-safe queues/futures).  The
+    engine builds one of these from the unit's ``continuous_spec``
+    (runtime/engine.py); ``SELDON_TPU_GEN_CONTINUOUS=0`` keeps the old
+    static path."""
+
+    def __init__(
+        self,
+        params,
+        cfg,
+        *,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        eos_token: int = -1,
+        max_new_tokens: int = 32,
+        prefix_cache=None,
+        draft_params=None,
+        draft_cfg=None,
+        spec_k: int = 4,
+        seed: int = 0,
+        block_size: Optional[int] = None,
+        num_blocks: Optional[int] = None,
+        slots: Optional[int] = None,
+        span: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token = int(eos_token)
+        self.max_new_tokens = int(max_new_tokens)
+        self.prefix_cache = prefix_cache
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.spec = draft_params is not None
+        self.spec_k = int(spec_k)
+        self.seed = int(seed)
+        if self.spec and (self.temperature > 0.0
+                          or cfg.kv_quant == "int8"
+                          or prefix_cache is not None):
+            # mirror speculative_generate's guards: greedy, float KV
+            raise ValueError(
+                "speculative continuous mode is greedy/float-KV only")
+        self.block_size = block_size or _env_int(
+            "SELDON_TPU_GEN_BLOCK_SIZE", 16)
+        self.num_blocks = num_blocks or _env_int(
+            "SELDON_TPU_GEN_POOL_BLOCKS", 1024)
+        self.slots = slots or _env_int("SELDON_TPU_GEN_SLOTS", 64)
+        self.span = span or _env_int("SELDON_TPU_GEN_SPAN", 8)
+        self.prefill_chunk = prefill_chunk or _env_int(
+            "SELDON_TPU_GEN_PREFILL_CHUNK", 128)
+        # dispatch-latency-aware adaptive chunking: prefill_chunk is the
+        # FLOOR (the guaranteed interleave grain); when a prefill tick's
+        # wall time is dispatch-dominated — doubling the chunk leaves the
+        # wall nearly flat, the relay/queueing signature — the effective
+        # chunk probes upward toward PREFILL_CHUNK_MAX, because a bigger
+        # chunk then shortens every TTFT path at zero stall cost.  When
+        # doubling makes the tick materially slower (compute-bound:
+        # directly-attached device, big model), it backs off and latches.
+        self.prefill_chunk_max = max(
+            _env_int("SELDON_TPU_GEN_PREFILL_CHUNK_MAX", 512),
+            self.prefill_chunk,
+        )
+        self._chunk_eff = self.prefill_chunk
+        self._chunk_wall: Dict[int, List[float]] = {}  # C -> [ema_s, n]
+        self._chunk_latched = self._chunk_eff >= self.prefill_chunk_max
+        # scheduler state (worker thread only, except arrivals)
+        self._arrivals: deque = deque()
+        self._waiting: deque = deque()
+        self._prefilling: List[_Sequence] = []
+        self._active: List[_Sequence] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._pool = None
+        self._draft_pool = None
+        self._allocator: Optional[BlockAllocator] = None
+        self._draft_allocator: Optional[BlockAllocator] = None
+        self._prefix_blocks: List[int] = []     # shared full blocks
+        self._prefix_len = 0
+        self._seq_counter = 0
+        self._admit_counter = 0
+        # lifetime counters for /stats + the gen_* Prometheus families
+        self.admitted_total = 0
+        self.retired_total: Dict[str, int] = {}
+        self.preempted_total = 0
+        self.steps_total: Dict[str, int] = {}
+        self.tokens_emitted_total = 0
+
+    # -- client surface (any thread) ------------------------------------
+
+    def submit(self, rows, max_new: Optional[int] = None) -> GenRequest:
+        """Unary generation: rows [B, S] (float wire rows fine — the
+        sanitize_prompt clamp applies).  Returns the request handle; its
+        ``future`` resolves to the eos-padded int32 ``[B, max_new]``
+        array — exactly ``generate()``'s output contract."""
+        return self._enqueue(rows, chunk=None, max_new=max_new)
+
+    def stream(self, rows, chunk: int = 8, max_new: Optional[int] = None):
+        """Streaming generation: a plain generator of ``[B, <=chunk]``
+        int32 arrays whose concatenation equals the unary output —
+        the stream_tokens contract, served by the scheduler."""
+        req = self._enqueue(rows, chunk=max(1, int(chunk)),
+                            max_new=max_new)
+
+        def _iter():
+            try:
+                while True:
+                    item = req.queue.get()
+                    if item is None:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+            finally:
+                if not req.future.done():
+                    req.cancel()
+                    with self._wake:
+                        self._wake.notify_all()
+
+        return _iter()
+
+    def _enqueue(self, rows, chunk, max_new) -> GenRequest:
+        rows = np.asarray(rows, dtype=np.float64)
+        if rows.ndim < 2:
+            rows = rows.reshape(1, -1)
+        # sanitize_prompt's clamp, host-side: NaN -> 0, clip to vocab
+        prompts = np.clip(
+            np.nan_to_num(rows), 0, self.cfg.vocab - 1
+        ).astype(np.int32)
+        req = GenRequest(len(prompts), chunk,
+                         max_new or self.max_new_tokens)
+        with self._wake:
+            if self._stopped:
+                raise RuntimeError("generation scheduler stopped")
+            for r, p in enumerate(prompts):
+                self._seq_counter += 1
+                seq = _Sequence(self._seq_counter, req, r, p, req.max_new)
+                if self.temperature > 0.0:
+                    import jax
+
+                    seq.key_data = np.asarray(jax.random.key_data(
+                        jax.random.fold_in(
+                            jax.random.key(self.seed), self._seq_counter)
+                    ))
+                req.seqs.append(seq)
+                self._arrivals.append(seq)
+            self._ensure_thread()
+            self._wake.notify_all()
+        return req
+
+    def prewarm(self, widths=()) -> int:
+        """Compile the serving-path executables before traffic: one probe
+        request per prompt width runs admission -> chunked prefill ->
+        decode rounds end to end (backed by the persistent compile
+        cache).  Returns the number of probes served."""
+        count = 0
+        for width in list(widths) or [4]:
+            w = width if isinstance(width, int) else int(np.prod(width))
+            probe = np.zeros((1, max(1, min(w, 4096))))
+            req = self.submit(probe, max_new=min(self.span + 1,
+                                                 self.max_new_tokens))
+            try:
+                req.future.result(timeout=900)
+                count += 1
+            except Exception as e:  # noqa: BLE001 - prewarm best-effort
+                logger.warning("genserver prewarm width %s failed: %s",
+                               width, e)
+        return count
+
+    def snapshot(self) -> Dict[str, Any]:
+        alloc = self._allocator
+        with self._lock:
+            waiting = len(self._waiting) + len(self._arrivals)
+            inflight = len(self._active) + len(self._prefilling)
+        doc = {
+            "mode": "speculative" if self.spec else "decode",
+            "slots": self.slots,
+            "inflight_sequences": inflight,
+            "waiting_sequences": waiting,
+            "kv_blocks": alloc.snapshot() if alloc is not None else {
+                "total": self.num_blocks - 1, "used": 0, "pinned": 0,
+                "high_water": 0,
+            },
+            "block_size": self.block_size,
+            "span": self.span,
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunk_effective": self._chunk_eff,
+            "admitted_total": self.admitted_total,
+            "retired_total": dict(self.retired_total),
+            "preempted_total": self.preempted_total,
+            "steps_total": dict(self.steps_total),
+            "tokens_emitted_total": self.tokens_emitted_total,
+        }
+        if self.spec:
+            dalloc = self._draft_allocator
+            doc["draft_kv_blocks"] = (
+                dalloc.snapshot() if dalloc is not None else {})
+        return doc
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stopped = True
+            self._wake.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
+
+    # -- worker thread ---------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="genserver", daemon=True)
+            self._thread.start()
+
+    def _ensure_device(self) -> None:
+        if self._pool is not None:
+            return
+        from seldon_core_tpu.models.generate import (
+            init_block_pool,
+            paged_write_prefix_blocks_jit,
+        )
+
+        self._pool = init_block_pool(
+            self.cfg, self.num_blocks, self.block_size)
+        self._allocator = BlockAllocator(self.num_blocks)
+        if self.spec:
+            self._draft_pool = init_block_pool(
+                self.draft_cfg, self.num_blocks, self.block_size)
+            self._draft_allocator = BlockAllocator(self.num_blocks)
+        if self.prefix_cache is not None:
+            P = int(self.prefix_cache["l0"]["k"].shape[2])
+            self._prefix_len = P
+            full = P // self.block_size
+            if full:
+                blocks = self._allocator.alloc(full)
+                if blocks is None:
+                    raise RuntimeError(
+                        f"KV pool ({self.num_blocks} blocks) smaller than "
+                        f"the shared prefix ({full} blocks)")
+                self._pool = paged_write_prefix_blocks_jit(
+                    self._pool, self.prefix_cache, tuple(blocks),
+                    cfg=self.cfg)
+                self._allocator.pin(blocks)
+                self._prefix_blocks = blocks
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while (not self._stopped and not self._arrivals
+                       and not self._waiting and not self._prefilling
+                       and not self._active):
+                    self._wake.wait()
+                if self._stopped:
+                    break
+                while self._arrivals:
+                    self._waiting.append(self._arrivals.popleft())
+            try:
+                progress = self._tick()
+            except Exception as e:  # noqa: BLE001 - fail loudly per request
+                logger.exception("genserver tick failed")
+                self._fail_all(e)
+                progress = True
+            if not progress:
+                # queued work that cannot run yet (pool dry, waiting on a
+                # retirement that cannot come this tick): don't spin hot
+                with self._wake:
+                    self._wake.wait(0.005)
+        self._fail_all(RuntimeError("generation scheduler stopped"))
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            seqs = (list(self._waiting) + list(self._prefilling)
+                    + list(self._active) + list(self._arrivals))
+            self._waiting.clear()
+            self._arrivals.clear()
+            self._prefilling, self._active = [], []
+        for seq in seqs:
+            self._release_blocks(seq)
+            req = seq.request
+            if not req.future.done():
+                req.future.set_exception(exc)
+            try:
+                req.queue.put_nowait(exc)
+            except queue.Full:
+                pass
+
+    # -- the scheduler step ----------------------------------------------
+
+    def _tick(self) -> bool:
+        """One scheduler iteration: admit, one prefill chunk, one decode
+        round, retire, account.  Exactly one fused telemetry record per
+        step (utils/hotrecord.py HOP_GEN_STEP).  Returns False when no
+        work could run (the loop then backs off instead of spinning)."""
+        t0 = time.perf_counter()
+        self._ensure_device()
+        self._drop_cancelled()
+        admitted = self._admit()
+        kind = None
+        tokens = 0
+        if self._prefilling:
+            kind = "prefill"
+            tokens = self._prefill_tick()
+        # a first token can finish a sequence (eos / max_new == 1): retire
+        # BEFORE the round so it neither wastes a slot nor a dispatch
+        retired = self._retire_finished()
+        if self._active:
+            if kind is None:
+                kind = "spec" if self.spec else "decode"
+            else:
+                kind = "mixed"
+            tokens += (self._spec_round() if self.spec
+                       else self._decode_round())
+        retired += self._retire_finished()
+        if kind is not None:
+            self.steps_total[kind] = self.steps_total.get(kind, 0) + 1
+            self.tokens_emitted_total += tokens
+        self._publish(admitted, retired, kind or "idle", tokens,
+                      time.perf_counter() - t0)
+        return kind is not None or admitted > 0 or retired > 0
+
+    def _drop_cancelled(self) -> None:
+        for coll in (self._waiting, self._prefilling, self._active):
+            for seq in [s for s in coll if s.request.cancelled]:
+                coll.remove(seq)
+                self._retire(seq, "cancelled")
+
+    def _blocks_needed(self, upto: int) -> int:
+        return -(-upto // self.block_size)  # ceil
+
+    def _ensure_capacity(self, seq: _Sequence, upto: int,
+                         draft: bool = False) -> bool:
+        """Grow ``seq``'s table to cover positions [0, upto), evicting
+        (preempt-youngest, recompute-on-readmit) when the pool is dry."""
+        alloc = self._draft_allocator if draft else self._allocator
+        shared = 0 if draft else len(self._prefix_blocks)
+        owned = seq.draft_blocks if draft else seq.blocks
+        need = self._blocks_needed(upto) - shared - len(owned)
+        if need <= 0:
+            return True
+        while not alloc.can_alloc(need):
+            victim = self._pick_victim(exclude=seq)
+            if victim is None:
+                return False
+            self._preempt(victim)
+        got = alloc.alloc(need)
+        if got is None:
+            return False
+        owned.extend(got)
+        return True
+
+    def _pick_victim(self, exclude: _Sequence) -> Optional[_Sequence]:
+        pool = [s for s in self._active + self._prefilling
+                if s is not exclude]
+        if not pool:
+            return None
+        return max(pool, key=lambda s: s.admit_order)  # youngest first
+
+    def _preempt(self, seq: _Sequence) -> None:
+        """Evict a running sequence: free its blocks and push it to the
+        FRONT of the waiting queue for recompute.  Its already-delivered
+        tokens become part of the re-prefill prompt and the pending token
+        is restored (never re-sampled), so the stream resumes exactly
+        where it stopped."""
+        for coll in (self._active, self._prefilling):
+            if seq in coll:
+                coll.remove(seq)
+        self._release_blocks(seq)
+        if seq.emitted:
+            # rebuild from the ORIGINAL prompt: emitted keeps growing, so
+            # folding into the already-folded prompt would duplicate
+            # context on a second preemption
+            seq.prompt = np.concatenate(
+                [seq.prompt0,
+                 np.asarray(seq.emitted[:-1], np.int32)]).astype(np.int32)
+            seq.pending = seq.emitted[-1]
+        seq.prefill_pos = 0
+        seq.n_valid = 0
+        seq.state = _Sequence.WAITING
+        self._waiting.appendleft(seq)
+        self.preempted_total += 1
+        # mirrored into retired_total so /stats per-reason retirement
+        # sums to the same figure as seldon_tpu_gen_retired_total
+        self.retired_total["preempted"] = (
+            self.retired_total.get("preempted", 0) + 1)
+        RECORDER.record_gen_retired("preempted")
+
+    def _release_blocks(self, seq: _Sequence) -> None:
+        if self._allocator is not None and seq.blocks:
+            self._allocator.free(seq.blocks)
+        seq.blocks = []
+        if self._draft_allocator is not None and seq.draft_blocks:
+            self._draft_allocator.free(seq.draft_blocks)
+        seq.draft_blocks = []
+
+    def _admit(self) -> int:
+        """FIFO admission into free slots; a sequence whose FIRST chunk
+        of blocks cannot be allocated stays queued (pool exhaustion
+        queues, never crashes).  A sequence that cannot fit even with the
+        scheduler otherwise EMPTY can never be served — that one fails
+        with a typed error instead of deadlocking the queue."""
+        admitted = 0
+        while self._waiting and (
+            len(self._active) + len(self._prefilling) < self.slots
+        ):
+            seq = self._waiting[0]
+            first = min(len(seq.prompt), self.prefill_chunk)
+            upto = self._prefix_len + first
+            shared = len(self._prefix_blocks)
+            need = self._blocks_needed(upto) - shared
+            d_need = self._blocks_needed(first) if self.spec else 0
+            if (not self._allocator.can_alloc(need)
+                    or (self.spec
+                        and not self._draft_allocator.can_alloc(d_need))):
+                if not self._active and not self._prefilling:
+                    # nothing will ever retire to free blocks: the pool
+                    # is smaller than one request's first chunk
+                    self._waiting.popleft()
+                    self._finish_error(seq, RuntimeError(
+                        f"KV pool ({self.num_blocks} blocks of "
+                        f"{self.block_size}) cannot hold one prefill "
+                        "chunk (grow SELDON_TPU_GEN_POOL_BLOCKS)"))
+                    continue
+                break  # pool dry: wait for a retirement to free blocks
+            self._waiting.popleft()
+            seq.blocks = self._allocator.alloc(need) or []
+            if self.spec:
+                seq.draft_blocks = (
+                    self._draft_allocator.alloc(d_need) or [])
+            # shared-prefix tail: the partially-filled boundary block is
+            # private — copy the tail K/V into this sequence's first block
+            p0 = len(self._prefix_blocks) * self.block_size
+            if self._prefix_len > p0 and seq.blocks:
+                import jax.numpy as jnp
+
+                from seldon_core_tpu.models.generate import (
+                    paged_write_prefix_tail_jit,
+                )
+
+                self._pool = paged_write_prefix_tail_jit(
+                    self._pool, self.prefix_cache,
+                    jnp.int32(seq.blocks[0]), cfg=self.cfg, p0=p0)
+            seq.n_valid = self._prefix_len
+            seq.state = _Sequence.PREFILL
+            seq.prefill_pos = 0
+            self._admit_counter += 1
+            seq.admit_order = self._admit_counter
+            self._prefilling.append(seq)
+            self.admitted_total += 1
+            admitted += 1
+            RECORDER.record_gen_admitted()
+            if not seq.request.admit_recorded:
+                # admission wait is this lane's queue wait — same family
+                # the MicroBatcher feeds, so /stats reads unchanged
+                seq.request.admit_recorded = True
+                RECORDER.observe_queue_wait(
+                    time.perf_counter() - seq.request.t_submit)
+        return admitted
+
+    def _table(self, seq: _Sequence, nblk: int, draft: bool = False
+               ) -> np.ndarray:
+        blocks = (seq.draft_blocks if draft
+                  else self._prefix_blocks + seq.blocks)
+        row = np.zeros((nblk,), np.int32)
+        row[: len(blocks)] = blocks[:nblk]
+        return row
+
+    # -- prefill ----------------------------------------------------------
+
+    def _prefill_tick(self) -> int:
+        """Consume one chunk of EVERY prefilling sequence's prompt as a
+        single batched device program — the interleave grain that keeps a
+        long prompt from stalling in-flight decode for more than ~one
+        chunk's worth of time, without serializing one dispatch per
+        prompt (16 co-arriving 512-token prompts at chunk 128 are 4
+        batched ticks, not 64 sequential ones — on a dispatch-latency
+        relay that difference IS the TTFT p50)."""
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.generate import (
+            paged_forward_jit,
+            sample_token,
+        )
+
+        t0 = time.perf_counter()
+        C = self._chunk_eff
+        # capacity pass first: eviction inside it may requeue OTHER
+        # prefilling sequences, so the batch is built only afterwards
+        for seq in list(self._prefilling):
+            if seq not in self._prefilling:
+                continue  # preempted by an earlier row's eviction
+            w = min(C, len(seq.prompt) - seq.prefill_pos)
+            upto = self._prefix_len + seq.prefill_pos + w
+            ok = self._ensure_capacity(seq, upto)
+            if ok and self.spec:
+                # draft pool sized like the target pool; best effort
+                self._ensure_capacity(
+                    seq, seq.prefill_pos + w, draft=True)
+            if not ok:
+                # cannot even hold this chunk: re-queue and wait.
+                # _admit OVERWRITES seq.blocks on re-admission (and
+                # resets prefill_pos — recompute-on-readmit), so the
+                # blocks held so far must go back to the pool now
+                self._prefilling.remove(seq)
+                self._release_blocks(seq)
+                if not self._active and not self._prefilling:
+                    # alone and still failing: no retirement can ever
+                    # free more — the prompt simply exceeds the pool.
+                    # Requeueing would livelock (admit -> prefill ->
+                    # requeue at full device utilization, forever)
+                    self._finish_error(seq, RuntimeError(
+                        f"KV pool ({self.num_blocks} blocks of "
+                        f"{self.block_size}) too small for prompt "
+                        f"length {len(seq.prompt)} (grow "
+                        "SELDON_TPU_GEN_POOL_BLOCKS)"))
+                    continue
+                self._waiting.appendleft(seq)
+                seq.state = _Sequence.WAITING
+        batch = list(self._prefilling)
+        if not batch:
+            return 0
+        B = _pow2(len(batch))
+        toks = np.zeros((B, C), np.int32)
+        start = np.zeros((B,), np.int32)
+        width = np.zeros((B,), np.int32)
+        widths = []
+        for i, seq in enumerate(batch):
+            lo = seq.prefill_pos
+            w = min(C, len(seq.prompt) - lo)
+            toks[i, :w] = seq.prompt[lo:lo + w]
+            start[i] = self._prefix_len + lo
+            width[i] = w
+            widths.append(w)
+        nblk = _pow2(max(
+            self._blocks_needed(int(start[i]) + widths[i])
+            for i in range(len(batch))
+        ))
+        tables = np.zeros((B, nblk), np.int32)
+        for i, seq in enumerate(batch):
+            tables[i] = self._table(seq, nblk)
+        OBSERVATORY.note_padding(len(batch), B)
+        logits, self._pool = paged_forward_jit(
+            self.params, jnp.asarray(toks), self._pool,
+            jnp.asarray(tables), jnp.asarray(start), jnp.asarray(width),
+            cfg=self.cfg, last_only=True,
+        )
+        if self.spec:
+            d_nblk = _pow2(max(
+                self._blocks_needed(seq.prefill_pos + widths[i])
+                for i, seq in enumerate(batch)
+            ))
+            d_tables = np.zeros((B, d_nblk), np.int32)
+            d_start = np.zeros((B,), np.int32)
+            for i, seq in enumerate(batch):
+                d_tables[i] = self._table(seq, d_nblk, draft=True)
+                d_start[i] = seq.prefill_pos
+            _, self._draft_pool = paged_forward_jit(
+                self.draft_params, jnp.asarray(toks), self._draft_pool,
+                jnp.asarray(d_tables), jnp.asarray(d_start),
+                jnp.asarray(width), cfg=self.draft_cfg, last_only=True,
+            )
+        logits_host = None
+        emitted = 0
+        for i, seq in enumerate(batch):
+            seq.prefill_pos += widths[i]
+            seq.n_valid = int(start[i]) + widths[i]
+            if seq.prefill_pos < len(seq.prompt):
+                continue
+            # prompt fully consumed: sample (or restore) the first token
+            self._prefilling.remove(seq)
+            if seq.pending is None:
+                if self.temperature > 0.0:
+                    key = jax.random.wrap_key_data(
+                        jnp.asarray(seq.key_data))
+                    k0, key = jax.random.split(key)
+                    seq.key_data = np.asarray(jax.random.key_data(key))
+                    first = int(sample_token(
+                        logits[i:i + 1], k0, self.temperature,
+                        self.top_k, self.top_p,
+                    )[0])
+                else:
+                    if logits_host is None:
+                        logits_host = np.asarray(logits)
+                    first = int(np.argmax(logits_host[i]))
+                seq.pending = first
+                self._emit_tokens(seq, [first])
+                emitted += 1
+            seq.state = _Sequence.RUNNING
+            self._active.append(seq)
+        if max(widths) == C:
+            # only adapt on SATURATED ticks: short prompts never use a
+            # wider executable, so probing one would compile it for
+            # nothing (and the wall of an unsaturated tick says nothing
+            # about width-C compute anyway)
+            self._adapt_chunk(C, time.perf_counter() - t0)
+        return emitted
+
+    def _adapt_chunk(self, C: int, wall_s: float) -> None:
+        """Probe the effective prefill chunk upward while ticks stay
+        dispatch-dominated.  Evidence rule: after >= 2 ticks at width C,
+        if doubling from C/2 left the EMA wall under 1.6x (compute would
+        have doubled it), keep probing; if the doubled width is >1.6x
+        slower, shrink back and LATCH — the floor is the configured
+        interleave grain and the ceiling is PREFILL_CHUNK_MAX."""
+        ema = self._chunk_wall.setdefault(C, [wall_s, 0])
+        ema[0] = 0.5 * ema[0] + 0.5 * wall_s
+        ema[1] += 1
+        if self._chunk_latched or ema[1] < 2:
+            return
+        prev = self._chunk_wall.get(C // 2)
+        if C > self.prefill_chunk and prev and ema[0] > 1.6 * prev[0]:
+            self._chunk_eff = C // 2
+            self._chunk_latched = True
+        elif C < self.prefill_chunk_max:
+            self._chunk_eff = min(2 * C, self.prefill_chunk_max)
+        else:
+            self._chunk_latched = True
+
+    # -- decode -----------------------------------------------------------
+
+    def _decode_round(self) -> int:
+        """One ``span``-step decode round for every RUNNING sequence as a
+        single device program; the only host sync is the token readback
+        the streams need anyway."""
+        import jax
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.generate import paged_decode_round_jit
+
+        batch = sorted(self._active, key=lambda s: s.sid)
+        for seq in batch:
+            if seq not in self._active:
+                continue  # preempted by an earlier row's eviction
+            if not self._ensure_capacity(seq, seq.n_valid + self.span):
+                # pool exhausted even after eviction: this sequence is
+                # alone and cannot fit — surface a typed failure
+                self._active.remove(seq)
+                self._finish_error(seq, RuntimeError(
+                    "KV pool too small for sequence length "
+                    f"{seq.n_valid + self.span} (grow "
+                    "SELDON_TPU_GEN_POOL_BLOCKS)"))
+                return 0
+        batch = sorted(self._active, key=lambda s: s.sid)
+        if not batch:
+            return 0
+        B = _pow2(len(batch))
+        nblk = _pow2(max(
+            self._blocks_needed(s.n_valid + self.span) for s in batch))
+        tables = np.zeros((B, nblk), np.int32)
+        token = np.zeros((B,), np.int32)
+        n_valid = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        seen = np.zeros((B,), bool)
+        for i, s in enumerate(batch):
+            tables[i] = self._table(s, nblk)
+            token[i] = s.pending
+            n_valid[i] = s.n_valid
+            active[i] = True
+            seen[i] = (self.eos_token >= 0
+                       and self.eos_token in s.emitted)
+        if self.temperature > 0.0:
+            kd = np.stack([
+                s.key_data if s.key_data is not None
+                else np.zeros_like(batch[0].key_data)
+                for s in batch
+            ] + [np.zeros_like(batch[0].key_data)] * (B - len(batch)))
+            keys = jax.random.wrap_key_data(jnp.asarray(kd))
+        else:
+            keys = jnp.zeros((B,), jnp.uint32)
+        OBSERVATORY.note_padding(len(batch), B)
+        toks, self._pool, _tok, _nv, _seen, keys_out = (
+            paged_decode_round_jit(
+                self.params, self._pool, jnp.asarray(tables),
+                jnp.asarray(token), jnp.asarray(n_valid),
+                jnp.asarray(active), jnp.asarray(seen), keys,
+                self.cfg, span=self.span, temperature=self.temperature,
+                top_k=self.top_k, top_p=self.top_p,
+                eos_token=self.eos_token,
+            )
+        )
+        toks = np.asarray(toks)  # the per-round host sync
+        if self.temperature > 0.0:
+            kd_out = np.asarray(jax.random.key_data(keys_out))
+        emitted = 0
+        for i, s in enumerate(batch):
+            if self.temperature > 0.0:
+                s.key_data = kd_out[i]
+            remaining = s.max_new - len(s.emitted)
+            take = min(self.span, remaining)
+            s.n_valid += self.span
+            s.pending = int(toks[i, -1])
+            self._emit_tokens(s, [int(t) for t in toks[i, :take]])
+            emitted += take
+        return emitted
+
+    def _spec_round(self) -> int:
+        """One speculative draft/verify round for every RUNNING sequence
+        (greedy): up to k+1 tokens per row per device program."""
+        import jax.numpy as jnp
+
+        from seldon_core_tpu.models.generate import paged_spec_round_jit
+
+        W = self.spec_k + 1
+        batch = sorted(self._active, key=lambda s: s.sid)
+        for seq in batch:
+            if seq not in self._active:
+                continue  # preempted by an earlier row's eviction
+            ok = (self._ensure_capacity(seq, seq.n_valid + W)
+                  and self._ensure_capacity(seq, seq.n_valid + W,
+                                            draft=True))
+            if not ok:
+                self._active.remove(seq)
+                self._finish_error(seq, RuntimeError(
+                    "KV pool too small for speculative round (grow "
+                    "SELDON_TPU_GEN_POOL_BLOCKS)"))
+                return 0
+        batch = sorted(self._active, key=lambda s: s.sid)
+        if not batch:
+            return 0
+        B = _pow2(len(batch))
+        nblk = _pow2(max(
+            self._blocks_needed(s.n_valid + W) for s in batch))
+        # draft tables mirror the target's coverage: spec mode forbids
+        # prefix caches, the only source of asymmetry
+        d_nblk = nblk
+        tables = np.zeros((B, nblk), np.int32)
+        d_tables = np.zeros((B, d_nblk), np.int32)
+        token = np.zeros((B,), np.int32)
+        n_valid = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        for i, s in enumerate(batch):
+            tables[i] = self._table(s, nblk)
+            d_tables[i] = self._table(s, d_nblk, draft=True)
+            token[i] = s.pending
+            n_valid[i] = s.n_valid
+            active[i] = True
+        OBSERVATORY.note_padding(len(batch), B)
+        new_toks, gained, corrected, self._pool, self._draft_pool = (
+            paged_spec_round_jit(
+                self.params, self.draft_params, self._pool,
+                self._draft_pool, jnp.asarray(tables),
+                jnp.asarray(d_tables), jnp.asarray(token),
+                jnp.asarray(n_valid), jnp.asarray(active),
+                self.cfg, self.draft_cfg, k=self.spec_k,
+            )
+        )
+        new_toks = np.asarray(new_toks)
+        gained = np.asarray(gained)
+        corrected = np.asarray(corrected)
+        emitted = 0
+        accept_sum, accept_rounds = 0.0, 0
+        for i, s in enumerate(batch):
+            g = int(gained[i])
+            remaining = s.max_new - len(s.emitted)
+            take = min(g, remaining)
+            s.n_valid += g
+            s.pending = int(corrected[i])
+            self._emit_tokens(s, [int(t) for t in new_toks[i, :take]])
+            emitted += take
+            accept_sum += (g - 1) / max(self.spec_k, 1)
+            accept_rounds += 1
+        if accept_rounds:
+            RECORDER.observe_accept_ratio(accept_sum / accept_rounds)
+        return emitted
+
+    # -- emission / retirement --------------------------------------------
+
+    def _emit_tokens(self, seq: _Sequence, toks: List[int]) -> None:
+        if not toks or seq.done:
+            return
+        seq.emitted.extend(toks)
+        if self.eos_token >= 0 and self.eos_token in seq.emitted:
+            # finished early: eos-pad the tail now so assembly never
+            # waits on a retired row (the mask_after_eos output contract)
+            first = seq.emitted.index(self.eos_token)
+            seq.emitted = (
+                seq.emitted[: first + 1]
+                + [self.eos_token] * (seq.max_new - first - 1)
+            )
+            seq.retire_reason = "eos"
+            seq.done = True
+        elif len(seq.emitted) >= seq.max_new:
+            seq.emitted = seq.emitted[: seq.max_new]
+            seq.retire_reason = "length"
+            seq.done = True
+        req = seq.request
+        if not req.ttft_recorded:
+            req.ttft_recorded = True
+            if req.chunk is not None:
+                # TTFT is a STREAMING-lane metric (one observation per
+                # stream, the scheduler is its canonical recorder now);
+                # unary requests only surface total latency
+                RECORDER.observe_ttft(time.perf_counter() - req.t_submit)
+        self._deliver(req)
+
+    def _deliver(self, req: GenRequest) -> None:
+        """Assemble per-request output from the per-row sequences: stream
+        chunks when every row has them, the final array at completion."""
+        if req.cancelled or req.future.done():
+            return
+        if req.chunk is not None:
+            while True:
+                avail = min(len(s.emitted) for s in req.seqs)
+                n = min(req.chunk, req.max_new - req.delivered)
+                if n <= 0 or avail - req.delivered < n:
+                    break
+                arr = np.asarray(
+                    [s.emitted[req.delivered:req.delivered + n]
+                     for s in req.seqs], np.int32)
+                req.delivered += n
+                req.queue.put(arr)
+        if all(s.done for s in req.seqs):
+            out = np.asarray([s.emitted for s in req.seqs], np.int32)
+            elapsed = time.perf_counter() - req.t_submit
+            if req.chunk is not None and elapsed > 0:
+                # like TTFT above: the decode-rate SLO family is fed once
+                # per STREAM (matching the static path, where the unary
+                # lane ran generate(eager=False) and recorded nothing)
+                RECORDER.observe_decode_rate(out.size / elapsed)
+            if not req.future.done():
+                req.future.set_result(out)
+            if req.chunk is not None:
+                req.queue.put(None)
+
+    def _retire_finished(self) -> int:
+        retired = 0
+        for seq in [s for s in self._active if s.done]:
+            self._active.remove(seq)
+            self._retire(seq, seq.retire_reason or "length")
+            retired += 1
+        return retired
+
+    def _retire(self, seq: _Sequence, reason: str) -> None:
+        self._release_blocks(seq)
+        seq.state = _Sequence.DONE
+        self.retired_total[reason] = self.retired_total.get(reason, 0) + 1
+        RECORDER.record_gen_retired(reason)
+        self._deliver(seq.request)
+
+    def _finish_error(self, seq: _Sequence, exc: BaseException) -> None:
+        self._retire(seq, "error")
+        req = seq.request
+        if not req.future.done():
+            req.future.set_exception(exc)
+        try:
+            req.queue.put_nowait(exc)
+        except queue.Full:
+            pass
+        # the request is dead: its sibling rows must not keep decoding
+        # (or holding KV blocks) for a client that already got the error
+        # — _drop_cancelled sweeps them at the next tick
+        req.cancelled = True
+
+    # -- accounting --------------------------------------------------------
+
+    def _publish(self, admitted: int, retired: int, kind: str,
+                 tokens: int, duration_s: float) -> None:
+        alloc = self._allocator
+        used = alloc.used if alloc is not None else 0
+        total = alloc.capacity if alloc is not None else 0
+        hw = alloc.high_water if alloc is not None else 0
+        with self._lock:
+            waiting = len(self._waiting) + len(self._arrivals)
+        inflight = len(self._active) + len(self._prefilling)
+        RECORDER.set_gen_scheduler(
+            inflight=inflight, waiting=waiting, blocks_used=used,
+            blocks_total=total, blocks_high_water=hw,
+        )
+        RECORDER.set_kv_slots(
+            active=used * self.block_size,
+            reserved=(total - used) * self.block_size,
+        )
+        if kind != "idle":
+            RECORDER.record_gen_step(kind)
+        SPINE.record_gen_step(
+            kind=kind, duration_s=duration_s, active=inflight,
+            waiting=waiting, admitted=admitted, retired=retired,
+            blocks_used=used, blocks_total=total, tokens=tokens,
+        )

@@ -93,6 +93,7 @@ HOP_QUEUE = "queue"        # per-caller micro-batch queue wait
 HOP_FLUSH = "flush"        # one stacked flush (occupancy + flush span)
 HOP_DISPATCH = "dispatch"  # one device dispatch (perf + quality + span)
 HOP_QUALITY = "quality"    # per-node quality observation (host/unit lanes)
+HOP_GEN_STEP = "gen_step"  # one continuous-batching scheduler step
 
 
 def _env_float(name: str, default: float) -> float:
@@ -124,6 +125,8 @@ class HotRecord:
         "quality_node", "batch_x", "batch_y",
         "error",          # exception type name of a FAILED dispatch
         "span",           # prebuilt Span (HOP_SPAN only)
+        "gen",            # (admitted, retired, blocks_used, blocks_total,
+                          # tokens) of one scheduler step (HOP_GEN_STEP)
     )
 
     def __init__(self, hop: str, flags: int):
@@ -151,6 +154,7 @@ class HotRecord:
         self.batch_y = None
         self.error = None
         self.span = None
+        self.gen = None
 
 
 class ThreadRing:
@@ -485,6 +489,45 @@ class TelemetrySpine:
             rows=rows, real_rows=rows, method=method, error=error,
         )
 
+    def record_gen_step(
+        self,
+        *,
+        kind: str,
+        duration_s: float,
+        active: int,
+        waiting: int,
+        admitted: int,
+        retired: int,
+        blocks_used: int,
+        blocks_total: int,
+        tokens: int,
+    ) -> bool:
+        """ONE record per continuous-batching scheduler step
+        (runtime/genserver.py): the step picture — kind, in-flight/
+        waiting sequences, admission/retirement flow, paged-KV-pool
+        occupancy, tokens emitted — lands in the ring and folds into a
+        ``gen_step`` tracer span off-path.  The scheduler sets its gauges
+        directly (one set per step is batcher-precedent cheap); this
+        record exists so traces and the hop accounting see the scheduler
+        the way they see every other hop."""
+        want_trace = TRACER.enabled and (
+            TRACER.sample >= 1.0 or self._rng.random() < TRACER.sample
+        )
+        flags = (WANT_RECORDER if self.telemetry_enabled else 0) | (
+            WANT_TRACE if want_trace else 0
+        )
+        if not flags:
+            return False
+        rec = HotRecord(HOP_GEN_STEP, flags)
+        rec.kind = kind
+        rec.rows = int(active)
+        rec.requests = int(waiting)
+        rec.start_s = time.time() - duration_s
+        rec.duration_s = float(duration_s)
+        rec.gen = (int(admitted), int(retired), int(blocks_used),
+                   int(blocks_total), int(tokens))
+        return self._append(rec)
+
     def record_quality(self, node: str, X, Y,
                        real_rows: Optional[int] = None) -> bool:
         """Host-mode / unit-pod quality hop: per-node batch references,
@@ -609,6 +652,26 @@ class TelemetrySpine:
                     puid="", name="flush", kind="batch", method="dispatch",
                     start_s=rec.start_s, duration_ms=rec.duration_s * 1e3,
                     attrs={"rows": rec.rows, "requests": rec.requests},
+                    span_id=new_span_id(),
+                ))
+                self.fold_cost["tracer"].observe(pc() - t0)
+            return
+        if rec.hop == HOP_GEN_STEP:
+            # gauges/counters were set by the scheduler itself (one call
+            # per step); the fold's job is the TRACE face of the step
+            if rec.flags & WANT_TRACE:
+                t0 = pc()
+                admitted, retired, used, total, tokens = rec.gen
+                TRACER._fold(Span(
+                    puid="", name="gen_step", kind="gen_step",
+                    method=rec.kind, start_s=rec.start_s,
+                    duration_ms=rec.duration_s * 1e3,
+                    attrs={
+                        "active": rec.rows, "waiting": rec.requests,
+                        "admitted": admitted, "retired": retired,
+                        "kv_blocks_used": used, "kv_blocks_total": total,
+                        "tokens": tokens,
+                    },
                     span_id=new_span_id(),
                 ))
                 self.fold_cost["tracer"].observe(pc() - t0)

@@ -114,6 +114,17 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_telemetry_ring_dropped_total": ("counter", ()),
     "seldon_tpu_telemetry_records_total": ("counter", ("hop",)),
     "seldon_tpu_framework_overhead_ms": ("gauge", ("subsystem",)),
+    # continuous-batching generation scheduler (runtime/genserver.py):
+    # in-flight/waiting sequence counts, paged-KV-pool occupancy
+    # (state=used|total|high_water — the SeldonTPUKVPoolPressure alert
+    # compares used against total), admission/retirement flow, and
+    # scheduler steps by kind (prefill|decode|spec|mixed)
+    "seldon_tpu_gen_inflight_sequences": ("gauge", ()),
+    "seldon_tpu_gen_waiting_sequences": ("gauge", ()),
+    "seldon_tpu_gen_kv_blocks": ("gauge", ("state",)),
+    "seldon_tpu_gen_admitted_total": ("counter", ()),
+    "seldon_tpu_gen_retired_total": ("counter", ("reason",)),
+    "seldon_tpu_gen_steps_total": ("counter", ("kind",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -250,6 +261,12 @@ class FlightRecorder:
         # framework-overhead p50s behind GET /overhead)
         self.telemetry_ring_dropped = 0
         self.telemetry_records: Dict[str, int] = {}    # hop -> folded
+        # continuous-batching generation scheduler mirrors
+        # (runtime/genserver.py feeds these once per scheduler step)
+        self.gen_scheduler: Dict[str, int] = {}
+        self.gen_admitted = 0
+        self.gen_retired: Dict[str, int] = {}
+        self.gen_steps: Dict[str, int] = {}
         # Prometheus high-water mark per hop: the counter is advanced by
         # deltas against THIS, not the snapshot mirror above — reset()
         # clears the mirror but must not rewind the monotone counter's
@@ -441,6 +458,36 @@ class FlightRecorder:
                 "(ring), and the per-request framework estimate (total) "
                 "judged against SELDON_TPU_OVERHEAD_BUDGET_MS",
                 ["subsystem"], registry=self.registry)
+            self._p_gen_inflight = Gauge(
+                "seldon_tpu_gen_inflight_sequences",
+                "Sequences riding the continuous-batching generation "
+                "scheduler (prefilling + decoding — runtime/genserver.py)",
+                registry=self.registry)
+            self._p_gen_waiting = Gauge(
+                "seldon_tpu_gen_waiting_sequences",
+                "Sequences queued for admission into the generation "
+                "scheduler (free slot or free KV blocks pending)",
+                registry=self.registry)
+            self._p_gen_kv_blocks = Gauge(
+                "seldon_tpu_gen_kv_blocks",
+                "Paged KV-pool blocks by state (used / total / "
+                "high_water); used/total is the pool pressure the "
+                "SeldonTPUKVPoolPressure alert watches",
+                ["state"], registry=self.registry)
+            self._p_gen_admitted = Counter(
+                "seldon_tpu_gen_admitted_total",
+                "Sequences admitted into the in-flight decode batch",
+                registry=self.registry)
+            self._p_gen_retired = Counter(
+                "seldon_tpu_gen_retired_total",
+                "Sequences retired from the scheduler, by reason "
+                "(eos / length / cancelled / preempted / error)",
+                ["reason"], registry=self.registry)
+            self._p_gen_steps = Counter(
+                "seldon_tpu_gen_steps_total",
+                "Scheduler steps executed, by kind (prefill / decode / "
+                "spec / mixed)",
+                ["kind"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -489,6 +536,50 @@ class FlightRecorder:
         if self.registry is not None:
             for k, v in states.items():
                 self._p_kv.labels(state=k).set(v)
+
+    # -- continuous-batching generation scheduler (runtime/genserver.py) -
+
+    def set_gen_scheduler(self, *, inflight: int, waiting: int,
+                          blocks_used: int, blocks_total: int,
+                          blocks_high_water: int) -> None:
+        """Point-in-time scheduler picture, refreshed once per scheduler
+        step: in-flight/waiting sequences + paged-KV-pool occupancy."""
+        self._gen += 1
+        with self._lock:
+            self.gen_scheduler.update({
+                "inflight": int(inflight), "waiting": int(waiting),
+                "blocks_used": int(blocks_used),
+                "blocks_total": int(blocks_total),
+                "blocks_high_water": int(blocks_high_water),
+            })
+        if self.registry is not None:
+            self._p_gen_inflight.set(inflight)
+            self._p_gen_waiting.set(waiting)
+            self._p_gen_kv_blocks.labels(state="used").set(blocks_used)
+            self._p_gen_kv_blocks.labels(state="total").set(blocks_total)
+            self._p_gen_kv_blocks.labels(state="high_water").set(
+                blocks_high_water)
+
+    def record_gen_admitted(self, n: int = 1) -> None:
+        self._gen += 1
+        with self._lock:
+            self.gen_admitted += int(n)
+        if self.registry is not None:
+            self._p_gen_admitted.inc(n)
+
+    def record_gen_retired(self, reason: str, n: int = 1) -> None:
+        self._gen += 1
+        with self._lock:
+            self.gen_retired[reason] = self.gen_retired.get(reason, 0) + n
+        if self.registry is not None:
+            self._p_gen_retired.labels(reason=reason).inc(n)
+
+    def record_gen_step(self, kind: str, n: int = 1) -> None:
+        self._gen += 1
+        with self._lock:
+            self.gen_steps[kind] = self.gen_steps.get(kind, 0) + n
+        if self.registry is not None:
+            self._p_gen_steps.labels(kind=kind).inc(n)
 
     # -- compile cache / audit accounting -------------------------------
 
@@ -762,6 +853,12 @@ class FlightRecorder:
             self.drain_hook()
         with self._lock:
             kv = dict(self.kv_slots)
+            gen_sched = {
+                "scheduler": dict(self.gen_scheduler),
+                "admitted": self.gen_admitted,
+                "retired": dict(self.gen_retired),
+                "steps": dict(self.gen_steps),
+            }
             cc = dict(self.compile_cache_events)
             latency_keys = list(self._latency)
             resilience = {
@@ -816,6 +913,7 @@ class FlightRecorder:
                 "decode_tokens_per_s": self.decode_rate.snapshot(),
                 "speculative_accept_ratio": self.accept_ratio.snapshot(),
                 "kv_cache_slots": kv,
+                "continuous": gen_sched,
             },
             "compile_cache_events": cc,
             "trace_spans": trace_spans,
@@ -904,6 +1002,10 @@ class FlightRecorder:
             self.telemetry_ring_dropped = 0
             self.telemetry_records = {}
             self.framework_overhead = {}
+            self.gen_scheduler = {}
+            self.gen_admitted = 0
+            self.gen_retired = {}
+            self.gen_steps = {}
 
 
 RECORDER = FlightRecorder()

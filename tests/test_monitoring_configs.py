@@ -121,5 +121,12 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_outlier_exceedances_total",
         "seldon_tpu_slo_burn_rate",
         "seldon_tpu_quality_sampled_total",
+        # continuous-batching generation scheduler (runtime/genserver.py)
+        "seldon_tpu_gen_inflight_sequences",
+        "seldon_tpu_gen_waiting_sequences",
+        "seldon_tpu_gen_kv_blocks",
+        "seldon_tpu_gen_admitted_total",
+        "seldon_tpu_gen_retired_total",
+        "seldon_tpu_gen_steps_total",
     ):
         assert family in text, f"{family} missing from every dashboard"
