@@ -36,6 +36,13 @@ perf-demo:
 quality-demo:
 	python scripts/quality_demo.py --out quality_demo
 
+# scale-out demo: gateway + 2 engine replicas, one deterministically slow
+# (testing/faults.py FaultyEngine) — asserts the power-of-two-choices
+# balancer steers away from it and that SELDON_TPU_REPLICAS=0 restores
+# the single-engine path (scale_demo/scale.json artifact)
+scale-demo:
+	python scripts/scale_demo.py --out scale_demo
+
 bench:
 	python bench.py
 
@@ -96,4 +103,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun

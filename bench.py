@@ -654,6 +654,301 @@ def probe_spec(smoke: bool) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+def probe_replicas(smoke: bool) -> dict:
+    """Horizontal scale-out arm: same-host REST qps at 1/2/4 engine
+    replicas behind the gateway's p2c balancer, plus the UDS-vs-TCP relay
+    lane comparison — subprocess, CPU engines (this arm measures the DATA
+    PLANE, not the device).  A failed arm reports its error instead of
+    aborting the bench: every other phase's keys still land."""
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_probe_replicas"]
+        + (["--smoke"] if smoke else []),
+        capture_output=True, text=True, cwd=REPO, timeout=1800,
+    )
+    if out.returncode != 0:
+        print(f"replica probe failed: {out.stderr[-2000:]}", file=sys.stderr)
+        return {"replica_probe_error": (out.stderr or "no output")[-300:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+class _CpuEngine:
+    """One CPU-pinned engine process on the Python fast lane — the
+    replica-probe worker (N of these coexist on one host; the TPU engine
+    class above assumes it owns the chip)."""
+
+    def __init__(self, rest_port: int, uds_path: str = ""):
+        self.tmp = tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        )
+        json.dump(STUB_DEPLOYMENT, self.tmp)
+        self.tmp.flush()
+        self.log = tempfile.NamedTemporaryFile(
+            "w+", suffix=".log", delete=False
+        )
+        env = dict(os.environ)
+        env.update({
+            "SELDON_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "ENGINE_HTTP_IMPL": "fast", "ENGINE_GRPC_IMPL": "fast",
+            "ENGINE_PREWARM_WIDTHS": "1", "ENGINE_MAX_BATCH": "256",
+            "ENGINE_BATCH_WAIT_MS": "0.5",
+        })
+        if uds_path:
+            env["ENGINE_UDS_PATH"] = uds_path
+        self.port = rest_port
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "seldon_core_tpu.runtime.engine_main",
+             "--file", self.tmp.name, "--host", "127.0.0.1",
+             "--rest-port", str(rest_port), "--grpc-port",
+             str(rest_port + 1000)],
+            stdout=self.log, stderr=subprocess.STDOUT, env=env, cwd=REPO,
+        )
+
+    def wait_up(self, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with open(self.log.name) as f:
+                text = f.read()
+            if "engine up" in text:
+                return
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"replica engine died at boot:\n{text}")
+            time.sleep(0.5)
+        raise RuntimeError("replica engine boot timed out")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        os.unlink(self.tmp.name)
+
+
+def _replica_probe_main(smoke: bool) -> None:
+    """Measure the two tentpole claims of the scale-out data plane:
+
+      * ``rest_qps_scaling`` — closed-loop qps through the gateway's
+        power-of-two-choices balancer at 1 -> 2 -> 4 same-host engine
+        replicas, under zipf-skewed request sizes (a heavy-tailed row
+        count per request — the load shape where blind rotation herds
+        onto whichever replica got the fat request).  Per-replica pick
+        and inflight spread ride along so an imbalance EXPLAINS a flat
+        curve instead of being asserted away.
+      * ``relay_uds_vs_tcp_x`` — p50 of the same unary predict against
+        the same engine over loopback TCP (HTTP head composition +
+        header re-parse) vs the zero-copy length-prefixed UDS lane
+        (runtime/udsrelay.py).
+
+    CPU engines on the Python fast lane: this arm prices the gateway ->
+    engine hop and the balancer, not the device; a TPU under the stub
+    graph would only add relay noise to both lanes equally."""
+    import asyncio
+
+    import numpy as np
+
+    n_max = 2 if smoke else 4
+    duration = 2.0 if smoke else 6.0
+    workers = 16 if smoke else 32
+    base_port = 18980
+    uds_dir = tempfile.mkdtemp(prefix="seldon-uds-")
+    uds_path = os.path.join(uds_dir, "engine0.sock")
+    engines = [
+        _CpuEngine(base_port + i, uds_path=uds_path if i == 0 else "")
+        for i in range(n_max)
+    ]
+    try:
+        for e in engines:
+            e.wait_up()
+        urls = [f"http://127.0.0.1:{e.port}" for e in engines]
+        doc = asyncio.run(_replica_probe_async(
+            urls, uds_path, duration, workers, np
+        ))
+    finally:
+        for e in engines:
+            e.stop()
+        try:
+            os.unlink(uds_path)
+        except OSError:
+            pass
+        try:
+            os.rmdir(uds_dir)
+        except OSError:
+            pass
+    print(json.dumps(doc))
+
+
+async def _replica_probe_async(urls, uds_path, duration, workers, np):
+    import asyncio
+
+    from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.messages import SeldonMessage
+
+    spec = SeldonDeploymentSpec.from_json_dict(STUB_DEPLOYMENT)
+    rng = np.random.default_rng(0)
+    # zipf-skewed request sizes, clipped to the contract's batch cap:
+    # most requests are 1-row, the tail is 100x heavier — the imbalance-
+    # inducing shape (pre-generated so payload synthesis is off-clock)
+    rows = np.minimum(rng.zipf(1.5, size=4096), 128)
+    payloads = {
+        int(r): json.dumps(
+            {"data": {"ndarray": [[0.0]] * int(r)}}, separators=(",", ":")
+        )
+        for r in set(rows.tolist())
+    }
+
+    # warm EVERY engine over EVERY distinct payload bucket before any
+    # timed config: the zipf tail's pad buckets otherwise compile inside
+    # whichever config sees them first (the shared disk compile cache
+    # makes that the FIRST config of the FIRST run — inflating every
+    # later scaling ratio)
+    import aiohttp
+
+    async with aiohttp.ClientSession() as warm_session:
+        for url in urls:
+            for body in payloads.values():
+                async with warm_session.post(
+                    url + "/api/v0.1/predictions", data=body
+                ) as r:
+                    await r.read()
+
+    async def drive(n_replicas: int) -> dict:
+        store = DeploymentStore()
+        store.register(spec, {"main": urls[:n_replicas]})
+        gateway = ApiGateway(store, require_auth=False)
+        counts = [0]
+        stop_at = [0.0]
+        spread_samples = []
+
+        async def worker(wid: int):
+            i = wid
+            while time.perf_counter() < stop_at[0]:
+                payload = payloads[int(rows[i % len(rows)])]
+                i += workers
+                msg = SeldonMessage.from_json(payload)
+                resp = await gateway.predict(msg)
+                if resp.status is not None and \
+                        resp.status.status == "FAILURE":
+                    raise RuntimeError(
+                        f"gateway predict failed: {resp.status.reason}"
+                    )
+                counts[0] += 1
+
+        async def sample_spread():
+            # mid-run inflight imbalance, the figure the
+            # SeldonTPUReplicaImbalance alert watches (max/mean of
+            # gateway-side per-replica inflight)
+            while time.perf_counter() < stop_at[0]:
+                for (_d, _p), (_fp, rs) in gateway._replica_sets.items():
+                    inflight = [ep.inflight for ep in rs.endpoints]
+                    mean = sum(inflight) / len(inflight)
+                    if mean > 0:
+                        spread_samples.append(max(inflight) / mean)
+                await asyncio.sleep(0.02)
+
+        # warm every replica's session + compile path off-clock
+        warm_deadline = time.perf_counter() + 1.0
+        stop_at[0] = warm_deadline
+        await asyncio.gather(*(worker(i) for i in range(4)))
+        counts[0] = 0
+        stop_at[0] = time.perf_counter() + duration
+        tasks = [worker(i) for i in range(workers)]
+        if n_replicas > 1:
+            tasks.append(sample_spread())
+        t0 = time.perf_counter()
+        await asyncio.gather(*tasks)
+        dt = time.perf_counter() - t0
+        snap = gateway.stats()["replicas"]
+        await gateway.close()
+        picks = [
+            ep["picks"]
+            for s in snap.values() for ep in s["endpoints"]
+        ]
+        mispicks = sum(s["mispicks"] for s in snap.values())
+        return {
+            "qps": counts[0] / dt,
+            "pick_spread": (
+                round(max(picks) / (sum(picks) / len(picks)), 3)
+                if picks and sum(picks) else None
+            ),
+            # time-averaged max/mean of per-replica inflight — sustained
+            # imbalance (the alert's axis); p95 rides along as the
+            # transient-burst view
+            "inflight_spread": (
+                round(float(np.mean(spread_samples)), 3)
+                if spread_samples else None
+            ),
+            "inflight_spread_p95": (
+                round(float(np.percentile(spread_samples, 95)), 3)
+                if spread_samples else None
+            ),
+            "mispick_ratio": (
+                round(mispicks / max(sum(picks), 1), 4)
+                if sum(picks) else None
+            ),
+        }
+
+    series = [1, 2] if len(urls) < 4 else [1, 2, 4]
+    scaling = {}
+    for n in series:
+        scaling[n] = await drive(n)
+
+    # ---- UDS vs TCP relay lanes: same engine, same payload ------------
+    from seldon_core_tpu.runtime.udsrelay import OP_PREDICT, UdsRelayClient
+
+    payload = json.dumps({"data": {"ndarray": [[0.0]]}})
+    reps = 100 if duration < 3 else 300
+    lat_tcp = []
+    async with aiohttp.ClientSession() as session:
+        url = urls[0] + "/api/v0.1/predictions"
+        for _ in range(10):  # warm the connection + engine path
+            async with session.post(url, data=payload) as r:
+                await r.read()
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            async with session.post(url, data=payload) as r:
+                await r.read()
+            lat_tcp.append(time.perf_counter() - t0)
+    client = UdsRelayClient(uds_path)
+    lat_uds = []
+    body = payload.encode()
+    for _ in range(10):
+        await client.call(OP_PREDICT, body)
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        await client.call(OP_PREDICT, body)
+        lat_uds.append(time.perf_counter() - t0)
+    await client.close()
+    tcp_p50 = float(np.percentile(lat_tcp, 50) * 1e3)
+    uds_p50 = float(np.percentile(lat_uds, 50) * 1e3)
+
+    base = scaling[series[0]]["qps"]
+    top = scaling[series[-1]]
+    return {
+        "rest_qps_scaling": {
+            str(n): round(s["qps"], 1) for n, s in scaling.items()
+        },
+        "rest_qps_scaling_2x": round(scaling[2]["qps"] / base, 2),
+        **(
+            {"rest_qps_scaling_4x": round(scaling[4]["qps"] / base, 2)}
+            if 4 in scaling else {}
+        ),
+        "replica_pick_spread": top["pick_spread"],
+        "replica_inflight_max_over_mean": top["inflight_spread"],
+        "replica_inflight_max_over_mean_p95": top["inflight_spread_p95"],
+        "replica_mispick_ratio": top["mispick_ratio"],
+        "relay_tcp_p50_ms": round(tcp_p50, 3),
+        "relay_uds_p50_ms": round(uds_p50, 3),
+        # >1 = the zero-copy lane beats loopback TCP on the same box
+        "relay_uds_vs_tcp_x": round(tcp_p50 / uds_p50, 2),
+        # the scaling ceiling on a small host is the host itself: N CPU
+        # engines + gateway + load driver share these cores, so read the
+        # curve against this number (docs/benchmarking.md)
+        "replica_host_cores": _host_cores(),
+    }
+
+
 def _probe_spec_main(smoke: bool) -> None:
     """Speculative decoding measured honestly in BOTH regimes:
 
@@ -1609,6 +1904,7 @@ def main() -> None:
     parser.add_argument("--_probe", action="store_true")
     parser.add_argument("--_probe_mfu", action="store_true")
     parser.add_argument("--_probe_spec", action="store_true")
+    parser.add_argument("--_probe_replicas", action="store_true")
     parser.add_argument(
         "--overhead-gate", action="store_true",
         help="run only the telemetry overhead budget check (all "
@@ -1638,6 +1934,9 @@ def main() -> None:
         return
     if args._probe_spec:
         _probe_spec_main(args.smoke)
+        return
+    if args._probe_replicas:
+        _replica_probe_main(args.smoke)
         return
     duration = args.duration or (3.0 if args.smoke else 8.0)
 
@@ -1746,6 +2045,15 @@ def main() -> None:
             "served_gen_efficiency_pct"),
     )
 
+    # ---- horizontal scale-out arm (CPU engines; data-plane axis) ---------
+    scale = probe_replicas(args.smoke)
+    emit_partial(
+        rest_qps_scaling_2x=scale.get("rest_qps_scaling_2x"),
+        relay_uds_vs_tcp_x=scale.get("relay_uds_vs_tcp_x"),
+        replica_inflight_max_over_mean=scale.get(
+            "replica_inflight_max_over_mean"),
+    )
+
     # ---- real model: MNIST MLP ------------------------------------------
     # plus two attribution controls that isolate the stub-vs-mnist gap:
     #   names removed (bare 784-double payload, SAME TPU engine)
@@ -1816,8 +2124,13 @@ def main() -> None:
         # 256 closed-loop clients against a ~105 ms relay floor cap out at
         # 256/0.105 ~= 2.4k req/s REGARDLESS of server speed — this row is
         # the reference-matched client count, not a server limit; the
-        # saturation row above is the server capacity figure
-        "rest_256_relay_cap_qps": round(256 / (probe["relay_floor_ms"] / 1e3), 0),
+        # saturation row above is the server capacity figure.  A failed or
+        # partial probe emits null here instead of KeyErroring the whole
+        # summary out of the artifact.
+        "rest_256_relay_cap_qps": (
+            round(256 / (probe["relay_floor_ms"] / 1e3), 0)
+            if probe.get("relay_floor_ms") else None
+        ),
         "grpc_max_qps_clients": grpc_peak_c,
         "grpc_max_qps_p50_ms": grpc_peak["p50_ms"],
         "grpc_256_qps": stub_grpc[256]["qps"],
@@ -1850,6 +2163,7 @@ def main() -> None:
         **mfu,
         **spec,
         **served_gen,
+        **scale,
         "duration_s": duration,
     }
     # full artifact to disk; compact machine line LAST on stdout
@@ -1872,6 +2186,9 @@ def main() -> None:
         "kv_pool_high_water_blocks",
         "span_framework_p50_ms", "overhead_within_budget",
         "relay_floor_ms", "model_params_m", "lm_config",
+        "rest_qps_scaling_2x", "rest_qps_scaling_4x",
+        "replica_inflight_max_over_mean", "relay_tcp_p50_ms",
+        "relay_uds_p50_ms", "relay_uds_vs_tcp_x",
     ]
     compact = {k: result[k] for k in compact_keys if k in result}
     compact["full_artifact"] = "BENCH_FULL.json"

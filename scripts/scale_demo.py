@@ -1,0 +1,183 @@
+"""Scale-out demo: a gateway balancing two engine replicas, one of them
+deterministically slow — proof the power-of-two-choices balancer steers.
+
+Boots (all in-process, CPU, deterministic — no TPU required):
+
+  * two ``EngineService`` replicas over the same single-model graph, the
+    second wrapped in ``testing/faults.FaultyEngine`` with a fixed
+    per-call delay — the "sick pod" every production fleet eventually
+    grows;
+  * an ``ApiGateway`` with both replicas registered as one replica set
+    (``gateway/balancer.py``), driven by N concurrent closed-loop
+    workers.
+
+Then ASSERTS (exit 1 on failure — the lane is non-blocking in CI but the
+artifact says pass/fail loudly):
+
+  1. the slow replica's pick share collapses well below the 50% blind
+     rotation would give it (p2c reads EWMA latency + inflight, so the
+     slow replica loses every sampled pairing once its EWMA climbs);
+  2. ``SELDON_TPU_REPLICAS=0`` (the kill switch) restores the
+     single-engine path: every pick lands on replica 0, no decisions
+     recorded.
+
+Artifacts:
+
+    <out>/scale.json   pick/inflight/EWMA table per replica, steering
+                       ratio, kill-switch check, mispick accounting
+    <out>/stats.json   the gateway /stats snapshot (replicas block)
+
+Run via ``make scale-demo``; CI uploads the artifact from a non-blocking
+lane, mirroring ``trace-demo`` / ``perf-demo`` / ``quality-demo``."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+
+# script lives in scripts/ — put the repo root on the path (sys.path
+# otherwise starts at scripts/ and the package import fails)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_FEATURES = 8
+SLOW_DELAY_S = 0.03
+
+
+def deployment() -> dict:
+    return {
+        "spec": {
+            "name": "scale-demo",
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "type": "MODEL"},
+                "components": [{
+                    "name": "m", "runtime": "inprocess",
+                    "class_path": "SigmoidPredictor",
+                    "parameters": [
+                        {"name": "n_features",
+                         "value": str(N_FEATURES), "type": "INT"},
+                    ],
+                }],
+            }],
+        }
+    }
+
+
+async def drive(gateway, n_requests: int, workers: int) -> None:
+    from seldon_core_tpu.messages import SeldonMessage
+
+    rng = np.random.default_rng(0)
+    payloads = [
+        json.dumps({"data": {
+            "ndarray": rng.normal(size=(2, N_FEATURES)).tolist()
+        }})
+        for _ in range(16)
+    ]
+
+    async def worker(wid: int) -> None:
+        for i in range(n_requests // workers):
+            msg = SeldonMessage.from_json(payloads[(wid + i) % 16])
+            resp = await gateway.predict(msg)
+            assert resp.status is None or resp.status.status != "FAILURE", (
+                resp.status and resp.status.reason
+            )
+
+    await asyncio.gather(*(worker(w) for w in range(workers)))
+
+
+async def run_demo(out_dir: str, n_requests: int) -> dict:
+    from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.testing.faults import FaultSpec, FaultyEngine
+
+    spec = SeldonDeploymentSpec.from_json_dict(deployment())
+    fast = EngineService(spec, max_batch=32, max_wait_ms=0.5)
+    slow = FaultyEngine(
+        EngineService(spec, max_batch=32, max_wait_ms=0.5),
+        FaultSpec(delay_s=SLOW_DELAY_S),
+    )
+    store = DeploymentStore()
+    store.register(spec, {"p": [fast, slow]})
+    gateway = ApiGateway(store, require_auth=False)
+
+    await drive(gateway, n_requests, workers=8)
+    stats = gateway.stats()
+    snap = stats["replicas"]["scale-demo/p"]
+    picks = [ep["picks"] for ep in snap["endpoints"]]
+    ewma = [ep["ewma_ms"] for ep in snap["endpoints"]]
+    total = sum(picks)
+    slow_share = picks[1] / total if total else 1.0
+    steered = slow_share < 0.3  # blind rotation would give it 0.5
+
+    # kill switch: every pick must land on replica 0, no p2c decisions
+    os.environ["SELDON_TPU_REPLICAS"] = "0"
+    try:
+        before = [ep["picks"] for ep in
+                  gateway.stats()["replicas"]["scale-demo/p"]["endpoints"]]
+        await drive(gateway, 32, workers=4)
+        after = [ep["picks"] for ep in
+                 gateway.stats()["replicas"]["scale-demo/p"]["endpoints"]]
+    finally:
+        del os.environ["SELDON_TPU_REPLICAS"]
+    kill_switch_ok = after[0] == before[0] and after[1] == before[1]
+
+    doc = {
+        "requests": n_requests,
+        "slow_replica_delay_ms": SLOW_DELAY_S * 1e3,
+        "picks": picks,
+        "ewma_ms": ewma,
+        "slow_pick_share": round(slow_share, 4),
+        "steered": steered,
+        "mispicks": snap["mispicks"],
+        "inflight_max_over_mean": snap["inflight_max_over_mean"],
+        "kill_switch_single_path": kill_switch_ok,
+        "passed": steered and kill_switch_ok,
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "scale.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+    with open(os.path.join(out_dir, "stats.json"), "w") as f:
+        json.dump(stats, f, indent=1)
+    await gateway.close()
+    await fast.close()
+    await slow.inner.close()
+    return doc
+
+
+def print_table(doc: dict) -> None:
+    print("%-12s %8s %10s" % ("replica", "picks", "ewma_ms"))
+    for i, (p, e) in enumerate(zip(doc["picks"], doc["ewma_ms"])):
+        tag = " (slow: +%.0f ms injected)" % doc["slow_replica_delay_ms"] \
+            if i == 1 else ""
+        print("%-12s %8d %10.2f%s" % (f"replica-{i}", p, e, tag))
+    print(
+        f"slow replica pick share: {doc['slow_pick_share']:.1%} "
+        f"(blind rotation = 50%; steered = {doc['steered']})"
+    )
+    print(f"mispicks: {doc['mispicks']}, "
+          f"inflight max/mean: {doc['inflight_max_over_mean']}")
+    print(f"kill switch single-path: {doc['kill_switch_single_path']}")
+    print("PASSED" if doc["passed"] else "FAILED")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="scale_demo")
+    parser.add_argument("--requests", type=int, default=256)
+    args = parser.parse_args(argv)
+    doc = asyncio.run(run_demo(args.out, args.requests))
+    print_table(doc)
+    print(f"\nartifact: {args.out}/scale.json "
+          f"(docs/operations.md 'scaling out the data plane')")
+    if not doc["passed"]:
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,17 +12,26 @@ Responsibilities mirrored from §2.3 of the survey:
     predictors the gateway splits traffic by replica weight — the TPU-native
     form of the reference's canary pattern (2 predictors, replica-weighted
     k8s service routing, docs/crd/readme.md).
+  * Engine replica sets: each predictor may register N engine endpoints;
+    within the weight-chosen predictor the gateway balances by
+    power-of-two-choices over live inflight/EWMA-latency scores with
+    passive /stats-scrape health (gateway/balancer.py).  The chosen
+    replica and both candidates' scores ride the request span so every
+    routing decision is auditable.
   * Request/response firehose publish, fire-and-forget (gateway/firehose.py).
   * Ingress metrics (seldon_api_ingress_server_requests_*).
 
 Targets are in-process ``EngineService``s (the common case: gateway and
-engines share the host) or remote engine base URLs.
+engines share the host), remote engine base URLs, ``uds:`` socket paths
+(the runtime/udsrelay.py zero-copy lane for co-located engines), or lists
+of any of those (a replica set).
 """
 
 from __future__ import annotations
 
 import asyncio
 import base64
+import random
 import secrets
 import time
 from dataclasses import dataclass, field
@@ -30,9 +39,21 @@ from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from seldon_core_tpu.gateway.balancer import (
+    PickDecision,
+    ReplicaEndpoint,
+    ReplicaSet,
+    parse_endpoint_spec,
+    replicas_enabled,
+    scrape_interval_s,
+    uds_enabled,
+)
+
 from seldon_core_tpu.gateway.firehose import Firehose
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.messages import Feedback, SeldonMessage, SeldonMessageError
+from seldon_core_tpu.runtime.udsrelay import OP_FEEDBACK, OP_PREDICT
+from seldon_core_tpu.utils.telemetry import RECORDER
 # importing the spine at module load wires the global TRACER's ring sink
 # BEFORE the gateway serves its first request — a gateway-only process
 # must not flip span routing mid-serving when someone first polls
@@ -61,7 +82,10 @@ class _Registration:
     deployment_id: str
     oauth_key: str
     oauth_secret: str
-    engines: List  # [(predictor_name, weight, EngineService | base_url)]
+    #: [(predictor_name, weight, engine)] where engine is an
+    #: EngineService, an endpoint spec string (base URL / ``uds:`` path /
+    #: ``url+uds:path``), or a LIST of those — a replica set
+    engines: List
 
 
 class DeploymentStore:
@@ -72,6 +96,7 @@ class DeploymentStore:
     def __init__(self):
         self._by_key: Dict[str, _Registration] = {}
         self._tokens: Dict[str, Tuple[str, float]] = {}  # token -> (key, expiry)
+        self._revision = 0
 
     def register(
         self,
@@ -92,12 +117,20 @@ class DeploymentStore:
             oauth_secret=spec.oauth_secret,
             engines=weighted,
         )
+        self._revision += 1
 
     def unregister(self, oauth_key: str) -> None:
         self._by_key.pop(oauth_key, None)
         self._tokens = {
             t: (k, exp) for t, (k, exp) in self._tokens.items() if k != oauth_key
         }
+        self._revision += 1
+
+    def revision(self) -> int:
+        """Monotone registration-change counter: bumps on every register
+        and unregister, including a re-registration of the SAME deployment
+        (whose content may have changed) — the gateway's prune gate."""
+        return self._revision
 
     # -- auth ---------------------------------------------------------------
 
@@ -151,7 +184,16 @@ class ApiGateway:
         self.require_auth = require_auth
         self.metrics = MetricsRegistry(deployment_name="gateway")
         self._rng = np.random.default_rng(seed)
+        self._seed = seed
         self._session = None  # lazy shared aiohttp session (remote engines)
+        # replica sets built lazily per (deployment, predictor) from the
+        # registration's engines entry; rebuilt when a re-registration
+        # changes the endpoint list.  The scrape task feeds their passive
+        # health off the engines' /stats surfaces.
+        self._replica_sets: Dict[Tuple[str, str], Tuple[tuple, ReplicaSet]] = {}
+        self._uds_clients: Dict[str, object] = {}
+        self._scrape_task: Optional[asyncio.Task] = None
+        self._pruned_for = None  # store-change marker at last prune
         # feedback ingress accounting: engines may live in other
         # processes, so the gateway keeps its own view of the reward
         # stream it routed (surfaced in /stats; the process-global
@@ -173,20 +215,96 @@ class ApiGateway:
             raise AuthError("auth disabled but no unique deployment registered")
         return regs[0]
 
-    def _pick_engine(self, reg: _Registration, predictor: Optional[str] = None):
-        """Replica-weighted predictor choice (canary traffic split)."""
+    def _replica_set(self, reg: _Registration, predictor_name: str,
+                     engine) -> ReplicaSet:
+        """The (cached) ReplicaSet behind one predictor's engines entry.
+        The fingerprint catches re-registrations that changed the endpoint
+        list — the set (and its learned EWMA state) is rebuilt only then."""
+        targets = (
+            list(engine) if isinstance(engine, (list, tuple)) else [engine]
+        )
+        # the fingerprint holds the TARGETS themselves: strings compare
+        # by value (same-URL re-registration keeps learned EWMA state),
+        # objects by identity — and the strong reference means a freed
+        # engine's address can never be recycled into a false cache hit
+        # (id() alone allowed exactly that)
+        fp = tuple(targets)
+        key = (reg.deployment_id, predictor_name)
+        cached = self._replica_sets.get(key)
+        if cached is None or cached[0] != fp:
+            rs = ReplicaSet(
+                targets,
+                # deterministic per (seed, deployment, predictor): str
+                # seeding is hash-randomization-proof
+                rng=random.Random(
+                    f"{self._seed}:{reg.deployment_id}:{predictor_name}"
+                ),
+                name=f"{reg.deployment_id}/{predictor_name}",
+            )
+            self._replica_sets[key] = (fp, rs)
+            cached = (fp, rs)
+        return cached[1]
+
+    def _pick_engine(
+        self, reg: _Registration, predictor: Optional[str] = None,
+        eligible=None,
+    ) -> Tuple[str, ReplicaSet, ReplicaEndpoint, Optional[PickDecision]]:
+        """Two-level choice: replica-weighted predictor split (canary,
+        unchanged), then power-of-two-choices over THAT predictor's
+        replica endpoints (gateway/balancer.py).  ``decision`` is None on
+        the pre-replica-set paths (single endpoint / kill switch).
+        ``eligible`` narrows the p2c pool (ReplicaSet.pick) to endpoints
+        the caller's lane can use."""
+        entry = None
         if predictor is not None:
             for name, _, engine in reg.engines:
                 if name == predictor:
-                    return name, engine
-        names = [e[0] for e in reg.engines]
-        weights = np.asarray([e[1] for e in reg.engines], dtype=np.float64)
-        if weights.sum() <= 0:
-            weights = np.ones_like(weights)
-        idx = int(self._rng.choice(len(names), p=weights / weights.sum()))
-        return reg.engines[idx][0], reg.engines[idx][2]
+                    entry = (name, engine)
+                    break
+        if entry is None:
+            names = [e[0] for e in reg.engines]
+            weights = np.asarray(
+                [e[1] for e in reg.engines], dtype=np.float64
+            )
+            if weights.sum() <= 0:
+                weights = np.ones_like(weights)
+            idx = int(self._rng.choice(len(names), p=weights / weights.sum()))
+            entry = (reg.engines[idx][0], reg.engines[idx][2])
+        name, engine = entry
+        rs = self._replica_set(reg, name, engine)
+        endpoint, decision = rs.pick(eligible)
+        self._ensure_scraper(rs)
+        return name, rs, endpoint, decision
 
     # -- data plane ---------------------------------------------------------
+
+    @staticmethod
+    def _replica_fault(resp: SeldonMessage) -> bool:
+        """Did the REPLICA fail?  Only transport-shaped failures (bad
+        gateway / unreachable / timeout) feed the balancer's failure
+        degradation — an engine-side validation FAILURE for a malformed
+        client payload says nothing about replica health, and blaming it
+        would let one bad client cycle every healthy replica through the
+        degraded state."""
+        st = resp.status
+        return (
+            st is not None
+            and st.status == "FAILURE"
+            and (st.code or 0) in (502, 503, 504)
+        )
+
+    @staticmethod
+    def _decision_attrs(decision: Optional[PickDecision]) -> dict:
+        """The routing decision as span attrs — chosen replica plus both
+        candidates' scores, so a misprediction is auditable straight off
+        the trace (PR-3/6 plumbing).  Empty on the pre-replica paths."""
+        if decision is None:
+            return {}
+        return {
+            "replica": decision.replica,
+            "p2c_candidates": ",".join(decision.candidates),
+            "p2c_scores": ",".join(str(s) for s in decision.scores),
+        }
 
     async def predict(
         self, msg: SeldonMessage, token: Optional[str] = None
@@ -195,15 +313,57 @@ class ApiGateway:
 
         reg = self._resolve(token)
         with self.metrics.time_ingress("predictions", "POST") as code:
-            predictor_name, engine = self._pick_engine(reg)
+            # a request that arrives with its deadline already spent is
+            # the CALLER's failure — answer before picking so it can't
+            # feed any replica's failure degradation
+            rem = remaining_s()
+            if rem is not None and rem <= 0:
+                code["code"] = "504"
+                return SeldonMessage.failure(
+                    "request deadline exhausted at gateway", code=504
+                )
+            # a hop clamped BELOW its normal timeout by the caller's
+            # budget can fail because the budget was too small, not
+            # because the replica is sick — such failures are accounted
+            # neutrally (inflight released, no EWMA, no failure streak)
+            # or one impatient client would cycle every healthy replica
+            # through fail-degradation
+            blameable = rem is None or rem >= 20.0
+            predictor_name, rs, endpoint, decision = self._pick_engine(reg)
             # the ingress span roots the request tree (or joins the
             # caller's trace when it sent a traceparent); the engine hop —
-            # in-process or HTTP — becomes its child
-            with TRACER.span(
-                msg.meta.puid, "gateway", kind="request", method="predict",
-                deployment=reg.deployment_id, predictor=predictor_name,
-            ):
-                resp = await self._dispatch_predict(engine, msg)
+            # in-process, UDS or HTTP — becomes its child
+            track = replicas_enabled()
+            if track:
+                endpoint.begin()
+            t0 = time.perf_counter()
+            ok = False
+            raised = True
+            try:
+                with TRACER.span(
+                    msg.meta.puid, "gateway", kind="request",
+                    method="predict", deployment=reg.deployment_id,
+                    predictor=predictor_name,
+                    **self._decision_attrs(decision),
+                ):
+                    resp = await self._dispatch_predict(endpoint, msg)
+                ok = not self._replica_fault(resp)
+                raised = False
+            finally:
+                if track:
+                    if raised:
+                        # the dispatch never returned — client hung up
+                        # (CancelledError) or a gateway-side bug, neither
+                        # of which says anything about REPLICA health:
+                        # account neutrally or three impatient clients
+                        # fail-degrade a healthy replica (real transport
+                        # failures return a typed 503, they don't raise)
+                        endpoint.release(batcher=True)
+                    elif ok or blameable:
+                        rs.complete(endpoint, decision,
+                                    time.perf_counter() - t0, ok=ok)
+                    else:
+                        endpoint.release(batcher=True)
             # record which predictor served (canary observability; feedback
             # routes back to the same predictor)
             resp.meta.requestPath.setdefault("predictor", predictor_name)
@@ -224,36 +384,237 @@ class ApiGateway:
             if feedback.response is not None:
                 predictor = feedback.response.meta.requestPath.get("predictor")
             fb_puid = feedback.puid()
-            _, engine = self._pick_engine(reg, predictor)
+            _, rs, endpoint, decision = self._pick_engine(reg, predictor)
             self.feedback_count += 1
             self.feedback_reward_sum += float(feedback.reward)
             if feedback.truth is not None:
                 self.feedback_truth_count += 1
-            with TRACER.span(
-                fb_puid, "gateway", kind="request", method="feedback",
-                deployment=reg.deployment_id,
-            ):
-                return await self._dispatch_feedback(engine, feedback)
+            # inflight-only accounting (release, not complete): a
+            # feedback ack is a ~1 ms bookkeeping hop — folding it into
+            # the EWMA that routes PREDICT traffic would drag a replica
+            # with a steady feedback stream toward "fastest" regardless
+            # of its real predict latency (same argument as streams)
+            track = replicas_enabled()
+            if track:
+                endpoint.begin(batcher=False)
+            try:
+                with TRACER.span(
+                    fb_puid, "gateway", kind="request", method="feedback",
+                    deployment=reg.deployment_id,
+                    **self._decision_attrs(decision),
+                ):
+                    return await self._dispatch_feedback(endpoint, feedback)
+            finally:
+                if track:
+                    endpoint.release()
 
-    async def _dispatch_predict(self, engine, msg: SeldonMessage) -> SeldonMessage:
-        if hasattr(engine, "predict"):  # in-process EngineService
-            return await engine.predict(msg)
-        return await self._http_post(str(engine) + "/api/v0.1/predictions", msg.to_json())
+    def _uds_client(self, path: str):
+        """Pooled relay client per socket path (runtime/udsrelay.py)."""
+        client = self._uds_clients.get(path)
+        if client is None or client.closed:
+            from seldon_core_tpu.runtime.udsrelay import UdsRelayClient
 
-    async def _dispatch_feedback(self, engine, fb: Feedback) -> SeldonMessage:
-        if hasattr(engine, "send_feedback"):
-            return await engine.send_feedback(fb)
-        return await self._http_post(str(engine) + "/api/v0.1/feedback", fb.to_json())
+            client = UdsRelayClient(path)
+            self._uds_clients[path] = client
+        return client
+
+    def _lane_for(self, endpoint: ReplicaEndpoint) -> str:
+        if hasattr(endpoint.target, "predict"):
+            return "inprocess"
+        if endpoint.uds_path is not None and uds_enabled():
+            return "uds"
+        return "tcp"
+
+    async def _dispatch(
+        self, endpoint: ReplicaEndpoint, obj, method: str, relay_op: int,
+        path: str,
+    ) -> SeldonMessage:
+        """One gateway->engine hop over whichever lane the endpoint
+        advertises: in-process call, framed UDS relay, or HTTP POST.
+        ``obj`` is the SeldonMessage/Feedback, ``method`` its in-process
+        method name, ``relay_op``/``path`` the lane-specific addresses."""
+        lane = self._lane_for(endpoint)
+        RECORDER.record_lane_request(lane)
+        if lane == "inprocess":
+            return await getattr(endpoint.target, method)(obj)
+        if lane == "uds":
+            return await self._uds_call(
+                endpoint.uds_path, relay_op, obj.to_json()
+            )
+        if endpoint.base_url is None:
+            return SeldonMessage.failure(
+                "endpoint has no TCP url and the UDS lane is disabled "
+                "(SELDON_TPU_UDS=0)", code=503,
+            )
+        return await self._http_post(
+            endpoint.base_url + path, obj.to_json()
+        )
+
+    async def _dispatch_predict(
+        self, endpoint: ReplicaEndpoint, msg: SeldonMessage
+    ) -> SeldonMessage:
+        return await self._dispatch(
+            endpoint, msg, "predict", OP_PREDICT, "/api/v0.1/predictions"
+        )
+
+    async def _dispatch_feedback(
+        self, endpoint: ReplicaEndpoint, fb: Feedback
+    ) -> SeldonMessage:
+        return await self._dispatch(
+            endpoint, fb, "send_feedback", OP_FEEDBACK, "/api/v0.1/feedback"
+        )
+
+    async def _uds_call(self, path: str, op: int, payload: str) -> SeldonMessage:
+        """One zero-copy relay round trip; transport failures surface the
+        same 503 shape the TCP lane produces, and the caller's remaining
+        deadline budget clamps the hop the same way _http_post's does (a
+        wedged engine fails at the deadline, not never).  The frame
+        format carries no headers, so the engine does not see the
+        deadline or traceparent — this lane's hop is bounded and traced
+        gateway-side only (the udsrelay.py scope contract)."""
+        total = 20.0
+        rem = remaining_s()
+        if rem is not None:
+            if rem <= 0:
+                return SeldonMessage.failure(
+                    "request deadline exhausted at gateway", code=504
+                )
+            total = min(total, rem)
+        try:
+            body, _status = await asyncio.wait_for(
+                self._uds_client(path).call(op, payload.encode()),
+                timeout=total,
+            )
+            return SeldonMessage.from_json(body.decode("utf-8", "replace"))
+        except asyncio.TimeoutError:
+            return SeldonMessage.failure(
+                f"engine timeout after {total:.1f}s on uds relay", code=504
+            )
+        except (ConnectionError, OSError) as e:
+            return SeldonMessage.failure(
+                f"engine unreachable: {e}", code=503
+            )
+        except SeldonMessageError as e:
+            return SeldonMessage.failure(
+                f"engine error: bad relay response: {e}", code=502
+            )
 
     def _get_session(self):
         """Shared pooled session; timeouts are PER REQUEST (a session-level
         total would make unary calls and long-lived SSE proxies poison each
-        other's deadline)."""
+        other's deadline).  Pool geometry is an env contract instead of a
+        library default: ``SELDON_TPU_GW_POOL`` caps concurrent upstream
+        connections (aiohttp's default 100 starves >100-replica fan-outs),
+        ``SELDON_TPU_GW_KEEPALIVE_S`` holds idle keep-alives (default 15 s
+        — engines' drain window outlives it, so rolling restarts don't
+        strand the pool on dead sockets)."""
+        import os
+
         import aiohttp
 
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
+            try:
+                pool = int(os.environ.get("SELDON_TPU_GW_POOL", "") or 100)
+            except ValueError:
+                pool = 100
+            try:
+                keepalive = float(
+                    os.environ.get("SELDON_TPU_GW_KEEPALIVE_S", "") or 15.0
+                )
+            except ValueError:
+                keepalive = 15.0
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(
+                    limit=pool, keepalive_timeout=keepalive
+                )
+            )
         return self._session
+
+    def _ensure_scraper(self, rs: ReplicaSet) -> None:
+        """Start the passive-health scrape loop once a URL-backed multi-
+        replica set exists (in-process sets read health directly; solo
+        sets have nothing to balance)."""
+        if (
+            self._scrape_task is not None
+            or not replicas_enabled()
+            or len(rs) < 2
+            or not any(ep.base_url for ep in rs.endpoints)
+        ):
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync tests): scores run on local state only
+        self._scrape_task = loop.create_task(self._scrape_loop())
+
+    def _prune_stale_sets(self) -> list:
+        """Drop replica sets (and return the relay clients) of
+        deployments no longer registered — without this an unregister
+        leaves the cached set alive forever: in-process EngineServices
+        pinned by the fingerprint's strong refs, URL sets perpetually
+        scraped, relay connections pooled to sockets nothing routes to.
+
+        Gated on the store actually changing: the full pass re-reads
+        every registration (one query + JSON parse each on the sqlite
+        store), which is pure waste on the every-2s scrape tick and every
+        /stats poll of a stable topology.  The gate reads the store's
+        revision counter — a re-registration of the SAME deployment (a
+        predictor dropped, a uds path moved) bumps it, where a
+        deployment-ID diff would miss the change and leave the stale set
+        scraped forever.  Stores without a revision() fall back to the
+        ID diff (they can at least prune on add/remove)."""
+        rev = getattr(self.store, "revision", None)
+        marker = (
+            ("rev", rev()) if callable(rev)
+            else ("ids", tuple(sorted(self.store.deployments())))
+        )
+        if marker == self._pruned_for:
+            return []
+        self._pruned_for = marker
+        live_pairs = set()
+        live_uds = set()
+        for reg in list(self.store._by_key.values()):
+            if reg is None:
+                continue
+            for name, _w, engine in reg.engines:
+                live_pairs.add((reg.deployment_id, name))
+                targets = (
+                    engine if isinstance(engine, (list, tuple))
+                    else [engine]
+                )
+                for t in targets:
+                    if isinstance(t, str):
+                        _base, uds = parse_endpoint_spec(t)
+                        if uds:
+                            live_uds.add(uds)
+        for key in list(self._replica_sets):
+            if key not in live_pairs:
+                del self._replica_sets[key]
+        stale_clients = [
+            c for p, c in self._uds_clients.items() if p not in live_uds
+        ]
+        self._uds_clients = {
+            p: c for p, c in self._uds_clients.items() if p in live_uds
+        }
+        return stale_clients
+
+    async def _scrape_loop(self) -> None:
+        interval = scrape_interval_s()
+        while True:
+            try:
+                for client in self._prune_stale_sets():
+                    await client.close()
+                for _fp, rs in list(self._replica_sets.values()):
+                    if len(rs) > 1:
+                        await rs.scrape_once(self._get_session())
+            except Exception:
+                # a malformed /stats body (proxy interposing, engine
+                # mid-deploy) must not kill the loop: the task is never
+                # restarted, so an escape here would freeze every
+                # replica's health at its last value for the gateway's
+                # lifetime
+                pass
+            await asyncio.sleep(interval)
 
     async def _http_post(self, url: str, payload: str) -> SeldonMessage:
         import aiohttp
@@ -310,14 +671,18 @@ class ApiGateway:
         latency percentiles, routing table, firehose backpressure, and the
         process-level flight-recorder telemetry (engines sharing this
         process report their batcher/generation internals here too)."""
-        from seldon_core_tpu.utils.telemetry import RECORDER
-
         return {
             "gateway": {
                 "require_auth": self.require_auth,
                 "deployments": self.store.deployments(),
                 "active_tokens": self.store.active_token_count(),
             },
+            # per-predictor replica sets: endpoints, gateway-side
+            # inflight/EWMA, picks, passive health, mispicks, imbalance.
+            # Pruned here as well as in the scrape loop — gateways whose
+            # sets are all in-process/uds-only never start the scraper,
+            # and an unregistered deployment must not pin its engines
+            "replicas": self._stats_replicas(),
             "feedback": {
                 "count": self.feedback_count,
                 "mean_reward": round(
@@ -331,7 +696,29 @@ class ApiGateway:
             "telemetry": RECORDER.snapshot(),
         }
 
+    def _stats_replicas(self) -> dict:
+        stale = self._prune_stale_sets()
+        if stale:
+            try:
+                loop = asyncio.get_running_loop()
+                for client in stale:
+                    loop.create_task(client.close())
+            except RuntimeError:
+                pass  # sync caller: connections close with the gateway
+        return {
+            f"{dep}/{pred}": rs.snapshot()
+            for (dep, pred), (_fp, rs) in sorted(
+                self._replica_sets.items()
+            )
+        }
+
     async def close(self) -> None:
+        if self._scrape_task is not None:
+            self._scrape_task.cancel()
+            self._scrape_task = None
+        for client in self._uds_clients.values():
+            await client.close()
+        self._uds_clients = {}
         if self._session is not None and not self._session.closed:
             await self._session.close()
 
@@ -441,7 +828,37 @@ def make_gateway_app(gateway: ApiGateway):
             return _error_response(str(e), code=401)
         except SeldonMessageError as e:
             return _error_response(str(e))
-        _, engine = gateway._pick_engine(reg)
+        def _streamable(ep):
+            return hasattr(ep.target, "generate_stream") or \
+                ep.base_url is not None
+
+        # streams stay on TCP (the relay lane is unary-only); the replica
+        # pick still applies so streams balance across the set too.  A
+        # mixed set may contain uds-only replicas (unary hot path only):
+        # the eligibility filter keeps the p2c pool — and the pick
+        # metrics — on endpoints that can actually serve the stream
+        _, rs, endpoint, _decision = gateway._pick_engine(
+            reg, eligible=_streamable
+        )
+        if not _streamable(endpoint):
+            # only reachable on the no-decision paths (kill switch /
+            # single endpoint), where pick() bypasses the filter and
+            # records no pick metrics.  Re-home by SCORE, not raw
+            # inflight — a breaker-open replica idles at inflight 0 and
+            # would otherwise catch every re-homed stream
+            capable = [ep for ep in rs.endpoints if _streamable(ep)]
+            if not capable:
+                return _error_response(
+                    "streaming requires a TCP endpoint (every replica "
+                    "is uds-only)", code=503,
+                )
+            now = time.monotonic()
+            endpoint = min(
+                capable, key=lambda ep: ep.score(now, rs.stale_after_s)
+            )
+        engine = endpoint.target
+        if not hasattr(engine, "generate_stream"):
+            engine = endpoint.base_url
         resp = web.StreamResponse(
             status=200,
             headers={"Content-Type": "text/event-stream",
@@ -449,52 +866,70 @@ def make_gateway_app(gateway: ApiGateway):
         )
         import json as _json
 
-        if hasattr(engine, "generate_stream"):  # in-process EngineService
+        # a live stream counts as load for the whole time it runs (or
+        # p2c stacks unary traffic onto a stream-saturated replica), but
+        # contributes no EWMA sample — stream wall time isn't comparable
+        # to a unary latency
+        track = replicas_enabled()
+        if track:
+            endpoint.begin(batcher=False)
+        try:
+            if hasattr(engine, "generate_stream"):  # in-process engine
+                try:
+                    text, chunk = engine.prepare_stream_request(payload)
+                except SeldonMessageError as e:
+                    return _error_response(str(e))
+                await resp.prepare(request)
+                agen = engine.generate_stream(text, chunk=chunk)
+                try:
+                    async for event in agen:
+                        await resp.write(
+                            b"data: " + event.encode() + b"\n\n"
+                        )
+                except Exception as e:  # mid-stream: in-band terminal
+                    # event, same SSE failure contract as the engine lane
+                    await resp.write(
+                        b'data: {"done": true, "error": %s}\n\n'
+                        % _json.dumps(str(e)).encode()
+                    )
+                finally:
+                    await agen.aclose()
+                await resp.write_eof()
+                return resp
+            # remote engine: stream the upstream SSE bytes unchanged
+            import aiohttp
+
             try:
-                text, chunk = engine.prepare_stream_request(payload)
-            except SeldonMessageError as e:
-                return _error_response(str(e))
-            await resp.prepare(request)
-            agen = engine.generate_stream(text, chunk=chunk)
-            try:
-                async for event in agen:
-                    await resp.write(b"data: " + event.encode() + b"\n\n")
-            except Exception as e:  # mid-stream: in-band terminal event,
-                # same SSE failure contract as the engine lane (rest.py)
+                async with gateway._get_session().post(
+                    str(engine) + "/api/v0.1/generate/stream",
+                    data=payload,
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, sock_connect=20
+                    ),
+                ) as upstream:
+                    if upstream.status != 200:
+                        return _error_response(
+                            await upstream.text(), code=upstream.status
+                        )
+                    await resp.prepare(request)
+                    async for chunk_bytes in upstream.content.iter_any():
+                        await resp.write(chunk_bytes)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                if not resp.prepared:
+                    return _error_response(
+                        f"engine unreachable: {e}", code=503
+                    )
+                # upstream broke mid-stream: emit a terminal error event —
+                # the SSE contract's in-band failure channel
                 await resp.write(
                     b'data: {"done": true, "error": %s}\n\n'
                     % _json.dumps(str(e)).encode()
                 )
-            finally:
-                await agen.aclose()
             await resp.write_eof()
             return resp
-        # remote engine: stream the upstream SSE bytes through unchanged
-        import aiohttp
-
-        try:
-            async with gateway._get_session().post(
-                str(engine) + "/api/v0.1/generate/stream", data=payload,
-                timeout=aiohttp.ClientTimeout(total=None, sock_connect=20),
-            ) as upstream:
-                if upstream.status != 200:
-                    return _error_response(
-                        await upstream.text(), code=upstream.status
-                    )
-                await resp.prepare(request)
-                async for chunk_bytes in upstream.content.iter_any():
-                    await resp.write(chunk_bytes)
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            if not resp.prepared:
-                return _error_response(f"engine unreachable: {e}", code=503)
-            # upstream broke mid-stream: emit a terminal error event — the
-            # SSE contract's in-band failure channel (headers already sent)
-            await resp.write(
-                b'data: {"done": true, "error": %s}\n\n'
-                % _json.dumps(str(e)).encode()
-            )
-        await resp.write_eof()
-        return resp
+        finally:
+            if track:
+                endpoint.release()
 
     async def ping(_):
         return web.Response(text="pong")

@@ -1,0 +1,434 @@
+"""Engine replica sets + power-of-two-choices gateway balancing.
+
+The reference scales a predictor by setting ``replicas`` on its engine
+Deployment and letting the k8s Service round-robin over the pods; the
+gateway never sees individual replicas.  Here the gateway DOES see them
+(Podracer-style, arxiv 2104.06272: sheets of identical workers behind a
+thin dispatcher) so it can balance on live load instead of blind
+rotation:
+
+* a :class:`ReplicaSet` holds N endpoints for one predictor — engine
+  base URLs, ``uds:`` socket paths (runtime/udsrelay.py zero-copy lane),
+  or in-process ``EngineService`` objects;
+* :meth:`ReplicaSet.pick` is **power-of-two-choices**: sample two
+  distinct replicas, score each as ``(outstanding requests) x (EWMA
+  latency)`` — expected wait, not just queue depth — and take the lower.
+  P2c gets within a constant factor of least-loaded at O(1) cost and,
+  unlike full least-loaded, doesn't herd every gateway replica onto the
+  same momentarily-idle engine;
+* health is **passive**: the gateway's periodic ``GET /stats`` scrape
+  (the surface every engine already serves) feeds per-replica engine-side
+  inflight and circuit-breaker state.  A replica whose breaker is open,
+  whose scrape failed, or whose scrape went stale is deprioritized by a
+  score penalty — composing with the PR-2 breakers rather than
+  duplicating their probing;
+* every decision is auditable: the chosen replica and both candidates'
+  scores ride the request span (gateway/apife.py stamps them), picks and
+  gateway-side inflight land in the ``seldon_tpu_replica_*`` families,
+  and hindsight **mispicks** (the pick finished slower than the losing
+  candidate's EWMA at decision time) are counted so a broken score
+  function shows up as a ratio, not an anecdote.
+
+``SELDON_TPU_REPLICAS=0`` is the kill switch: every pick returns the
+first endpoint with no sampling, no scoring and no metrics — bit-for-bit
+today's single-engine path.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from seldon_core_tpu.utils.telemetry import RECORDER
+
+__all__ = [
+    "ReplicaEndpoint",
+    "ReplicaSet",
+    "PickDecision",
+    "parse_endpoint_spec",
+    "replicas_enabled",
+    "uds_enabled",
+]
+
+#: EWMA smoothing for per-replica latency; small enough to remember a
+#: slow spell for ~10 requests, large enough to converge fast after boot
+_EWMA_ALPHA = 0.2
+#: score floor so a no-sample-yet replica isn't infinitely attractive
+_EWMA_FLOOR_MS = 0.1
+#: additive score penalty for a degraded replica (breaker open / scrape
+#: failed / scrape stale / fast-failing): it still serves when EVERY
+#: candidate is degraded, but never beats a healthy one
+_UNHEALTHY_PENALTY = 1e9
+#: consecutive dispatch failures before a replica is degraded — without
+#: this a replica that FAILS in microseconds drains its inflight
+#: instantly, scores at the EWMA floor, and becomes a traffic black hole
+#: (failures don't update the EWMA, so nothing else raises its score)
+_FAIL_DEGRADE_AFTER = 3
+#: how long the failure degradation lasts after the latest failure — the
+#: passive half-open: after a quiet cooldown the replica gets sampled
+#: again, and one success clears it (one more failure re-arms it)
+_FAIL_DEGRADE_COOLDOWN_S = 5.0
+
+
+def replicas_enabled() -> bool:
+    """Kill switch: ``SELDON_TPU_REPLICAS=0`` restores the single-engine
+    path (first registered endpoint, no p2c, no replica metrics)."""
+    return os.environ.get("SELDON_TPU_REPLICAS", "1") != "0"
+
+
+def uds_enabled() -> bool:
+    """Kill switch: ``SELDON_TPU_UDS=0`` keeps every dispatch on TCP even
+    when an endpoint advertises a ``uds:`` socket path."""
+    return os.environ.get("SELDON_TPU_UDS", "1") != "0"
+
+
+def parse_endpoint_spec(spec: str) -> Tuple[Optional[str], Optional[str]]:
+    """``(base_url, uds_path)`` from an endpoint spec string.
+
+    Three forms (gateway_main env contract, docs/operations.md):
+
+    * ``http://host:port``                   TCP only
+    * ``uds:/path/to.sock``                  UDS only (no /stats scrape,
+                                             no SSE proxy — hot path only)
+    * ``http://host:port+uds:/path/to.sock`` TCP for scrape/stream, UDS
+                                             for the predict/feedback hot
+                                             path
+    """
+    spec = spec.strip()
+    if "+uds:" in spec:
+        base, _, uds = spec.partition("+uds:")
+        return base.rstrip("/") or None, uds or None
+    if spec.startswith("uds:"):
+        return None, spec[len("uds:"):] or None
+    return spec.rstrip("/") or None, None
+
+
+class ReplicaEndpoint:
+    """One engine replica as the gateway sees it: the dispatch target plus
+    the live score inputs (gateway-side inflight, EWMA latency, scraped
+    engine-side inflight + breaker state)."""
+
+    __slots__ = (
+        "target", "base_url", "uds_path", "name", "index", "set_name",
+        "inflight", "batcher_inflight", "ewma_ms", "picks", "failures",
+        "consec_failures", "fail_degraded_until",
+        "scraped_inflight", "scrape_ts", "scrape_failed", "breaker_open",
+    )
+
+    def __init__(self, target, index: int = 0, set_name: str = "default"):
+        self.index = index
+        self.set_name = set_name
+        if isinstance(target, str):
+            self.base_url, self.uds_path = parse_endpoint_spec(target)
+            self.target = target
+            self.name = self.base_url or f"uds:{self.uds_path}"
+        else:  # in-process EngineService-like object
+            self.base_url = None
+            self.uds_path = None
+            self.target = target
+            self.name = f"inprocess-{index}"
+        self.inflight = 0
+        # the subset of ``inflight`` that rides the engine's MicroBatcher
+        # (unary predicts) — the only part the scraped engine-side
+        # ``inflight_dispatches`` figure can also contain
+        self.batcher_inflight = 0
+        self.ewma_ms = 0.0  # 0 = no successful sample yet
+        self.picks = 0
+        self.failures = 0
+        self.consec_failures = 0
+        self.fail_degraded_until = 0.0
+        # passive health, fed by ReplicaSet.scrape_once
+        self.scraped_inflight = 0
+        self.scrape_ts = 0.0
+        self.scrape_failed = False
+        self.breaker_open = False
+
+    # -- health ----------------------------------------------------------
+
+    def degraded(self, now: float, stale_after_s: float) -> bool:
+        # fast-failure degradation applies to EVERY target kind — it is
+        # the only health signal a uds-only or in-process endpoint has,
+        # and the cooldown expiring is the passive half-open probe
+        if now < self.fail_degraded_until:
+            return True
+        if isinstance(self.target, str):
+            if self.breaker_open or self.scrape_failed:
+                return True
+            # staleness only counts once a scrape ever succeeded — sets
+            # that never run the scraper (tests, in-bench single shots)
+            # must not read as degraded
+            return (
+                self.scrape_ts > 0.0
+                and now - self.scrape_ts > stale_after_s
+            )
+        # in-process: breaker state is readable directly, no scrape needed
+        open_breakers = getattr(self.target, "open_breakers", None)
+        return bool(open_breakers()) if callable(open_breakers) else False
+
+    def score(self, now: float, stale_after_s: float) -> float:
+        """Expected wait: (queued work) x (per-request cost).  Gateway-side
+        inflight is authoritative for work THIS gateway queued; the scraped
+        engine-side inflight adds load other gateways put there."""
+        s = (
+            (self.inflight + self.scraped_inflight + 1)
+            * max(self.ewma_ms, _EWMA_FLOOR_MS)
+        )
+        if self.degraded(now, stale_after_s):
+            s += _UNHEALTHY_PENALTY
+        return s
+
+    # -- dispatch accounting ---------------------------------------------
+
+    def begin(self, batcher: bool = True) -> None:
+        """``batcher=False`` for dispatches that do NOT enter the engine's
+        MicroBatcher (streams, feedback acks) — they count as load but must
+        not be subtracted from the scraped engine-side figure."""
+        self.inflight += 1
+        if batcher:
+            self.batcher_inflight += 1
+        RECORDER.set_replica_inflight(self.set_name, self.name, self.inflight)
+
+    def complete(self, latency_s: float, ok: bool = True) -> None:
+        self.inflight = max(0, self.inflight - 1)
+        self.batcher_inflight = max(0, self.batcher_inflight - 1)
+        RECORDER.set_replica_inflight(self.set_name, self.name, self.inflight)
+        if ok:
+            ms = latency_s * 1e3
+            self.ewma_ms = (
+                ms if self.ewma_ms == 0.0
+                else (1 - _EWMA_ALPHA) * self.ewma_ms + _EWMA_ALPHA * ms
+            )
+            self.consec_failures = 0
+            self.fail_degraded_until = 0.0
+        else:
+            self.failures += 1
+            self.consec_failures += 1
+            if self.consec_failures >= _FAIL_DEGRADE_AFTER:
+                # a fast-failing replica would otherwise WIN every pick:
+                # failures drain inflight instantly and never raise the
+                # EWMA, pinning its score at the floor — degrade it for a
+                # cooldown instead of letting it eat the traffic
+                self.fail_degraded_until = (
+                    time.monotonic() + _FAIL_DEGRADE_COOLDOWN_S
+                )
+
+    def release(self, batcher: bool = False) -> None:
+        """End a dispatch WITHOUT a latency sample — long-lived streams
+        and feedback acks: their wall time isn't comparable to a unary
+        EWMA, but while they run they must count as load or p2c keeps
+        stacking unary traffic onto a stream-saturated replica.
+        ``batcher=True`` when closing a dispatch that was begun as
+        batcher-bound (the neutral-accounting unary path)."""
+        self.inflight = max(0, self.inflight - 1)
+        if batcher:
+            self.batcher_inflight = max(0, self.batcher_inflight - 1)
+        RECORDER.set_replica_inflight(self.set_name, self.name, self.inflight)
+
+    def snapshot(self) -> dict:
+        return {
+            "endpoint": self.name,
+            "uds_path": self.uds_path,
+            "inflight": self.inflight,
+            "scraped_inflight": self.scraped_inflight,
+            "ewma_ms": round(self.ewma_ms, 3),
+            "picks": self.picks,
+            "failures": self.failures,
+            "consec_failures": self.consec_failures,
+            "fail_degraded": time.monotonic() < self.fail_degraded_until,
+            "breaker_open": self.breaker_open,
+            "scrape_failed": self.scrape_failed,
+        }
+
+
+@dataclass
+class PickDecision:
+    """Why a replica was chosen — stamped onto the request span and used
+    for hindsight mispick accounting at completion."""
+
+    replica: str
+    candidates: List[str]
+    scores: List[float]
+    #: losing candidate's EWMA at decision time (0 = no sample / solo pick)
+    loser_ewma_ms: float = 0.0
+
+
+class ReplicaSet:
+    """N engine endpoints for one predictor + the p2c pick over them."""
+
+    def __init__(self, targets, rng: Optional[random.Random] = None,
+                 stale_after_s: Optional[float] = None,
+                 name: str = "default"):
+        if not targets:
+            raise ValueError("ReplicaSet needs at least one endpoint")
+        #: replica-set identity (deployment/predictor at the gateway) —
+        #: the `set` label on the seldon_tpu_replica_* families, so
+        #: imbalance is judged WITHIN a set, never across sets
+        self.name = name
+        self.endpoints = [
+            ReplicaEndpoint(t, i, set_name=name)
+            for i, t in enumerate(targets)
+        ]
+        self._rng = rng or random.Random(0)
+        if stale_after_s is None:
+            stale_after_s = 3.0 * scrape_interval_s()
+        self.stale_after_s = float(stale_after_s)
+        self.mispicks = 0
+
+    def __len__(self) -> int:
+        return len(self.endpoints)
+
+    # -- the balancer ----------------------------------------------------
+
+    def pick(
+        self, eligible=None
+    ) -> Tuple[ReplicaEndpoint, Optional[PickDecision]]:
+        """Power-of-two-choices; ``decision`` is None exactly on the paths
+        that predate replica sets (kill switch / single endpoint), so the
+        span stays byte-identical there.  ``eligible`` narrows the p2c
+        pool to endpoints a caller can actually use (e.g. streams need a
+        TCP/in-process lane) so the pick — and its metrics — land on the
+        endpoint that serves; an empty filtered pool falls back to the
+        full set and the caller handles the capability miss."""
+        if not replicas_enabled() or len(self.endpoints) == 1:
+            return self.endpoints[0], None
+        pool = self.endpoints
+        if eligible is not None:
+            pool = [ep for ep in pool if eligible(ep)] or self.endpoints
+        now = time.monotonic()
+        if len(pool) == 1:
+            chosen = pool[0]
+            chosen.picks += 1
+            RECORDER.record_replica_pick(self.name, chosen.name)
+            return chosen, PickDecision(
+                replica=chosen.name, candidates=[chosen.name],
+                scores=[round(chosen.score(now, self.stale_after_s), 4)],
+                loser_ewma_ms=0.0,
+            )
+        i, j = self._rng.sample(range(len(pool)), 2)
+        a, b = pool[i], pool[j]
+        sa, sb = (
+            a.score(now, self.stale_after_s),
+            b.score(now, self.stale_after_s),
+        )
+        chosen, loser = (a, b) if sa <= sb else (b, a)
+        chosen.picks += 1
+        RECORDER.record_replica_pick(self.name, chosen.name)
+        return chosen, PickDecision(
+            replica=chosen.name,
+            candidates=[a.name, b.name],
+            scores=[round(sa, 4), round(sb, 4)],
+            # a degraded loser doesn't judge the pick: beating a sick
+            # replica's historical EWMA is not a prediction error, and
+            # counting it would pin the mispick ratio at 1.0 exactly
+            # while the balancer steers correctly
+            loser_ewma_ms=(
+                0.0 if loser.degraded(now, self.stale_after_s)
+                else loser.ewma_ms
+            ),
+        )
+
+    def complete(self, endpoint: ReplicaEndpoint,
+                 decision: Optional[PickDecision],
+                 latency_s: float, ok: bool = True) -> None:
+        """Close one dispatch: update the endpoint's score inputs and judge
+        the pick in hindsight (mispick = a successful request that ran
+        longer than the losing candidate's EWMA at decision time — the
+        loser would LIKELY have been faster)."""
+        endpoint.complete(latency_s, ok=ok)
+        if (
+            ok
+            and decision is not None
+            and decision.loser_ewma_ms > 0.0
+            and latency_s * 1e3 > decision.loser_ewma_ms
+        ):
+            self.mispicks += 1
+            RECORDER.record_replica_mispick()
+
+    # -- passive health (the /stats scrape) ------------------------------
+
+    async def scrape_once(self, session) -> int:
+        """One scrape pass over the URL-backed endpoints: engine-side
+        inflight dispatches + breaker state out of ``GET /stats``.
+        Returns how many endpoints answered.  Never raises — a dead
+        replica marks itself degraded, it must not kill the scrape loop.
+        Endpoints scrape CONCURRENTLY so a pass is bounded by the 1 s
+        per-endpoint timeout, not by how many replicas are down — N-1
+        dead replicas scraped serially would age the healthy one past
+        the staleness window and falsely degrade it."""
+        import asyncio
+
+        import aiohttp
+
+        async def one(ep) -> int:
+            try:
+                timeout = aiohttp.ClientTimeout(total=1.0)
+                async with session.get(
+                    ep.base_url + "/stats", timeout=timeout
+                ) as r:
+                    doc = await r.json(content_type=None)
+                if not isinstance(doc, dict):
+                    raise ValueError("stats body is not an object")
+                batch = (doc.get("telemetry") or {}).get("batch") or {}
+                # subtract OWN batcher-bound inflight: the engine's
+                # figure includes unary work THIS gateway queued, which
+                # the score already counts live — double-counting a stale
+                # snapshot of our own burst makes picks herd away from a
+                # replica for a whole scrape interval after the burst
+                # drained.  Only the batcher-bound subset is subtracted:
+                # streams and feedback acks raise ep.inflight but never
+                # appear in inflight_dispatches, and subtracting them
+                # would erase OTHER gateways' real load from the signal
+                ep.scraped_inflight = max(
+                    0,
+                    int(batch.get("inflight_dispatches", 0) or 0)
+                    - ep.batcher_inflight,
+                )
+                breakers = (
+                    (doc.get("resilience") or {}).get("breakers") or {}
+                )
+                ep.breaker_open = any(
+                    (br or {}).get("state") not in (None, "closed")
+                    for br in breakers.values()
+                )
+                ep.scrape_ts = time.monotonic()
+                ep.scrape_failed = False
+                return 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # passive health: ANY scrape problem just marks the
+                # replica degraded — an exception type we didn't predict
+                # must not differ in effect from one we did
+                ep.scrape_failed = True
+                return 0
+
+        # in-process / uds-only endpoints have no scrape surface
+        targets = [ep for ep in self.endpoints if ep.base_url is not None]
+        if not targets:
+            return 0
+        return sum(await asyncio.gather(*(one(ep) for ep in targets)))
+
+    def snapshot(self) -> dict:
+        inflight = [ep.inflight for ep in self.endpoints]
+        mean = sum(inflight) / max(len(inflight), 1)
+        return {
+            "endpoints": [ep.snapshot() for ep in self.endpoints],
+            "mispicks": self.mispicks,
+            # max/mean of the gateway-side inflight — the imbalance the
+            # bench arm and the SeldonTPUReplicaImbalance alert judge
+            "inflight_max_over_mean": round(
+                (max(inflight) / mean) if mean > 0 else 1.0, 3
+            ),
+        }
+
+
+def scrape_interval_s() -> float:
+    """``SELDON_TPU_GW_SCRAPE_S`` — how often the gateway refreshes each
+    replica's /stats-derived health (default 2 s; stale = 3 intervals)."""
+    try:
+        return float(os.environ.get("SELDON_TPU_GW_SCRAPE_S", "") or 2.0)
+    except ValueError:
+        return 2.0

@@ -15,7 +15,20 @@ values):
                                           the operator's watch_dir
   GATEWAY_ENGINE_URL_TEMPLATE             engine base URL per deployment,
                                           default "http://{name}:8000"
-                                          ({name} = deployment Service)
+                                          ({name} = deployment Service;
+                                          {predictor} and {replica} are
+                                          also substituted)
+  GATEWAY_ENGINE_REPLICAS                 N>1 expands a {replica}-bearing
+                                          template into an N-endpoint
+                                          replica set per predictor
+                                          (power-of-two-choices balancing,
+                                          gateway/balancer.py)
+  GATEWAY_ENGINE_URL_MAP                  per-predictor overrides; a JSON
+                                          LIST value registers a replica
+                                          set, and endpoint specs may
+                                          carry a "+uds:/path" suffix for
+                                          the zero-copy co-located lane
+                                          (runtime/udsrelay.py)
 
     python -m seldon_core_tpu.gateway.gateway_main [--spec-dir DIR]
 """
@@ -46,42 +59,97 @@ def _build_store():
 
 
 def _engine_url_map() -> dict:
-    """Explicit per-predictor overrides: '{"<deployment>/<predictor>": url}'
-    — topologies where predictor engines don't follow one URL pattern
-    (canary pairs on distinct ports, split-cluster serving).  Parsed once
-    at boot; a malformed value is a fatal config error with a clear
-    message, not a crash-loop in the poll tick."""
+    """Explicit per-predictor overrides: '{"<deployment>/<predictor>":
+    url-or-list}' — topologies where predictor engines don't follow one
+    URL pattern (canary pairs on distinct ports, split-cluster serving).
+    A LIST value registers a replica set the gateway balances over.
+    Parsed once at boot; a malformed value is a fatal config error with a
+    clear message, not a crash-loop in the poll tick."""
     raw_map = os.environ.get("GATEWAY_ENGINE_URL_MAP", "").strip()
     if not raw_map:
         return {}
     try:
-        return {str(k): str(v) for k, v in json.loads(raw_map).items()}
-    except (json.JSONDecodeError, AttributeError) as e:
+        out = {}
+        for k, v in json.loads(raw_map).items():
+            if isinstance(v, list):
+                if not v or not all(isinstance(u, str) for u in v):
+                    raise ValueError(
+                        f"{k!r}: a replica list must be non-empty strings"
+                    )
+                out[str(k)] = [str(u) for u in v]
+            else:
+                out[str(k)] = str(v)
+        return out
+    except (json.JSONDecodeError, AttributeError, ValueError) as e:
         raise SystemExit(
             f"GATEWAY_ENGINE_URL_MAP is not a JSON object of "
-            f"'deployment/predictor' -> url: {e}"
+            f"'deployment/predictor' -> url (or list of urls): {e}"
         ) from e
 
 
 def _engine_url_template() -> str:
     """Validated once at boot: a template with placeholders other than
-    {name}/{predictor} is a fatal config error with a clear message — NOT
-    a KeyError escaping from the poll loop on the first matching spec."""
+    {name}/{predictor}/{replica} is a fatal config error with a clear
+    message — NOT a KeyError escaping from the poll loop on the first
+    matching spec."""
     template = os.environ.get(
         "GATEWAY_ENGINE_URL_TEMPLATE", "http://{name}:8000"
     )
     try:
-        template.format(name="x", predictor="y")
+        template.format(name="x", predictor="y", replica=0)
     except (KeyError, IndexError, ValueError) as e:
         raise SystemExit(
             f"GATEWAY_ENGINE_URL_TEMPLATE {template!r} is invalid: only "
-            f"{{name}} and {{predictor}} placeholders are supported ({e})"
+            f"{{name}}, {{predictor}} and {{replica}} placeholders are "
+            f"supported ({e})"
         ) from e
     return template
 
 
+def _engine_replicas() -> int:
+    """``GATEWAY_ENGINE_REPLICAS``: endpoints per predictor rendered from
+    a {replica}-bearing template (validated at boot, same policy as the
+    template itself)."""
+    raw = os.environ.get("GATEWAY_ENGINE_REPLICAS", "").strip()
+    if not raw:
+        return 1
+    try:
+        n = int(raw)
+    except ValueError as e:
+        raise SystemExit(
+            f"GATEWAY_ENGINE_REPLICAS {raw!r} is not an integer"
+        ) from e
+    if n < 1:
+        raise SystemExit(f"GATEWAY_ENGINE_REPLICAS must be >= 1, got {n}")
+    return n
+
+
+def _check_replica_template(replicas: int, template: str) -> int:
+    """Same fatal-at-boot policy as every other misconfig here: a replica
+    count the template can't render would otherwise register
+    single-endpoint sets and the scale-out would silently not exist."""
+    if replicas > 1 and "{replica}" not in template:
+        raise SystemExit(
+            f"GATEWAY_ENGINE_REPLICAS={replicas} needs a {{replica}} "
+            f"placeholder in GATEWAY_ENGINE_URL_TEMPLATE (got {template!r})"
+        )
+    return replicas
+
+
+def _render_endpoints(template: str, name: str, predictor: str,
+                      replicas: int):
+    """One URL, or — when a {replica} template meets replicas>1 — a
+    replica-set list the gateway p2c-balances over."""
+    if replicas > 1 and "{replica}" in template:
+        return [
+            template.format(name=name, predictor=predictor, replica=i)
+            for i in range(replicas)
+        ]
+    return template.format(name=name, predictor=predictor, replica=0)
+
+
 def _register_specs(store, spec_dir: str, seen: dict, url_map: dict,
-                    template: str) -> None:
+                    template: str, replicas: int = 1) -> None:
     for path in sorted(glob.glob(os.path.join(spec_dir, "*.json"))):
         mtime = os.path.getmtime(path)
         if seen.get(path) == mtime:
@@ -91,17 +159,20 @@ def _register_specs(store, spec_dir: str, seen: dict, url_map: dict,
                 spec = SeldonDeploymentSpec.from_json_dict(json.load(f))
             # {predictor} in the template routes each predictor to its own
             # engine Service — the canary topology (one engine pod per
-            # predictor, replica-weighted split in ApiGateway._pick_engine)
+            # predictor, replica-weighted split in ApiGateway._pick_engine);
+            # {replica} x GATEWAY_ENGINE_REPLICAS renders a replica SET
+            # per predictor instead (p2c balancing within the predictor)
             engines = {
                 p.name: url_map.get(
                     f"{spec.name}/{p.name}",
-                    template.format(name=spec.name, predictor=p.name),
+                    _render_endpoints(template, spec.name, p.name, replicas),
                 )
                 for p in spec.predictors
             }
             store.register(spec, engines)
             seen[path] = mtime
-            print(f"registered {spec.name} -> {sorted(engines.values())}",
+            print(f"registered {spec.name} -> "
+                  f"{sorted(str(v) for v in engines.values())}",
                   flush=True)
         except (GraphSpecError, ValueError, OSError,
                 json.JSONDecodeError) as e:
@@ -128,8 +199,9 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
     seen: dict = {}
     url_map = _engine_url_map()
     template = _engine_url_template()  # fatal at boot if malformed
+    replicas = _check_replica_template(_engine_replicas(), template)
     if spec_dir:
-        _register_specs(store, spec_dir, seen, url_map, template)
+        _register_specs(store, spec_dir, seen, url_map, template, replicas)
     runner = await serve_app(make_gateway_app(gateway), host, rest_port)
     grpc_server = make_gateway_grpc_server(gateway, host, grpc_port)
     await grpc_server.start()
@@ -153,7 +225,8 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
             await asyncio.wait_for(stop.wait(), timeout=5.0)
         except asyncio.TimeoutError:
             if spec_dir:  # poll for new/changed deployment specs
-                _register_specs(store, spec_dir, seen, url_map, template)
+                _register_specs(store, spec_dir, seen, url_map, template,
+                                replicas)
     await grpc_server.stop(grace=5.0)
     await runner.cleanup()
     if gateway.firehose is not None:

@@ -48,7 +48,18 @@ CREATE TABLE IF NOT EXISTS tokens (
     expiry REAL NOT NULL
 );
 CREATE INDEX IF NOT EXISTS tokens_by_key ON tokens(oauth_key);
+CREATE TABLE IF NOT EXISTS meta (
+    k TEXT PRIMARY KEY,
+    v INTEGER NOT NULL
+);
 """
+
+# bumped inside the same transaction as the registration write, so every
+# gateway replica sharing the file observes other replicas' changes too
+_BUMP_REVISION = (
+    "INSERT INTO meta VALUES ('revision', 1) "
+    "ON CONFLICT(k) DO UPDATE SET v = v + 1"
+)
 
 
 class SqliteDeploymentStore:
@@ -68,13 +79,25 @@ class SqliteDeploymentStore:
 
     def register(self, spec: SeldonDeploymentSpec,
                  engines: Dict[str, object]) -> None:
-        """``engines``: predictor name -> engine base URL (shared state can
-        only carry references another replica can dial)."""
+        """``engines``: predictor name -> engine base URL, or a LIST of
+        endpoint specs (a replica set the gateway balances over with
+        power-of-two-choices — gateway/balancer.py).  Shared state can
+        only carry references another replica can dial, so in-process
+        engines are rejected in either form."""
         weighted = []
         for p in spec.predictors:
             if p.name in engines:
                 engine = engines[p.name]
-                if not isinstance(engine, str):
+                if isinstance(engine, (list, tuple)):
+                    if not engine or not all(
+                        isinstance(u, str) for u in engine
+                    ):
+                        raise TypeError(
+                            "a replica set must be a non-empty list of "
+                            "endpoint spec strings"
+                        )
+                    engine = [str(u) for u in engine]
+                elif not isinstance(engine, str):
                     raise TypeError(
                         "SqliteDeploymentStore carries engine URLs; "
                         "in-process engines are per-replica "
@@ -91,6 +114,7 @@ class SqliteDeploymentStore:
                 "INSERT OR REPLACE INTO registrations VALUES (?, ?, ?, ?)",
                 (key, spec.name, spec.oauth_secret, json.dumps(weighted)),
             )
+            self._conn.execute(_BUMP_REVISION)
             self._conn.commit()
 
     def unregister(self, oauth_key: str) -> None:
@@ -101,7 +125,19 @@ class SqliteDeploymentStore:
             self._conn.execute(
                 "DELETE FROM tokens WHERE oauth_key = ?", (oauth_key,)
             )
+            self._conn.execute(_BUMP_REVISION)
             self._conn.commit()
+
+    def revision(self) -> int:
+        """Monotone registration-change counter shared through the sqlite
+        file — bumps on every register/unregister by ANY gateway replica,
+        including same-deployment re-registrations (the gateway's prune
+        gate reads this instead of diffing deployment IDs)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM meta WHERE k = 'revision'"
+            ).fetchone()
+        return int(row[0]) if row else 0
 
     def _registration(self, oauth_key: str):
         with self._lock:

@@ -65,14 +65,25 @@ __all__ = [
 def unit_rngs(names, rng=None):
     """Deterministic per-unit PRNG keys, shared convention between the host
     interpreter and the compiled executor so routing decisions are identical
-    in both modes for a given seed."""
+    in both modes for a given seed.
+
+    Keys are derived from the unit's NAME (crc32 fold), not its position
+    in the sorted name list: a unit's state must not depend on which
+    OTHER units share its graph, or a sharded node engine
+    (graph/sharding.py node_subspec — one leaf served standalone) would
+    train different weights than the same leaf inside the collapsed
+    engine, turning a pure topology change into a silent numerics
+    change."""
+    import zlib
+
     import jax
 
     if rng is None:
         rng = jax.random.key(0)
-    ordered = sorted(names)
-    keys = jax.random.split(rng, max(len(ordered), 1))
-    return {name: keys[i] for i, name in enumerate(ordered)}
+    return {
+        name: jax.random.fold_in(rng, zlib.crc32(name.encode()))
+        for name in names
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -31,12 +31,18 @@ from typing import Dict, List
 from seldon_core_tpu.graph.defaulting import default_and_validate
 from seldon_core_tpu.graph.spec import PredictorSpec, SeldonDeploymentSpec
 
-__all__ = ["generate_manifests", "engine_deployment", "to_yaml_stream"]
+__all__ = ["generate_manifests", "engine_deployment", "to_yaml_stream",
+           "SHARD_ANNOTATION"]
 
 ENGINE_IMAGE = "seldon-core-tpu/engine:latest"
 ENGINE_REST_PORT = 8000   # cluster-manager application.properties:5
 ENGINE_GRPC_PORT = 5001   # cluster-manager application.properties:6
 ENGINE_METRICS_PATH = "/prometheus"
+
+#: ``seldon.io/shard-graph: "true"`` materializes one engine
+#: Deployment+Service per shardable MODEL leaf (graph/sharding.py) — the
+#: reference's pod-per-node topology (PAPER.md §1) won back at scale-out
+SHARD_ANNOTATION = "seldon.io/shard-graph"
 
 
 def _labels(spec: SeldonDeploymentSpec, predictor: PredictorSpec,
@@ -326,12 +332,52 @@ def deployment_service(spec: SeldonDeploymentSpec) -> dict:
     }
 
 
+def node_engine_service(node_spec: SeldonDeploymentSpec,
+                        predictor: PredictorSpec) -> dict:
+    """ClusterIP Service fronting one node engine (graph sharding).  No
+    Ambassador route: node engines are internal mesh hops, only the root
+    engine's deployment Service is externally routable."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": node_spec.name,
+            "labels": {"seldon-deployment-id": node_spec.name},
+        },
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"seldon-deployment-id": node_spec.name,
+                         "seldon-predictor": predictor.name,
+                         "seldon-type": "engine"},
+            "ports": [
+                {"port": ENGINE_REST_PORT, "targetPort": ENGINE_REST_PORT,
+                 "name": "rest"},
+                {"port": ENGINE_GRPC_PORT, "targetPort": ENGINE_GRPC_PORT,
+                 "name": "grpc"},
+            ],
+        },
+    }
+
+
+def _shard_enabled(spec: SeldonDeploymentSpec) -> bool:
+    return str(
+        spec.annotations.get(SHARD_ANNOTATION, "")
+    ).strip().lower() in ("1", "true", "yes")
+
+
 def generate_manifests(spec: SeldonDeploymentSpec,
                        run_defaulting: bool = True,
                        engine_image: str = "",
                        engine_env: "Dict[str, str] | None" = None) -> List[dict]:
     """All resources for a deployment, reference createResources order:
-    engine Deployments, component Deployments/Services, deployment Service."""
+    engine Deployments, component Deployments/Services, deployment Service.
+
+    With ``seldon.io/shard-graph: "true"`` and >= 2 shardable MODEL
+    leaves, each leaf becomes its OWN engine Deployment+Service (the
+    reference's pod-per-node topology) and the root engine's graph is
+    rewritten to dispatch to them over the resilient remote client —
+    graph/sharding.py.  A single-leaf graph is served collapsed even when
+    annotated: sharding it would only add a network hop."""
     if run_defaulting:
         default_and_validate(spec)
     out: List[dict] = []
@@ -344,12 +390,45 @@ def generate_manifests(spec: SeldonDeploymentSpec,
                     f"component name 'engine' is reserved "
                     f"(predictor {predictor.name!r})"
                 )
+        sharded_names: set = set()
+        engine_pred = predictor
+        if _shard_enabled(spec):
+            from seldon_core_tpu.graph.sharding import (
+                node_subspec,
+                shard_predictor,
+                shardable_nodes,
+            )
+
+            nodes = shardable_nodes(predictor)
+            if len(nodes) >= 2:
+                endpoints = {}
+                for unit in nodes:
+                    nspec = node_subspec(spec, unit.name, predictor.name)
+                    node_pred = nspec.predictors[0]
+                    out.append(
+                        engine_deployment(nspec, node_pred,
+                                          engine_image=engine_image,
+                                          engine_env=engine_env)
+                    )
+                    out.append(node_engine_service(nspec, node_pred))
+                    # the node Service's DNS name is the nspec name
+                    endpoints[unit.name] = (nspec.name, ENGINE_REST_PORT)
+                engine_pred = shard_predictor(
+                    spec, endpoints, predictor.name
+                ).predictor(predictor.name)
+                sharded_names = set(endpoints)
         out.append(
-            engine_deployment(spec, predictor, engine_image=engine_image,
+            engine_deployment(spec, engine_pred, engine_image=engine_image,
                               engine_env=engine_env)
         )
-        for binding in predictor.components:
-            if binding.runtime in ("rest", "grpc"):
+        for binding in engine_pred.components:
+            if (
+                binding.runtime in ("rest", "grpc")
+                and binding.name not in sharded_names
+            ):
+                # genuinely-remote components keep their microservice
+                # Deployment; sharded leaves are node ENGINES above, not
+                # generic model pods
                 out.append(component_deployment(spec, predictor, binding))
                 out.append(component_service(spec, predictor, binding))
     out.append(deployment_service(spec))

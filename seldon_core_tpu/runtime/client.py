@@ -162,7 +162,18 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
 
         self.node = node
         self.binding = binding
-        self.base = f"http://{binding.host or 'localhost'}:{binding.port}"
+        host = binding.host or "localhost"
+        # co-located node engines may bind a unix socket (httpfast.py
+        # start_uds): a "unix:/path/to.sock" host dials it through
+        # aiohttp's UnixConnector — same HTTP surface, no TCP stack in
+        # the loop.  The URL host is a placeholder (the connector ignores
+        # it); retries/breakers/deadlines apply unchanged.
+        self._uds_path: Optional[str] = None
+        if host.startswith("unix:"):
+            self._uds_path = host[len("unix:"):]
+            self.base = "http://engine"
+        else:
+            self.base = f"http://{host}:{binding.port}"
         self.timeout_s = timeout_s
         self.retry_policy = retry_policy or RetryPolicy(max_attempts=retries)
         self.breaker = breaker
@@ -182,7 +193,13 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
             # no session-level total timeout: each ATTEMPT gets its own
             # ClientTimeout clamped to the remaining request budget — a
             # session-wide total would multiply by the retry count
-            self._session = aiohttp.ClientSession(headers=self._headers)
+            connector = (
+                aiohttp.UnixConnector(path=self._uds_path)
+                if self._uds_path is not None else None
+            )
+            self._session = aiohttp.ClientSession(
+                headers=self._headers, connector=connector
+            )
         return self._session
 
     async def close(self) -> None:

@@ -13,6 +13,21 @@ Ports: ``ENGINE_SERVER_PORT`` (default 8000) REST,
 operator wires into every engine container
 (cluster-manager SeldonDeploymentOperatorImpl.java:98-144).
 
+Serving-mesh extensions:
+
+* ``ENGINE_GRAPH_NODE`` / ``--node NAME`` — serve ONE node of the loaded
+  deployment's graph as a standalone engine (graph/sharding.py
+  node_subspec): the pod-per-node topology; the root engine dispatches
+  to it over ``POST /predict``.
+* ``ENGINE_UDS_PATH`` / ``--uds-path`` — additionally bind the zero-copy
+  length-prefixed relay lane on a unix socket (runtime/udsrelay.py) for
+  a co-located gateway.  ``SELDON_TPU_UDS=0`` skips the bind.
+* ``ENGINE_HTTP_UDS_PATH`` / ``--http-uds-path`` — additionally serve
+  the FULL HTTP route table on a unix socket (httpfast.py fast lane) so
+  a co-located root engine can dial this node engine with a ``unix:``
+  binding (runtime/client.py UnixConnector).  Distinct from the framed
+  relay above: this one speaks HTTP.
+
     python -m seldon_core_tpu.runtime.engine_main [--file deployment.json]
 """
 
@@ -73,7 +88,8 @@ def load_deployment_from_env(
 
 
 async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
-                host="0.0.0.0", rest_port=None, grpc_port=None) -> None:
+                host="0.0.0.0", rest_port=None, grpc_port=None,
+                uds_path=None, http_uds_path=None) -> None:
     from seldon_core_tpu.runtime.engine import EngineService
     from seldon_core_tpu.runtime.grpc_server import make_engine_grpc_server
     from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
@@ -173,9 +189,31 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
 
         async def grpc_stop():
             await grpc_server.stop(grace=5.0)
+    # zero-copy relay lane for a co-located gateway (runtime/udsrelay.py);
+    # rides ALONGSIDE the TCP lanes — /stats scrape + SSE stay on TCP
+    uds_server = None
+    uds_path = uds_path or os.environ.get("ENGINE_UDS_PATH", "").strip()
+    if uds_path and os.environ.get("SELDON_TPU_UDS", "1") != "0":
+        from seldon_core_tpu.runtime.udsrelay import serve_uds
+
+        uds_server = await serve_uds(engine, uds_path)
+    # HTTP face on a unix socket: the node-mesh lane a sharded root's
+    # `unix:` binding dials (runtime/client.py).  Bound regardless of the
+    # main HTTP lane's impl — the native plane can't listen on a UDS
+    http_uds_server = None
+    http_uds_path = http_uds_path or \
+        os.environ.get("ENGINE_HTTP_UDS_PATH", "").strip()
+    if http_uds_path and os.environ.get("SELDON_TPU_UDS", "1") != "0":
+        from seldon_core_tpu.runtime.httpfast import FastHttpServer
+
+        http_uds_server = FastHttpServer(engine)
+        await http_uds_server.start_uds(http_uds_path)
     print(
         f"engine up: predictor={engine.predictor.name} mode={engine.mode} "
-        f"rest=:{rest_port} grpc=:{grpc_port}",
+        f"rest=:{rest_port} grpc=:{grpc_port}"
+        + (f" uds={uds_path}" if uds_server is not None else "")
+        + (f" http-uds={http_uds_path}"
+           if http_uds_server is not None else ""),
         flush=True,
     )
 
@@ -217,6 +255,10 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         await runner.cleanup()
     if fast_server is not None:
         await fast_server.stop()
+    if uds_server is not None:
+        await uds_server.stop()
+    if http_uds_server is not None:
+        await http_uds_server.stop()
     if native_plane is not None:
         await native_plane.stop()
     print("engine stopped", flush=True)
@@ -229,6 +271,22 @@ def main(argv=None) -> None:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--rest-port", type=int, default=None)
     parser.add_argument("--grpc-port", type=int, default=None)
+    parser.add_argument(
+        "--node", default=None,
+        help="serve ONE graph node of the deployment as a standalone "
+             "node engine (graph sharding; env ENGINE_GRAPH_NODE)",
+    )
+    parser.add_argument(
+        "--uds-path", default=None,
+        help="also bind the zero-copy UDS relay lane on this socket path "
+             "(env ENGINE_UDS_PATH)",
+    )
+    parser.add_argument(
+        "--http-uds-path", default=None,
+        help="also serve the HTTP route table on this unix socket — the "
+             "node-mesh lane a sharded root's unix: binding dials "
+             "(env ENGINE_HTTP_UDS_PATH)",
+    )
     args = parser.parse_args(argv)
     if os.environ.get("SELDON_FORCE_CPU") == "1":
         # host-CPU serving for control-plane demos/tests: several engines
@@ -242,8 +300,20 @@ def main(argv=None) -> None:
 
     enable_compile_cache()
     deployment = load_deployment_from_env(args.file)
+    node = args.node or os.environ.get("ENGINE_GRAPH_NODE", "").strip()
+    if node:
+        # pod-per-node topology: this process serves ONE leaf of the graph
+        # (the operator ships the FULL deployment to every shard; the node
+        # name selects the slice — graph/sharding.py)
+        from seldon_core_tpu.graph.sharding import node_subspec
+
+        deployment = default_and_validate(
+            node_subspec(deployment, node, args.predictor)
+        )
     asyncio.run(
-        serve(deployment, args.predictor, args.host, args.rest_port, args.grpc_port)
+        serve(deployment, args.predictor, args.host, args.rest_port,
+              args.grpc_port, uds_path=args.uds_path,
+              http_uds_path=args.http_uds_path)
     )
 
 

@@ -611,11 +611,17 @@ class _FastHttpProtocol(asyncio.Protocol):
 
 
 class FastHttpServer:
-    """Owns the listening socket; ``await start()`` / ``await stop()``."""
+    """Owns the listening socket; ``await start()`` / ``await stop()``.
+    ``start_uds`` additionally serves the SAME route table over a unix
+    domain socket — the HTTP face of the co-located lane (the gateway's
+    framed relay is runtime/udsrelay.py; this one serves node-mesh peers
+    dialing ``unix:`` bindings through runtime/client.py)."""
 
     def __init__(self, engine):
         self.routes = _EngineRoutes(engine)
         self._server: Optional[asyncio.AbstractServer] = None
+        self._uds_server: Optional[asyncio.AbstractServer] = None
+        self._uds_path: Optional[str] = None
         self._protocols: set = set()
 
     async def start(self, host: str, port: int) -> None:
@@ -626,24 +632,54 @@ class FastHttpServer:
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
+    async def start_uds(self, path: str) -> None:
+        import os
+
+        try:
+            os.unlink(path)  # stale socket from a crashed predecessor
+        except FileNotFoundError:
+            pass
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        loop = asyncio.get_running_loop()
+        self._uds_server = await loop.create_unix_server(
+            lambda: _FastHttpProtocol(self.routes, self._protocols),
+            path=path,
+        )
+        self._uds_path = path
+
     async def stop(self) -> None:
-        if self._server is None:
+        servers = [s for s in (self._server, self._uds_server) if s is not None]
+        if not servers:
             return
-        self._server.close()
+        for s in servers:
+            s.close()
         # Server.wait_closed (3.12.1+) waits for every connection handler;
         # idle keepalive connections never finish on their own, so close
         # their transports first or shutdown hangs forever
         for proto in list(self._protocols):
             if proto.transport is not None:
                 proto.transport.close()
-        try:
-            await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
-        except asyncio.TimeoutError:
-            pass  # listener is closed either way; don't wedge shutdown
+        for s in servers:
+            try:
+                await asyncio.wait_for(s.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass  # listener is closed either way; don't wedge shutdown
         self._server = None
+        self._uds_server = None
+        if self._uds_path is not None:
+            import os
+
+            try:
+                os.unlink(self._uds_path)
+            except FileNotFoundError:
+                pass
+            self._uds_path = None
 
 
-async def serve_fast(engine, host: str, port: int) -> FastHttpServer:
+async def serve_fast(engine, host: str, port: int,
+                     uds_path: Optional[str] = None) -> FastHttpServer:
     server = FastHttpServer(engine)
     await server.start(host, port)
+    if uds_path:
+        await server.start_uds(uds_path)
     return server

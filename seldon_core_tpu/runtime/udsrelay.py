@@ -1,0 +1,401 @@
+"""Zero-copy UDS relay lane — co-located gateway<->engine dispatch.
+
+Every bench round has had ``relay_floor_ms`` bounded by the TCP loopback
+hop's fixed costs: connection bookkeeping, HTTP head composition, header
+re-parse, chunked-body state machines.  When gateway and engine share a
+host none of that buys anything, so this lane replaces it with the
+cheapest framing that still multiplexes methods:
+
+    request  frame:  !IB   payload_len(u32) | op(u8)      | payload
+    response frame:  !IH   payload_len(u32) | status(u16) | payload
+
+over a ``SOCK_STREAM`` unix domain socket.  No TLS, no header re-parse,
+no per-request allocation beyond the payload itself: the server slices
+the receive buffer with memoryviews (one prefix trim per read, the
+httpfast.py discipline) and hands the body view off until the single
+str-decode the engine's ``predict_json`` contract requires; responses go
+out as one ``writev``-shaped (header, body) pair.
+
+Ops:
+
+    OP_PREDICT   payload = SeldonMessage JSON  -> response JSON + status
+    OP_FEEDBACK  payload = Feedback JSON       -> ack JSON + status
+    OP_PING      empty                         -> b"pong", 200
+
+Scope (documented contract, tests/test_udsrelay.py): unary predict and
+feedback only — SSE streaming and the observability surfaces stay on the
+TCP lane (an endpoint spec ``http://..+uds:/path`` carries both).  The
+frame carries no headers, so deadline budgets and trace context do NOT
+propagate to the engine on this lane: the gateway clamps the hop to its
+remaining budget locally (apife._uds_call) and the hop is traced from
+the gateway span only.  Calls needing engine-side deadline clamping or
+joined engine spans belong on the TCP lane.  The
+client pipelines nothing: each pooled connection carries one request at
+a time, so responses can never interleave.  ``SELDON_TPU_UDS=0``
+(gateway/balancer.py) keeps every dispatch on TCP.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from typing import Optional
+
+from seldon_core_tpu.messages import (
+    Feedback,
+    SeldonMessage,
+    SeldonMessageError,
+)
+
+__all__ = [
+    "OP_PREDICT",
+    "OP_FEEDBACK",
+    "OP_PING",
+    "UdsEngineServer",
+    "UdsRelayClient",
+    "serve_uds",
+]
+
+OP_PREDICT = 1
+OP_FEEDBACK = 2
+OP_PING = 3
+
+_REQ_HEAD = struct.Struct("!IB")   # payload length, op
+_RESP_HEAD = struct.Struct("!IH")  # payload length, status
+_MAX_FRAME = 256 * 1024 * 1024     # matches the HTTP lanes' body cap
+_JSON_500 = 500
+# per-connection backpressure: the shipped client never pipelines, but
+# the server must not trust that — a runaway local writer would otherwise
+# turn every buffered frame into a concurrent engine task.  Reading
+# pauses once this many responses are pending and resumes at the low
+# mark; excess frames wait in the kernel socket buffer until the
+# client's writes block.
+_PAUSE_PENDING = 64
+_RESUME_PENDING = 16
+
+
+class _UdsServerProtocol(asyncio.Protocol):
+    """One accepted relay connection.  Requests on a connection are
+    handled strictly in order (the client sends one at a time); a handler
+    task per frame keeps a slow dispatch from blocking other
+    CONNECTIONS, while the per-connection FIFO queue keeps responses in
+    request order if a client ever does pipeline."""
+
+    def __init__(self, engine, protocols: Optional[set] = None):
+        self.engine = engine
+        self.protocols = protocols
+        self.buf = bytearray()
+        self.transport: Optional[asyncio.Transport] = None
+        self.queue: "asyncio.Queue" = asyncio.Queue()
+        self.writer_task: Optional[asyncio.Task] = None
+        self.closing = False
+        self.paused = False
+        self.close_after_drain = False
+
+    def connection_made(self, transport):
+        self.transport = transport
+        if self.protocols is not None:
+            self.protocols.add(self)
+        self.writer_task = asyncio.get_running_loop().create_task(
+            self._writer()
+        )
+
+    def connection_lost(self, exc):
+        self.closing = True
+        if self.protocols is not None:
+            self.protocols.discard(self)
+        if self.writer_task is not None:
+            self.writer_task.cancel()
+        # cancel handler tasks still queued behind the writer — their
+        # client is gone; without this they run to completion unconsumed
+        # (wasted engine work + "Task exception was never retrieved")
+        while True:
+            try:
+                task = self.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            task.cancel()
+
+    async def _writer(self):
+        while True:
+            task = await self.queue.get()
+            if (
+                self.paused
+                and self.queue.qsize() < _RESUME_PENDING
+                and self.transport is not None
+                and not self.transport.is_closing()
+            ):
+                self.paused = False
+                self.transport.resume_reading()
+            try:
+                status, body = await task
+            except asyncio.CancelledError:
+                raise
+            except SeldonMessageError as e:
+                status = e.http_code
+                body = SeldonMessage.failure(
+                    str(e), code=status
+                ).to_json().encode()
+            except Exception as e:  # unexpected: 500, keep serving
+                status = _JSON_500
+                body = SeldonMessage.failure(
+                    str(e), code=_JSON_500
+                ).to_json().encode()
+            if self.transport is None or self.transport.is_closing():
+                continue
+            # one head + one body write — the transport coalesces into a
+            # single writev; no intermediate head+body concatenation copy
+            self.transport.write(_RESP_HEAD.pack(len(body), status))
+            if body:
+                self.transport.write(body)
+            if self.close_after_drain and self.queue.empty():
+                # the terminal 413 (and everything queued before it) is
+                # out; now the connection can die
+                self.transport.close()
+                return
+
+    def data_received(self, data):
+        self.buf += data
+        consumed = 0
+        view = memoryview(self.buf)
+        try:
+            while not self.closing:
+                remaining = len(self.buf) - consumed
+                if remaining < _REQ_HEAD.size:
+                    break
+                length, op = _REQ_HEAD.unpack_from(view, consumed)
+                if length > _MAX_FRAME:
+                    # stop parsing, but the 413 rides the FIFO writer
+                    # BEHIND any already-queued responses — writing it
+                    # directly would let a pipelining client read it as
+                    # the answer to an earlier, still-running request.
+                    # The writer closes the transport once drained.
+                    self.closing = True
+                    self.close_after_drain = True
+                    body = SeldonMessage.failure(
+                        "frame too large", code=413
+                    ).to_json().encode()
+
+                    async def _reject(b=body):
+                        return 413, b
+
+                    task = asyncio.get_running_loop().create_task(
+                        _reject()
+                    )
+                    task.add_done_callback(
+                        lambda t: None if t.cancelled() else t.exception()
+                    )
+                    self.queue.put_nowait(task)
+                    break
+                if remaining < _REQ_HEAD.size + length:
+                    break
+                start = consumed + _REQ_HEAD.size
+                # the payload is sliced as a view of the receive buffer
+                # and decoded exactly once — the engine's predict_json
+                # contract is str, and that decode is the lane's only
+                # copy.  release() before the buffer trim below: a live
+                # export would make the bytearray unresizable.
+                with view[start: start + length] as payload:
+                    text = str(payload, "utf-8", "replace")
+                self._dispatch(op, text)
+                consumed = start + length
+        finally:
+            view.release()
+        if consumed:
+            del self.buf[:consumed]
+
+    def _dispatch(self, op: int, text: str):
+        task = asyncio.get_running_loop().create_task(
+            self._handle(op, text)
+        )
+        # the writer normally consumes the result; if it is cancelled
+        # mid-await (client hung up) the in-flight handler finishes
+        # detached — retrieve its exception so asyncio doesn't log
+        # "Task exception was never retrieved" on every disconnect
+        task.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception()
+        )
+        self.queue.put_nowait(task)
+        if not self.paused and self.queue.qsize() >= _PAUSE_PENDING:
+            self.paused = True
+            self.transport.pause_reading()
+
+    async def _handle(self, op: int, text: str):
+        if op == OP_PREDICT:
+            text_out, status = await self.engine.predict_json(text)
+            return status or 200, text_out.encode()
+        if op == OP_FEEDBACK:
+            fb = Feedback.from_json(text)
+            ack = await self.engine.send_feedback(fb)
+            ok = ack.status is None or ack.status.status == "SUCCESS"
+            status = 200 if ok else (ack.status.code or 200)
+            return status or 200, ack.to_json().encode()
+        if op == OP_PING:
+            return 200, b"pong"
+        return 400, SeldonMessage.failure(
+            f"unknown relay op {op}", code=400
+        ).to_json().encode()
+
+
+class UdsEngineServer:
+    """Owns the listening unix socket; ``await start()`` / ``await
+    stop()``.  A stale socket file from a crashed predecessor is unlinked
+    before binding (the conventional UDS idiom)."""
+
+    def __init__(self, engine, path: str):
+        self.engine = engine
+        self.path = path
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._protocols: set = set()
+
+    async def start(self) -> None:
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_unix_server(
+            lambda: _UdsServerProtocol(self.engine, self._protocols),
+            path=self.path,
+        )
+
+    async def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.close()
+        for proto in list(self._protocols):
+            if proto.transport is not None:
+                proto.transport.close()
+        try:
+            await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+        except asyncio.TimeoutError:
+            pass
+        self._server = None
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+async def serve_uds(engine, path: str) -> UdsEngineServer:
+    server = UdsEngineServer(engine, path)
+    await server.start()
+    return server
+
+
+class UdsRelayClient:
+    """Pooled relay client: up to ``pool`` persistent connections to one
+    engine socket, each carrying one request at a time (acquire ->
+    write frame -> read response -> release).  A connection that errors
+    mid-call is dropped and the call fails typed; the next call dials a
+    fresh one — connection establishment over UDS is microseconds, so no
+    retry choreography is worth its complexity here (the gateway's
+    breaker/retry machinery sits above this lane)."""
+
+    def __init__(self, path: str, pool: int = 8):
+        self.path = path
+        self.pool = max(1, int(pool))
+        self._idle: "asyncio.Queue" = asyncio.Queue()
+        self._open = 0
+        self._lock = asyncio.Lock()
+        self.closed = False
+
+    async def _acquire(self):
+        while True:
+            try:
+                conn = self._idle.get_nowait()
+            except asyncio.QueueEmpty:
+                conn = None
+            # None is the freed-capacity token a broken release leaves so
+            # a waiter can dial fresh instead of sleeping forever
+            if conn is not None:
+                reader, writer = conn
+                if writer.is_closing():
+                    self._open -= 1
+                    continue
+                return conn
+            async with self._lock:
+                if self._open < self.pool:
+                    self._open += 1
+                    try:
+                        return await asyncio.open_unix_connection(self.path)
+                    except (OSError, asyncio.CancelledError):
+                        # CancelledError: a deadline timeout landed mid-
+                        # dial — the slot must go back or N timeouts
+                        # exhaust the pool forever
+                        self._open -= 1
+                        self._idle.put_nowait(None)
+                        raise
+            # pool exhausted: wait for a release (a live connection, or a
+            # None capacity token from a broken one)
+            conn = await self._idle.get()
+            if conn is None:
+                continue
+            reader, writer = conn
+            if writer.is_closing():
+                self._open -= 1
+                self._idle.put_nowait(None)
+                continue
+            return conn
+
+    def _release(self, conn, broken: bool = False) -> None:
+        if broken or self.closed:
+            self._open -= 1
+            conn[1].close()
+            # wake one pool waiter: capacity is free even though no
+            # connection came back (without this, a caller blocked in
+            # _acquire hangs forever once every held connection breaks)
+            self._idle.put_nowait(None)
+            return
+        self._idle.put_nowait(conn)
+
+    async def call(self, op: int, payload: bytes) -> "tuple[bytes, int]":
+        """One framed round trip; returns ``(body, status)``."""
+        if self.closed:
+            raise ConnectionError("relay client closed")
+        conn = await self._acquire()
+        reader, writer = conn
+        try:
+            writer.write(_REQ_HEAD.pack(len(payload), op))
+            if payload:
+                writer.write(payload)
+            await writer.drain()
+            head = await reader.readexactly(_RESP_HEAD.size)
+            length, status = _RESP_HEAD.unpack(head)
+            body = await reader.readexactly(length) if length else b""
+        except (OSError, asyncio.IncompleteReadError) as e:
+            self._release(conn, broken=True)
+            raise ConnectionError(f"uds relay {self.path}: {e}") from e
+        except asyncio.CancelledError:
+            # a deadline/timeout cancelled us mid-frame: the connection
+            # has an orphaned request in flight — drop it, free the slot
+            self._release(conn, broken=True)
+            raise
+        self._release(conn)
+        return body, status
+
+    async def predict(self, payload: str) -> "tuple[str, int]":
+        body, status = await self.call(OP_PREDICT, payload.encode())
+        return body.decode("utf-8", "replace"), status
+
+    async def feedback(self, payload: str) -> "tuple[str, int]":
+        body, status = await self.call(OP_FEEDBACK, payload.encode())
+        return body.decode("utf-8", "replace"), status
+
+    async def ping(self) -> bool:
+        body, status = await self.call(OP_PING, b"")
+        return status == 200 and body == b"pong"
+
+    async def close(self) -> None:
+        self.closed = True
+        while True:
+            try:
+                conn = self._idle.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if conn is None:  # capacity token from a broken release
+                continue
+            self._open -= 1
+            conn[1].close()

@@ -35,7 +35,7 @@ from typing import Dict, List, Mapping, Optional, Union
 from seldon_core_tpu.graph.interpreter import NodeRuntime
 from seldon_core_tpu.messages import Feedback, SeldonMessage
 
-__all__ = ["FaultSpec", "FaultyNodeRuntime", "InjectedFault"]
+__all__ = ["FaultSpec", "FaultyNodeRuntime", "FaultyEngine", "InjectedFault"]
 
 
 class InjectedFault(Exception):
@@ -160,3 +160,59 @@ class FaultyNodeRuntime(NodeRuntime):
         closer = getattr(self.inner, "close", None)
         if closer is not None:
             await closer()
+
+
+class FaultyEngine:
+    """An ``EngineService`` wrapper injecting faults at the ENGINE edge —
+    the replica-set counterpart of :class:`FaultyNodeRuntime` (which wraps
+    graph-node hops).  A gateway replica set built over
+    ``[engine, FaultyEngine(engine2, delay_s=...)]`` exercises the
+    power-of-two-choices balancer against a deterministically slow or
+    failing replica (scripts/scale_demo.py, tests/test_replica_balancer.py).
+
+    Same determinism contract as the node wrapper: one seeded RNG stream,
+    per-method call counts in ``self.calls``."""
+
+    def __init__(self, inner, faults: Union[FaultSpec, Mapping[str, FaultSpec]],
+                 seed: int = 0):
+        self.inner = inner
+        self._faults = faults
+        self._rng = random.Random(seed)
+        self.calls: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+
+    def _spec_for(self, method: str) -> Optional[FaultSpec]:
+        if isinstance(self._faults, FaultSpec):
+            return self._faults
+        return self._faults.get(method)
+
+    async def _maybe_fault(self, method: str) -> bool:
+        """Delay always applies; one uniform draw decides error vs
+        malformed (timeouts collapse into errors at this edge — the
+        gateway sees a failure message either way).  True = respond with
+        a FAILURE message instead of delegating."""
+        self.calls[method] = self.calls.get(method, 0) + 1
+        spec = self._spec_for(method)
+        if spec is None:
+            return False
+        if spec.delay_s > 0:
+            await asyncio.sleep(spec.delay_s)
+        if self._rng.random() < spec.total_failure_rate:
+            self.injected[method] = self.injected.get(method, 0) + 1
+            return True
+        return False
+
+    async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        if await self._maybe_fault("predict"):
+            return SeldonMessage.failure("injected engine fault", code=503)
+        return await self.inner.predict(msg)
+
+    async def send_feedback(self, feedback: Feedback) -> SeldonMessage:
+        if await self._maybe_fault("send_feedback"):
+            return SeldonMessage.failure("injected engine fault", code=503)
+        return await self.inner.send_feedback(feedback)
+
+    def __getattr__(self, name):
+        # stats/ready/open_breakers/predict_json/... delegate untouched so
+        # the wrapper stays a drop-in EngineService wherever one is used
+        return getattr(self.inner, name)

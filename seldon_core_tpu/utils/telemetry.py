@@ -125,6 +125,22 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_gen_admitted_total": ("counter", ()),
     "seldon_tpu_gen_retired_total": ("counter", ("reason",)),
     "seldon_tpu_gen_steps_total": ("counter", ("kind",)),
+    # serving-mesh data plane (gateway/balancer.py): per-replica gateway-
+    # side inflight and pick counts (the power-of-two-choices signal and
+    # its outcome — max/mean of the inflight gauge is the imbalance the
+    # SeldonTPUReplicaImbalance alert watches), hindsight mispicks (the
+    # chosen replica finished slower than the losing candidate's EWMA at
+    # decision time), and per-lane relay counters (uds vs tcp vs
+    # inprocess — says which transport the gateway->engine hop actually
+    # rode)
+    # the ``set`` label is the replica-set identity (deployment/predictor
+    # at the gateway): imbalance is only meaningful WITHIN one set — a
+    # 95/5 canary's idle second set would otherwise drag a cross-set
+    # average down and page the imbalance alert forever
+    "seldon_tpu_replica_inflight": ("gauge", ("set", "replica")),
+    "seldon_tpu_replica_picks_total": ("counter", ("set", "replica")),
+    "seldon_tpu_replica_mispicks_total": ("counter", ()),
+    "seldon_tpu_relay_lane_requests_total": ("counter", ("lane",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -267,6 +283,13 @@ class FlightRecorder:
         self.gen_admitted = 0
         self.gen_retired: Dict[str, int] = {}
         self.gen_steps: Dict[str, int] = {}
+        # serving-mesh mirrors (gateway/balancer.py feeds these): per-
+        # set per-replica gateway-side inflight + lifetime picks,
+        # hindsight mispicks, and gateway->engine requests by relay lane
+        self.replica_inflight: Dict[str, Dict[str, int]] = {}
+        self.replica_picks: Dict[str, Dict[str, int]] = {}
+        self.replica_mispicks = 0
+        self.lane_requests: Dict[str, int] = {}
         # Prometheus high-water mark per hop: the counter is advanced by
         # deltas against THIS, not the snapshot mirror above — reset()
         # clears the mirror but must not rewind the monotone counter's
@@ -488,6 +511,28 @@ class FlightRecorder:
                 "Scheduler steps executed, by kind (prefill / decode / "
                 "spec / mixed)",
                 ["kind"], registry=self.registry)
+            self._p_replica_inflight = Gauge(
+                "seldon_tpu_replica_inflight",
+                "Gateway-side in-flight requests per engine replica "
+                "(the power-of-two-choices load signal — "
+                "gateway/balancer.py; `set` = deployment/predictor)",
+                ["set", "replica"], registry=self.registry)
+            self._p_replica_picks = Counter(
+                "seldon_tpu_replica_picks_total",
+                "Requests routed to each engine replica by the gateway "
+                "balancer (`set` = deployment/predictor)",
+                ["set", "replica"], registry=self.registry)
+            self._p_replica_mispicks = Counter(
+                "seldon_tpu_replica_mispicks_total",
+                "p2c picks that finished slower than the losing "
+                "candidate's EWMA latency at decision time (ratio vs "
+                "seldon_tpu_replica_picks_total audits the balancer)",
+                registry=self.registry)
+            self._p_lane_requests = Counter(
+                "seldon_tpu_relay_lane_requests_total",
+                "Gateway->engine dispatches by relay lane "
+                "(uds / tcp / inprocess — runtime/udsrelay.py)",
+                ["lane"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -580,6 +625,42 @@ class FlightRecorder:
             self.gen_steps[kind] = self.gen_steps.get(kind, 0) + n
         if self.registry is not None:
             self._p_gen_steps.labels(kind=kind).inc(n)
+
+    # -- serving-mesh balancer (gateway/balancer.py feeds these) ---------
+
+    def set_replica_inflight(self, set_name: str, replica: str,
+                             n: int) -> None:
+        """Gateway-side outstanding requests on one replica of one
+        replica set (``set_name`` = deployment/predictor).  Deliberately
+        does NOT bump the stats-cache generation: it moves per request
+        under traffic, exactly when the cache exists to help."""
+        with self._lock:
+            self.replica_inflight.setdefault(set_name, {})[replica] = int(n)
+        if self.registry is not None:
+            self._p_replica_inflight.labels(
+                set=set_name, replica=replica
+            ).set(n)
+
+    def record_replica_pick(self, set_name: str, replica: str) -> None:
+        with self._lock:
+            picks = self.replica_picks.setdefault(set_name, {})
+            picks[replica] = picks.get(replica, 0) + 1
+        if self.registry is not None:
+            self._p_replica_picks.labels(
+                set=set_name, replica=replica
+            ).inc()
+
+    def record_replica_mispick(self) -> None:
+        with self._lock:
+            self.replica_mispicks += 1
+        if self.registry is not None:
+            self._p_replica_mispicks.inc()
+
+    def record_lane_request(self, lane: str) -> None:
+        with self._lock:
+            self.lane_requests[lane] = self.lane_requests.get(lane, 0) + 1
+        if self.registry is not None:
+            self._p_lane_requests.labels(lane=lane).inc()
 
     # -- compile cache / audit accounting -------------------------------
 
@@ -885,6 +966,16 @@ class FlightRecorder:
                 "agree": self.feedback_agree,
                 "disagree": self.feedback_disagree,
             }
+            replicas = {
+                "inflight": {
+                    s: dict(d) for s, d in self.replica_inflight.items()
+                },
+                "picks": {
+                    s: dict(d) for s, d in self.replica_picks.items()
+                },
+                "mispicks": self.replica_mispicks,
+                "lanes": dict(self.lane_requests),
+            }
             quality = {
                 "drift": dict(self.drift_scores),
                 "slo_burn": dict(self.slo_burn),
@@ -903,6 +994,7 @@ class FlightRecorder:
             "perf": perf,
             "feedback": feedback,
             "quality": quality,
+            "replicas": replicas,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -1006,6 +1098,10 @@ class FlightRecorder:
             self.gen_admitted = 0
             self.gen_retired = {}
             self.gen_steps = {}
+            self.replica_inflight = {}
+            self.replica_picks = {}
+            self.replica_mispicks = 0
+            self.lane_requests = {}
 
 
 RECORDER = FlightRecorder()

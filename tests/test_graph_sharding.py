@@ -1,0 +1,249 @@
+"""Graph sharding (graph/sharding.py + operator/manifests.py): one engine
+process per MODEL leaf, the reference's pod-per-node topology won back at
+process granularity.
+
+The end-to-end case is the contract that matters: a combiner graph served
+by a sharded root (node engines behind ``POST /predict`` over TCP and the
+``unix:`` socket lane) must produce the SAME predictions as the collapsed
+single-process engine — sharding is a topology change, never a numerics
+change."""
+
+import asyncio
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.sharding import (
+    node_subspec,
+    shard_predictor,
+    shardable_nodes,
+)
+from seldon_core_tpu.graph.spec import GraphSpecError, SeldonDeploymentSpec
+from seldon_core_tpu.operator.manifests import (
+    SHARD_ANNOTATION,
+    generate_manifests,
+)
+from seldon_core_tpu.runtime.engine import EngineService
+
+
+def combiner_spec(name="shard-dep", annotate=False, n_members=2):
+    members = [
+        {
+            "name": f"m{i}", "runtime": "inprocess",
+            "class_path": "SigmoidPredictor",
+            "parameters": [
+                {"name": "n_features", "value": "4", "type": "INT"},
+                {"name": "seed", "value": str(i), "type": "INT"},
+            ],
+        }
+        for i in range(n_members)
+    ]
+    doc = {
+        "spec": {
+            "name": name,
+            "predictors": [{
+                "name": "p",
+                "graph": {
+                    "name": "ens", "type": "COMBINER",
+                    "implementation": "AVERAGE_COMBINER",
+                    "children": [
+                        {"name": f"m{i}", "type": "MODEL"}
+                        for i in range(n_members)
+                    ],
+                },
+                "components": members,
+            }],
+        }
+    }
+    if annotate:
+        doc["spec"]["annotations"] = {SHARD_ANNOTATION: "true"}
+    return SeldonDeploymentSpec.from_json_dict(doc)
+
+
+def test_shardable_nodes_are_inprocess_model_leaves():
+    spec = combiner_spec()
+    nodes = shardable_nodes(spec.predictor("p"))
+    assert sorted(u.name for u in nodes) == ["m0", "m1"]
+
+    # a leaf already bound remotely is NOT shardable (it is already a pod)
+    remote = combiner_spec()
+    remote.predictors[0].components[0].runtime = "rest"
+    remote.predictors[0].components[0].host = "h"
+    remote.predictors[0].components[0].port = 9000
+    assert [u.name for u in shardable_nodes(remote.predictor("p"))] == ["m1"]
+
+
+def test_node_subspec_slices_one_leaf():
+    spec = combiner_spec(annotate=True)
+    sub = node_subspec(spec, "m0")
+    assert sub.name == "shard-dep-p-m0"
+    pred = sub.predictors[0]
+    assert pred.graph.name == "m0" and not pred.graph.children
+    assert [b.name for b in pred.components] == ["m0"]
+    # the shard marker must not survive into the subspec (it would
+    # re-shard on the next materialization pass)
+    assert SHARD_ANNOTATION not in sub.annotations
+    # slicing never mutates the source spec
+    assert spec.predictor("p").graph.find("m0") is not None
+
+    with pytest.raises(GraphSpecError, match="not found"):
+        node_subspec(spec, "nope")
+    with pytest.raises(GraphSpecError, match="children"):
+        node_subspec(spec, "ens")
+
+
+def test_shard_predictor_rewrites_bindings():
+    spec = combiner_spec()
+    sharded = shard_predictor(
+        spec, {"m0": ("node-a", 8000), "m1": ("unix:/run/m1.sock", 0)}
+    )
+    comp = {b.name: b for b in sharded.predictor("p").components}
+    assert comp["m0"].runtime == "rest"
+    assert (comp["m0"].host, comp["m0"].port) == ("node-a", 8000)
+    assert comp["m1"].host == "unix:/run/m1.sock"
+    # source spec untouched
+    assert all(
+        b.runtime == "inprocess"
+        for b in spec.predictor("p").components
+    )
+    with pytest.raises(GraphSpecError, match="not shardable"):
+        shard_predictor(spec, {"ens": ("h", 1)})
+
+
+def test_manifests_shard_annotation_materializes_node_engines():
+    spec = combiner_spec(annotate=True)
+    out = generate_manifests(spec)
+    deployments = {
+        m["metadata"]["name"] for m in out if m["kind"] == "Deployment"
+    }
+    services = {
+        m["metadata"]["name"] for m in out if m["kind"] == "Service"
+    }
+    # one engine Deployment+Service per shardable leaf, plus the root
+    assert {"shard-dep-p-m0-p-engine", "shard-dep-p-m1-p-engine",
+            "shard-dep-p-engine"} <= deployments
+    assert {"shard-dep-p-m0", "shard-dep-p-m1", "shard-dep"} <= services
+    # the ROOT engine's predictor env carries the REWRITTEN graph: its
+    # leaves dispatch to the node Services, not in-process
+    import base64
+
+    root = next(
+        m for m in out
+        if m["metadata"]["name"] == "shard-dep-p-engine"
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in root["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    pred = json.loads(base64.b64decode(env["ENGINE_PREDICTOR"]))
+    bindings = {
+        c["name"]: c
+        for cs in pred["componentSpecs"]
+        for c in cs["spec"]["containers"]
+    }
+    assert bindings["m0"]["runtime"] == "rest"
+    assert bindings["m0"]["host"] == "shard-dep-p-m0"
+    assert bindings["m1"]["runtime"] == "rest"
+    # sharded leaves became node ENGINES — no generic component model
+    # pods duplicated for them
+    assert not any(
+        d.endswith(("-m0", "-m1")) and "engine" not in d
+        for d in deployments
+    )
+
+
+def test_manifests_single_leaf_stays_collapsed():
+    spec = combiner_spec(annotate=True, n_members=1)
+    out = generate_manifests(spec)
+    deployments = {
+        m["metadata"]["name"] for m in out if m["kind"] == "Deployment"
+    }
+    assert deployments == {"shard-dep-p-engine"}
+
+
+def test_unannotated_spec_unchanged():
+    plain = combiner_spec(annotate=False)
+    out = generate_manifests(plain)
+    deployments = {
+        m["metadata"]["name"] for m in out if m["kind"] == "Deployment"
+    }
+    assert deployments == {"shard-dep-p-engine"}
+
+
+def test_sharded_serving_matches_collapsed(tmp_path):
+    """Pod-per-node at process granularity: m0 behind a TCP node engine,
+    m1 behind a ``unix:`` socket node engine, the root dispatching both —
+    predictions identical to the collapsed single-process engine."""
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+
+    async def run():
+        spec = combiner_spec()
+        collapsed = EngineService(spec, max_batch=8, max_wait_ms=0.5)
+
+        e0 = EngineService(
+            node_subspec(spec, "m0"), max_batch=8, max_wait_ms=0.5
+        )
+        e1 = EngineService(
+            node_subspec(spec, "m1"), max_batch=8, max_wait_ms=0.5
+        )
+        s0 = await serve_fast(e0, "127.0.0.1", 0)
+        uds = str(tmp_path / "m1.sock")
+        s1 = await serve_fast(e1, "127.0.0.1", 0, uds_path=uds)
+        sharded_spec = shard_predictor(spec, {
+            "m0": ("127.0.0.1", s0.port),
+            "m1": (f"unix:{uds}", 0),
+        })
+        root = EngineService(sharded_spec, max_batch=8, max_wait_ms=0.5)
+
+        rng = np.random.default_rng(0)
+        payload = json.dumps({
+            "data": {"ndarray": rng.normal(size=(3, 4)).tolist()}
+        })
+        want_text, want_status = await collapsed.predict_json(payload)
+        got_text, got_status = await root.predict_json(payload)
+        assert want_status == 200 and got_status == 200
+        want = np.asarray(json.loads(want_text)["data"]["ndarray"])
+        got = np.asarray(json.loads(got_text)["data"]["ndarray"])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+        await root.close()
+        await s0.stop()
+        await s1.stop()
+        await e0.close()
+        await e1.close()
+        await collapsed.close()
+
+    asyncio.run(run())
+
+
+def test_engine_main_node_selection(tmp_path, monkeypatch):
+    """``ENGINE_GRAPH_NODE`` slices the shipped deployment down to one
+    leaf before serving — the operator ships the FULL spec to every
+    shard and the env selects the slice (engine_main.main's exact path:
+    load -> node_subspec -> default_and_validate)."""
+    from seldon_core_tpu.graph.defaulting import default_and_validate
+    from seldon_core_tpu.runtime.engine_main import load_deployment_from_env
+
+    monkeypatch.delenv("ENGINE_PREDICTOR", raising=False)
+    monkeypatch.delenv("ENGINE_SELDON_DEPLOYMENT", raising=False)
+    spec_path = tmp_path / "dep.json"
+    spec_path.write_text(combiner_spec().to_json())
+    full = load_deployment_from_env(str(spec_path))
+    sliced = default_and_validate(node_subspec(full, "m1", None))
+    pred = sliced.predictors[0]
+    assert sliced.name == "shard-dep-p-m1"
+    assert pred.graph.name == "m1" and not pred.graph.children
+    assert [b.name for b in pred.components] == ["m1"]
+    # the slice boots a real engine (the node process the root dials)
+    engine = EngineService(sliced, max_batch=4, max_wait_ms=0.5)
+
+    async def run():
+        text, status = await engine.predict_json(json.dumps(
+            {"data": {"ndarray": [[0.0, 0.1, 0.2, 0.3]]}}
+        ))
+        assert status == 200
+        await engine.close()
+
+    asyncio.run(run())

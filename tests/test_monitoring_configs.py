@@ -128,5 +128,10 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_gen_admitted_total",
         "seldon_tpu_gen_retired_total",
         "seldon_tpu_gen_steps_total",
+        # serving-mesh replica balancer (gateway/balancer.py)
+        "seldon_tpu_replica_inflight",
+        "seldon_tpu_replica_picks_total",
+        "seldon_tpu_replica_mispicks_total",
+        "seldon_tpu_relay_lane_requests_total",
     ):
         assert family in text, f"{family} missing from every dashboard"

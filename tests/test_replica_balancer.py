@@ -1,0 +1,599 @@
+"""Engine replica sets + power-of-two-choices balancing
+(gateway/balancer.py), the gateway integration (apife._pick_engine
+weight math, decision span attrs, dispatch accounting), and the shared
+sqlite store's multi-engine registrations.
+
+The hand-computed cases pin the score function — expected wait =
+(inflight + scraped + 1) x max(ewma, floor), plus the degraded penalty —
+because a broken score silently turns p2c into random choice and only
+shows up as tail latency much later."""
+
+import asyncio
+import json
+import random
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+from seldon_core_tpu.gateway.balancer import (
+    _EWMA_ALPHA,
+    _EWMA_FLOOR_MS,
+    _UNHEALTHY_PENALTY,
+    PickDecision,
+    ReplicaEndpoint,
+    ReplicaSet,
+    parse_endpoint_spec,
+)
+from seldon_core_tpu.gateway.state import SqliteDeploymentStore
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.testing.faults import FaultSpec, FaultyEngine
+from seldon_core_tpu.utils.telemetry import RECORDER
+
+
+def sigmoid_spec(name="rs-dep", replicas=2, n_predictors=1):
+    def predictor(pname, seed, reps):
+        return {
+            "name": pname,
+            "replicas": reps,
+            "graph": {"name": "m", "type": "MODEL"},
+            "components": [{
+                "name": "m", "runtime": "inprocess",
+                "class_path": "SigmoidPredictor",
+                "parameters": [
+                    {"name": "n_features", "value": "4", "type": "INT"},
+                    {"name": "seed", "value": str(seed), "type": "INT"},
+                ],
+            }],
+        }
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": name,
+            "oauth_key": "k", "oauth_secret": "s",
+            "predictors": [
+                predictor(f"p{i}" if n_predictors > 1 else "p", i, replicas)
+                for i in range(n_predictors)
+            ],
+        }
+    })
+
+
+def msg4():
+    return SeldonMessage.from_array(np.zeros((1, 4), np.float32))
+
+
+# -- endpoint spec parsing -------------------------------------------------
+
+
+def test_parse_endpoint_spec_three_forms():
+    assert parse_endpoint_spec("http://h:8000") == ("http://h:8000", None)
+    assert parse_endpoint_spec("http://h:8000/") == ("http://h:8000", None)
+    assert parse_endpoint_spec("uds:/run/e.sock") == (None, "/run/e.sock")
+    assert parse_endpoint_spec("http://h:8000+uds:/run/e.sock") == (
+        "http://h:8000", "/run/e.sock"
+    )
+
+
+# -- score function, hand-computed ----------------------------------------
+
+
+def test_score_is_expected_wait():
+    ep = ReplicaEndpoint("http://a:1")
+    # no samples yet: floor keeps the score finite and non-zero
+    assert ep.score(0.0, 10.0) == pytest.approx(1 * _EWMA_FLOOR_MS)
+    ep.ewma_ms = 8.0
+    ep.inflight = 2
+    ep.scraped_inflight = 3
+    # (2 gateway-side + 3 scraped + 1) * 8 ms
+    assert ep.score(0.0, 10.0) == pytest.approx(6 * 8.0)
+
+
+def test_ewma_update_and_failure_counting():
+    ep = ReplicaEndpoint("http://a:1")
+    ep.begin()
+    ep.complete(0.010)  # first sample seeds the EWMA directly
+    assert ep.ewma_ms == pytest.approx(10.0)
+    ep.begin()
+    ep.complete(0.020)
+    assert ep.ewma_ms == pytest.approx(
+        (1 - _EWMA_ALPHA) * 10.0 + _EWMA_ALPHA * 20.0
+    )
+    before = ep.ewma_ms
+    ep.begin()
+    ep.complete(5.0, ok=False)  # failures don't poison the latency signal
+    assert ep.ewma_ms == before
+    assert ep.failures == 1
+    assert ep.inflight == 0
+
+
+def test_degraded_penalty_orders_below_healthy():
+    rs = ReplicaSet(["http://a:1", "http://b:1"], rng=random.Random(0))
+    a, b = rs.endpoints
+    a.ewma_ms = 100.0   # slow but healthy
+    b.ewma_ms = 1.0     # fast but breaker-open
+    b.breaker_open = True
+    now = 0.0
+    assert a.score(now, rs.stale_after_s) < b.score(now, rs.stale_after_s)
+    assert b.score(now, rs.stale_after_s) >= _UNHEALTHY_PENALTY
+
+
+def test_fast_failing_replica_degrades_instead_of_magnetizing():
+    """Failures drain inflight instantly and never raise the EWMA, so
+    without failure-degradation a dead replica scores at the floor and
+    WINS every pick — the black-hole shape.  Three consecutive failures
+    must flip it degraded; cooldown expiry is the passive half-open and
+    one success clears the streak."""
+    import time as _time
+
+    rs = ReplicaSet(["uds:/run/a.sock", "http://b:1"],
+                    rng=random.Random(0))
+    a, b = rs.endpoints
+    b.ewma_ms = 50.0  # healthy but slow
+    for _ in range(2):
+        a.begin()
+        a.complete(0.0001, ok=False)
+    now = _time.monotonic()
+    assert not a.degraded(now, rs.stale_after_s)  # streak too short
+    a.begin()
+    a.complete(0.0001, ok=False)  # third consecutive failure
+    now = _time.monotonic()
+    assert a.degraded(now, rs.stale_after_s)
+    # the dead-fast replica must now LOSE to the slow healthy one
+    assert a.score(now, rs.stale_after_s) > b.score(now, rs.stale_after_s)
+    # cooldown expired -> sampled again (passive half-open probe)
+    a.fail_degraded_until = now - 0.001
+    assert not a.degraded(_time.monotonic(), rs.stale_after_s)
+    # one success clears the streak entirely
+    a.begin()
+    a.complete(0.001, ok=True)
+    assert a.consec_failures == 0 and a.fail_degraded_until == 0.0
+
+
+def test_stale_scrape_degrades_only_after_first_success():
+    ep = ReplicaEndpoint("http://a:1")
+    # never scraped (tests, single-shot benches): not degraded
+    assert not ep.degraded(1000.0, 6.0)
+    ep.scrape_ts = 1.0
+    assert ep.degraded(1000.0, 6.0)       # stale now
+    assert not ep.degraded(5.0, 6.0)      # fresh enough
+
+
+# -- p2c pick -------------------------------------------------------------
+
+
+def test_p2c_picks_lower_score_and_records_decision():
+    RECORDER.reset()
+    rs = ReplicaSet(["http://a:1", "http://b:1"], rng=random.Random(3))
+    a, b = rs.endpoints
+    a.ewma_ms, b.ewma_ms = 50.0, 2.0
+    chosen, decision = rs.pick()
+    assert chosen is b
+    assert decision is not None
+    assert decision.replica == "http://b:1"
+    assert set(decision.candidates) == {"http://a:1", "http://b:1"}
+    assert len(decision.scores) == 2
+    assert decision.loser_ewma_ms == pytest.approx(50.0)
+    snap = RECORDER.snapshot()["replicas"]
+    assert snap["picks"]["default"]["http://b:1"] == 1
+
+
+def test_single_endpoint_and_kill_switch_bypass_p2c(monkeypatch):
+    solo = ReplicaSet(["http://a:1"])
+    ep, decision = solo.pick()
+    assert ep.name == "http://a:1" and decision is None
+
+    rs = ReplicaSet(["http://a:1", "http://b:1"], rng=random.Random(0))
+    rs.endpoints[0].ewma_ms = 1e6  # would never win a scored pick
+    monkeypatch.setenv("SELDON_TPU_REPLICAS", "0")
+    for _ in range(8):
+        ep, decision = rs.pick()
+        assert ep is rs.endpoints[0] and decision is None
+    assert rs.endpoints[0].picks == 0  # no p2c accounting either
+
+
+def test_mispick_hindsight_accounting():
+    RECORDER.reset()
+    rs = ReplicaSet(["http://a:1", "http://b:1"], rng=random.Random(0))
+    ep = rs.endpoints[0]
+    decision = PickDecision(
+        replica=ep.name, candidates=[ep.name, "http://b:1"],
+        scores=[1.0, 2.0], loser_ewma_ms=5.0,
+    )
+    ep.begin()
+    rs.complete(ep, decision, latency_s=0.050)  # 50 ms > loser's 5 ms
+    assert rs.mispicks == 1
+    ep.begin()
+    rs.complete(ep, decision, latency_s=0.001)  # 1 ms < 5 ms: good pick
+    assert rs.mispicks == 1
+    # failures are not mispicks (the loser might have failed too)
+    ep.begin()
+    rs.complete(ep, decision, latency_s=9.9, ok=False)
+    assert rs.mispicks == 1
+    assert RECORDER.snapshot()["replicas"]["mispicks"] == 1
+
+
+def test_degraded_loser_never_judges_the_pick():
+    """Beating a sick replica's historical EWMA is not a prediction
+    error: a pick whose loser was degraded carries loser_ewma_ms=0, so
+    steering around an open breaker can't pin the mispick ratio at 1."""
+    rs = ReplicaSet(["http://a:1", "http://b:1"], rng=random.Random(3))
+    a, b = rs.endpoints
+    a.ewma_ms = 50.0          # healthy, slow
+    b.ewma_ms = 2.0           # was fast...
+    b.breaker_open = True     # ...but is now sick
+    chosen, decision = rs.pick()
+    assert chosen is a        # steered around the degraded fast one
+    assert decision.loser_ewma_ms == 0.0
+    a.begin()
+    rs.complete(a, decision, latency_s=0.050)  # 50ms >> b's old 2ms
+    assert rs.mispicks == 0
+
+
+def test_gateway_prunes_replica_sets_on_unregister():
+    spec = sigmoid_spec()
+    store = DeploymentStore()
+    store.register(spec, {"p": ["http://a:1", "http://b:1"]})
+    gw = ApiGateway(store, require_auth=False)
+    reg = store._by_key["k"]
+    gw._pick_engine(reg)
+    assert ("rs-dep", "p") in gw._replica_sets
+    store.unregister("k")
+    assert gw.stats()["replicas"] == {}  # stats() prunes the stale set
+    assert gw._replica_sets == {}
+
+
+def test_replica_set_snapshot_imbalance():
+    rs = ReplicaSet(["http://a:1", "http://b:1"])
+    rs.endpoints[0].inflight = 3
+    rs.endpoints[1].inflight = 1
+    snap = rs.snapshot()
+    assert snap["inflight_max_over_mean"] == pytest.approx(1.5)
+    assert [e["endpoint"] for e in snap["endpoints"]] == [
+        "http://a:1", "http://b:1"
+    ]
+
+
+# -- _pick_engine weight math ---------------------------------------------
+
+
+def test_pick_engine_weighted_split_and_named_predictor():
+    spec = sigmoid_spec(n_predictors=2)
+    # weights 3:1 ride the predictors' replicas into the registration
+    spec.predictors[0].replicas = 3
+    spec.predictors[1].replicas = 1
+    store = DeploymentStore()
+    store.register(spec, {"p0": "http://p0:1", "p1": "http://p1:1"})
+    gw = ApiGateway(store, require_auth=False, seed=11)
+    reg = store._by_key["k"]
+    served = [gw._pick_engine(reg)[0] for _ in range(200)]
+    counts = {p: served.count(p) for p in set(served)}
+    # 3:1 expectation with slack: p0 must dominate, p1 must get traffic
+    assert counts["p0"] > counts["p1"] > 0
+    assert counts["p0"] > 100
+    # a named predictor bypasses the weighted draw entirely
+    name, rs, ep, _ = gw._pick_engine(reg, predictor="p1")
+    assert name == "p1" and ep.base_url == "http://p1:1"
+
+
+def test_pick_engine_all_zero_replicas_uniform():
+    """The replicas=0 edge: zero total weight falls back to uniform
+    instead of dividing by zero."""
+    spec = sigmoid_spec(n_predictors=2)
+    for p in spec.predictors:
+        p.replicas = 0
+    store = DeploymentStore()
+    store.register(spec, {"p0": "http://p0:1", "p1": "http://p1:1"})
+    gw = ApiGateway(store, require_auth=False, seed=5)
+    reg = store._by_key["k"]
+    served = [gw._pick_engine(reg)[0] for _ in range(100)]
+    counts = {p: served.count(p) for p in set(served)}
+    assert counts.get("p0", 0) > 20 and counts.get("p1", 0) > 20
+
+
+def test_replica_set_cache_rebuilds_on_reregistration():
+    spec = sigmoid_spec()
+    store = DeploymentStore()
+    store.register(spec, {"p": ["http://a:1", "http://b:1"]})
+    gw = ApiGateway(store, require_auth=False)
+    reg = store._by_key["k"]
+    _, rs1, _, _ = gw._pick_engine(reg)
+    _, rs2, _, _ = gw._pick_engine(reg)
+    assert rs1 is rs2  # cached between picks
+    store.register(spec, {"p": ["http://a:1", "http://c:1"]})
+    reg = store._by_key["k"]
+    _, rs3, _, _ = gw._pick_engine(reg)
+    assert rs3 is not rs1
+    assert [e.name for e in rs3.endpoints] == ["http://a:1", "http://c:1"]
+
+
+def test_decision_attrs_shape():
+    assert ApiGateway._decision_attrs(None) == {}
+    attrs = ApiGateway._decision_attrs(PickDecision(
+        replica="http://b:1", candidates=["http://a:1", "http://b:1"],
+        scores=[3.2, 1.1], loser_ewma_ms=4.0,
+    ))
+    assert attrs == {
+        "replica": "http://b:1",
+        "p2c_candidates": "http://a:1,http://b:1",
+        "p2c_scores": "3.2,1.1",
+    }
+
+
+# -- gateway end-to-end: p2c steers around a slow replica ------------------
+
+
+def test_gateway_steers_around_slow_inprocess_replica():
+    RECORDER.reset()
+
+    async def run():
+        spec = sigmoid_spec()
+        fast = EngineService(spec, max_batch=8, max_wait_ms=0.5)
+        slow = FaultyEngine(
+            EngineService(spec, max_batch=8, max_wait_ms=0.5),
+            FaultSpec(delay_s=0.03),
+        )
+        store = DeploymentStore()
+        store.register(spec, {"p": [fast, slow]})
+        gw = ApiGateway(store, require_auth=False)
+
+        async def worker(n):
+            for _ in range(n):
+                resp = await gw.predict(msg4())
+                assert resp.status is None or \
+                    resp.status.status != "FAILURE"
+
+        await asyncio.gather(*(worker(24) for _ in range(6)))
+        snap = gw.stats()["replicas"]["rs-dep/p"]
+        picks = [ep["picks"] for ep in snap["endpoints"]]
+        assert sum(picks) == 144
+        # blind rotation gives the slow replica half; p2c must starve it
+        assert picks[1] / sum(picks) < 0.3
+        # lane accounting says both dispatches rode the in-process lane
+        lanes = RECORDER.snapshot()["replicas"]["lanes"]
+        assert lanes.get("inprocess", 0) >= 144
+        await gw.close()
+        await fast.close()
+        await slow.inner.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_replicas_kill_switch_single_path(monkeypatch):
+    """SELDON_TPU_REPLICAS=0 restores today's single-engine behavior:
+    first endpoint, no decision attrs, no pick accounting."""
+    RECORDER.reset()
+
+    async def run():
+        spec = sigmoid_spec()
+        e0 = EngineService(spec, max_batch=8, max_wait_ms=0.5)
+        e1 = EngineService(spec, max_batch=8, max_wait_ms=0.5)
+        store = DeploymentStore()
+        store.register(spec, {"p": [e0, e1]})
+        gw = ApiGateway(store, require_auth=False)
+        monkeypatch.setenv("SELDON_TPU_REPLICAS", "0")
+        for _ in range(6):
+            resp = await gw.predict(msg4())
+            assert resp.status is None or resp.status.status != "FAILURE"
+        snap = gw.stats()["replicas"]["rs-dep/p"]
+        assert [ep["picks"] for ep in snap["endpoints"]] == [0, 0]
+        assert RECORDER.snapshot()["replicas"]["picks"] == {}
+        await gw.close()
+        await e0.close()
+        await e1.close()
+
+    asyncio.run(run())
+
+
+# -- sqlite store multi-engine registrations -------------------------------
+
+
+def test_sqlite_store_replica_list_roundtrip(tmp_path):
+    db = str(tmp_path / "gw.db")
+    spec = sigmoid_spec(replicas=3)
+    store = SqliteDeploymentStore(db)
+    store.register(spec, {
+        "p": ["http://e0:8000", "http://e1:8000+uds:/run/e1.sock"],
+    })
+    # a SECOND store over the same file (another gateway replica) sees
+    # the same weighted replica set
+    other = SqliteDeploymentStore(db)
+    reg = other._registration("k")
+    assert reg.engines == [
+        ("p", 3, ["http://e0:8000", "http://e1:8000+uds:/run/e1.sock"]),
+    ]
+    store.close()
+    other.close()
+
+
+def test_sqlite_store_weight_clamps_and_rejections(tmp_path):
+    db = str(tmp_path / "gw.db")
+    spec = sigmoid_spec()
+    spec.predictors[0].replicas = -2  # clamped to 0, not carried negative
+    store = SqliteDeploymentStore(db)
+    store.register(spec, {"p": "http://e0:8000"})
+    assert store._registration("k").engines == [("p", 0, "http://e0:8000")]
+    with pytest.raises(TypeError, match="non-empty list"):
+        store.register(spec, {"p": []})
+    with pytest.raises(TypeError, match="non-empty list"):
+        store.register(spec, {"p": [object()]})
+    with pytest.raises(TypeError, match="in-process"):
+        store.register(spec, {"p": object()})
+    store.close()
+
+
+def test_gateway_main_replica_template_expansion(monkeypatch):
+    from seldon_core_tpu.gateway.gateway_main import (
+        _engine_replicas,
+        _engine_url_map,
+        _render_endpoints,
+    )
+
+    monkeypatch.setenv("GATEWAY_ENGINE_REPLICAS", "3")
+    assert _engine_replicas() == 3
+    monkeypatch.setenv("GATEWAY_ENGINE_REPLICAS", "0")
+    with pytest.raises(SystemExit):
+        _engine_replicas()
+    monkeypatch.setenv("GATEWAY_ENGINE_REPLICAS", "x")
+    with pytest.raises(SystemExit):
+        _engine_replicas()
+
+    tpl = "http://{name}-{predictor}-{replica}:8000"
+    assert _render_endpoints(tpl, "d", "p", 2) == [
+        "http://d-p-0:8000", "http://d-p-1:8000",
+    ]
+    # replicas>1 with no {replica} placeholder is fatal at boot — the
+    # scale-out must never silently not exist
+    from seldon_core_tpu.gateway.gateway_main import _check_replica_template
+
+    assert _check_replica_template(4, tpl) == 4
+    assert _check_replica_template(1, "http://{name}:8000") == 1
+    with pytest.raises(SystemExit, match="needs a .replica."):
+        _check_replica_template(4, "http://{name}:8000")
+
+    monkeypatch.setenv(
+        "GATEWAY_ENGINE_URL_MAP",
+        json.dumps({"d/p": ["http://a:1", "uds:/run/a.sock"]}),
+    )
+    assert _engine_url_map() == {"d/p": ["http://a:1", "uds:/run/a.sock"]}
+    monkeypatch.setenv("GATEWAY_ENGINE_URL_MAP", json.dumps({"d/p": []}))
+    with pytest.raises(SystemExit, match="non-empty"):
+        _engine_url_map()
+
+
+# -- review-pass regressions ----------------------------------------------
+
+
+def test_gateway_prunes_on_same_deployment_reregistration():
+    # a re-registration that DROPS a predictor must prune its replica
+    # set even though the deployment-ID list is unchanged — otherwise
+    # the stale set is scraped (and its relay clients pooled) forever
+    spec = sigmoid_spec(n_predictors=2)
+    store = DeploymentStore()
+    store.register(spec, {"p0": ["http://a:1", "http://b:1"],
+                          "p1": ["http://c:1", "http://d:1"]})
+    gw = ApiGateway(store, require_auth=False)
+    reg = store._by_key["k"]
+    gw._pick_engine(reg, "p0")
+    gw._pick_engine(reg, "p1")
+    assert ("rs-dep", "p0") in gw._replica_sets
+    assert ("rs-dep", "p1") in gw._replica_sets
+    store.register(spec, {"p0": ["http://a:1", "http://b:1"]})
+    gw.stats()  # stats() runs the prune
+    assert ("rs-dep", "p0") in gw._replica_sets
+    assert ("rs-dep", "p1") not in gw._replica_sets
+
+
+def test_sqlite_store_revision_bumps_on_every_write(tmp_path):
+    store = SqliteDeploymentStore(str(tmp_path / "gw.db"))
+    spec = sigmoid_spec()
+    assert store.revision() == 0
+    store.register(spec, {"p": ["http://a:1"]})
+    r1 = store.revision()
+    store.register(spec, {"p": ["http://a:1", "http://b:1"]})  # same dep
+    r2 = store.revision()
+    store.unregister("k")
+    r3 = store.revision()
+    assert r1 < r2 < r3
+    # a second gateway replica on the same file sees the bumps
+    other = SqliteDeploymentStore(str(tmp_path / "gw.db"))
+    assert other.revision() == r3
+    store.close()
+    other.close()
+
+
+def test_batcher_inflight_tracks_only_batcher_dispatches():
+    ep = ReplicaEndpoint("http://a:1")
+    ep.begin()                 # unary predict: rides the MicroBatcher
+    ep.begin(batcher=False)    # stream/feedback: engine-side, unbatched
+    assert (ep.inflight, ep.batcher_inflight) == (2, 1)
+    ep.release()               # stream end
+    assert (ep.inflight, ep.batcher_inflight) == (1, 1)
+    ep.complete(0.01)          # unary end
+    assert (ep.inflight, ep.batcher_inflight) == (0, 0)
+    ep.begin()
+    ep.release(batcher=True)   # neutral-accounting unary close
+    assert (ep.inflight, ep.batcher_inflight) == (0, 0)
+
+
+def test_scrape_subtracts_only_own_batcher_inflight():
+    # the engine-side inflight_dispatches figure contains only batcher
+    # work: subtracting streams/feedback (which never enter the batcher)
+    # would erase OTHER gateways' real load from the score signal
+    class _Resp:
+        def __init__(self, doc):
+            self._doc = doc
+
+        async def json(self, content_type=None):
+            return self._doc
+
+        async def __aenter__(self):
+            return self
+
+        async def __aexit__(self, *a):
+            return False
+
+    class _Session:
+        def get(self, url, timeout=None):
+            return _Resp({"telemetry": {"batch": {"inflight_dispatches": 5}}})
+
+    rs = ReplicaSet(["http://a:1"])
+    ep = rs.endpoints[0]
+    ep.begin()                 # 1 own unary in the engine's figure
+    ep.begin(batcher=False)    # own stream: NOT in the engine's figure
+    ep.begin(batcher=False)
+    assert asyncio.run(rs.scrape_once(_Session())) == 1
+    # 5 engine-side - 1 own batcher-bound = 4 (other gateways' load kept)
+    assert ep.scraped_inflight == 4
+
+
+def test_pick_eligibility_filter_lands_picks_on_capable_endpoint():
+    rs = ReplicaSet(["uds:/run/a.sock", "http://b:1"],
+                    rng=random.Random(7))
+    streamable = lambda ep: ep.base_url is not None
+    for _ in range(8):
+        ep, decision = rs.pick(streamable)
+        assert ep.base_url == "http://b:1"
+        assert decision is not None and decision.replica == ep.name
+    # picks (and their metrics) land on the endpoint that serves
+    assert rs.endpoints[1].picks == 8
+    assert rs.endpoints[0].picks == 0
+    # an impossible filter falls back to the full pool — the caller
+    # handles the capability miss (e.g. streams answer 503)
+    ep, _ = rs.pick(lambda _ep: False)
+    assert ep in rs.endpoints
+
+
+def test_cancelled_predict_is_neutral_for_replica_health():
+    # a client hanging up (handler cancellation) says nothing about the
+    # replica: it must not feed the failure streak that fail-degrades,
+    # and it must release the inflight it began
+    class Wedged:
+        def __init__(self):
+            self.gate = asyncio.Event()
+
+        async def predict(self, msg):
+            await self.gate.wait()
+            return msg
+
+    async def run():
+        spec = sigmoid_spec()
+        store = DeploymentStore()
+        wedged = Wedged()
+        store.register(spec, {"p": [wedged]})
+        gw = ApiGateway(store, require_auth=False)
+        task = asyncio.create_task(gw.predict(msg4()))
+        await asyncio.sleep(0.05)
+        ep = gw._replica_sets[("rs-dep", "p")][1].endpoints[0]
+        assert (ep.inflight, ep.batcher_inflight) == (1, 1)
+        task.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        assert (ep.inflight, ep.batcher_inflight) == (0, 0)
+        assert ep.consec_failures == 0
+        assert ep.failures == 0
+        assert ep.fail_degraded_until == 0.0
+        await gw.close()
+
+    asyncio.run(run())

@@ -1,0 +1,425 @@
+"""Zero-copy UDS relay lane (runtime/udsrelay.py): framing, the pooled
+client, error surfaces, and the gateway dispatching over it — plus the
+node-mesh ``unix:`` binding through httpfast's UDS listener and
+runtime/client.py's UnixConnector path.
+
+Documented scope contract under test: unary predict/feedback only; the
+kill switch (``SELDON_TPU_UDS=0``) keeps every dispatch on TCP."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import (
+    DefaultData,
+    Feedback,
+    SeldonMessage,
+)
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.udsrelay import (
+    OP_FEEDBACK,
+    OP_PING,
+    OP_PREDICT,
+    UdsRelayClient,
+    serve_uds,
+)
+
+
+def sigmoid_spec(name="uds-dep"):
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": name,
+            "oauth_key": "k", "oauth_secret": "s",
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "type": "MODEL"},
+                "components": [{
+                    "name": "m", "runtime": "inprocess",
+                    "class_path": "SigmoidPredictor",
+                    "parameters": [
+                        {"name": "n_features", "value": "4",
+                         "type": "INT"},
+                    ],
+                }],
+            }],
+        }
+    })
+
+
+def payload(rows=1):
+    return json.dumps({"data": {"ndarray": [[0.0, 0.1, 0.2, 0.3]] * rows}})
+
+
+def test_relay_predict_matches_http_lane(tmp_path):
+    async def run():
+        engine = EngineService(sigmoid_spec(), max_batch=8, max_wait_ms=0.5)
+        path = str(tmp_path / "e.sock")
+        server = await serve_uds(engine, path)
+        client = UdsRelayClient(path)
+        try:
+            assert await client.ping()
+            text, status = await client.predict(payload())
+            assert status == 200
+            direct_text, direct_status = await engine.predict_json(payload())
+            assert direct_status == 200
+            # identical engine contract through the framed lane
+            relay = json.loads(text)
+            direct = json.loads(direct_text)
+            assert relay["data"]["ndarray"] == direct["data"]["ndarray"]
+        finally:
+            await client.close()
+            await server.stop()
+            await engine.close()
+        assert not os.path.exists(path)  # socket unlinked at stop
+
+    asyncio.run(run())
+
+
+def test_relay_feedback_and_unknown_op(tmp_path):
+    async def run():
+        engine = EngineService(sigmoid_spec(), max_batch=8, max_wait_ms=0.5)
+        path = str(tmp_path / "e.sock")
+        server = await serve_uds(engine, path)
+        client = UdsRelayClient(path)
+        try:
+            x = np.zeros((1, 4), np.float32)
+            fb = Feedback(
+                request=SeldonMessage(data=DefaultData(array=x)),
+                response=SeldonMessage(
+                    data=DefaultData(array=np.asarray([[0.5, 0.5]]))
+                ),
+                reward=1.0,
+            )
+            text, status = await client.feedback(fb.to_json())
+            assert status == 200
+            body, status = await client.call(99, b"")
+            assert status == 400
+            assert "unknown relay op" in \
+                SeldonMessage.from_json(body.decode()).status.info
+        finally:
+            await client.close()
+            await server.stop()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+def test_relay_large_and_fragmented_frames(tmp_path):
+    """A ~1 MB body frames correctly, and many requests on one pooled
+    connection keep responses in order (the concurrency exercises the
+    server's per-connection FIFO)."""
+    async def run():
+        engine = EngineService(sigmoid_spec(), max_batch=64, max_wait_ms=0.5)
+        path = str(tmp_path / "e.sock")
+        server = await serve_uds(engine, path)
+        client = UdsRelayClient(path, pool=4)
+        try:
+            big = payload(rows=4096)  # ~100 KB of JSON through one frame
+            text, status = await client.predict(big)
+            assert status == 200
+            assert len(json.loads(text)["data"]["ndarray"]) == 4096
+            results = await asyncio.gather(*(
+                client.predict(payload(rows=r % 5 + 1)) for r in range(32)
+            ))
+            for i, (text, status) in enumerate(results):
+                assert status == 200
+                assert len(json.loads(text)["data"]["ndarray"]) == i % 5 + 1
+        finally:
+            await client.close()
+            await server.stop()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+def test_relay_engine_error_becomes_failure_message(tmp_path):
+    class BrokenEngine:
+        async def predict_json(self, text):
+            raise RuntimeError("engine exploded")
+
+    async def run():
+        path = str(tmp_path / "b.sock")
+        server = await serve_uds(BrokenEngine(), path)
+        client = UdsRelayClient(path)
+        try:
+            body, status = await client.call(OP_PREDICT, payload().encode())
+            assert status == 500
+            msg = SeldonMessage.from_json(body.decode())
+            assert msg.status.status == "FAILURE"
+            assert "engine exploded" in msg.status.info
+            # the connection keeps serving after a handler error
+            body, status = await client.call(OP_PING, b"")
+            assert status == 200 and body == b"pong"
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_relay_client_connection_error_typed(tmp_path):
+    async def run():
+        client = UdsRelayClient(str(tmp_path / "nobody-home.sock"))
+        with pytest.raises((ConnectionError, OSError)):
+            await client.call(OP_PING, b"")
+        await client.close()
+
+    asyncio.run(run())
+
+
+def test_relay_pool_waiters_wake_when_connections_break(tmp_path):
+    """A broken release must free pool capacity TO WAITERS: with pool=1
+    and a server that kills every connection, the second concurrent
+    caller must fail typed, not sleep forever on the idle queue."""
+    async def run():
+        path = str(tmp_path / "rude.sock")
+
+        async def rude(reader, writer):
+            writer.close()  # accept, then hang up before any response
+
+        server = await asyncio.start_unix_server(rude, path=path)
+        client = UdsRelayClient(path, pool=1)
+
+        async def call():
+            try:
+                await client.call(OP_PING, b"")
+                return "ok"
+            except (ConnectionError, OSError):
+                return "typed"
+
+        try:
+            results = await asyncio.wait_for(
+                asyncio.gather(call(), call(), call()), timeout=5.0
+            )
+            assert results == ["typed"] * 3
+            assert client._open == 0  # every slot returned to the pool
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_gateway_uds_call_honors_deadline_budget(tmp_path):
+    """The relay hop is clamped to the caller's remaining deadline (the
+    TCP lane's contract): a wedged engine fails 504 at the budget, the
+    pooled slot is reclaimed, and the connection is not reused."""
+    from seldon_core_tpu.runtime.resilience import deadline_scope
+
+    class WedgedEngine:
+        async def predict_json(self, text):
+            await asyncio.sleep(60.0)
+
+    async def run():
+        path = str(tmp_path / "w.sock")
+        server = await serve_uds(WedgedEngine(), path)
+        spec = sigmoid_spec()
+        store = DeploymentStore()
+        store.register(spec, {"p": [f"uds:{path}"]})
+        gw = ApiGateway(store, require_auth=False)
+        msg = SeldonMessage.from_array(np.zeros((1, 4), np.float32))
+        try:
+            with deadline_scope(0.3):
+                resp = await asyncio.wait_for(gw.predict(msg), timeout=5.0)
+            assert resp.status.status == "FAILURE"
+            assert resp.status.code == 504
+            assert "timeout" in resp.status.info
+        finally:
+            await gw.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_gateway_dispatches_over_uds_and_kill_switch(tmp_path, monkeypatch):
+    """An endpoint spec carrying ``+uds:`` rides the relay lane;
+    ``SELDON_TPU_UDS=0`` puts the SAME registration back on TCP."""
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+    from seldon_core_tpu.utils.telemetry import RECORDER
+
+    async def run():
+        RECORDER.reset()
+        engine = EngineService(sigmoid_spec(), max_batch=8, max_wait_ms=0.5)
+        path = str(tmp_path / "e.sock")
+        uds_server = await serve_uds(engine, path)
+        tcp_server = await serve_fast(engine, "127.0.0.1", 0)
+        spec = sigmoid_spec()
+        store = DeploymentStore()
+        store.register(spec, {
+            "p": [f"http://127.0.0.1:{tcp_server.port}+uds:{path}"],
+        })
+        gw = ApiGateway(store, require_auth=False)
+        msg = SeldonMessage.from_array(np.zeros((1, 4), np.float32))
+        try:
+            resp = await gw.predict(msg)
+            assert resp.status is None or resp.status.status != "FAILURE"
+            lanes = RECORDER.snapshot()["replicas"]["lanes"]
+            assert lanes.get("uds") == 1 and "tcp" not in lanes
+
+            monkeypatch.setenv("SELDON_TPU_UDS", "0")
+            resp = await gw.predict(msg)
+            assert resp.status is None or resp.status.status != "FAILURE"
+            lanes = RECORDER.snapshot()["replicas"]["lanes"]
+            assert lanes.get("uds") == 1 and lanes.get("tcp") == 1
+        finally:
+            monkeypatch.delenv("SELDON_TPU_UDS", raising=False)
+            await gw.close()
+            await uds_server.stop()
+            await tcp_server.stop()
+            await engine.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_uds_unreachable_is_typed_503(tmp_path):
+    async def run():
+        spec = sigmoid_spec()
+        store = DeploymentStore()
+        store.register(spec, {"p": [f"uds:{tmp_path}/gone.sock"]})
+        gw = ApiGateway(store, require_auth=False)
+        resp = await gw.predict(
+            SeldonMessage.from_array(np.zeros((1, 4), np.float32))
+        )
+        assert resp.status.status == "FAILURE"
+        assert "unreachable" in resp.status.info
+        await gw.close()
+
+    asyncio.run(run())
+
+
+def test_httpfast_uds_listener_serves_node_mesh_client(tmp_path):
+    """The OTHER unix-socket lane: httpfast serving its full HTTP route
+    table on a UDS, dialed by runtime/client.py's ``unix:`` binding —
+    what sharded node meshes use (graph/sharding.py)."""
+    from seldon_core_tpu.graph.spec import ComponentBinding, PredictiveUnit
+    from seldon_core_tpu.runtime.client import RestNodeRuntime
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+
+    async def run():
+        engine = EngineService(sigmoid_spec(), max_batch=8, max_wait_ms=0.5)
+        # not-yet-created parent dir: start_uds creates it, same as the
+        # relay lane does for ENGINE_UDS_PATH
+        path = str(tmp_path / "run" / "seldon" / "node.sock")
+        server = await serve_fast(engine, "127.0.0.1", 0, uds_path=path)
+        node = PredictiveUnit.from_json_dict(
+            {"name": "m", "type": "MODEL"}
+        )
+        binding = ComponentBinding(
+            name="m", runtime="rest", host=f"unix:{path}", port=0
+        )
+        runtime = RestNodeRuntime(node, binding, timeout_s=5.0)
+        try:
+            msg = SeldonMessage.from_array(np.zeros((2, 4), np.float32))
+            resp = await runtime.predict(msg)
+            assert resp.status is None or resp.status.status != "FAILURE"
+            assert resp.data.array.shape[0] == 2
+        finally:
+            await runtime.close()
+            await server.stop()
+            await engine.close()
+        assert not os.path.exists(path)
+
+    asyncio.run(run())
+
+
+def test_relay_server_pauses_reading_under_pipelined_flood(tmp_path):
+    """The shipped client never pipelines, but the server must not trust
+    that: a runaway local writer's frames stop becoming concurrent engine
+    tasks once the pending-response queue hits the high-water mark
+    (transport.pause_reading), and every queued frame still gets its
+    response, in order, once the engine drains."""
+    from seldon_core_tpu.runtime.udsrelay import (
+        _PAUSE_PENDING,
+        _REQ_HEAD,
+        _RESP_HEAD,
+    )
+
+    gate = asyncio.Event()
+
+    class WedgedEngine:
+        async def predict_json(self, text):
+            await gate.wait()
+            return text, 200
+
+    async def run():
+        path = str(tmp_path / "e.sock")
+        server = await serve_uds(WedgedEngine(), path)
+        reader, writer = await asyncio.open_unix_connection(path)
+        n = _PAUSE_PENDING + 40
+        try:
+            for i in range(n):
+                body = str(i).encode()
+                writer.write(_REQ_HEAD.pack(len(body), OP_PREDICT) + body)
+            await writer.drain()
+            # let the loop deliver frames until the server pauses itself
+            for _ in range(200):
+                await asyncio.sleep(0.005)
+                if any(p.paused for p in server._protocols):
+                    break
+            assert any(p.paused for p in server._protocols)
+            gate.set()  # engine drains: every frame answered, in order
+            for i in range(n):
+                head = await reader.readexactly(_RESP_HEAD.size)
+                length, status = _RESP_HEAD.unpack(head)
+                body = await reader.readexactly(length)
+                assert status == 200
+                assert body == str(i).encode()
+            assert all(not p.paused for p in server._protocols)
+        finally:
+            writer.close()
+            await server.stop()
+
+    asyncio.run(run())
+
+
+def test_relay_oversized_frame_413_ordered_behind_pending(tmp_path):
+    """The terminal 413 for an oversized frame rides the FIFO writer
+    behind already-queued responses — a pipelining client must never
+    read it as the answer to an earlier, still-running request."""
+    from seldon_core_tpu.runtime.udsrelay import (
+        _MAX_FRAME,
+        _REQ_HEAD,
+        _RESP_HEAD,
+    )
+
+    gate = asyncio.Event()
+
+    class GatedEngine:
+        async def predict_json(self, text):
+            await gate.wait()
+            return text, 200
+
+    async def run():
+        path = str(tmp_path / "e.sock")
+        server = await serve_uds(GatedEngine(), path)
+        reader, writer = await asyncio.open_unix_connection(path)
+        try:
+            body = b"first"
+            writer.write(_REQ_HEAD.pack(len(body), OP_PREDICT) + body)
+            # header-only declaration of an impossible frame
+            writer.write(_REQ_HEAD.pack(_MAX_FRAME + 1, OP_PREDICT))
+            await writer.drain()
+            await asyncio.sleep(0.05)
+            gate.set()
+            head = await reader.readexactly(_RESP_HEAD.size)
+            length, status = _RESP_HEAD.unpack(head)
+            assert status == 200  # the pending request's real answer
+            assert await reader.readexactly(length) == body
+            head = await reader.readexactly(_RESP_HEAD.size)
+            length, status = _RESP_HEAD.unpack(head)
+            assert status == 413
+            SeldonMessage.from_json(
+                (await reader.readexactly(length)).decode()
+            )
+            assert await reader.read(1) == b""  # then the server hangs up
+        finally:
+            writer.close()
+            await server.stop()
+
+    asyncio.run(run())
