@@ -43,6 +43,15 @@ quality-demo:
 scale-demo:
 	python scripts/scale_demo.py --out scale_demo
 
+# safe-rollout demo: shadow mirroring -> firehose replay vet -> staged
+# canary under injected drift -> automatic rollback with zero failed
+# live requests; proves both kill switches (SELDON_TPU_SHADOW=0,
+# SELDON_TPU_ROLLOUTS=0).  Artifact canary_demo/rollout.json +
+# shadow.json + replay.json (scripts/canary_demo.py; docs/operations.md
+# "safe rollout" runbook)
+canary-demo:
+	python scripts/canary_demo.py --out canary_demo
+
 bench:
 	python bench.py
 
@@ -51,8 +60,15 @@ bench:
 # SELDON_TPU_OVERHEAD_BUDGET_MS (default 1.0).  Fails loudly on breach;
 # prove it gates with SELDON_TPU_TELEMETRY_TEST_DELAY_MS=2.
 # CPU-friendly — no TPU required (docs/operations.md runbook).
+# Relative A/B mode: when the absolute budget is breached, the baseline
+# ref (OVERHEAD_BASELINE, default HEAD — set it to origin/main when the
+# working tree IS HEAD, or empty for the pure absolute gate) is measured
+# in a clean worktree ON THE SAME BOX and the gate fails only if this
+# tree exceeds SELDON_TPU_OVERHEAD_REL_TOLERANCE (1.25x) of it — slow
+# containers read as "parity", regressions still go red.
+OVERHEAD_BASELINE ?= HEAD
 overhead-gate:
-	JAX_PLATFORMS=cpu python bench.py --overhead-gate
+	JAX_PLATFORMS=cpu python bench.py --overhead-gate $(if $(OVERHEAD_BASELINE),--overhead-gate-baseline $(OVERHEAD_BASELINE),)
 
 # continuous-batching TTFT gate: the concurrent-stream probe (staggered
 # arrivals into an already-decoding batch) must keep TTFT p50 within
@@ -103,4 +119,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo canary-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun

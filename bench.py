@@ -57,6 +57,28 @@ REFERENCE_REST_QPS = 12088.95  # docs/benchmarking.md:44
 REFERENCE_GRPC_QPS = 28256.39  # docs/benchmarking.md:58
 REPO = os.path.dirname(os.path.abspath(__file__))
 
+# every engine subprocess the bench spawns is registered here and reaped
+# at interpreter exit — PR 8 found two stale engines from earlier crashed
+# runs skewing A/B numbers (a boot-timeout used to raise out of
+# Engine.__init__ with the half-booted process still alive, outside any
+# caller's try/finally).  atexit is the backstop; orderly paths still
+# stop() engines promptly.
+_SPAWNED_PROCS: list = []
+
+
+def _register_spawn(proc) -> None:
+    if not _SPAWNED_PROCS:
+        import atexit
+
+        atexit.register(_reap_spawned)
+    _SPAWNED_PROCS.append(proc)
+
+
+def _reap_spawned() -> None:
+    for p in _SPAWNED_PROCS:
+        if p.poll() is None:
+            p.kill()  # last line of defense: no drain courtesy at exit
+
 STUB_DEPLOYMENT = {
     "spec": {
         "name": "bench-stub",
@@ -153,17 +175,23 @@ class Engine:
              "--grpc-port", str(self.GRPC_PORT)],
             stdout=self.log, stderr=subprocess.STDOUT, env=env, cwd=REPO,
         )
+        _register_spawn(self.proc)
         deadline = time.monotonic() + boot_timeout_s
         while time.monotonic() < deadline:
             with open(self.log.name) as f:
                 text = f.read()
             if "engine up" in text:
                 if "native data plane unavailable" in text:
+                    self.stop()
                     raise RuntimeError(f"native plane did not start:\n{text}")
                 return
             if self.proc.poll() is not None:
                 raise RuntimeError(f"engine died at boot:\n{text}")
             time.sleep(2.0)
+        # the caller never gets an object to .stop() when __init__
+        # raises: kill the half-booted engine HERE or it leaks past the
+        # bench and skews the next run's numbers
+        self.stop()
         raise RuntimeError("engine boot timed out")
 
     def stop(self) -> None:
@@ -702,6 +730,7 @@ class _CpuEngine:
              str(rest_port + 1000)],
             stdout=self.log, stderr=subprocess.STDOUT, env=env, cwd=REPO,
         )
+        _register_spawn(self.proc)
 
     def wait_up(self, timeout_s: float = 120.0) -> None:
         deadline = time.monotonic() + timeout_s
@@ -1558,48 +1587,167 @@ def _ttft_gate_main(smoke: bool) -> None:
     )
 
 
-def _overhead_gate_main(smoke: bool) -> None:
+def _overhead_probe_best(smoke: bool, attempts: int = 3) -> dict:
+    """Best-of-N span probe: returns the attempt with the LOWEST
+    framework p50 (host scheduling noise only ever inflates the figure,
+    so the minimum is the honest estimate of the instrumentation cost)."""
+    best = None
+    for _ in range(attempts):
+        doc = _span_probe(n=40 if smoke else 200)
+        if doc.get("overhead_within_budget"):
+            return doc
+        if best is None or (
+            doc.get("span_framework_p50_ms") is not None
+            and doc["span_framework_p50_ms"]
+            < best.get("span_framework_p50_ms", float("inf"))
+        ):
+            best = doc
+    return best
+
+
+def _baseline_probe(ref: str, smoke: bool) -> Optional[dict]:
+    """Measure REF's span probe on THIS box: check the committed tree out
+    into a throwaway git worktree and run `bench.py --overhead-probe-json`
+    there in a subprocess.  Returns the probe doc, or None when the
+    baseline can't be built (not a git checkout, broken ref) — callers
+    fall back to the absolute gate."""
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="seldon-overhead-baseline-")
+    wt = os.path.join(tmp, "tree")
+    try:
+        add = subprocess.run(
+            ["git", "worktree", "add", "--detach", wt, ref],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+        )
+        if add.returncode != 0:
+            print(
+                f"overhead-gate: cannot build baseline {ref!r}: "
+                f"{add.stderr.strip()[-500:]}",
+                file=sys.stderr,
+            )
+            return None
+        # same harness, baseline library: the probe code is THIS
+        # bench.py (older refs may predate --overhead-probe-json), the
+        # measured seldon_core_tpu is the worktree's (sys.path[0] = the
+        # script's directory)
+        shutil.copy(os.path.join(REPO, "bench.py"),
+                    os.path.join(wt, "bench.py"))
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the injected trip-proof delay must NOT leak into the baseline:
+        # with it set on both sides the ratio is ~1.0 and the gate would
+        # wave the very regression the knob exists to prove it catches
+        env.pop("SELDON_TPU_TELEMETRY_TEST_DELAY_MS", None)
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--overhead-probe-json"]
+            + (["--smoke"] if smoke else []),
+            capture_output=True, text=True, cwd=wt, env=env, timeout=900,
+        )
+        if out.returncode != 0:
+            print(
+                f"overhead-gate: baseline probe failed: "
+                f"{out.stderr.strip()[-500:]}",
+                file=sys.stderr,
+            )
+            return None
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (OSError, subprocess.TimeoutExpired, json.JSONDecodeError,
+            IndexError) as e:
+        print(f"overhead-gate: baseline probe error: {e}", file=sys.stderr)
+        return None
+    finally:
+        subprocess.run(
+            ["git", "worktree", "remove", "--force", wt],
+            capture_output=True, cwd=REPO,
+        )
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _overhead_gate_main(smoke: bool, baseline_ref: Optional[str] = None) -> None:
     """`bench.py --overhead-gate` / `make overhead-gate`: the gated
     regression check behind ROADMAP item 4.  Runs the span probe with
     all observatories enabled and FAILS (exit 2) when the framework-added
     p50 with full instrumentation exceeds SELDON_TPU_OVERHEAD_BUDGET_MS
     (default 1.0).  Inject SELDON_TPU_TELEMETRY_TEST_DELAY_MS=2 to prove
-    the gate trips (docs/operations.md)."""
+    the gate trips (docs/operations.md).
+
+    **Relative A/B mode** (``--overhead-gate-baseline REF``, the
+    `make overhead-gate` default of HEAD): when the absolute budget is
+    breached, REF is measured in a clean worktree ON THE SAME BOX and the
+    gate passes as long as this tree stays within
+    ``SELDON_TPU_OVERHEAD_REL_TOLERANCE`` (default 1.25x) of the
+    baseline — so the lane flags *regressions you wrote*, not how slow
+    today's container happens to be.  The absolute figure is still
+    printed; a box that can't meet the budget at HEAD reads as
+    "parity with baseline", not green-by-silence."""
     # best-of-3: a regression gate must not flake on host scheduling
     # noise (shared CI runners, loaded laptops) — a REAL instrumentation
     # regression shifts the floor and fails every attempt, while one
     # noisy block must not turn a clean PR red
-    doc = None
-    for attempt in range(3):
-        doc = _span_probe(n=40 if smoke else 200)
-        if doc.get("overhead_within_budget"):
-            break
-        print(
-            f"overhead-gate: attempt {attempt + 1} measured "
-            f"{doc.get('span_framework_p50_ms')} ms (budget "
-            f"{doc['overhead_budget_ms']}); retrying",
-            file=sys.stderr,
-        )
-    print(json.dumps(doc, indent=1))
+    doc = _overhead_probe_best(smoke)
     framework = doc.get("span_framework_p50_ms")
     budget = doc["overhead_budget_ms"]
     if framework is None:
+        print(json.dumps(doc, indent=1))
         print("overhead-gate: FAIL — no spans recorded", file=sys.stderr)
         raise SystemExit(2)
-    if framework > budget:
+    if framework <= budget:
+        print(json.dumps(doc, indent=1))
         print(
-            f"overhead-gate: FAIL — span_framework_p50_ms {framework} > "
-            f"budget {budget} ms on every attempt (decomposition above; "
-            f"see GET /overhead and docs/operations.md 'telemetry "
-            f"overhead budget')",
+            f"overhead-gate: OK — span_framework_p50_ms {framework} <= "
+            f"budget {budget} ms",
+            file=sys.stderr,
+        )
+        return
+    baseline = None
+    if baseline_ref:
+        print(
+            f"overhead-gate: {framework} ms > budget {budget} ms — "
+            f"measuring baseline {baseline_ref!r} on this box for the "
+            f"relative verdict",
+            file=sys.stderr,
+        )
+        baseline = _baseline_probe(baseline_ref, smoke)
+    if baseline is not None and baseline.get("span_framework_p50_ms"):
+        try:
+            tol = float(os.environ.get(
+                "SELDON_TPU_OVERHEAD_REL_TOLERANCE", "") or 1.25)
+        except ValueError:
+            tol = 1.25
+        base_ms = baseline["span_framework_p50_ms"]
+        ratio = framework / base_ms if base_ms > 0 else float("inf")
+        doc["overhead_baseline_ref"] = baseline_ref
+        doc["overhead_baseline_p50_ms"] = base_ms
+        doc["overhead_vs_baseline_x"] = round(ratio, 3)
+        print(json.dumps(doc, indent=1))
+        if ratio <= tol:
+            print(
+                f"overhead-gate: OK (relative) — {framework} ms is "
+                f"{ratio:.2f}x of baseline {base_ms} ms (tolerance "
+                f"{tol}x; the absolute {budget} ms budget is breached "
+                f"by the BOX, not this tree)",
+                file=sys.stderr,
+            )
+            return
+        print(
+            f"overhead-gate: FAIL — {framework} ms is {ratio:.2f}x of "
+            f"same-box baseline {base_ms} ms (> {tol}x tolerance): this "
+            f"tree regressed the instrumentation cost (decomposition "
+            f"above; see GET /overhead and docs/operations.md "
+            f"'telemetry overhead budget')",
             file=sys.stderr,
         )
         raise SystemExit(2)
+    print(json.dumps(doc, indent=1))
     print(
-        f"overhead-gate: OK — span_framework_p50_ms {framework} <= "
-        f"budget {budget} ms",
+        f"overhead-gate: FAIL — span_framework_p50_ms {framework} > "
+        f"budget {budget} ms on every attempt (decomposition above; "
+        f"see GET /overhead and docs/operations.md 'telemetry "
+        f"overhead budget')",
         file=sys.stderr,
     )
+    raise SystemExit(2)
 
 
 def _probe_main(smoke: bool) -> None:
@@ -1912,6 +2060,20 @@ def main() -> None:
              "SELDON_TPU_OVERHEAD_BUDGET_MS) — CPU-friendly, no TPU needed",
     )
     parser.add_argument(
+        "--overhead-gate-baseline", metavar="REF", default=None,
+        help="relative A/B mode for --overhead-gate: when the absolute "
+             "budget is breached, measure REF (e.g. HEAD) in a clean git "
+             "worktree on the same box and fail only if this tree "
+             "exceeds SELDON_TPU_OVERHEAD_REL_TOLERANCE (1.25x) of it — "
+             "flags regressions, not container speed",
+    )
+    parser.add_argument(
+        "--overhead-probe-json", action="store_true",
+        help="run the span probe once (best-of-3) and print ONLY its "
+             "JSON — the machine-readable arm the relative gate runs "
+             "inside the baseline worktree",
+    )
+    parser.add_argument(
         "--ttft-gate", action="store_true",
         help="run only the concurrent-stream TTFT check (N staggered "
              "streams through the continuous-batching scheduler; fails "
@@ -1920,8 +2082,11 @@ def main() -> None:
     )
     parser.add_argument("--duration", type=float, default=None)
     args = parser.parse_args()
+    if args.overhead_probe_json:
+        print(json.dumps(_overhead_probe_best(args.smoke)))
+        return
     if args.overhead_gate:
-        _overhead_gate_main(args.smoke)
+        _overhead_gate_main(args.smoke, args.overhead_gate_baseline)
         return
     if args.ttft_gate:
         _ttft_gate_main(args.smoke)

@@ -48,6 +48,21 @@ FORCE_CPU = True  # --tpu clears this
 # -- process helpers ---------------------------------------------------------
 
 
+def _reap_at_exit(proc) -> None:
+    """atexit backstop: a demo killed mid-boot (Ctrl-C in wait_for,
+    assertion in the driver) must not leave an engine process running —
+    PR 8 found exactly such strays skewing later bench runs.  Orderly
+    teardown still goes through the finally/stop() paths; this only
+    fires for processes still alive at interpreter exit."""
+    import atexit
+
+    def _kill():
+        if proc.poll() is None:
+            proc.kill()
+
+    atexit.register(_kill)
+
+
 def wait_for(url: str, timeout_s: float, proc=None) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -92,6 +107,7 @@ class Stack:
         if predictor:
             cmd += ["--predictor", predictor]
         self.procs.append(subprocess.Popen(env=env, cwd=REPO, args=cmd))
+        _reap_at_exit(self.procs[-1])
         wait_for(f"http://127.0.0.1:{port}/ready", 300, self.procs[-1])
 
     def gateway(self, deployment: dict, url_map=None, template=None) -> None:
@@ -114,6 +130,7 @@ class Stack:
              "--spec-dir", spec_dir, "--host", "127.0.0.1"],
             env=env, cwd=REPO,
         ))
+        _reap_at_exit(self.procs[-1])
         wait_for(f"http://127.0.0.1:{GW_REST}/ready", 60, self.procs[-1])
 
     def token(self, key: str, secret: str) -> str:

@@ -35,6 +35,21 @@ ENGINE_REST, ENGINE_GRPC = 18800, 18801
 GW_REST, GW_GRPC = 18808, 18809
 
 
+def _reap_at_exit(proc) -> None:
+    """atexit backstop: a demo killed mid-boot (Ctrl-C in wait_for,
+    assertion in the driver) must not leave an engine process running —
+    PR 8 found exactly such strays skewing later bench runs.  Orderly
+    teardown still goes through the finally/stop() paths; this only
+    fires for processes still alive at interpreter exit."""
+    import atexit
+
+    def _kill():
+        if proc.poll() is None:
+            proc.kill()
+
+    atexit.register(_kill)
+
+
 def wait_for(url: str, timeout_s: float, proc=None) -> None:
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
@@ -91,6 +106,7 @@ def main() -> int:
             env=env, cwd=REPO,
         )
         procs.append(engine)
+        _reap_at_exit(engine)
         wait_for(f"http://127.0.0.1:{ENGINE_REST}/ready", 300, engine)
 
         print("[2/5] gateway (sqlite token store, firehose)")
@@ -108,6 +124,7 @@ def main() -> int:
             env=gw_env, cwd=REPO,
         )
         procs.append(gateway)
+        _reap_at_exit(gateway)
         wait_for(f"http://127.0.0.1:{GW_REST}/ready", 60, gateway)
 
         print("[3/5] OAuth client-credentials token")

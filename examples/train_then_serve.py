@@ -38,6 +38,21 @@ SEQ = 16
 PERIOD = 4  # the task: sequences repeat with this period
 
 
+def _reap_at_exit(proc) -> None:
+    """atexit backstop: a demo killed mid-boot (Ctrl-C in wait_for,
+    assertion in the driver) must not leave an engine process running —
+    PR 8 found exactly such strays skewing later bench runs.  Orderly
+    teardown still goes through the finally/stop() paths; this only
+    fires for processes still alive at interpreter exit."""
+    import atexit
+
+    def _kill():
+        if proc.poll() is None:
+            proc.kill()
+
+    atexit.register(_kill)
+
+
 def batches(rng, batch=64):
     """Synthetic copy task: token t equals token t-PERIOD, so a trained
     model continues any periodic prompt exactly."""
@@ -138,6 +153,7 @@ def main() -> int:
          "--rest-port", str(PORT), "--grpc-port", str(PORT + 1)],
         env=env, cwd=REPO,
     )
+    _reap_at_exit(proc)
     try:
         deadline = time.monotonic() + 300
         while time.monotonic() < deadline:

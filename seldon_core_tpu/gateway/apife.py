@@ -50,10 +50,15 @@ from seldon_core_tpu.gateway.balancer import (
 )
 
 from seldon_core_tpu.gateway.firehose import Firehose
+from seldon_core_tpu.gateway.shadow import (
+    ShadowConfig,
+    ShadowMirror,
+    shadow_config_from_spec,
+)
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.messages import Feedback, SeldonMessage, SeldonMessageError
 from seldon_core_tpu.runtime.udsrelay import OP_FEEDBACK, OP_PREDICT
-from seldon_core_tpu.utils.telemetry import RECORDER
+from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
 # importing the spine at module load wires the global TRACER's ring sink
 # BEFORE the gateway serves its first request — a gateway-only process
 # must not flip span routing mid-serving when someone first polls
@@ -86,6 +91,10 @@ class _Registration:
     #: EngineService, an endpoint spec string (base URL / ``uds:`` path /
     #: ``url+uds:path``), or a LIST of those — a replica set
     engines: List
+    #: mirror policy when one predictor is annotated seldon.io/shadow
+    #: (gateway/shadow.py) — that predictor serves weight-0 live traffic
+    #: and receives the sampled fire-and-forget copies instead
+    shadow: Optional[ShadowConfig] = None
 
 
 class DeploymentStore:
@@ -104,19 +113,59 @@ class DeploymentStore:
         engines: Dict[str, object],
     ) -> None:
         """``engines``: predictor name -> EngineService (or URL)."""
+        shadow = shadow_config_from_spec(spec)
         weighted = []
         for p in spec.predictors:
             if p.name in engines:
-                weighted.append((p.name, max(int(p.replicas), 0), engines[p.name]))
+                # a shadow predictor never serves live traffic: weight 0
+                # regardless of its replica count (replicas still size
+                # its engines — it must absorb the mirrored fraction)
+                weight = (
+                    0 if shadow is not None and p.name == shadow.predictor
+                    else max(int(p.replicas), 0)
+                )
+                weighted.append((p.name, weight, engines[p.name]))
         if not weighted:
             raise ValueError(f"no engines supplied for deployment {spec.name!r}")
+        if shadow is not None and shadow.predictor not in (
+            w[0] for w in weighted
+        ):
+            shadow = None  # annotated predictor has no engine: no mirror
         key = spec.oauth_key or spec.name
         self._by_key[key] = _Registration(
             deployment_id=spec.name,
             oauth_key=key,
             oauth_secret=spec.oauth_secret,
             engines=weighted,
+            shadow=shadow,
         )
+        self._revision += 1
+
+    def set_weights(self, deployment_id: str,
+                    weights: Dict[str, int]) -> None:
+        """Reassign the live traffic split of one deployment in place —
+        the rollout controller's single lever (operator/rollouts.py).
+        Predictors absent from ``weights`` keep their weight; unknown
+        predictor names are a typed error (a rollout must never silently
+        shift 0% instead of 5%).  Bumps the revision so gateway caches
+        notice."""
+        reg = None
+        for r in self._by_key.values():
+            if r.deployment_id == deployment_id:
+                reg = r
+                break
+        if reg is None:
+            raise KeyError(f"deployment not registered: {deployment_id!r}")
+        known = {name for name, _, _ in reg.engines}
+        unknown = set(weights) - known
+        if unknown:
+            raise KeyError(
+                f"unknown predictors for {deployment_id!r}: {sorted(unknown)}"
+            )
+        reg.engines = [
+            (name, max(int(weights.get(name, w)), 0), engine)
+            for name, w, engine in reg.engines
+        ]
         self._revision += 1
 
     def unregister(self, oauth_key: str) -> None:
@@ -202,6 +251,18 @@ class ApiGateway:
         self.feedback_count = 0
         self.feedback_reward_sum = 0.0
         self.feedback_truth_count = 0
+        # shadow mirroring (gateway/shadow.py): sampled fire-and-forget
+        # duplication of live predicts to a weight-0 shadow predictor,
+        # dispatched through the same pick/lane machinery live uses
+        self.shadow = ShadowMirror(self._shadow_dispatch, seed=seed)
+        # per-(deployment, predictor) live traffic accounting — the
+        # canary observability the rollout controller gates stages on
+        # (requests/errors since boot + rolling latency); bounded by the
+        # registration table, not by traffic
+        self._traffic: Dict[Tuple[str, str], dict] = {}
+        #: optional RolloutController (operator/rollouts.py) — attach to
+        #: serve its status on GET /rollouts
+        self.rollouts = None
 
     # -- principal resolution ----------------------------------------------
 
@@ -267,7 +328,16 @@ class ApiGateway:
                 [e[1] for e in reg.engines], dtype=np.float64
             )
             if weights.sum() <= 0:
+                # degenerate all-zero split: serve uniformly — but never
+                # from the shadow predictor (weight-0 BY DESIGN) unless
+                # it is the only predictor there is
                 weights = np.ones_like(weights)
+                if reg.shadow is not None and len(names) > 1:
+                    for i, n in enumerate(names):
+                        if n == reg.shadow.predictor:
+                            weights[i] = 0.0
+                if weights.sum() <= 0:
+                    weights = np.ones_like(weights)
             idx = int(self._rng.choice(len(names), p=weights / weights.sum()))
             entry = (reg.engines[idx][0], reg.engines[idx][2])
         name, engine = entry
@@ -367,11 +437,65 @@ class ApiGateway:
             # record which predictor served (canary observability; feedback
             # routes back to the same predictor)
             resp.meta.requestPath.setdefault("predictor", predictor_name)
-            if resp.status is not None and resp.status.status == "FAILURE":
+            live_error = (
+                resp.status is not None and resp.status.status == "FAILURE"
+            )
+            if live_error:
                 code["code"] = str(resp.status.code or 500)
+            live_latency_s = time.perf_counter() - t0
+            self._note_traffic(
+                reg.deployment_id, predictor_name, live_latency_s, live_error
+            )
+            # shadow mirroring rides AFTER the live answer exists — one
+            # RNG draw for the unsampled path, one create_task for the
+            # sampled one; the mirror dispatch/diff never touches this
+            # request's latency (gateway/shadow.py invariants)
+            self.shadow.maybe_mirror(
+                reg, predictor_name, msg, resp, live_latency_s
+            )
         if self.firehose is not None:
             self.firehose.publish(reg.deployment_id, msg, resp)
         return resp
+
+    def _note_traffic(self, deployment: str, predictor: str,
+                      latency_s: float, error: bool) -> None:
+        key = (deployment, predictor)
+        entry = self._traffic.get(key)
+        if entry is None:
+            entry = self._traffic[key] = {
+                "count": 0, "errors": 0, "latency_ms": Reservoir(1024),
+            }
+        entry["count"] += 1
+        if error:
+            entry["errors"] += 1
+        entry["latency_ms"].observe(latency_s * 1e3)
+
+    def predictor_traffic(self, deployment: str,
+                          predictor: str) -> Tuple[int, int]:
+        """(requests, errors) served so far for one predictor — the
+        error-rate signal the rollout controller diffs per stage."""
+        entry = self._traffic.get((deployment, predictor))
+        if entry is None:
+            return (0, 0)
+        return (entry["count"], entry["errors"])
+
+    async def _shadow_dispatch(self, reg, predictor: str,
+                               msg: SeldonMessage) -> SeldonMessage:
+        """One mirrored hop to the shadow predictor, through the real
+        replica-set pick + lane machinery.  Inflight-only accounting
+        (release, not complete): mirrored traffic must keep the shadow
+        set's load visible without letting mirror latencies feed routing
+        EWMAs or failure streaks — the shadow predictor is under test,
+        not under management."""
+        _name, _rs, endpoint, _decision = self._pick_engine(reg, predictor)
+        track = replicas_enabled()
+        if track:
+            endpoint.begin(batcher=False)
+        try:
+            return await self._dispatch_predict(endpoint, msg)
+        finally:
+            if track:
+                endpoint.release()
 
     async def send_feedback(
         self, feedback: Feedback, token: Optional[str] = None
@@ -590,6 +714,10 @@ class ApiGateway:
         for key in list(self._replica_sets):
             if key not in live_pairs:
                 del self._replica_sets[key]
+        for key in list(self._traffic):
+            if key not in live_pairs:
+                del self._traffic[key]
+        self.shadow.prune({dep for dep, _ in live_pairs})
         stale_clients = [
             c for p, c in self._uds_clients.items() if p not in live_uds
         ]
@@ -683,6 +811,21 @@ class ApiGateway:
             # sets are all in-process/uds-only never start the scraper,
             # and an unregistered deployment must not pin its engines
             "replicas": self._stats_replicas(),
+            # per-predictor live traffic + the shadow mirror's compact
+            # health block (full divergence table on GET /shadow) + the
+            # attached rollout controller's state when one is wired
+            "traffic": {
+                f"{dep}/{pred}": {
+                    "count": e["count"],
+                    "errors": e["errors"],
+                    "latency_ms": e["latency_ms"].snapshot(),
+                }
+                for (dep, pred), e in sorted(self._traffic.items())
+            },
+            "shadow": self.shadow.snapshot(),
+            "rollouts": (
+                None if self.rollouts is None else self.rollouts.snapshot()
+            ),
             "feedback": {
                 "count": self.feedback_count,
                 "mean_reward": round(
@@ -713,6 +856,7 @@ class ApiGateway:
         }
 
     async def close(self) -> None:
+        self.shadow.cancel_all()
         if self._scrape_task is not None:
             self._scrape_task.cancel()
             self._scrape_task = None
@@ -955,6 +1099,21 @@ def make_gateway_app(gateway: ApiGateway):
     async def stats(_):
         return web.json_response(gateway.stats())
 
+    async def shadow(_):
+        # the shadow mirror's full divergence table: per-deployment
+        # config, mirrored/capped counts, disagreement percentiles,
+        # latency deltas, error deltas (gateway/shadow.py)
+        return web.json_response(gateway.shadow.document())
+
+    async def rollouts(_):
+        # rollout status surface — present when a RolloutController
+        # (operator/rollouts.py) is attached to this gateway
+        if gateway.rollouts is None:
+            return web.json_response(
+                {"error": "no rollout controller attached"}, status=404
+            )
+        return web.json_response(gateway.rollouts.document())
+
     async def overhead(_):
         # the ingress hop writes fused telemetry records too (its request
         # spans route through the per-thread ring): the gateway's
@@ -972,6 +1131,8 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/ready", ready)
     app.router.add_get("/prometheus", prometheus)
     app.router.add_get("/stats", stats)
+    app.router.add_get("/shadow", shadow)
+    app.router.add_get("/rollouts", rollouts)
     app.router.add_get("/overhead", overhead)
 
     async def _cleanup(_app):

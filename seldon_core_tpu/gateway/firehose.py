@@ -76,6 +76,26 @@ class Firehose:
         except asyncio.QueueFull:
             self.dropped += 1
 
+    def publish_event(self, deployment: str, kind: str, **fields) -> None:
+        """Control-plane event on the same firehose (fire-and-forget,
+        same drop-when-full trade): rollout stage shifts and rollbacks
+        (operator/rollouts.py) land next to the request stream they
+        acted on, so one grep over the JSONL reconstructs WHY traffic
+        moved.  ``kind`` becomes the line's ``event`` field; request/
+        response stay absent so stream consumers keyed on them skip
+        these lines cleanly."""
+        event = {
+            "puid": "",
+            "deployment": deployment,
+            "ts": time.time(),
+            "event": kind,
+            **fields,
+        }
+        try:
+            self._queue.put_nowait(event)
+        except asyncio.QueueFull:
+            self.dropped += 1
+
     async def _drain(self) -> None:
         while True:
             event = await self._queue.get()

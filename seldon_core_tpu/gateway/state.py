@@ -31,6 +31,10 @@ from seldon_core_tpu.gateway.apife import (
     AuthError,
     _Registration,
 )
+from seldon_core_tpu.gateway.shadow import (
+    ShadowConfig,
+    shadow_config_from_spec,
+)
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 
 __all__ = ["SqliteDeploymentStore"]
@@ -84,6 +88,7 @@ class SqliteDeploymentStore:
         power-of-two-choices — gateway/balancer.py).  Shared state can
         only carry references another replica can dial, so in-process
         engines are rejected in either form."""
+        shadow = shadow_config_from_spec(spec)
         weighted = []
         for p in spec.predictors:
             if p.name in engines:
@@ -103,16 +108,74 @@ class SqliteDeploymentStore:
                         "in-process engines are per-replica "
                         "(use the in-memory DeploymentStore)"
                     )
-                weighted.append((p.name, max(int(p.replicas), 0), engine))
+                # same shadow contract as the in-memory store: an
+                # annotated shadow predictor serves weight-0 live traffic
+                weight = (
+                    0 if shadow is not None and p.name == shadow.predictor
+                    else max(int(p.replicas), 0)
+                )
+                weighted.append((p.name, weight, engine))
         if not weighted:
             raise ValueError(
                 f"no engines supplied for deployment {spec.name!r}"
             )
+        if shadow is not None and shadow.predictor not in (
+            w[0] for w in weighted
+        ):
+            shadow = None
         key = spec.oauth_key or spec.name
+        # wrapped form carries the shadow policy alongside the engines;
+        # the reader accepts the bare-list form older rows persisted
+        doc = {
+            "engines": weighted,
+            "shadow": None if shadow is None else shadow.to_json_dict(),
+        }
         with self._lock:
             self._conn.execute(
                 "INSERT OR REPLACE INTO registrations VALUES (?, ?, ?, ?)",
-                (key, spec.name, spec.oauth_secret, json.dumps(weighted)),
+                (key, spec.name, spec.oauth_secret, json.dumps(doc)),
+            )
+            self._conn.execute(_BUMP_REVISION)
+            self._conn.commit()
+
+    def set_weights(self, deployment_id: str, weights) -> None:
+        """Reassign one deployment's live traffic split in place — the
+        rollout controller's lever, same semantics as the in-memory
+        store's ``set_weights`` (unknown predictors are a typed error);
+        the revision bump propagates the change to every gateway replica
+        sharing the file."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT oauth_key, engines_json FROM registrations "
+                "WHERE deployment_id = ?",
+                (deployment_id,),
+            ).fetchone()
+            if row is None:
+                raise KeyError(
+                    f"deployment not registered: {deployment_id!r}"
+                )
+            key, engines_json = row
+            doc = json.loads(engines_json)
+            engines = doc["engines"] if isinstance(doc, dict) else doc
+            known = {e[0] for e in engines}
+            unknown = set(weights) - known
+            if unknown:
+                raise KeyError(
+                    f"unknown predictors for {deployment_id!r}: "
+                    f"{sorted(unknown)}"
+                )
+            engines = [
+                [name, max(int(weights.get(name, w)), 0), engine]
+                for name, w, engine in engines
+            ]
+            if isinstance(doc, dict):
+                doc["engines"] = engines
+            else:
+                doc = engines
+            self._conn.execute(
+                "UPDATE registrations SET engines_json = ? "
+                "WHERE oauth_key = ?",
+                (json.dumps(doc), key),
             )
             self._conn.execute(_BUMP_REVISION)
             self._conn.commit()
@@ -148,11 +211,20 @@ class SqliteDeploymentStore:
             ).fetchone()
         if row is None:
             return None
+        doc = json.loads(row[2])
+        if isinstance(doc, dict):
+            engines, shadow = doc["engines"], doc.get("shadow")
+        else:  # bare-list rows persisted before the shadow field existed
+            engines, shadow = doc, None
         return _Registration(
             deployment_id=row[0],
             oauth_key=oauth_key,
             oauth_secret=row[1],
-            engines=[tuple(e) for e in json.loads(row[2])],
+            engines=[tuple(e) for e in engines],
+            shadow=(
+                None if shadow is None
+                else ShadowConfig.from_json_dict(shadow)
+            ),
         )
 
     # -- auth --------------------------------------------------------------

@@ -45,6 +45,7 @@ __all__ = [
     "DispatchTimeoutError",
     "DeadlineExceededError",
     "new_puid",
+    "prediction_delta",
 ]
 
 ArrayLike = Any  # np.ndarray | jax.Array | nested lists
@@ -614,3 +615,60 @@ class Feedback:
 
     def to_json(self) -> str:
         return json.dumps(self.to_json_dict(), separators=(",", ":"))
+
+
+def prediction_delta(live: Optional["SeldonMessage"],
+                     other: Optional["SeldonMessage"],
+                     atol: float = 1e-6) -> dict:
+    """Compare two prediction messages — the ONE disagreement rule the
+    shadow mirror (gateway/shadow.py), the firehose replayer
+    (runtime/replay.py) and their tests share, so "divergence" means the
+    same thing whether a candidate is vetted offline or mirrored live.
+
+    Returns ``{"comparable": bool, "disagree": float, "mean_abs_delta":
+    float|None}``:
+
+      * classification shape ([rows, >1 classes] on both sides):
+        ``disagree`` = fraction of rows whose argmax differs — a score
+        wiggle below the decision boundary is NOT a disagreement;
+      * everything else numeric: ``disagree`` = fraction of elements
+        differing by more than ``atol``;
+      * error/shape/kind mismatches: ``comparable=False`` with
+        ``disagree`` pinned to 1.0 (a candidate that errors or changes
+        the output contract disagrees maximally by definition).
+    """
+    full = {"comparable": False, "disagree": 1.0, "mean_abs_delta": None}
+
+    def _failed(m: Optional["SeldonMessage"]) -> bool:
+        return (m is None
+                or (m.status is not None and m.status.status == "FAILURE"))
+
+    if _failed(live) and _failed(other):
+        # both sides failed: they AGREE (an error-for-error candidate is
+        # not diverging, it is faithfully reproducing the baseline)
+        return {"comparable": False, "disagree": 0.0,
+                "mean_abs_delta": None}
+    if _failed(live) or _failed(other):
+        return full
+    if live.data_kind != other.data_kind:
+        return full
+    if live.data_kind != "data":
+        # non-tensor payloads (strData/binData): byte-equality is the
+        # only defensible comparison
+        same = (live.str_data == other.str_data
+                and live.bin_data == other.bin_data)
+        return {"comparable": True, "disagree": 0.0 if same else 1.0,
+                "mean_abs_delta": None}
+    a = np.asarray(live.array(), dtype=np.float64)
+    b = np.asarray(other.array(), dtype=np.float64)
+    if a.shape != b.shape or a.size == 0:
+        return full
+    mean_abs = float(np.mean(np.abs(a - b)))
+    if a.ndim == 2 and a.shape[1] > 1:
+        disagree = float(np.mean(
+            np.argmax(a, axis=1) != np.argmax(b, axis=1)
+        ))
+    else:
+        disagree = float(np.mean(np.abs(a - b) > atol))
+    return {"comparable": True, "disagree": disagree,
+            "mean_abs_delta": round(mean_abs, 9)}

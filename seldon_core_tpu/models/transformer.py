@@ -40,6 +40,7 @@ from seldon_core_tpu.parallel.pipeline import (
     stack_stage_params,
     stage_param_shardings,
 )
+from seldon_core_tpu.parallel.mesh import shard_map as compat_shard_map
 from seldon_core_tpu.parallel.ring_attention import ring_attention
 
 __all__ = ["LMConfig", "lm_init", "lm_apply", "lm_loss", "lm_train_step",
@@ -325,7 +326,7 @@ def _attention(q, k, v, mesh: Optional[Mesh], causal: bool,
         )
 
         ring = partial(
-            jax.shard_map,
+            compat_shard_map,
             mesh=mesh,
             in_specs=(specs, specs, specs),
             out_specs=specs,

@@ -398,7 +398,8 @@ class Reconciler:
 
     def __init__(self, client: KubeClient, namespace: str = "default",
                  engine_image: str = "",
-                 engine_env: Optional[Dict[str, str]] = None):
+                 engine_env: Optional[Dict[str, str]] = None,
+                 rollouts=None):
         # engine_image/engine_env: the chart-level engine knobs
         # (bundle.py values.engine) flowing into every rendered engine pod,
         # the reference's ENGINE_CONTAINER_IMAGE_AND_VERSION property role
@@ -406,6 +407,11 @@ class Reconciler:
         self.namespace = namespace
         self.engine_image = engine_image
         self.engine_env = dict(engine_env or {})
+        #: optional RolloutController (operator/rollouts.py): CRs
+        #: annotated ``seldon.io/canary`` get staged traffic shifts with
+        #: gate-checked auto-rollback, driven one tick per reconcile and
+        #: written back onto the CR status as ``status.rollout``
+        self.rollouts = rollouts
 
     # -- CRD bootstrap ---------------------------------------------------
 
@@ -518,8 +524,35 @@ class Reconciler:
                 if (kind, res_name) not in desired_keys:
                     self.client.delete(kind, self.namespace, res_name)
                     counts["deletes"] += 1
-        self._update_status(name)
+        self._update_status(name, rollout=self._reconcile_rollout(cr))
         return counts
+
+    def _reconcile_rollout(self, cr: dict) -> Optional[dict]:
+        """One rollout-controller tick for an annotated CR: desired-state
+        intake (idempotent; the CR's config hash is the quarantine
+        identity) then a stage decision.  Returns the status block to
+        write back, None when no controller is wired or the CR doesn't
+        opt in."""
+        if self.rollouts is None:
+            return None
+        from seldon_core_tpu.operator.rollouts import plan_from_annotations
+
+        try:
+            spec = SeldonDeploymentSpec.from_json_dict(cr)
+            # hash over the CR spec only — status/annotation churn (our
+            # own write-backs included) must not read as "spec changed"
+            # and reopen a quarantine
+            plan = plan_from_annotations(
+                spec, config_hash=_config_hash({"spec": cr.get("spec")})
+            )
+        except Exception as e:
+            return {"state": "invalid",
+                    "error": f"{type(e).__name__}: {e}"}
+        if plan is None:
+            return None
+        self.rollouts.apply(plan)
+        self.rollouts.tick_deployment(plan.deployment)
+        return self.rollouts.status_block(plan.deployment)
 
     def _replace_converged(self, m: dict, retries: int = 2) -> None:
         """Replace with 409 resolution: our rendering is authoritative for
@@ -541,6 +574,10 @@ class Reconciler:
 
     def reconcile_deleted(self, name: str) -> int:
         """CR removed: prune everything it owned."""
+        if self.rollouts is not None:
+            # the quarantine dies with the CR — a re-created deployment
+            # is a new spec by definition
+            self.rollouts.forget(name)
         deleted = 0
         for kind in ("Deployment", "Service"):
             for live in self.client.list(
@@ -553,9 +590,11 @@ class Reconciler:
 
     # -- status ------------------------------------------------------------
 
-    def _update_status(self, name: str) -> None:
+    def _update_status(self, name: str,
+                       rollout: Optional[dict] = None) -> None:
         """CR status from observed Deployment readiness — the write-back
-        half (SeldonDeploymentStatusUpdateImpl.java:49-104)."""
+        half (SeldonDeploymentStatusUpdateImpl.java:49-104) — plus the
+        rollout controller's state for canary-annotated CRs."""
         deployments = self.client.list(
             "Deployment", self.namespace, {OWNER_LABEL: name}
         )
@@ -571,12 +610,15 @@ class Reconciler:
             })
             if ready < want:
                 available = False
-        self._patch_cr_status(name, {
+        status = {
             "state": "Available" if available else "Creating",
             "predictorStatus": sorted(
                 predictor_status, key=lambda p: p["name"]
             ),
-        })
+        }
+        if rollout is not None:
+            status["rollout"] = rollout
+        self._patch_cr_status(name, status)
 
     def _patch_cr_status(self, name: str, status: dict) -> None:
         # write-suppression: a status patch bumps the CR's resourceVersion,

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from seldon_core_tpu.graph.units import Unit, register_unit
 from seldon_core_tpu.graph.spec import GraphSpecError
 from seldon_core_tpu.parallel.mesh import build_mesh
+from seldon_core_tpu.parallel.mesh import shard_map as compat_shard_map
 
 __all__ = ["SharedEnsembleUnit", "stack_member_states", "ensemble_mean_fn"]
 
@@ -47,7 +48,7 @@ def ensemble_mean_fn(
     and the ensemble mean reduces with ONE psum over ICI."""
 
     @partial(
-        jax.shard_map,
+        compat_shard_map,
         mesh=mesh,
         in_specs=(P(axis), P()),
         out_specs=P(),

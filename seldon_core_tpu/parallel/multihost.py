@@ -72,6 +72,19 @@ def initialize(
     if process_id is None and ENV_PROCESS_ID in os.environ:
         process_id = int(os.environ[ENV_PROCESS_ID])
     try:
+        # CPU multi-controller (the minikube-replacement test topology)
+        # needs an explicit cross-process collectives implementation on
+        # 0.4.x jaxlib — without it every cross-process reduction dies
+        # with "Multiprocess computations aren't implemented on the CPU
+        # backend".  Newer jax selects gloo automatically; setting it is
+        # harmless there and a no-op on TPU backends.
+        if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:  # unknown config on this jax: leave default
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from seldon_core_tpu.parallel.mesh import shard_map as compat_shard_map
+
 __all__ = [
     "stack_stage_params",
     "stage_param_shardings",
@@ -139,7 +141,7 @@ def pipeline_apply(
         lambda p: P(axis, *([None] * (jnp.ndim(p) - 1))), stacked_params
     )
     x_spec = P(None, bspec, *([None] * (x_micro.ndim - 2)))
-    mapped = jax.shard_map(
+    mapped = compat_shard_map(
         run,
         mesh=mesh,
         in_specs=(in_param_spec, x_spec),

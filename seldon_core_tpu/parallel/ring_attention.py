@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from seldon_core_tpu.parallel.mesh import shard_map as compat_shard_map
+
 __all__ = ["ring_attention", "ring_attention_sharded"]
 
 _NEG_INF = -1e30
@@ -67,7 +69,13 @@ def ring_attention(
     Call INSIDE shard_map/pjit with q/k/v local blocks of shape
     [B, H, S_local, D].  Returns the local output block [B, H, S_local, D].
     """
-    n_blocks = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size is a >=0.5 addition; psum(1) over the axis is the
+    # 0.4.x-safe spelling of the same quantity (static under shard_map)
+    n_blocks = (
+        jax.lax.axis_size(axis_name)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis_name)
+    )
     my_idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     q_offset = my_idx * s_local
@@ -100,7 +108,7 @@ def ring_attention_sharded(
     over ``axis``.  For use outside an enclosing shard_map."""
 
     @partial(
-        jax.shard_map,
+        compat_shard_map,
         mesh=mesh,
         in_specs=(P(None, None, axis, None),) * 3,
         out_specs=P(None, None, axis, None),

@@ -141,6 +141,16 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_replica_picks_total": ("counter", ("set", "replica")),
     "seldon_tpu_replica_mispicks_total": ("counter", ()),
     "seldon_tpu_relay_lane_requests_total": ("counter", ("lane",)),
+    # traffic lifecycle (gateway/shadow.py + operator/rollouts.py):
+    # shadow-mirror outcomes and live-vs-shadow divergence, the shadow
+    # hop's own latency (never on the live response path), canary
+    # auto-rollbacks by breached gate, and the active rollout's candidate
+    # traffic percent per deployment
+    "seldon_tpu_shadow_requests_total": ("counter", ("outcome",)),
+    "seldon_tpu_shadow_disagreement": ("histogram", ()),
+    "seldon_tpu_shadow_latency_seconds": ("histogram", ()),
+    "seldon_tpu_rollbacks_total": ("counter", ("reason",)),
+    "seldon_tpu_rollout_stage": ("gauge", ("deployment",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -290,6 +300,13 @@ class FlightRecorder:
         self.replica_picks: Dict[str, Dict[str, int]] = {}
         self.replica_mispicks = 0
         self.lane_requests: Dict[str, int] = {}
+        # traffic-lifecycle mirrors (gateway/shadow.py mirror outcomes +
+        # divergence, operator/rollouts.py rollbacks and stage weights)
+        self.shadow_requests: Dict[str, int] = {}      # outcome -> n
+        self.shadow_disagreement = Reservoir()
+        self.shadow_latency = Reservoir()
+        self.rollbacks: Dict[str, int] = {}            # reason -> n
+        self.rollout_stage: Dict[str, float] = {}      # deployment -> pct
         # Prometheus high-water mark per hop: the counter is advanced by
         # deltas against THIS, not the snapshot mirror above — reset()
         # clears the mirror but must not rewind the monotone counter's
@@ -533,6 +550,36 @@ class FlightRecorder:
                 "Gateway->engine dispatches by relay lane "
                 "(uds / tcp / inprocess — runtime/udsrelay.py)",
                 ["lane"], registry=self.registry)
+            self._p_shadow_requests = Counter(
+                "seldon_tpu_shadow_requests_total",
+                "Shadow-mirror outcomes (gateway/shadow.py): mirrored / "
+                "sampled_out / capped (concurrency or budget) / "
+                "shadow_error — live traffic never appears here",
+                ["outcome"], registry=self.registry)
+            self._p_shadow_disagreement = Histogram(
+                "seldon_tpu_shadow_disagreement",
+                "Per-mirrored-request prediction disagreement between "
+                "the live and shadow predictors (0 = identical, 1 = "
+                "every row differs)",
+                registry=self.registry, buckets=_RATIO_BUCKETS)
+            self._p_shadow_latency = Histogram(
+                "seldon_tpu_shadow_latency_seconds",
+                "Shadow-hop wall time (off the live response path by "
+                "construction; compare against "
+                "seldon_tpu_request_latency_seconds for the delta)",
+                registry=self.registry, buckets=_LATENCY_BUCKETS)
+            self._p_rollbacks = Counter(
+                "seldon_tpu_rollbacks_total",
+                "Canary auto-rollbacks by breached gate "
+                "(drift / burn_rate / error_rate / shadow / manual — "
+                "operator/rollouts.py)",
+                ["reason"], registry=self.registry)
+            self._p_rollout_stage = Gauge(
+                "seldon_tpu_rollout_stage",
+                "Candidate traffic percent of the active rollout per "
+                "deployment (0 before stage 1 and after a rollback; "
+                "100 = fully promoted)",
+                ["deployment"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -661,6 +708,46 @@ class FlightRecorder:
             self.lane_requests[lane] = self.lane_requests.get(lane, 0) + 1
         if self.registry is not None:
             self._p_lane_requests.labels(lane=lane).inc()
+
+    # -- traffic lifecycle (gateway/shadow.py / operator/rollouts.py) ----
+
+    def record_shadow(self, outcome: str, n: int = 1) -> None:
+        """Shadow-mirror decision accounting: ``mirrored`` (a copy was
+        dispatched), ``sampled_out``, ``capped`` (concurrency/budget
+        guard dropped it), ``shadow_error`` (the shadow hop failed —
+        never a live failure by construction)."""
+        with self._lock:
+            self.shadow_requests[outcome] = (
+                self.shadow_requests.get(outcome, 0) + n)
+        if self.registry is not None:
+            self._p_shadow_requests.labels(outcome=outcome).inc(n)
+
+    def observe_shadow(self, disagreement: Optional[float],
+                       latency_s: float) -> None:
+        """One completed mirror: live-vs-shadow prediction disagreement
+        (None when the pair wasn't comparable — e.g. the shadow errored)
+        and the shadow hop's own wall time."""
+        self.shadow_latency.observe(latency_s)
+        if self.registry is not None:
+            self._p_shadow_latency.observe(latency_s)
+        if disagreement is not None:
+            self.shadow_disagreement.observe(float(disagreement))
+            if self.registry is not None:
+                self._p_shadow_disagreement.observe(float(disagreement))
+
+    def record_rollback(self, reason: str) -> None:
+        self._gen += 1
+        with self._lock:
+            self.rollbacks[reason] = self.rollbacks.get(reason, 0) + 1
+        if self.registry is not None:
+            self._p_rollbacks.labels(reason=reason).inc()
+
+    def set_rollout_stage(self, deployment: str, percent: float) -> None:
+        self._gen += 1
+        with self._lock:
+            self.rollout_stage[deployment] = float(percent)
+        if self.registry is not None:
+            self._p_rollout_stage.labels(deployment=deployment).set(percent)
 
     # -- compile cache / audit accounting -------------------------------
 
@@ -976,6 +1063,11 @@ class FlightRecorder:
                 "mispicks": self.replica_mispicks,
                 "lanes": dict(self.lane_requests),
             }
+            lifecycle = {
+                "shadow": dict(self.shadow_requests),
+                "rollbacks": dict(self.rollbacks),
+                "rollout_stage": dict(self.rollout_stage),
+            }
             quality = {
                 "drift": dict(self.drift_scores),
                 "slo_burn": dict(self.slo_burn),
@@ -985,6 +1077,8 @@ class FlightRecorder:
                     "exceeded": self.outlier_exceeded,
                 },
             }
+        lifecycle["shadow_disagreement"] = self.shadow_disagreement.snapshot()
+        lifecycle["shadow_latency_s"] = self.shadow_latency.snapshot()
         perf["compile_s"] = self.compile_seconds.snapshot()
         feedback["mean_reward"] = round(
             self.feedback_reward.snapshot()["mean"], 6
@@ -995,6 +1089,7 @@ class FlightRecorder:
             "feedback": feedback,
             "quality": quality,
             "replicas": replicas,
+            "traffic_lifecycle": lifecycle,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -1102,6 +1197,11 @@ class FlightRecorder:
             self.replica_picks = {}
             self.replica_mispicks = 0
             self.lane_requests = {}
+            self.shadow_requests = {}
+            self.shadow_disagreement = Reservoir()
+            self.shadow_latency = Reservoir()
+            self.rollbacks = {}
+            self.rollout_stage = {}
 
 
 RECORDER = FlightRecorder()

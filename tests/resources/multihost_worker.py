@@ -26,6 +26,7 @@ import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 
 from seldon_core_tpu.parallel import multihost as mh  # noqa: E402
+from seldon_core_tpu.parallel.mesh import shard_map as compat_shard_map  # noqa: E402
 
 
 def main() -> None:
@@ -52,7 +53,7 @@ def main() -> None:
 
     # per-device psum through shard_map: every process sees the same value
     psummed = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             lambda x: jax.lax.psum(x.sum(), "dp"),
             mesh=mesh, in_specs=P("dp"), out_specs=P(),
         )

@@ -128,6 +128,12 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_gen_admitted_total",
         "seldon_tpu_gen_retired_total",
         "seldon_tpu_gen_steps_total",
+        # traffic lifecycle (gateway/shadow.py + operator/rollouts.py)
+        "seldon_tpu_shadow_requests_total",
+        "seldon_tpu_shadow_disagreement",
+        "seldon_tpu_shadow_latency_seconds",
+        "seldon_tpu_rollbacks_total",
+        "seldon_tpu_rollout_stage",
         # serving-mesh replica balancer (gateway/balancer.py)
         "seldon_tpu_replica_inflight",
         "seldon_tpu_replica_picks_total",
