@@ -43,6 +43,17 @@ quality-demo:
 scale-demo:
 	python scripts/scale_demo.py --out scale_demo
 
+# autopilot demo: learned cost-model shed-before-dispatch — a heavy
+# tight-deadline class is refused with typed 503s at admission (zero
+# wasted device dispatches, measured exactly via the perf observatory's
+# dispatched-row delta) and the serveable class's p99 improves; proves
+# the SELDON_TPU_AUTOPILOT=0 kill switch restores the reactive path.
+# Artifact autopilot_demo/autopilot.json + the GET /autopilot page
+# (scripts/autopilot_demo.py; docs/operations.md "reading the
+# /autopilot page")
+autopilot-demo:
+	python scripts/autopilot_demo.py --out autopilot_demo
+
 # safe-rollout demo: shadow mirroring -> firehose replay vet -> staged
 # canary under injected drift -> automatic rollback with zero failed
 # live requests; proves both kill switches (SELDON_TPU_SHADOW=0,
@@ -119,4 +130,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo canary-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun

@@ -308,14 +308,15 @@ class ApiGateway:
 
     def _pick_engine(
         self, reg: _Registration, predictor: Optional[str] = None,
-        eligible=None,
+        eligible=None, rows: Optional[int] = None,
     ) -> Tuple[str, ReplicaSet, ReplicaEndpoint, Optional[PickDecision]]:
         """Two-level choice: replica-weighted predictor split (canary,
         unchanged), then power-of-two-choices over THAT predictor's
         replica endpoints (gateway/balancer.py).  ``decision`` is None on
         the pre-replica-set paths (single endpoint / kill switch).
         ``eligible`` narrows the p2c pool (ReplicaSet.pick) to endpoints
-        the caller's lane can use."""
+        the caller's lane can use.  ``rows`` makes each candidate's score
+        shape-aware (autopilot cost-aware routing)."""
         entry = None
         if predictor is not None:
             for name, _, engine in reg.engines:
@@ -342,9 +343,20 @@ class ApiGateway:
             entry = (reg.engines[idx][0], reg.engines[idx][2])
         name, engine = entry
         rs = self._replica_set(reg, name, engine)
-        endpoint, decision = rs.pick(eligible)
+        endpoint, decision = rs.pick(eligible, rows=rows)
         self._ensure_scraper(rs)
         return name, rs, endpoint, decision
+
+    @staticmethod
+    def _request_rows(msg: SeldonMessage) -> Optional[int]:
+        """Row count of a predict payload — the shape signal the
+        autopilot-blended p2c score prices candidates with.  None (the
+        shape-blind legacy score) for non-tensor payloads.  The shared
+        rule (runtime/autopilot.py message_rows) so gateway buckets
+        match interpreter branch buckets."""
+        from seldon_core_tpu.runtime.autopilot import message_rows
+
+        return message_rows(msg)
 
     # -- data plane ---------------------------------------------------------
 
@@ -361,6 +373,22 @@ class ApiGateway:
             st is not None
             and st.status == "FAILURE"
             and (st.code or 0) in (502, 503, 504)
+            # a predictive load shed (runtime/autopilot.py) is the engine
+            # DECIDING, not the replica dying: blaming it would cycle
+            # every correctly-shedding replica through fail-degradation
+            # exactly under the tight-deadline bursts sheds exist for
+            and not ApiGateway._is_autopilot_shed(resp)
+        )
+
+    @staticmethod
+    def _is_autopilot_shed(resp: SeldonMessage) -> bool:
+        from seldon_core_tpu.runtime.autopilot import SHED_INFO_PREFIX
+
+        st = resp.status
+        return (
+            st is not None
+            and (st.code or 0) == 503
+            and str(st.info or "").startswith(SHED_INFO_PREFIX)
         )
 
     @staticmethod
@@ -399,7 +427,10 @@ class ApiGateway:
             # or one impatient client would cycle every healthy replica
             # through fail-degradation
             blameable = rem is None or rem >= 20.0
-            predictor_name, rs, endpoint, decision = self._pick_engine(reg)
+            rows = self._request_rows(msg)
+            predictor_name, rs, endpoint, decision = self._pick_engine(
+                reg, rows=rows
+            )
             # the ingress span roots the request tree (or joins the
             # caller's trace when it sent a traceparent); the engine hop —
             # in-process, UDS or HTTP — becomes its child
@@ -409,6 +440,7 @@ class ApiGateway:
             t0 = time.perf_counter()
             ok = False
             raised = True
+            shed = False
             try:
                 with TRACER.span(
                     msg.meta.puid, "gateway", kind="request",
@@ -417,6 +449,7 @@ class ApiGateway:
                     **self._decision_attrs(decision),
                 ):
                     resp = await self._dispatch_predict(endpoint, msg)
+                shed = self._is_autopilot_shed(resp)
                 ok = not self._replica_fault(resp)
                 raised = False
             finally:
@@ -429,9 +462,18 @@ class ApiGateway:
                         # fail-degrade a healthy replica (real transport
                         # failures return a typed 503, they don't raise)
                         endpoint.release(batcher=True)
+                    elif shed:
+                        # predictive shed: neutral accounting — not a
+                        # failure streak (the replica is deciding, not
+                        # dying) and not a latency sample (a ~1 ms
+                        # refusal fed into the EWMA would make the
+                        # shedding replica look FAST and herd more
+                        # traffic onto it)
+                        endpoint.release(batcher=True)
                     elif ok or blameable:
                         rs.complete(endpoint, decision,
-                                    time.perf_counter() - t0, ok=ok)
+                                    time.perf_counter() - t0, ok=ok,
+                                    rows=rows)
                     else:
                         endpoint.release(batcher=True)
             # record which predictor served (canary observability; feedback

@@ -42,6 +42,7 @@ import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
+from seldon_core_tpu.runtime.autopilot import autopilot_enabled, pad_bucket
 from seldon_core_tpu.utils.telemetry import RECORDER
 
 __all__ = [
@@ -113,10 +114,16 @@ class ReplicaEndpoint:
 
     __slots__ = (
         "target", "base_url", "uds_path", "name", "index", "set_name",
-        "inflight", "batcher_inflight", "ewma_ms", "picks", "failures",
-        "consec_failures", "fail_degraded_until",
+        "inflight", "batcher_inflight", "ewma_ms", "shape_ms", "picks",
+        "failures", "consec_failures", "fail_degraded_until",
         "scraped_inflight", "scrape_ts", "scrape_failed", "breaker_open",
     )
+
+    #: minimum samples before a shape bucket's own EWMA is trusted
+    #: outright; below it the prediction blends toward the global EWMA
+    SHAPE_MIN_SAMPLES = 5
+    #: bounded per-shape table — pow2 buckets give ~20 keys max anyway
+    SHAPE_MAX_BUCKETS = 32
 
     def __init__(self, target, index: int = 0, set_name: str = "default"):
         self.index = index
@@ -136,6 +143,12 @@ class ReplicaEndpoint:
         # ``inflight_dispatches`` figure can also contain
         self.batcher_inflight = 0
         self.ewma_ms = 0.0  # 0 = no successful sample yet
+        # per-request-shape latency models (autopilot cost-aware routing):
+        # pad bucket (pow2 of row count) -> [ewma_ms, samples].  A 1-row
+        # predict and a 512-row predict have wildly different walls; a
+        # shape-blind EWMA averages them into a score that mispredicts
+        # both.  SELDON_TPU_AUTOPILOT=0 restores the blind EWMA
+        self.shape_ms: dict = {}
         self.picks = 0
         self.failures = 0
         self.consec_failures = 0
@@ -168,13 +181,33 @@ class ReplicaEndpoint:
         open_breakers = getattr(self.target, "open_breakers", None)
         return bool(open_breakers()) if callable(open_breakers) else False
 
-    def score(self, now: float, stale_after_s: float) -> float:
+    def predicted_ms(self, rows: Optional[int] = None) -> float:
+        """Per-request latency prediction for a request of ``rows`` rows:
+        the pad bucket's own EWMA once it has ``SHAPE_MIN_SAMPLES``,
+        blended toward the shape-blind global EWMA below that, and the
+        global EWMA when the shape is unknown or the autopilot is off —
+        bit-for-bit the pre-autopilot score input in that case."""
+        if rows is None or not autopilot_enabled():
+            return self.ewma_ms
+        model = self.shape_ms.get(pad_bucket(rows))
+        if model is None or model[1] == 0:
+            return self.ewma_ms
+        ms, n = model
+        if n >= self.SHAPE_MIN_SAMPLES or self.ewma_ms == 0.0:
+            return ms
+        w = n / self.SHAPE_MIN_SAMPLES
+        return w * ms + (1.0 - w) * self.ewma_ms
+
+    def score(self, now: float, stale_after_s: float,
+              rows: Optional[int] = None) -> float:
         """Expected wait: (queued work) x (per-request cost).  Gateway-side
         inflight is authoritative for work THIS gateway queued; the scraped
-        engine-side inflight adds load other gateways put there."""
+        engine-side inflight adds load other gateways put there.  The
+        per-request cost is shape-aware when the caller passes the request
+        row count (autopilot cost-aware routing)."""
         s = (
             (self.inflight + self.scraped_inflight + 1)
-            * max(self.ewma_ms, _EWMA_FLOOR_MS)
+            * max(self.predicted_ms(rows), _EWMA_FLOOR_MS)
         )
         if self.degraded(now, stale_after_s):
             s += _UNHEALTHY_PENALTY
@@ -191,7 +224,8 @@ class ReplicaEndpoint:
             self.batcher_inflight += 1
         RECORDER.set_replica_inflight(self.set_name, self.name, self.inflight)
 
-    def complete(self, latency_s: float, ok: bool = True) -> None:
+    def complete(self, latency_s: float, ok: bool = True,
+                 rows: Optional[int] = None) -> None:
         self.inflight = max(0, self.inflight - 1)
         self.batcher_inflight = max(0, self.batcher_inflight - 1)
         RECORDER.set_replica_inflight(self.set_name, self.name, self.inflight)
@@ -201,6 +235,16 @@ class ReplicaEndpoint:
                 ms if self.ewma_ms == 0.0
                 else (1 - _EWMA_ALPHA) * self.ewma_ms + _EWMA_ALPHA * ms
             )
+            if rows is not None:
+                bucket = pad_bucket(rows)
+                model = self.shape_ms.get(bucket)
+                if model is not None:
+                    model[0] = (
+                        (1 - _EWMA_ALPHA) * model[0] + _EWMA_ALPHA * ms
+                    )
+                    model[1] += 1
+                elif len(self.shape_ms) < self.SHAPE_MAX_BUCKETS:
+                    self.shape_ms[bucket] = [ms, 1]
             self.consec_failures = 0
             self.fail_degraded_until = 0.0
         else:
@@ -283,7 +327,7 @@ class ReplicaSet:
     # -- the balancer ----------------------------------------------------
 
     def pick(
-        self, eligible=None
+        self, eligible=None, rows: Optional[int] = None
     ) -> Tuple[ReplicaEndpoint, Optional[PickDecision]]:
         """Power-of-two-choices; ``decision`` is None exactly on the paths
         that predate replica sets (kill switch / single endpoint), so the
@@ -291,7 +335,10 @@ class ReplicaSet:
         pool to endpoints a caller can actually use (e.g. streams need a
         TCP/in-process lane) so the pick — and its metrics — land on the
         endpoint that serves; an empty filtered pool falls back to the
-        full set and the caller handles the capability miss."""
+        full set and the caller handles the capability miss.  ``rows``
+        makes the score's latency term shape-aware (autopilot cost-aware
+        routing): each candidate is priced for THIS request's pad bucket
+        instead of its shape-blind EWMA."""
         if not replicas_enabled() or len(self.endpoints) == 1:
             return self.endpoints[0], None
         pool = self.endpoints
@@ -304,18 +351,27 @@ class ReplicaSet:
             RECORDER.record_replica_pick(self.name, chosen.name)
             return chosen, PickDecision(
                 replica=chosen.name, candidates=[chosen.name],
-                scores=[round(chosen.score(now, self.stale_after_s), 4)],
+                scores=[round(
+                    chosen.score(now, self.stale_after_s, rows), 4
+                )],
                 loser_ewma_ms=0.0,
             )
         i, j = self._rng.sample(range(len(pool)), 2)
         a, b = pool[i], pool[j]
         sa, sb = (
-            a.score(now, self.stale_after_s),
-            b.score(now, self.stale_after_s),
+            a.score(now, self.stale_after_s, rows),
+            b.score(now, self.stale_after_s, rows),
         )
         chosen, loser = (a, b) if sa <= sb else (b, a)
         chosen.picks += 1
         RECORDER.record_replica_pick(self.name, chosen.name)
+        if rows is not None and autopilot_enabled():
+            # count only picks a shape model actually informed — a pick
+            # that fell back to the shape-blind EWMA on both candidates
+            # is not a predictive decision
+            bucket = pad_bucket(rows)
+            if a.shape_ms.get(bucket) or b.shape_ms.get(bucket):
+                RECORDER.record_autopilot_decision("p2c")
         return chosen, PickDecision(
             replica=chosen.name,
             candidates=[a.name, b.name],
@@ -323,21 +379,23 @@ class ReplicaSet:
             # a degraded loser doesn't judge the pick: beating a sick
             # replica's historical EWMA is not a prediction error, and
             # counting it would pin the mispick ratio at 1.0 exactly
-            # while the balancer steers correctly
+            # while the balancer steers correctly.  Hindsight uses the
+            # same shape-aware prediction the pick scored with
             loser_ewma_ms=(
                 0.0 if loser.degraded(now, self.stale_after_s)
-                else loser.ewma_ms
+                else loser.predicted_ms(rows)
             ),
         )
 
     def complete(self, endpoint: ReplicaEndpoint,
                  decision: Optional[PickDecision],
-                 latency_s: float, ok: bool = True) -> None:
+                 latency_s: float, ok: bool = True,
+                 rows: Optional[int] = None) -> None:
         """Close one dispatch: update the endpoint's score inputs and judge
         the pick in hindsight (mispick = a successful request that ran
         longer than the losing candidate's EWMA at decision time — the
         loser would LIKELY have been faster)."""
-        endpoint.complete(latency_s, ok=ok)
+        endpoint.complete(latency_s, ok=ok, rows=rows)
         if (
             ok
             and decision is not None

@@ -20,6 +20,7 @@ graphs that genuinely span processes.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
@@ -395,15 +396,39 @@ class GraphExecutor:
                         f"router {node.name!r} chose branch {branch} but has "
                         f"{len(node.children)} children"
                     )
+                if branch != -1:
+                    # cost-aware routing (runtime/autopilot.py): demote a
+                    # branch predicted to blow the request deadline to the
+                    # fastest predicted branch that fits — the predictive
+                    # counterpart of the reactive fallback-on-failure
+                    branch = self._autopilot_branch(node, msg, branch)
                 msg.meta.routing[node.name] = branch
                 routed_branch = branch
                 selected = node.children if branch == -1 else [node.children[branch]]
             else:
                 selected = node.children
 
+            t_children = time.perf_counter()
             child_msgs = await self._dispatch_children(
                 node, msg, selected, routed_branch, methods
             )
+            if routed_branch is not None and routed_branch != -1:
+                # per-branch latency learning for the SHAPE that rode it
+                # (a reactive fallback mid-dispatch updates meta.routing,
+                # so the branch that actually served gets the sample)
+                from seldon_core_tpu.runtime.autopilot import (
+                    AUTOPILOT,
+                    branch_key,
+                )
+
+                AUTOPILOT.observe(
+                    branch_key(
+                        node.name,
+                        msg.meta.routing.get(node.name, routed_branch),
+                        _msg_rows(msg),
+                    ),
+                    time.perf_counter() - t_children,
+                )
 
             # 3. merge (engine PredictiveUnitBean.java:115-124)
             if UnitMethod.AGGREGATE in methods:
@@ -429,6 +454,58 @@ class GraphExecutor:
             with tracer.span(puid, node.name, method="transform_output"):
                 out = await rt.transform_output(out)
         return out
+
+    def _autopilot_branch(self, node: PredictiveUnit, msg: SeldonMessage,
+                          branch: int) -> int:
+        """Cost-aware routing for ROUTER/ensemble nodes: price the routed
+        branch with its learned per-branch latency for THIS request's
+        shape bucket (runtime/autopilot.py).  When a deadline budget is
+        in force and the prediction says the chosen branch cannot answer
+        inside it while another branch can, demote to the fastest
+        predicted branch that fits — stamped into ``meta.tags`` and a
+        span event so the decision is auditable, and recorded into
+        ``meta.routing`` so feedback trains the branch that actually
+        served.  No deadline, no predictions, or the kill switch off:
+        the router's own choice stands untouched."""
+        from seldon_core_tpu.runtime.autopilot import (
+            AUTOPILOT,
+            autopilot_enabled,
+            branch_key,
+            shed_margin,
+        )
+
+        if not autopilot_enabled():
+            return branch
+        dl = current_deadline()
+        if dl is None:
+            return branch
+        rows = _msg_rows(msg)
+        pred = AUTOPILOT.predict_s(branch_key(node.name, branch, rows))
+        rem = dl.remaining_s()
+        margin = shed_margin()
+        if pred is None or pred <= rem * margin:
+            return branch
+        best = None
+        for b in range(len(node.children)):
+            if b == branch:
+                continue
+            p = AUTOPILOT.predict_s(branch_key(node.name, b, rows))
+            if p is not None and p <= rem * margin and (
+                best is None or p < best[1]
+            ):
+                best = (b, p)
+        if best is None:
+            return branch  # nothing predicted to fit: let the pick ride
+        RECORDER.record_autopilot_decision("route")
+        self.tracer.event(
+            "autopilot_reroute", node=node.name,
+            from_branch=int(branch), to_branch=int(best[0]),
+            predicted_ms=round(pred * 1e3, 3),
+            to_predicted_ms=round(best[1] * 1e3, 3),
+            remaining_ms=round(rem * 1e3, 3),
+        )
+        msg.meta.tags[f"seldon.autopilot.reroute.{node.name}"] = int(best[0])
+        return best[0]
 
     # -- graceful degradation (resilience layer) ----------------------------
 
@@ -598,6 +675,15 @@ class GraphExecutor:
             rt = self.runtimes.get(name)
             if isinstance(rt, InProcessNodeRuntime):
                 rt.state = st
+
+
+def _msg_rows(msg: SeldonMessage) -> Optional[int]:
+    """Row count for per-branch latency bucketing — the shared rule
+    (runtime/autopilot.py message_rows), so interpreter branch buckets
+    match gateway p2c buckets."""
+    from seldon_core_tpu.runtime.autopilot import message_rows
+
+    return message_rows(msg)
 
 
 def _fork_message(msg: SeldonMessage) -> SeldonMessage:

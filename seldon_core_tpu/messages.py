@@ -44,6 +44,7 @@ __all__ = [
     "SeldonMessageError",
     "DispatchTimeoutError",
     "DeadlineExceededError",
+    "LoadShedError",
     "new_puid",
     "prediction_delta",
 ]
@@ -76,6 +77,18 @@ class DeadlineExceededError(SeldonMessageError):
     across every node hop and retry so timeouts never stack."""
 
     http_code = 504
+
+
+class LoadShedError(SeldonMessageError):
+    """Predictive load shed (runtime/autopilot.py): the autopilot's
+    predicted queue + dispatch latency exceeded the request's remaining
+    deadline budget, so the engine refused the request *before* burning
+    device time on an answer the caller could never use.  503 at the
+    edge — retryable downstream (runtime/resilience.py classifies 503
+    transient), so a shed composes with the circuit breakers and the
+    global retry budget instead of bypassing them."""
+
+    http_code = 503
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,335 @@
+"""Learned cost-model autopilot — the layer that makes the observatories
+act instead of watch.
+
+PRs 1-9 made every latency-sensitive quantity *visible* — per-executable
+FLOPs/bytes and measured dispatch walls (utils/perf.py), deadline
+budgets (runtime/resilience.py), p2c replica scores
+(gateway/balancer.py) — but every decision stayed reactive: a blown
+deadline was discovered after the dispatch that blew it.  This module
+closes the loop with an on-line latency predictor per executable /
+pad-bucket ("A Learned Performance Model for TPUs", arxiv 2008.01040,
+and "TpuGraphs", arxiv 2308.13490, show the static cost features we
+already capture predict runtime well) and wires its predictions into
+three decision points:
+
+  * **Predictive micro-batch sizing** (runtime/batching.py): a bucket
+    with waiting requests picks the flush prefix / pad bucket that
+    maximizes predicted goodput — real rows per predicted second, so
+    pad waste is priced in — under the waiting requests' tightest
+    remaining deadline.
+  * **Deadline-aware admission control** (runtime/engine.py): when
+    predicted queue + dispatch latency exceeds the request's remaining
+    deadline budget, the engine sheds with a typed 503
+    (``LoadShedError``) *before* burning device time.  The 503 is
+    retryable downstream, so the shed composes with the PR-2 circuit
+    breakers and the global retry budget instead of bypassing them.
+  * **Cost-aware routing**: the gateway's p2c scores blend a
+    per-replica latency prediction for the *actual request shape*
+    (gateway/balancer.py), and host-mode ROUTER nodes learn per-branch
+    latency so a routed branch predicted to blow the deadline is
+    demoted to a predicted-to-fit branch (graph/interpreter.py).
+
+The model is deliberately tiny — one robust online location/scale
+estimate per key (EWMA with Huber-clipped residuals: a single straggler
+cannot yank the estimate, a real shift converges in a few samples), no
+ML dependencies.  Keys are the SAME executable identities the perf
+observatory uses (``predict[128x784/float64]``), so every pad bucket is
+its own model.  Before a key has ``min_samples`` measured dispatches its
+prediction blends toward the perf observatory's **seed prior**: the
+overhead-adjusted roofline time (``cost_analysis()`` features x
+``SELDON_TPU_PERF_OVERHEAD_X``, scaled by the observatory's measured
+calibration ratio — utils/perf.py ``seed_predicted_s``), so a
+never-dispatched pad bucket still prices sanely.
+
+**Learning rides the existing telemetry spine**: measured dispatch walls
+arrive via the fused per-hop HotRecord and fold into the model in the
+drainer (utils/hotrecord.py), off the dispatch path — the hot path pays
+zero new locks and zero new ring writes for learning.  Predictions are
+plain dict reads.  Every decision is stamped onto the request span and
+counted in the ``seldon_tpu_autopilot_*`` families so mispredictions
+are auditable via the PR-3/PR-6 plumbing, and ``GET /autopilot``
+exposes the per-key model table.
+
+``SELDON_TPU_AUTOPILOT=0`` is the kill switch: every decision site
+checks it and restores the prior behaviour bit-for-bit (flush-all
+batching, no admission shed, EWMA-only p2c scores, no branch demotion).
+Knobs (docs/operations.md "reading the /autopilot page"):
+
+  * ``SELDON_TPU_AUTOPILOT``            kill switch (default on)
+  * ``SELDON_TPU_AUTOPILOT_LR``         online learning rate (0.3)
+  * ``SELDON_TPU_AUTOPILOT_MIN_SAMPLES``samples before a key's learned
+                                        estimate is trusted outright (5)
+  * ``SELDON_TPU_AUTOPILOT_SHED_MARGIN``shed when predicted latency >
+                                        margin x remaining budget (1.25)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
+
+__all__ = [
+    "Autopilot",
+    "AUTOPILOT",
+    "autopilot_enabled",
+    "shed_margin",
+    "pad_bucket",
+    "branch_key",
+    "message_rows",
+    "SHED_INFO_PREFIX",
+]
+
+#: every LoadShedError message starts with this, and it is how the
+#: gateway recognizes a predictive shed on the wire (apife.py): a shed
+#: is an ENGINE DECISION, not replica sickness — it must count as load
+#: for routing but never feed fail-degradation or the latency EWMA
+SHED_INFO_PREFIX = "autopilot load shed"
+
+
+def autopilot_enabled() -> bool:
+    """Kill switch: ``SELDON_TPU_AUTOPILOT=0`` restores every decision
+    site's pre-autopilot behaviour bit-for-bit (the model keeps learning
+    off-path so flipping the switch back on starts warm)."""
+    return os.environ.get("SELDON_TPU_AUTOPILOT", "1") != "0"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def shed_margin() -> float:
+    """Admission sheds when predicted latency exceeds ``margin`` x the
+    remaining deadline budget.  The default 1.25 demands headroom beyond
+    the model's typical ~25% misprediction before refusing work — a shed
+    must be CONFIDENTLY doomed (shed precision stays >= 0.9), at the
+    cost of letting marginal requests try and sometimes miss.  Lower
+    toward 1.0 to shed earlier (more capacity saved, lower precision);
+    raise to shed only on hopeless requests."""
+    return _env_float("SELDON_TPU_AUTOPILOT_SHED_MARGIN", 1.25)
+
+
+def pad_bucket(rows: int) -> int:
+    """Power-of-two pad bucket for a row count — the same bucketing the
+    MicroBatcher pads to and the balancer's shape models key on."""
+    n = max(int(rows), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def branch_key(node: str, branch: int, rows: Optional[int]) -> str:
+    """Model key for one ROUTER branch at one request-shape bucket —
+    the per-branch analogue of the per-executable key."""
+    bucket = pad_bucket(rows) if rows else 1
+    return f"branch:{node}/{int(branch)}[{bucket}]"
+
+
+def message_rows(msg) -> Optional[int]:
+    """Row count of a SeldonMessage's tensor payload (None for
+    non-tensor payloads) — THE shape-bucketing rule every decision site
+    shares (gateway p2c pricing, router branch keys), so the buckets
+    cannot drift between layers."""
+    try:
+        data = msg.data
+        if data is None or data.array is None:
+            return None
+        import numpy as np
+
+        shape = np.shape(data.array)
+        return int(shape[0]) if len(shape) >= 2 else 1
+    except Exception:  # noqa: BLE001 - shape probing must never fail a path
+        return None
+
+
+class _KeyModel:
+    """Robust online latency estimate for one key: EWMA location with
+    Huber-clipped residuals plus an EWMA absolute-deviation scale.  A
+    single outlier moves the estimate by at most ``lr * OUTLIER_K *
+    scale``; a sustained shift converges at the learning rate."""
+
+    __slots__ = ("key", "n", "est_s", "scale_s", "last_s")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.n = 0
+        self.est_s = 0.0
+        self.scale_s = 0.0
+        self.last_s = 0.0
+
+
+class Autopilot:
+    """Process-global per-key latency predictor.  All methods are cheap,
+    lock-free (plain dict ops under the GIL — ``observe`` runs in the
+    spine drainer, ``predict_s`` on decision sites) and never raise."""
+
+    #: residuals are clipped at this many scales before they update the
+    #: location — the "robust" in robust online regression
+    OUTLIER_K = 4.0
+    #: bounded model table: an exploding shape set must not grow memory;
+    #: novel keys beyond the cap are simply not modelled (predict -> seed)
+    MAX_KEYS = 256
+
+    def __init__(
+        self,
+        lr: Optional[float] = None,
+        min_samples: Optional[int] = None,
+    ):
+        self.lr = (
+            lr if lr is not None
+            else _env_float("SELDON_TPU_AUTOPILOT_LR", 0.3)
+        )
+        self.min_samples = int(
+            min_samples if min_samples is not None
+            else _env_float("SELDON_TPU_AUTOPILOT_MIN_SAMPLES", 5)
+        )
+        self._models: Dict[str, _KeyModel] = {}
+        #: |measured - predicted| / predicted per observed dispatch, the
+        #: honesty figure behind seldon_tpu_autopilot_mispredict_pct
+        self.mispredict_pct = Reservoir(1024)
+        #: seed priors resolve through this hook (set to the perf
+        #: observatory's seed_predicted_s below; injectable for tests)
+        self.seed_fn: Optional[Callable[[str], Optional[float]]] = None
+
+    # -- learning (off-path: the spine drainer calls this) ---------------
+
+    def observe(self, key: str, seconds: float) -> Optional[float]:
+        """Fold one measured wall time into the key's model.  Returns the
+        prediction that was in force BEFORE this observation (None when
+        the key had neither samples nor a seed) so the caller can stamp
+        predicted-vs-measured onto the span it is folding."""
+        if not key or seconds <= 0:
+            return None
+        pred = self.predict_s(key)
+        m = self._models.get(key)
+        if m is None:
+            if len(self._models) >= self.MAX_KEYS:
+                return pred
+            m = self._models[key] = _KeyModel(key)
+        if m.n == 0:
+            m.est_s = float(seconds)
+            # first-sample scale: half the observation — wide enough to
+            # admit real movement, finite so clipping works immediately
+            m.scale_s = float(seconds) * 0.5
+        else:
+            resid = float(seconds) - m.est_s
+            lim = self.OUTLIER_K * max(m.scale_s, 1e-9)
+            clipped = max(-lim, min(lim, resid))
+            m.est_s += self.lr * clipped
+            m.scale_s += self.lr * (min(abs(resid), lim) - m.scale_s)
+        m.n += 1
+        m.last_s = float(seconds)
+        if pred is not None and pred > 0:
+            self.mispredict_pct.observe(
+                abs(float(seconds) - pred) / pred * 100.0
+            )
+        return pred
+
+    # -- prediction (decision sites) --------------------------------------
+
+    def _seed_s(self, key: str) -> Optional[float]:
+        if self.seed_fn is None:
+            return None
+        try:
+            return self.seed_fn(key)
+        except Exception:  # noqa: BLE001 - a prior must never fail a path
+            return None
+
+    def predict_s(self, key: str) -> Optional[float]:
+        """Predicted wall seconds for one key: the learned estimate once
+        ``min_samples`` dispatches are in, the seed prior before any, and
+        a sample-count-weighted blend between (so the first measurements
+        pull the roofline prior toward reality instead of snapping)."""
+        m = self._models.get(key)
+        if m is None or m.n == 0:
+            return self._seed_s(key)
+        if m.n >= self.min_samples:
+            return m.est_s
+        seed = self._seed_s(key)
+        if seed is None:
+            return m.est_s
+        w = m.n / self.min_samples
+        return w * m.est_s + (1.0 - w) * seed
+
+    # -- surfaces ----------------------------------------------------------
+
+    def publish_gauges(self) -> None:
+        """Refresh the seldon_tpu_autopilot_* gauges — called from the
+        spine's throttled gauge refresh, never per-request."""
+        snap = self.mispredict_pct.snapshot()
+        RECORDER.set_autopilot_model(
+            mispredict_p50_pct=snap["p50"] if snap["count"] else None,
+            keys=len(self._models),
+        )
+
+    def document(self) -> Dict[str, Any]:
+        """The ``GET /autopilot`` body: knobs, the per-key model table
+        (sorted by sample count), and the misprediction distribution."""
+        rows: List[Dict[str, Any]] = []
+        # list() under the GIL: the drainer inserts new keys concurrently
+        # and a plain dict iteration would raise mid-growth
+        for m in list(self._models.values()):
+            pred = self.predict_s(m.key)
+            seed = self._seed_s(m.key)
+            rows.append({
+                "key": m.key,
+                "samples": m.n,
+                "predicted_ms": (
+                    None if pred is None else round(pred * 1e3, 4)
+                ),
+                "learned_ms": round(m.est_s * 1e3, 4) if m.n else None,
+                "seed_ms": None if seed is None else round(seed * 1e3, 4),
+                "scale_ms": round(m.scale_s * 1e3, 4),
+                "last_ms": round(m.last_s * 1e3, 4),
+                "trusted": m.n >= self.min_samples,
+            })
+        rows.sort(key=lambda r: r["samples"], reverse=True)
+        snap = self.mispredict_pct.snapshot()
+        sheds, decisions = RECORDER.autopilot_counters()
+        return {
+            "enabled": autopilot_enabled(),
+            "knobs": {
+                "kill_switch": "SELDON_TPU_AUTOPILOT",
+                "lr": self.lr,
+                "min_samples_before_trust": self.min_samples,
+                "shed_margin": shed_margin(),
+            },
+            "keys": rows,
+            "mispredict_pct": {
+                k: round(snap[k], 3)
+                for k in ("count", "mean", "p50", "p95", "p99", "max")
+            },
+            "sheds": sheds,
+            "decisions": decisions,
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Compact health block — the full table lives on /autopilot."""
+        snap = self.mispredict_pct.snapshot()
+        return {
+            "enabled": autopilot_enabled(),
+            "keys": len(self._models),
+            "observations": snap["count"],
+            "mispredict_p50_pct": round(snap["p50"], 2),
+        }
+
+    def reset(self) -> None:
+        """Fresh state — tests and A/B bench arms only."""
+        self._models = {}
+        self.mispredict_pct = Reservoir(1024)
+
+
+AUTOPILOT = Autopilot()
+
+
+def _wire_seed() -> None:
+    # seed priors come from the perf observatory's overhead-adjusted
+    # roofline (late import: utils/perf.py must stay importable first)
+    from seldon_core_tpu.utils.perf import OBSERVATORY
+
+    AUTOPILOT.seed_fn = OBSERVATORY.seed_predicted_s
+
+
+_wire_seed()

@@ -32,6 +32,8 @@ import numpy as np
 
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
+from seldon_core_tpu.runtime.autopilot import autopilot_enabled, pad_bucket
+from seldon_core_tpu.runtime.resilience import current_deadline
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
@@ -71,8 +73,17 @@ class MicroBatcher:
         coalesce_ms: float = 0.5,
         dispatch_timeout_s: float = 0.0,
         atomic_chunks: bool = False,
+        predict_s_fn=None,
     ):
         self.batch_fn = batch_fn
+        # learned-cost-model hook (runtime/autopilot.py): a callable
+        # ``(padded_rows:int, sample_x) -> Optional[seconds]`` predicting
+        # the dispatch wall for one pad bucket.  When set (and the
+        # autopilot kill switch is on) each flush picks the prefix/pad
+        # bucket maximizing predicted goodput instead of flushing
+        # everything waiting; None keeps the legacy flush-all behaviour
+        # bit-for-bit
+        self.predict_s_fn = predict_s_fn
         # dispatch sites that accept real_rows get the pre-padding row
         # count alongside the padded chunk — pad rows must not enter
         # per-row statistics (quality observatory) even though they ride
@@ -99,6 +110,10 @@ class MicroBatcher:
         self._sem = asyncio.Semaphore(self.max_inflight)
         self._buckets: Dict[Tuple, Deque] = {}
         self._pumps: Dict[Tuple, asyncio.Task] = {}
+        # rolling wall of recent stacked flushes (any bucket): what a
+        # busy dispatch slot actually costs to wait out — the admission
+        # predictor's slot-wait term (one float store per flush)
+        self._flush_ewma_s = 0.0
         self._inflight: set = set()  # strong refs: bare create_task is GC-able
         self.recorder = RECORDER  # flight-recorder hub (occupancy/wait/slots)
 
@@ -111,14 +126,53 @@ class MicroBatcher:
             x = np.atleast_2d(x)
         key = (x.shape[1:], x.dtype)  # np.dtype hashes fine; str() is ~5us
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        # trace context captured at enqueue: the flush task records each
-        # caller's queue wait as a span parented under ITS request span
+        # trace context + deadline captured at enqueue: the flush task
+        # records each caller's queue wait as a span parented under ITS
+        # request span, and the autopilot's flush planner reads the
+        # waiting requests' tightest remaining deadline
         self._buckets.setdefault(key, deque()).append(
-            (x, fut, time.perf_counter(), current_trace_context())
+            (x, fut, time.perf_counter(), current_trace_context(),
+             current_deadline())
         )
         if key not in self._pumps:
             self._pumps[key] = asyncio.create_task(self._pump(key))
         return await fut
+
+    def predicted_latency_s(self, x) -> "float | None":
+        """Predicted submit-to-response latency for a request shaped like
+        ``x``, BEFORE it enqueues — the deadline-aware admission signal
+        (runtime/engine.py sheds when this exceeds the remaining budget).
+        Predicted dispatch wall for the pad bucket the request would land
+        in (rows already waiting included), plus one dispatch rotation
+        when every in-flight slot is busy, plus the coalesce window.
+        None when no model covers the bucket (admission then stays
+        reactive, exactly the pre-autopilot behaviour)."""
+        if self.predict_s_fn is None:
+            return None
+        x = np.asarray(x)
+        if x.ndim < 2:
+            x = np.atleast_2d(x)
+        key = (x.shape[1:], x.dtype)
+        waiting = sum(len(e[0]) for e in self._buckets.get(key, ()))
+        # FIFO: full flushes already queued ahead of us each cost one
+        # rotation; the remainder coalesces into OUR flush
+        flushes_ahead = waiting // self.max_batch
+        total = min(waiting - flushes_ahead * self.max_batch + len(x),
+                    self.max_batch)
+        padded = (
+            min(pad_bucket(total), self.max_batch)
+            if self.pad_to_buckets else total
+        )
+        disp = self.predict_s_fn(padded, x)
+        if disp is None or disp <= 0:
+            return None
+        # a rotation is whatever is ACTUALLY flushing lately (possibly a
+        # much bigger bucket than ours), not our own bucket's cost
+        rotation = self._flush_ewma_s or disp
+        wait = flushes_ahead * rotation
+        if len(self._inflight) >= self.max_inflight:
+            wait += rotation  # every slot busy: one more full rotation
+        return wait + disp + self.coalesce_s
 
     def snapshot(self) -> dict:
         """Point-in-time batcher state for ``/stats`` — queued rows per
@@ -161,22 +215,18 @@ class MicroBatcher:
                     else:
                         await asyncio.sleep(0)
                 bucket = self._buckets.get(key)
-                take, rows = [], 0
-                while bucket and rows < self.max_batch:
-                    # never let a COALESCED stack exceed max_batch (only a
-                    # single oversized request may, and then it is alone in
-                    # the batch, so multi-chunk dispatch stays per-request)
-                    if take and rows + len(bucket[0][0]) > self.max_batch:
-                        break
-                    entry = bucket.popleft()
-                    take.append(entry)
-                    rows += len(entry[0])
+                take, predicted_s = [], None
+                if bucket:
+                    n_take, predicted_s = self._plan_flush(bucket)
+                    take = [bucket.popleft() for _ in range(n_take)]
                 if bucket is not None and not bucket:
                     del self._buckets[key]
                 if not take:
                     self._sem.release()
                     continue
-                t = asyncio.get_running_loop().create_task(self._run_batch(take))
+                t = asyncio.get_running_loop().create_task(
+                    self._run_batch(take, predicted_s)
+                )
                 self._inflight.add(t)
                 self.recorder.set_inflight(len(self._inflight))
                 t.add_done_callback(self._inflight.discard)
@@ -189,12 +239,79 @@ class MicroBatcher:
             # check, so a concurrent submit can't be orphaned
             self._pumps.pop(key, None)
 
-    async def _run_batch(self, bucket) -> None:
+    def _take_count(self, bucket) -> int:
+        """The legacy greedy take: as many whole requests as fit under
+        max_batch (only a single oversized request may exceed it, and
+        then it is alone in the batch, so multi-chunk dispatch stays
+        per-request)."""
+        k, rows = 0, 0
+        for entry in bucket:
+            if k and rows + len(entry[0]) > self.max_batch:
+                break
+            k += 1
+            rows += len(entry[0])
+            if rows >= self.max_batch:
+                break
+        return k
+
+    def _plan_flush(self, bucket):
+        """How many waiting requests this flush should take, and the
+        predicted dispatch wall of that choice (None = unplanned/legacy).
+
+        With a latency model attached, candidate flushes are the
+        prefixes of the queue that exactly land on distinct pad buckets
+        (FIFO: a flush can't skip the head), scored by predicted goodput
+        — real rows per predicted second, so pad waste prices itself —
+        among candidates whose predicted wall fits the included
+        requests' tightest remaining deadline (when none fit, goodput
+        alone decides and admission control owns the miss).  A prefix
+        shorter than the queue leaves the tail for the next dispatch
+        slot, which the pump loop takes immediately.  Kill switch /
+        unpadded buckets / missing model: the legacy take, bit-for-bit."""
+        k_max = self._take_count(bucket)
+        if (
+            self.predict_s_fn is None
+            or not self.pad_to_buckets
+            or k_max <= 1
+            or not autopilot_enabled()
+        ):
+            return k_max, None
+        from itertools import islice
+
+        sample = bucket[0][0]
+        rows = 0
+        tightest = None
+        preds = {}  # padded size -> predicted seconds (one model read each)
+        scored = []  # every prefix: (k, rows, predicted, tightest remaining)
+        # islice, not bucket[k-1]: deque indexing is O(n), which would
+        # make candidate enumeration quadratic in the queue length.
+        # EVERY prefix is scored — two prefixes sharing a pad bucket
+        # differ in their tightest deadline, and the shorter one may be
+        # the only feasible flush at that bucket's predicted wall
+        for k, entry in enumerate(islice(bucket, k_max), 1):
+            rows += len(entry[0])
+            dl = entry[4]
+            if dl is not None:
+                rem = dl.remaining_s()
+                tightest = rem if tightest is None else min(tightest, rem)
+            padded = min(pad_bucket(rows), self.max_batch)
+            t = preds.get(padded)
+            if t is None:
+                t = self.predict_s_fn(padded, sample)
+                if t is None or t <= 0:
+                    return k_max, None  # unmodelled bucket: legacy flush
+                preds[padded] = t
+            scored.append((k, rows, t, tightest))
+        fits = [s for s in scored if s[3] is None or s[2] <= s[3]]
+        k, _r, t, _dl = max(fits or scored, key=lambda s: (s[1] / s[2], s[0]))
+        return k, t
+
+    async def _run_batch(self, bucket, predicted_s=None) -> None:
         xs = [e[0] for e in bucket]
         futs = [e[1] for e in bucket]
         now = time.perf_counter()
         now_epoch = time.time()
-        for x, _, t_enq, ctx in bucket:
+        for x, _, t_enq, ctx, _dl in bucket:
             # ONE fused ring record per caller: the queue-wait reservoir
             # observation AND the per-caller queue span (parented under
             # the caller's request span — the "queue" phase of the
@@ -216,9 +333,15 @@ class MicroBatcher:
                 # span.  In a finally so FAILED dispatches still count —
                 # occupancy must not diverge from real traffic exactly
                 # during the incidents operators read it for
+                flush_s = time.perf_counter() - t_flush
+                self._flush_ewma_s = (
+                    flush_s if self._flush_ewma_s == 0.0
+                    else 0.7 * self._flush_ewma_s + 0.3 * flush_s
+                )
                 SPINE.record_flush(
                     rows=total, requests=len(bucket), start_s=now_epoch,
-                    duration_s=time.perf_counter() - t_flush,
+                    duration_s=flush_s,
+                    predicted_s=predicted_s,
                 )
             ys = np.asarray(ys)[:total]
             # one walk decides whether aux carries per-row arrays at all;

@@ -43,10 +43,17 @@ from seldon_core_tpu.messages import (
     DeadlineExceededError,
     DispatchTimeoutError,
     Feedback,
+    LoadShedError,
     Meta,
     SeldonMessage,
     SeldonMessageError,
     new_puid,
+)
+from seldon_core_tpu.runtime.autopilot import (
+    AUTOPILOT,
+    SHED_INFO_PREFIX,
+    autopilot_enabled,
+    shed_margin,
 )
 from seldon_core_tpu.runtime.resilience import (
     CircuitBreaker,
@@ -262,6 +269,11 @@ class EngineService:
                 dispatch_timeout_s=self.dispatch_timeout_s * 1.5,
                 # stateful graphs must apply state atomically per request
                 atomic_chunks=not pad_ok,
+                # learned cost-model autopilot: predictive flush sizing
+                # reads per-pad-bucket latency predictions through this
+                # hook (kill switch checked inside the batcher, so
+                # SELDON_TPU_AUTOPILOT=0 keeps flush-all bit-for-bit)
+                predict_s_fn=self._predict_dispatch_s,
             )
         if self.batcher is not None:
             # batchable graphs have no routers, so the executed path — and
@@ -397,6 +409,8 @@ class EngineService:
             # MAB router state read back out of the pytree (per-branch
             # success/tries — utils/quality.py router_quality)
             "routers": router_quality(self.states()),
+            # learned cost-model health (full table on GET /autopilot)
+            "autopilot": AUTOPILOT.snapshot(),
             "audit": self.audit.snapshot(),
             "staleness_s": round(staleness, 3),
         }
@@ -426,6 +440,21 @@ class EngineService:
                 "mode": self.mode,
             },
             **OBSERVATORY.document(),
+        }
+
+    def autopilot_document(self) -> dict:
+        """The ``GET /autopilot`` body: the process-global learned
+        cost-model (per-executable/pad-bucket latency table, knobs,
+        misprediction distribution, shed/decision counters —
+        runtime/autopilot.py) under this engine's identity."""
+        SPINE.drain()  # pending dispatch records train the model first
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            **AUTOPILOT.document(),
         }
 
     def quality_document(self) -> dict:
@@ -651,6 +680,20 @@ class EngineService:
                 compiled += 1
         return compiled
 
+    def _predict_dispatch_s(self, padded_rows, x):
+        """Autopilot prediction hook: the dispatch wall the learned model
+        expects for this graph at one pad bucket of x's feature shape —
+        the SAME executable identity the perf observatory keys on, so
+        seed priors and measured corrections land on one table row."""
+        from seldon_core_tpu.utils.perf import executable_key
+
+        key = executable_key(
+            "predict",
+            (int(padded_rows),) + tuple(np.shape(x)[1:]),
+            getattr(x, "dtype", np.float64),
+        )
+        return AUTOPILOT.predict_s(key)
+
     async def _submit(self, rows):
         """Batched dispatch under the engine deadline — the reference's
         per-call budget (5 s gRPC deadlines,
@@ -659,7 +702,13 @@ class EngineService:
         that never returns.  A request-level deadline budget
         (Seldon-Deadline-Ms / gRPC deadline, runtime/resilience.py) clamps
         the wait further: the device hop draws from the same budget as
-        every other hop."""
+        every other hop.
+
+        Deadline-aware admission (runtime/autopilot.py): when the learned
+        cost model predicts queue + dispatch latency beyond the remaining
+        budget, shed with a typed 503 BEFORE the request burns a dispatch
+        slot or device time — the answer could never arrive in time, and
+        the 503 is retryable so another replica can still serve it."""
         timeout = self.dispatch_timeout_s
         rem = remaining_s()
         if rem is not None:
@@ -668,6 +717,23 @@ class EngineService:
                 raise DeadlineExceededError(
                     "request deadline exhausted before device dispatch"
                 )
+            if autopilot_enabled():
+                predictor = getattr(
+                    self.batcher, "predicted_latency_s", None
+                )
+                est = predictor(rows) if predictor is not None else None
+                if est is not None and est > rem * shed_margin():
+                    RECORDER.record_autopilot_shed("admission")
+                    self.tracer.event(
+                        "autopilot_shed",
+                        predicted_ms=round(est * 1e3, 3),
+                        remaining_ms=round(rem * 1e3, 3),
+                    )
+                    raise LoadShedError(
+                        f"{SHED_INFO_PREFIX}: predicted queue+dispatch "
+                        f"{est * 1e3:.1f} ms exceeds the remaining "
+                        f"deadline budget ({rem * 1e3:.1f} ms)"
+                    )
             timeout = min(timeout, rem)
         try:
             return await asyncio.wait_for(self.batcher.submit(rows), timeout)

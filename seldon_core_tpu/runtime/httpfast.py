@@ -24,6 +24,8 @@ Semantics match ``rest.py:make_engine_app`` route for route:
                                (utils/quality.py)
   GET  /overhead               telemetry overhead budget
                                (utils/hotrecord.py)
+  GET  /autopilot              learned cost-model table
+                               (runtime/autopilot.py)
   GET  /trace /trace/export
 
 ``GET /prometheus?format=openmetrics`` serves the OpenMetrics exposition
@@ -134,6 +136,7 @@ class _EngineRoutes:
             b"/perf": self._perf,
             b"/quality": self._quality,
             b"/overhead": self._overhead,
+            b"/autopilot": self._autopilot,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
@@ -243,6 +246,15 @@ class _EngineRoutes:
         return (
             200,
             _json.dumps(self.engine.overhead_document()).encode(),
+            _JSON,
+        )
+
+    async def _autopilot(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return (
+            200,
+            _json.dumps(self.engine.autopilot_document()).encode(),
             _JSON,
         )
 

@@ -206,6 +206,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # docs/operations.md "telemetry overhead budget" runbook)
         return web.json_response(engine.overhead_document())
 
+    async def autopilot(_):
+        # learned cost-model autopilot: per-executable/pad-bucket latency
+        # model table, knobs, misprediction distribution, shed counters
+        # (runtime/autopilot.py; docs/operations.md runbook)
+        return web.json_response(engine.autopilot_document())
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
@@ -290,6 +296,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/perf", perf)
     app.router.add_get("/quality", quality)
     app.router.add_get("/overhead", overhead)
+    app.router.add_get("/autopilot", autopilot)
     app.router.add_post("/quality/reference", _quality_reference)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)
@@ -436,11 +443,25 @@ def make_unit_app(runtime: InProcessNodeRuntime) -> web.Application:
             **SPINE.overhead_document(),
         })
 
+    async def autopilot(_):
+        # whatever this unit process dispatched trains the process-global
+        # cost model; its table is inspectable on unit pods too
+        from seldon_core_tpu.runtime.autopilot import AUTOPILOT
+        from seldon_core_tpu.utils.hotrecord import SPINE
+
+        SPINE.drain()
+        return web.json_response({
+            "unit": {"name": runtime.node.name,
+                     "type": getattr(runtime.node.type, "name", None)},
+            **AUTOPILOT.document(),
+        })
+
     app.router.add_get("/ping", ping)
     app.router.add_get("/stats", stats)
     app.router.add_get("/perf", perf)
     app.router.add_get("/quality", quality)
     app.router.add_get("/overhead", overhead)
+    app.router.add_get("/autopilot", autopilot)
     app.router.add_post("/quality/reference", _quality_reference)
     return app
 

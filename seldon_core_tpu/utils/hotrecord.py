@@ -122,6 +122,7 @@ class HotRecord:
         "compile_cache",  # "hit" | "miss" | None
         "queue_wait_s",
         "requests",       # callers coalesced into a flush
+        "predicted_s",    # autopilot-predicted wall of a planned flush
         "quality_node", "batch_x", "batch_y",
         "error",          # exception type name of a FAILED dispatch
         "span",           # prebuilt Span (HOP_SPAN only)
@@ -149,6 +150,7 @@ class HotRecord:
         self.compile_cache = None
         self.queue_wait_s = 0.0
         self.requests = 0
+        self.predicted_s = None
         self.quality_node = ""
         self.batch_x = None
         self.batch_y = None
@@ -399,9 +401,12 @@ class TelemetrySpine:
         return self._append(rec)
 
     def record_flush(self, rows: int, requests: int, start_s: float,
-                     duration_s: float) -> bool:
+                     duration_s: float,
+                     predicted_s: Optional[float] = None) -> bool:
         """One record per stacked flush: batch occupancy + the
-        standalone flush span (multi-request, so it has no parent)."""
+        standalone flush span (multi-request, so it has no parent).
+        ``predicted_s`` carries the autopilot's planned-flush prediction
+        so the decision rides the existing write — never a new one."""
         want_trace = TRACER.enabled and (
             TRACER.sample >= 1.0 or self._rng.random() < TRACER.sample
         )
@@ -415,6 +420,7 @@ class TelemetrySpine:
         rec.requests = int(requests)
         rec.start_s = start_s
         rec.duration_s = float(duration_s)
+        rec.predicted_s = predicted_s
         return self._append(rec)
 
     def record_dispatch(
@@ -645,13 +651,22 @@ class TelemetrySpine:
             if rec.flags & WANT_RECORDER:
                 t0 = pc()
                 RECORDER.observe_batch(rec.rows)
+                if rec.predicted_s is not None:
+                    # an autopilot-planned flush: the decision counter
+                    # rides the fold, never the flush path itself
+                    RECORDER.record_autopilot_decision("flush")
                 self.fold_cost["recorder"].observe(pc() - t0)
             if rec.flags & WANT_TRACE:
                 t0 = pc()
+                attrs = {"rows": rec.rows, "requests": rec.requests}
+                if rec.predicted_s is not None:
+                    attrs["autopilot_predicted_ms"] = round(
+                        rec.predicted_s * 1e3, 3
+                    )
                 TRACER._fold(Span(
                     puid="", name="flush", kind="batch", method="dispatch",
                     start_s=rec.start_s, duration_ms=rec.duration_s * 1e3,
-                    attrs={"rows": rec.rows, "requests": rec.requests},
+                    attrs=attrs,
                     span_id=new_span_id(),
                 ))
                 self.fold_cost["tracer"].observe(pc() - t0)
@@ -700,6 +715,15 @@ class TelemetrySpine:
                 for k in ("flops", "mfu", "bound"):
                     if k in derived:
                         attrs[k] = derived[k]
+                # the autopilot learns from the SAME fused record
+                # (runtime/autopilot.py — no hot-path write of its own);
+                # the prediction in force before this measurement lands
+                # on the dispatch span so mispredictions read off traces
+                from seldon_core_tpu.runtime.autopilot import AUTOPILOT
+
+                pred = AUTOPILOT.observe(rec.executable, rec.duration_s)
+                if pred is not None:
+                    attrs["autopilot_predicted_ms"] = round(pred * 1e3, 3)
                 self.fold_cost["perf"].observe(pc() - t0)
             if rec.flags & WANT_QUALITY:
                 t0 = pc()
@@ -756,6 +780,14 @@ class TelemetrySpine:
         RECORDER.set_framework_overhead("budget", self.budget_ms)
         for hop, n in self.records_total.items():
             RECORDER.set_telemetry_records(hop, n)
+        # autopilot model health shares the throttled refresh: one gauge
+        # pass per second, never per observation
+        try:
+            from seldon_core_tpu.runtime.autopilot import AUTOPILOT
+
+            AUTOPILOT.publish_gauges()
+        except Exception:  # noqa: BLE001 - gauges must not wedge a drain
+            pass
 
     # -- the /overhead surface ---------------------------------------------
 

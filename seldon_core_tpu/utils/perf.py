@@ -118,7 +118,7 @@ class _ExecutableStats:
 
     __slots__ = (
         "key", "cost", "compile_s", "calls", "rows_total", "latency",
-        "ratio", "last", "anomalies",
+        "ratio", "calibration", "last", "anomalies",
     )
 
     def __init__(self, key: str):
@@ -130,6 +130,9 @@ class _ExecutableStats:
         self.latency = Reservoir(512)
         #: rolling measured/predicted ratios — the drift baseline
         self.ratio = Reservoir(512)
+        #: rolling measured / (overhead-adjusted roofline) ratios — the
+        #: per-pad-bucket calibration the autopilot's seed prior uses
+        self.calibration = Reservoir(256)
         #: most recent derived figures (mfu, tflops, gbs, bound, ratio)
         self.last: Dict[str, Any] = {}
         self.anomalies = 0
@@ -320,9 +323,21 @@ class PerfObservatory:
             if predicted_s > 0:
                 slowdown = seconds / predicted_s
                 derived["predicted_s"] = predicted_s
-                # the ratio reads in name order: predicted over measured,
-                # 1.0 = running exactly as fast as the roofline allows
-                derived["predicted_vs_measured"] = predicted_s / seconds
+                # the WALL-time prior is the overhead-adjusted roofline:
+                # raw roofline prices device work only, and overhead_x is
+                # already the configured device-vs-wall factor (the same
+                # one the overhead-bound classification below uses).
+                # Using it on BOTH sides keeps this ratio, the per-bucket
+                # calibration, and the autopilot's seed prior
+                # (seed_predicted_s) in agreement — before this fix the
+                # /perf page showed raw-roofline ratios while the
+                # overhead classification judged the adjusted time
+                adjusted_s = predicted_s * self.overhead_x
+                derived["adjusted_predicted_s"] = adjusted_s
+                # reads in name order: predicted over measured, 1.0 =
+                # wall time exactly at the overhead-adjusted roofline
+                derived["predicted_vs_measured"] = adjusted_s / seconds
+                ent.calibration.observe(seconds / adjusted_s)
                 ent.ratio.observe(slowdown)
                 if slowdown > self.overhead_x:
                     derived["bound"] = "overhead"
@@ -362,6 +377,48 @@ class PerfObservatory:
         with self._lock:
             ent.last = dict(derived)
         return derived
+
+    def seed_predicted_s(self, key: str) -> Optional[float]:
+        """The autopilot's seed prior for one executable/pad bucket:
+        overhead-adjusted roofline time (``cost_analysis()`` features x
+        ``SELDON_TPU_PERF_OVERHEAD_X`` — the same adjusted time
+        ``predicted_vs_measured`` reports) scaled by the measured
+        calibration ratio — this key's own rolling median when it has
+        dispatched, else the median across every calibrated executable
+        (so a never-dispatched pad bucket inherits the box's measured
+        wall-vs-roofline behaviour).  None when the key has no cost
+        features (the autopilot then waits for measurements)."""
+        if not self.enabled:
+            return None
+        ent = self._execs.get(key)
+        if ent is None or ent.key == self.OVERFLOW_KEY or not ent.cost:
+            return None
+        cost = ent.cost
+        peaks = self.peaks()
+        t_compute = cost.get("flops", 0.0) / (
+            peaks["peak_bf16_tflops"] * 1e12
+        )
+        t_memory = cost.get("bytes_accessed", 0.0) / (
+            peaks["peak_hbm_gbs"] * 1e9
+        )
+        roofline = max(t_compute, t_memory)
+        if roofline <= 0:
+            return None
+        adjusted = roofline * self.overhead_x
+        cal = ent.calibration.snapshot()
+        if cal["count"]:
+            return adjusted * cal["p50"]
+        # cross-bucket transfer: the median of every calibrated key's
+        # median — one slow shape cannot skew it the way a mean would
+        with self._lock:
+            entries = list(self._execs.values())
+        medians = sorted(
+            c["p50"] for c in (e.calibration.snapshot() for e in entries)
+            if c["count"]
+        )
+        if medians:
+            return adjusted * medians[len(medians) // 2]
+        return adjusted
 
     def note_padding(self, real_rows: int, padded_rows: int) -> None:
         """Micro-batcher padding accounting: pad rows burn FLOPs without
@@ -448,6 +505,13 @@ class PerfObservatory:
                 row["arithmetic_intensity"] = round(
                     cost["flops"] / cost["bytes_accessed"], 3
                 )
+        cal = ent.calibration.snapshot()
+        if cal["count"]:
+            # measured wall / overhead-adjusted roofline, rolling median
+            # per pad bucket — 1.0 = the adjusted prior prices this
+            # bucket exactly; the autopilot seed (seed_predicted_s) and
+            # this figure agree by construction
+            row["calibration_ratio"] = float("%.4g" % cal["p50"])
         last = ent.last
         if last:
             for k in ("mfu", "achieved_tflops", "achieved_gbs",

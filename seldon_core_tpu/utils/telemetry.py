@@ -151,6 +151,17 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_shadow_latency_seconds": ("histogram", ()),
     "seldon_tpu_rollbacks_total": ("counter", ("reason",)),
     "seldon_tpu_rollout_stage": ("gauge", ("deployment",)),
+    # learned cost-model autopilot (runtime/autopilot.py): predictive
+    # decisions taken (site = flush pad-bucket choice / p2c shape
+    # blending / router branch demotion), deadline-aware admission sheds
+    # (requests refused with a typed 503 BEFORE burning device time),
+    # the rolling |measured-predicted|/predicted p50 that audits the
+    # model (the SeldonTPUAutopilotMispredict alert's axis), and the
+    # model-table size
+    "seldon_tpu_autopilot_decisions_total": ("counter", ("site",)),
+    "seldon_tpu_autopilot_shed_total": ("counter", ("where",)),
+    "seldon_tpu_autopilot_mispredict_pct": ("gauge", ()),
+    "seldon_tpu_autopilot_keys": ("gauge", ()),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -307,6 +318,14 @@ class FlightRecorder:
         self.shadow_latency = Reservoir()
         self.rollbacks: Dict[str, int] = {}            # reason -> n
         self.rollout_stage: Dict[str, float] = {}      # deployment -> pct
+        # learned cost-model autopilot mirrors (runtime/autopilot.py
+        # feeds these: decision counters from the spine folds, shed
+        # counters from the admission gate, model gauges from the
+        # throttled gauge refresh)
+        self.autopilot_decisions: Dict[str, int] = {}  # site -> n
+        self.autopilot_sheds: Dict[str, int] = {}      # where -> n
+        self.autopilot_mispredict_p50_pct: Optional[float] = None
+        self.autopilot_keys = 0
         # Prometheus high-water mark per hop: the counter is advanced by
         # deltas against THIS, not the snapshot mirror above — reset()
         # clears the mirror but must not rewind the monotone counter's
@@ -580,6 +599,30 @@ class FlightRecorder:
                 "deployment (0 before stage 1 and after a rollback; "
                 "100 = fully promoted)",
                 ["deployment"], registry=self.registry)
+            self._p_autopilot_decisions = Counter(
+                "seldon_tpu_autopilot_decisions_total",
+                "Predictive decisions taken by the learned cost-model "
+                "autopilot, by site (flush = goodput-optimal pad-bucket "
+                "choice, p2c = shape-aware replica score, route = "
+                "deadline-driven branch demotion — runtime/autopilot.py)",
+                ["site"], registry=self.registry)
+            self._p_autopilot_shed = Counter(
+                "seldon_tpu_autopilot_shed_total",
+                "Requests shed with a typed 503 because predicted "
+                "queue+dispatch latency exceeded the remaining deadline "
+                "budget — refused BEFORE burning device time",
+                ["where"], registry=self.registry)
+            self._p_autopilot_mispredict = Gauge(
+                "seldon_tpu_autopilot_mispredict_pct",
+                "Rolling p50 of |measured - predicted| / predicted "
+                "dispatch wall, percent — the autopilot's honesty figure "
+                "(SeldonTPUAutopilotMispredict alerts on it)",
+                registry=self.registry)
+            self._p_autopilot_keys = Gauge(
+                "seldon_tpu_autopilot_keys",
+                "Per-executable/pad-bucket latency models in the "
+                "autopilot table (GET /autopilot lists them)",
+                registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -748,6 +791,44 @@ class FlightRecorder:
             self.rollout_stage[deployment] = float(percent)
         if self.registry is not None:
             self._p_rollout_stage.labels(deployment=deployment).set(percent)
+
+    # -- learned cost-model autopilot (runtime/autopilot.py) -------------
+
+    def record_autopilot_decision(self, site: str, n: int = 1) -> None:
+        """One predictive decision taken (flush / p2c / route) — bumped
+        off-path (spine folds) or at low-rate decision sites, never per
+        hot-path dispatch."""
+        with self._lock:
+            self.autopilot_decisions[site] = (
+                self.autopilot_decisions.get(site, 0) + n)
+        if self.registry is not None:
+            self._p_autopilot_decisions.labels(site=site).inc(n)
+
+    def record_autopilot_shed(self, where: str) -> None:
+        self._gen += 1
+        with self._lock:
+            self.autopilot_sheds[where] = (
+                self.autopilot_sheds.get(where, 0) + 1)
+        if self.registry is not None:
+            self._p_autopilot_shed.labels(where=where).inc()
+
+    def autopilot_counters(self) -> "tuple[Dict[str, int], Dict[str, int]]":
+        """(sheds, decisions) copied under the lock — the /autopilot
+        page reads these concurrently with request threads writing."""
+        with self._lock:
+            return dict(self.autopilot_sheds), dict(self.autopilot_decisions)
+
+    def set_autopilot_model(self, mispredict_p50_pct: Optional[float],
+                            keys: int) -> None:
+        """Model-health gauges, refreshed from the spine's throttled
+        gauge pass (utils/hotrecord.py), not per observation."""
+        with self._lock:
+            self.autopilot_mispredict_p50_pct = mispredict_p50_pct
+            self.autopilot_keys = int(keys)
+        if self.registry is not None:
+            if mispredict_p50_pct is not None:
+                self._p_autopilot_mispredict.set(mispredict_p50_pct)
+            self._p_autopilot_keys.set(keys)
 
     # -- compile cache / audit accounting -------------------------------
 
@@ -1068,6 +1149,12 @@ class FlightRecorder:
                 "rollbacks": dict(self.rollbacks),
                 "rollout_stage": dict(self.rollout_stage),
             }
+            autopilot = {
+                "decisions": dict(self.autopilot_decisions),
+                "sheds": dict(self.autopilot_sheds),
+                "mispredict_p50_pct": self.autopilot_mispredict_p50_pct,
+                "keys": self.autopilot_keys,
+            }
             quality = {
                 "drift": dict(self.drift_scores),
                 "slo_burn": dict(self.slo_burn),
@@ -1090,6 +1177,7 @@ class FlightRecorder:
             "quality": quality,
             "replicas": replicas,
             "traffic_lifecycle": lifecycle,
+            "autopilot": autopilot,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -1202,6 +1290,10 @@ class FlightRecorder:
             self.shadow_latency = Reservoir()
             self.rollbacks = {}
             self.rollout_stage = {}
+            self.autopilot_decisions = {}
+            self.autopilot_sheds = {}
+            self.autopilot_mispredict_p50_pct = None
+            self.autopilot_keys = 0
 
 
 RECORDER = FlightRecorder()

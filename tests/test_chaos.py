@@ -282,9 +282,11 @@ def test_gateway_deadline_respected_end_to_end():
     from aiohttp import web
 
     async def run():
-        # a unit server that hangs far beyond any sane budget
+        # a unit server that hangs far beyond any sane budget (but NOT
+        # 30 s: AppRunner.cleanup waits this handler out at teardown, so
+        # its length is pure tier-1 wall time)
         async def hang(request):
-            await asyncio.sleep(30)
+            await asyncio.sleep(6)
 
         uapp = web.Application()
         uapp.router.add_post("/predict", hang)
@@ -333,8 +335,10 @@ def test_deadline_set_at_gateway_respected_through_full_chain():
     from seldon_core_tpu.gateway.apife import make_gateway_app
 
     async def run():
+        # hung far beyond any sane budget, short enough that teardown
+        # (which waits the handler out) stays cheap
         async def hang(request):
-            await asyncio.sleep(30)
+            await asyncio.sleep(6)
 
         uapp = web.Application()
         uapp.router.add_post("/predict", hang)

@@ -98,6 +98,7 @@ def test_flash_vjp_under_jit_and_value_and_grad():
     assert all(g.shape == q.shape for g in grads)
 
 
+@pytest.mark.slow  # heavyweight equivalence check: full-suite/CI-shard coverage; excluded from the tier-1 time budget
 def test_lm_train_step_with_flash_matches_xla_attention():
     """lm_train_step(use_flash=True) (flash VJP, interpret-mode pallas via
     monkeypatched interpret default is not available here, so call the loss

@@ -63,6 +63,7 @@ def test_init_cache_exact_length():
     assert c["l0"]["k"].shape[2] == 130
 
 
+@pytest.mark.slow  # heavyweight equivalence check: full-suite/CI-shard coverage; excluded from the tier-1 time budget
 def test_generate_unchanged_with_rounded_cache():
     """Greedy generate must be bit-identical whether the cache is exactly
     sized or rounded up (the extra slots are masked)."""

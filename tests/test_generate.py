@@ -7,6 +7,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.models.generate import TransformerGenerator, generate
@@ -29,6 +30,7 @@ def _naive_greedy(params, prompt, max_new):
     return jnp.stack(out, axis=1)
 
 
+@pytest.mark.slow  # heavyweight equivalence check: full-suite/CI-shard coverage; excluded from the tier-1 time budget
 def test_cached_generation_matches_naive():
     params = lm_init(jax.random.key(0), CFG)
     prompt = jnp.asarray(

@@ -139,5 +139,10 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_replica_picks_total",
         "seldon_tpu_replica_mispicks_total",
         "seldon_tpu_relay_lane_requests_total",
+        # learned cost-model autopilot (runtime/autopilot.py)
+        "seldon_tpu_autopilot_decisions_total",
+        "seldon_tpu_autopilot_shed_total",
+        "seldon_tpu_autopilot_mispredict_pct",
+        "seldon_tpu_autopilot_keys",
     ):
         assert family in text, f"{family} missing from every dashboard"

@@ -99,6 +99,7 @@ def test_ring_attention_matches_dense(devices8):
         np.testing.assert_allclose(got, expected, atol=2e-5, err_msg=f"causal={causal}")
 
 
+@pytest.mark.slow  # heavyweight equivalence check: full-suite/CI-shard coverage; excluded from the tier-1 time budget
 def test_lm_train_step_sharded_dp_tp_sp(devices8):
     """Full training step jitted over a dp=2 x tp=2 x sp=2 mesh: params
     tp-sharded, batch dp-sharded, sequence sp-sharded (ring attention)."""

@@ -114,15 +114,26 @@ def test_mfu_math_against_hand_computed_flops():
     assert d["arithmetic_intensity"] == pytest.approx(flops / nbytes)
     predicted = max(flops / peak_flops_s, nbytes / peak_bytes_s)
     assert d["predicted_s"] == pytest.approx(predicted)
-    # reads in name order: predicted over measured, 1.0 = at the roofline
-    assert d["predicted_vs_measured"] == pytest.approx(predicted / seconds)
+    # reads in name order: predicted over measured, 1.0 = wall time at
+    # the OVERHEAD-ADJUSTED roofline — the same adjusted time the
+    # overhead-bound classification judges and the autopilot seeds from
+    adjusted = predicted * obs.overhead_x
+    assert d["adjusted_predicted_s"] == pytest.approx(adjusted)
+    assert d["predicted_vs_measured"] == pytest.approx(adjusted / seconds)
     # 20 ms of wall for sub-microsecond predicted device work: overhead
     assert d["bound"] == "overhead"
-    # the per-executable /perf row reports the same figures
+    # the per-executable /perf row reports the same figures, plus the
+    # per-pad-bucket calibration ratio (measured / adjusted roofline)
     row = obs.document()["executables"][0]
     assert row["executable"] == key
     assert row["mfu"] == pytest.approx(d["mfu"], abs=1e-6)
     assert row["compile_s"] == pytest.approx(0.25)
+    assert row["calibration_ratio"] == pytest.approx(
+        seconds / adjusted, rel=1e-3
+    )
+    # the autopilot seed prior agrees with the page: adjusted roofline
+    # scaled by the key's own measured calibration = measured wall
+    assert obs.seed_predicted_s(key) == pytest.approx(seconds, rel=1e-3)
 
 
 def test_extract_cost_features_tolerates_odd_shapes():

@@ -90,6 +90,7 @@ def test_pipelined_lm_forward_matches_dense(devices8):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # heavyweight equivalence check: full-suite/CI-shard coverage; excluded from the tier-1 time budget
 def test_pipelined_train_step_matches_dense_loss(devices8):
     mesh = build_mesh({"dp": 2, "pp": 2}, devices=devices8[:4])
     rng = np.random.default_rng(2)

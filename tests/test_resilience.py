@@ -438,7 +438,10 @@ def test_rest_client_attempts_share_one_deadline_budget():
     from seldon_core_tpu.runtime.client import RemoteCallError
 
     async def hang(request):
-        await asyncio.sleep(30)
+        # hangs far beyond any sane budget (the asserts bound elapsed
+        # at ~3 s) but NOT 30 s: AppRunner.cleanup waits this handler
+        # out at teardown, so its length is pure tier-1 wall time
+        await asyncio.sleep(6)
 
     async def run():
         app = web.Application()

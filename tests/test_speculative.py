@@ -46,6 +46,7 @@ def test_speculative_self_draft_max_acceptance():
     assert int(rounds[0]) <= 5, int(rounds[0])
 
 
+@pytest.mark.slow  # heavyweight equivalence check: full-suite/CI-shard coverage; excluded from the tier-1 time budget
 def test_speculative_batched_matches_single_rows():
     """The defining batched invariant: every row of a vmapped batch equals
     its own B=1 decode exactly (f32), with per-row round counts."""
