@@ -63,6 +63,16 @@ autopilot-demo:
 canary-demo:
 	python scripts/canary_demo.py --out canary_demo
 
+# overload-survival demo: a 10x-share hog tenant vs a well-behaved
+# victim over a fixed-capacity engine — fair admission holds the
+# victim's p99, the brownout ladder engages and reverts in order, and
+# the kill-switch arm (SELDON_TPU_BROWNOUT=0 + SELDON_TPU_TENANCY=0)
+# shows the starvation this layer prevents.  Artifact
+# overload_demo/overload.json (scripts/overload_demo.py;
+# docs/operations.md "Surviving overload")
+overload-demo:
+	JAX_PLATFORMS=cpu python scripts/overload_demo.py --out overload_demo
+
 bench:
 	python bench.py
 
@@ -88,6 +98,14 @@ overhead-gate:
 # — turns this lane red.  CPU-friendly (docs/operations.md runbook).
 ttft-gate:
 	JAX_PLATFORMS=cpu python bench.py --ttft-gate --smoke
+
+# multi-tenant fairness gate: a victim tenant's p99 under a 10x-share
+# hog must stay within SELDON_TPU_FAIRNESS_BOUND (default 1.5) x its
+# solo baseline with zero victim failures — the runtime/qos.py token
+# bucket + weighted-fair-queue admission contract, best-of-3.
+# CPU-friendly (docs/operations.md "Surviving overload" runbook).
+fairness-gate:
+	JAX_PLATFORMS=cpu python bench.py --fairness-gate
 
 # regenerate every artifact-quoted doc figure from the committed round
 # snapshot / fail when the docs drift from it (CI runs docs-check)
@@ -130,4 +148,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo bench overhead-gate ttft-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo bench overhead-gate ttft-gate fairness-gate demos train-demo stack bundle images publish release-dryrun

@@ -57,6 +57,16 @@ from seldon_core_tpu.gateway.shadow import (
 )
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 from seldon_core_tpu.messages import Feedback, SeldonMessage, SeldonMessageError
+from seldon_core_tpu.runtime.brownout import BROWNOUT, BROWNOUT_INFO_PREFIX
+from seldon_core_tpu.runtime.qos import (
+    THROTTLE_INFO_PREFIX,
+    TenantGovernor,
+    current_tenant,
+    current_tier,
+    qos_scope,
+    resolve_tenant,
+    tenancy_enabled,
+)
 from seldon_core_tpu.runtime.udsrelay import OP_FEEDBACK, OP_PREDICT
 from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
 # importing the spine at module load wires the global TRACER's ring sink
@@ -80,6 +90,14 @@ TOKEN_TTL_S = 3600.0
 
 class AuthError(Exception):
     pass
+
+
+def _release_brownout_sink(sink) -> None:
+    """Detach a gateway's firehose event sink from the global brownout
+    controller — only if it is still the installed one (a later gateway
+    may have taken over already)."""
+    if sink is not None and BROWNOUT.event_sink is sink:
+        BROWNOUT.event_sink = None
 
 
 @dataclass
@@ -263,6 +281,39 @@ class ApiGateway:
         #: optional RolloutController (operator/rollouts.py) — attach to
         #: serve its status on GET /rollouts
         self.rollouts = None
+        # multi-tenant fair admission (runtime/qos.py): per-tenant token
+        # buckets + weighted fair queueing over dispatch slots, LRU-
+        # bounded accounting.  Inert with default knobs (no rate limit,
+        # fair queue off) — today's behaviour bit-for-bit
+        self.tenants = TenantGovernor()
+        # the fair queue's backlog is an overload signal for the
+        # brownout ladder; the firehose carries its typed transitions
+        self._brownout_key = f"gateway:{id(self)}"
+        # late-bound through a weakref: a swapped-in governor (tests,
+        # demos) keeps feeding the signal, and the registry never pins
+        # a gateway that was dropped without close()
+        import weakref
+
+        _ref = weakref.ref(self)
+        BROWNOUT.register_depth(
+            self._brownout_key,
+            lambda: (lambda g: 0 if g is None
+                     else g.tenants.queue_depth())(_ref()),
+        )
+        weakref.finalize(self, BROWNOUT.unregister_depth,
+                         self._brownout_key)
+        self._brownout_sink = None
+        if firehose is not None and BROWNOUT.event_sink is None:
+            self._brownout_sink = (
+                lambda kind, **fields: firehose.publish_event(
+                    "_gateway", kind, **fields)
+            )
+            BROWNOUT.event_sink = self._brownout_sink
+            # released on close() (and by finalize if close is skipped)
+            # so a later gateway's firehose can take over instead of
+            # transitions publishing to a closed queue forever
+            weakref.finalize(self, _release_brownout_sink,
+                             self._brownout_sink)
 
     # -- principal resolution ----------------------------------------------
 
@@ -382,14 +433,18 @@ class ApiGateway:
 
     @staticmethod
     def _is_autopilot_shed(resp: SeldonMessage) -> bool:
+        """A predictive/policy shed — autopilot admission OR a brownout
+        tier shed.  Both are the engine DECIDING, not dying: they count
+        as load for routing but feed neither fail-degradation nor the
+        latency EWMA."""
         from seldon_core_tpu.runtime.autopilot import SHED_INFO_PREFIX
 
         st = resp.status
-        return (
-            st is not None
-            and (st.code or 0) == 503
-            and str(st.info or "").startswith(SHED_INFO_PREFIX)
-        )
+        if st is None or (st.code or 0) != 503:
+            return False
+        info = str(st.info or "")
+        return (info.startswith(SHED_INFO_PREFIX)
+                or info.startswith(BROWNOUT_INFO_PREFIX))
 
     @staticmethod
     def _decision_attrs(decision: Optional[PickDecision]) -> dict:
@@ -410,7 +465,35 @@ class ApiGateway:
         from seldon_core_tpu.utils.tracing import TRACER
 
         reg = self._resolve(token)
+        # tenant identity (runtime/qos.py): the Seldon-Tenant header
+        # (bound to the context by the HTTP lane), else the auth
+        # principal, else "anon"; the tier header picks the lane
+        tenant = resolve_tenant(
+            current_tenant(), reg.oauth_key if token else None
+        )
+        tier = current_tier()
         with self.metrics.time_ingress("predictions", "POST") as code:
+            BROWNOUT.maybe_tick()
+            # fair admission FIRST: a hog's excess is refused before it
+            # holds a queue slot, a deadline check, or a replica pick
+            throttled = self.tenants.admit(tenant, tier)
+            if throttled is not None:
+                code["code"] = "429"
+                return SeldonMessage.failure(
+                    f"{THROTTLE_INFO_PREFIX}: tenant {tenant!r} over its "
+                    f"{throttled} limit — retry later", code=429,
+                )
+            if BROWNOUT.sheds_tier(tier):
+                # staged degradation: lower tiers answer a typed
+                # retryable 503 while the ladder is engaged
+                RECORDER.record_brownout_shed(tier)
+                self.tenants.note_shed(tenant)
+                code["code"] = "503"
+                return SeldonMessage.failure(
+                    f"{BROWNOUT_INFO_PREFIX}: {tier!r} tier shed at "
+                    f"brownout stage {BROWNOUT.stage()} — retry later",
+                    code=503,
+                )
             # a request that arrives with its deadline already spent is
             # the CALLER's failure — answer before picking so it can't
             # feed any replica's failure degradation
@@ -428,54 +511,67 @@ class ApiGateway:
             # through fail-degradation
             blameable = rem is None or rem >= 20.0
             rows = self._request_rows(msg)
-            predictor_name, rs, endpoint, decision = self._pick_engine(
-                reg, rows=rows
-            )
-            # the ingress span roots the request tree (or joins the
-            # caller's trace when it sent a traceparent); the engine hop —
-            # in-process, UDS or HTTP — becomes its child
-            track = replicas_enabled()
-            if track:
-                endpoint.begin()
-            t0 = time.perf_counter()
-            ok = False
-            raised = True
-            shed = False
-            try:
-                with TRACER.span(
-                    msg.meta.puid, "gateway", kind="request",
-                    method="predict", deployment=reg.deployment_id,
-                    predictor=predictor_name,
-                    **self._decision_attrs(decision),
-                ):
-                    resp = await self._dispatch_predict(endpoint, msg)
-                shed = self._is_autopilot_shed(resp)
-                ok = not self._replica_fault(resp)
-                raised = False
-            finally:
+            # the fair-queue slot covers pick + dispatch: a freed slot
+            # always goes to the pending request with the smallest
+            # virtual tag, so a hog's backlog cannot starve a
+            # well-behaved tenant's next request (inert when
+            # SELDON_TPU_GW_FAIR_INFLIGHT is unset)
+            async with self.tenants.slot(tenant):
+                predictor_name, rs, endpoint, decision = self._pick_engine(
+                    reg, rows=rows
+                )
+                # the ingress span roots the request tree (or joins the
+                # caller's trace when it sent a traceparent); the engine
+                # hop — in-process, UDS or HTTP — becomes its child
+                track = replicas_enabled()
                 if track:
-                    if raised:
-                        # the dispatch never returned — client hung up
-                        # (CancelledError) or a gateway-side bug, neither
-                        # of which says anything about REPLICA health:
-                        # account neutrally or three impatient clients
-                        # fail-degrade a healthy replica (real transport
-                        # failures return a typed 503, they don't raise)
-                        endpoint.release(batcher=True)
-                    elif shed:
-                        # predictive shed: neutral accounting — not a
-                        # failure streak (the replica is deciding, not
-                        # dying) and not a latency sample (a ~1 ms
-                        # refusal fed into the EWMA would make the
-                        # shedding replica look FAST and herd more
-                        # traffic onto it)
-                        endpoint.release(batcher=True)
-                    elif ok or blameable:
-                        rs.complete(endpoint, decision,
-                                    time.perf_counter() - t0, ok=ok,
-                                    rows=rows)
-                    else:
-                        endpoint.release(batcher=True)
+                    endpoint.begin()
+                t0 = time.perf_counter()
+                ok = False
+                raised = True
+                shed = False
+                try:
+                    with TRACER.span(
+                        msg.meta.puid, "gateway", kind="request",
+                        method="predict", deployment=reg.deployment_id,
+                        predictor=predictor_name,
+                        tenant=tenant, tier=tier,
+                        **self._decision_attrs(decision),
+                    ), qos_scope(tenant, tier):
+                        # the RESOLVED identity (principal fallback
+                        # included) binds the dispatch scope, so the
+                        # remote lanes forward what the gateway resolved
+                        # — the raw header is absent exactly for
+                        # authenticated callers
+                        resp = await self._dispatch_predict(endpoint, msg)
+                    shed = self._is_autopilot_shed(resp)
+                    ok = not self._replica_fault(resp)
+                    raised = False
+                finally:
+                    if track:
+                        if raised:
+                            # the dispatch never returned — client hung up
+                            # (CancelledError) or a gateway-side bug,
+                            # neither of which says anything about REPLICA
+                            # health: account neutrally or three impatient
+                            # clients fail-degrade a healthy replica (real
+                            # transport failures return a typed 503, they
+                            # don't raise)
+                            endpoint.release(batcher=True)
+                        elif shed:
+                            # predictive/policy shed: neutral accounting —
+                            # not a failure streak (the replica is
+                            # deciding, not dying) and not a latency
+                            # sample (a ~1 ms refusal fed into the EWMA
+                            # would make the shedding replica look FAST
+                            # and herd more traffic onto it)
+                            endpoint.release(batcher=True)
+                        elif ok or blameable:
+                            rs.complete(endpoint, decision,
+                                        time.perf_counter() - t0, ok=ok,
+                                        rows=rows)
+                        else:
+                            endpoint.release(batcher=True)
             # record which predictor served (canary observability; feedback
             # routes back to the same predictor)
             resp.meta.requestPath.setdefault("predictor", predictor_name)
@@ -488,6 +584,16 @@ class ApiGateway:
             self._note_traffic(
                 reg.deployment_id, predictor_name, live_latency_s, live_error
             )
+            # per-tenant accounting: the governor's /stats row and the
+            # quality observatory's per-tenant SLO ring (GET /quality).
+            # An engine-side policy shed (autopilot/brownout 503) is
+            # flow control, not a tenant error — same rule as the
+            # global SLO feed (utils/metrics.py) — or a brownout would
+            # latch the per-tenant burn at the cap during exactly the
+            # event this view exists to attribute
+            tenant_error = live_error and not shed
+            self.tenants.note_result(tenant, live_latency_s, tenant_error)
+            self._note_tenant_slo(tenant, live_latency_s, tenant_error)
             # shadow mirroring rides AFTER the live answer exists — one
             # RNG draw for the unsampled path, one create_task for the
             # sampled one; the mirror dispatch/diff never touches this
@@ -496,8 +602,16 @@ class ApiGateway:
                 reg, predictor_name, msg, resp, live_latency_s
             )
         if self.firehose is not None:
-            self.firehose.publish(reg.deployment_id, msg, resp)
+            self.firehose.publish(reg.deployment_id, msg, resp,
+                                  tenant=tenant, tier=tier)
         return resp
+
+    @staticmethod
+    def _note_tenant_slo(tenant: str, latency_s: float,
+                         error: bool) -> None:
+        from seldon_core_tpu.utils.quality import QUALITY
+
+        QUALITY.record_tenant_request(tenant, latency_s, error=error)
 
     def _note_traffic(self, deployment: str, predictor: str,
                       latency_s: float, error: bool) -> None:
@@ -822,6 +936,21 @@ class ApiGateway:
             tp = traceparent_header_value()
             if tp is not None:
                 headers[TRACEPARENT_HEADER] = tp
+            # tenant/tier ride to the remote engine so its admission
+            # (brownout tier sheds, genserver lanes) and its spans see
+            # the same identity the gateway resolved
+            from seldon_core_tpu.runtime.qos import (
+                TENANT_HEADER,
+                TIER_HEADER,
+                TIER_INTERACTIVE,
+            )
+
+            tenant = current_tenant()
+            if tenant:
+                headers[TENANT_HEADER] = tenant
+            tier = current_tier()
+            if tier != TIER_INTERACTIVE:
+                headers[TIER_HEADER] = tier
             headers = headers or None
             timeout = aiohttp.ClientTimeout(total=total)
             try:
@@ -865,6 +994,11 @@ class ApiGateway:
                 for (dep, pred), e in sorted(self._traffic.items())
             },
             "shadow": self.shadow.snapshot(),
+            # per-tenant admission accounting (runtime/qos.py): bounded
+            # rows (LRU past 256 tenants), token-bucket refusals, fair-
+            # queue depth — plus the brownout ladder's stage/transitions
+            "tenants": self.tenants.snapshot(),
+            "brownout": BROWNOUT.snapshot(),
             "rollouts": (
                 None if self.rollouts is None else self.rollouts.snapshot()
             ),
@@ -898,6 +1032,8 @@ class ApiGateway:
         }
 
     async def close(self) -> None:
+        BROWNOUT.unregister_depth(self._brownout_key)
+        _release_brownout_sink(self._brownout_sink)
         self.shadow.cancel_all()
         if self._scrape_task is not None:
             self._scrape_task.cancel()
@@ -951,6 +1087,13 @@ def make_gateway_app(gateway: ApiGateway):
             {"access_token": tok, "token_type": "bearer", "expires_in": int(TOKEN_TTL_S)}
         )
 
+    from seldon_core_tpu.runtime.qos import (
+        TENANT_HEADER,
+        TIER_HEADER,
+        bind_qos,
+        qos_scope,
+    )
+
     async def predictions(request):
         try:
             msg = SeldonMessage.from_json(await _payload_text(request))
@@ -965,11 +1108,15 @@ def make_gateway_app(gateway: ApiGateway):
         try:
             # deadline set at the gateway governs the whole request tree;
             # an incoming traceparent makes the gateway span the caller's
-            # child instead of a fresh root
+            # child instead of a fresh root; tenant/tier headers bind the
+            # QoS identity the same way (runtime/qos.py)
             with trace_scope(
                 parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
             ), maybe_deadline_scope(
                 deadline_ms_header(request.headers.get(DEADLINE_HEADER))
+            ), qos_scope(
+                request.headers.get(TENANT_HEADER),
+                request.headers.get(TIER_HEADER),
             ):
                 resp = await gateway.predict(msg, _bearer(request))
         except AuthError as e:
@@ -997,6 +1144,9 @@ def make_gateway_app(gateway: ApiGateway):
                 parse_traceparent(request.headers.get(TRACEPARENT_HEADER))
             ), maybe_deadline_scope(
                 deadline_ms_header(request.headers.get(DEADLINE_HEADER))
+            ), qos_scope(
+                request.headers.get(TENANT_HEADER),
+                request.headers.get(TIER_HEADER),
             ):
                 ack = await gateway.send_feedback(fb, _bearer(request))
         except AuthError as e:
@@ -1014,6 +1164,36 @@ def make_gateway_app(gateway: ApiGateway):
             return _error_response(str(e), code=401)
         except SeldonMessageError as e:
             return _error_response(str(e))
+        # QoS admission for streams: same tenant bucket + brownout tier
+        # shed as unary predicts (the fair queue governs unary dispatch
+        # slots only — a stream holds its slot for its whole lifetime).
+        # The scope stays open across the stream so the genserver's tier
+        # lane sees the request's tier at admission.
+        from seldon_core_tpu.runtime.qos import parse_tier as _parse_tier
+
+        tenant = resolve_tenant(
+            request.headers.get(TENANT_HEADER),
+            reg.oauth_key if _bearer(request) else None,
+        )
+        tier = _parse_tier(request.headers.get(TIER_HEADER))
+        BROWNOUT.maybe_tick()
+        throttled = gateway.tenants.admit(tenant, tier)
+        if throttled is not None:
+            return _error_response(
+                f"{THROTTLE_INFO_PREFIX}: tenant {tenant!r} over its "
+                f"{throttled} limit — retry later", code=429,
+            )
+        if BROWNOUT.sheds_tier(tier):
+            RECORDER.record_brownout_shed(tier)
+            gateway.tenants.note_shed(tenant)
+            return _error_response(
+                f"{BROWNOUT_INFO_PREFIX}: {tier!r} tier stream shed at "
+                f"brownout stage {BROWNOUT.stage()}", code=503,
+            )
+        # aiohttp handlers run in their own task: binding the identity
+        # task-locally keeps it visible through the whole stream
+        # (genserver tier lane) with no scope to unwind
+        bind_qos(tenant, tier)
         def _streamable(ep):
             return hasattr(ep.target, "generate_stream") or \
                 ep.base_url is not None
@@ -1065,9 +1245,25 @@ def make_gateway_app(gateway: ApiGateway):
                     text, chunk = engine.prepare_stream_request(payload)
                 except SeldonMessageError as e:
                     return _error_response(str(e))
-                await resp.prepare(request)
                 agen = engine.generate_stream(text, chunk=chunk)
+                # prime before the 200: genserver admission sheds raise
+                # on the first __anext__ and must answer a typed
+                # retryable 503, not an in-band frame on a 200 (same
+                # contract as the engine REST lane)
+                first = None
                 try:
+                    first = await agen.__anext__()
+                except StopAsyncIteration:
+                    pass
+                except SeldonMessageError as e:
+                    await agen.aclose()
+                    return _error_response(str(e), code=e.http_code)
+                await resp.prepare(request)
+                try:
+                    if first is not None:
+                        await resp.write(
+                            b"data: " + first.encode() + b"\n\n"
+                        )
                     async for event in agen:
                         await resp.write(
                             b"data: " + event.encode() + b"\n\n"
@@ -1089,6 +1285,9 @@ def make_gateway_app(gateway: ApiGateway):
                 async with gateway._get_session().post(
                     str(engine) + "/api/v0.1/generate/stream",
                     data=payload,
+                    # tenant/tier ride upstream so the remote engine's
+                    # genserver schedules the stream on the right lane
+                    headers={TENANT_HEADER: tenant, TIER_HEADER: tier},
                     timeout=aiohttp.ClientTimeout(
                         total=None, sock_connect=20
                     ),
@@ -1156,6 +1355,14 @@ def make_gateway_app(gateway: ApiGateway):
             )
         return web.json_response(gateway.rollouts.document())
 
+    async def quality(_):
+        # the process-global quality observatory (shared with in-process
+        # engines): drift/feedback/SLO — including the per-tenant SLO
+        # rings the gateway's predict accounting feeds
+        from seldon_core_tpu.utils.quality import QUALITY
+
+        return web.json_response(QUALITY.document())
+
     async def overhead(_):
         # the ingress hop writes fused telemetry records too (its request
         # spans route through the per-thread ring): the gateway's
@@ -1175,6 +1382,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/stats", stats)
     app.router.add_get("/shadow", shadow)
     app.router.add_get("/rollouts", rollouts)
+    app.router.add_get("/quality", quality)
     app.router.add_get("/overhead", overhead)
 
     async def _cleanup(_app):

@@ -61,9 +61,14 @@ class Firehose:
         }
 
     def publish(
-        self, deployment: str, request: SeldonMessage, response: SeldonMessage
+        self, deployment: str, request: SeldonMessage,
+        response: SeldonMessage, tenant: Optional[str] = None,
+        tier: Optional[str] = None,
     ) -> None:
-        """Fire-and-forget; drops when the queue is full (never blocks)."""
+        """Fire-and-forget; drops when the queue is full (never blocks).
+        ``tenant``/``tier`` (runtime/qos.py) land as top-level fields so
+        a grep over the JSONL attributes traffic per tenant; absent for
+        pre-tenancy producers — consumers must tolerate both."""
         event = {
             "puid": response.meta.puid or request.meta.puid,
             "deployment": deployment,
@@ -71,6 +76,10 @@ class Firehose:
             "request": request.to_json_dict(),
             "response": response.to_json_dict(),
         }
+        if tenant is not None:
+            event["tenant"] = tenant
+        if tier is not None:
+            event["tier"] = tier
         try:
             self._queue.put_nowait(event)
         except asyncio.QueueFull:

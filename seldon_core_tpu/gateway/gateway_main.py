@@ -4,7 +4,15 @@ Env contract (rendered by operator/bundle.py, mirroring the apife chart
 values):
 
   GATEWAY_REST_PORT / GATEWAY_GRPC_PORT   listen ports (8080 / 5000)
-  GATEWAY_OAUTH_ENABLED                   "0" disables auth (single-tenant)
+  GATEWAY_OAUTH_ENABLED                   "0" disables auth (open gateway;
+                                          tenant identity then comes from
+                                          the Seldon-Tenant header alone)
+
+Multi-tenant QoS (runtime/qos.py; docs/operations.md "Surviving
+overload"): requests carry Seldon-Tenant / Seldon-Tier headers, and the
+SELDON_TPU_TENANT_* / SELDON_TPU_GW_FAIR_INFLIGHT env knobs turn on
+per-tenant token buckets and weighted-fair admission; the brownout
+ladder (SELDON_TPU_BROWNOUT_*) sheds lower tiers under overload.
   GATEWAY_STATE_PATH                      sqlite file for replica-shared
                                           tokens/registrations (the
                                           reference's Redis role,

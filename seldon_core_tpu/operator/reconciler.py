@@ -399,7 +399,7 @@ class Reconciler:
     def __init__(self, client: KubeClient, namespace: str = "default",
                  engine_image: str = "",
                  engine_env: Optional[Dict[str, str]] = None,
-                 rollouts=None):
+                 rollouts=None, autoscaler=None):
         # engine_image/engine_env: the chart-level engine knobs
         # (bundle.py values.engine) flowing into every rendered engine pod,
         # the reference's ENGINE_CONTAINER_IMAGE_AND_VERSION property role
@@ -412,6 +412,13 @@ class Reconciler:
         #: gate-checked auto-rollback, driven one tick per reconcile and
         #: written back onto the CR status as ``status.rollout``
         self.rollouts = rollouts
+        #: optional ScaleAheadPlanner (operator/scaleahead.py): CRs
+        #: annotated ``seldon.io/autoscale`` get their rendered engine
+        #: Deployments' spec.replicas written from the planner's
+        #: queue-growth forecast — scale-out lands ahead of the 5m burn
+        #: window, scale-in is gated on the rollout controller
+        self.autoscaler = autoscaler
+        self._autoscale_status: Dict[str, dict] = {}
 
     # -- CRD bootstrap ---------------------------------------------------
 
@@ -441,6 +448,10 @@ class Reconciler:
         )
         name = cr.get("metadata", {}).get("name", spec.name)
         uid = cr.get("metadata", {}).get("uid", "")
+        # predictive scale-ahead BEFORE hashing: the replica override is
+        # part of the desired state, so convergence sees it like any
+        # other spec change (steady forecast = steady hash = zero writes)
+        self._apply_autoscale(spec, name, manifests)
         for m in manifests:
             md = m.setdefault("metadata", {})
             md["namespace"] = self.namespace
@@ -524,8 +535,78 @@ class Reconciler:
                 if (kind, res_name) not in desired_keys:
                     self.client.delete(kind, self.namespace, res_name)
                     counts["deletes"] += 1
-        self._update_status(name, rollout=self._reconcile_rollout(cr))
+        self._update_status(
+            name, rollout=self._reconcile_rollout(cr),
+            autoscale=self._autoscale_status.get(name),
+        )
         return counts
+
+    def _apply_autoscale(self, spec, name: str,
+                         manifests: List[dict]) -> None:
+        """Override rendered engine Deployments' ``spec.replicas`` with
+        the scale-ahead planner's decision (operator/scaleahead.py).
+        No-op without a planner or the ``seldon.io/autoscale``
+        annotation; a malformed annotation raises (the caller surfaces
+        it as a Failed CR, same contract as a malformed graph)."""
+        self._autoscale_status.pop(name, None)
+        if self.autoscaler is None:
+            return
+        from seldon_core_tpu.operator.scaleahead import AutoscalePolicy
+
+        policy = AutoscalePolicy.from_spec(spec)  # raises on malformed
+        if policy is None:
+            return
+        # a live canary gates scale-IN: shrinking the fleet mid-rollout
+        # would let a capacity cut mask (or masquerade as) a candidate
+        # regression.  Scale-out stays allowed — a rollout under load
+        # needs capacity more, not less.
+        rollout_active = False
+        if self.rollouts is not None:
+            block = self.rollouts.status_block(name)
+            rollout_active = bool(
+                block and block.get("state") in ("pending", "running")
+            )
+        decisions = []
+        for m in manifests:
+            if m.get("kind") != "Deployment":
+                continue
+            if m.get("metadata", {}).get("labels", {}).get(
+                    "seldon-type") != "engine":
+                continue  # component pods scale with their own story
+            # "current" is the LIVE Deployment's count — the previous
+            # autoscale decision — not the freshly rendered CR baseline:
+            # judging scale-in against the baseline would reset an 8-
+            # replica fleet to the CR's 1 in a single tick with neither
+            # the hysteresis nor the rollout gate ever seeing a
+            # want < current transition
+            current = int(m.get("spec", {}).get("replicas", 1))
+            live = self.client.get(
+                "Deployment", self.namespace,
+                m.get("metadata", {}).get("name", ""),
+            )
+            if live is not None:
+                current = int(
+                    live.get("spec", {}).get("replicas", current))
+            decision = self.autoscaler.desired_replicas(
+                name, current, policy, rollout_active=rollout_active,
+            )
+            m["spec"]["replicas"] = decision["desired_replicas"]
+            decisions.append({
+                "deployment": m["metadata"].get("name", ""),
+                "current_replicas": decision["current_replicas"],
+                "desired_replicas": decision["desired_replicas"],
+                "reason": decision["reason"],
+                # integer-rounded so a steady load reads as an unchanged
+                # status (the write-suppression gate compares values)
+                "load_now": int(round(decision["load_now"])),
+                "load_forecast": int(round(decision["load_forecast"])),
+            })
+        if decisions:
+            self._autoscale_status[name] = {
+                "enabled": True,
+                "rollout_gated": rollout_active,
+                "decisions": decisions,
+            }
 
     def _reconcile_rollout(self, cr: dict) -> Optional[dict]:
         """One rollout-controller tick for an annotated CR: desired-state
@@ -591,7 +672,8 @@ class Reconciler:
     # -- status ------------------------------------------------------------
 
     def _update_status(self, name: str,
-                       rollout: Optional[dict] = None) -> None:
+                       rollout: Optional[dict] = None,
+                       autoscale: Optional[dict] = None) -> None:
         """CR status from observed Deployment readiness — the write-back
         half (SeldonDeploymentStatusUpdateImpl.java:49-104) — plus the
         rollout controller's state for canary-annotated CRs."""
@@ -618,6 +700,10 @@ class Reconciler:
         }
         if rollout is not None:
             status["rollout"] = rollout
+        if autoscale is not None:
+            # decision timestamps are stripped for write-suppression: a
+            # steady decision must read as an unchanged status
+            status["autoscale"] = autoscale
         self._patch_cr_status(name, status)
 
     def _patch_cr_status(self, name: str, status: dict) -> None:

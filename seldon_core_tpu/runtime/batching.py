@@ -33,6 +33,7 @@ import numpy as np
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
 from seldon_core_tpu.runtime.autopilot import autopilot_enabled, pad_bucket
+from seldon_core_tpu.runtime.qos import TIER_INTERACTIVE, current_tier, tier_rank
 from seldon_core_tpu.runtime.resilience import current_deadline
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
@@ -124,7 +125,13 @@ class MicroBatcher:
             # a 1-D payload would be bucketed as len(x) scalar rows and come
             # back sliced by feature count — treat it as one sample instead
             x = np.atleast_2d(x)
-        key = (x.shape[1:], x.dtype)  # np.dtype hashes fine; str() is ~5us
+        # the latency tier (runtime/qos.py) is part of the bucket key:
+        # tiers never co-stack (a batch tier's rows must not ride an
+        # interactive flush's deadline budget), and the pump gives
+        # interactive buckets first claim on a freed dispatch slot.
+        # Default traffic is all-interactive, so the key's extra element
+        # is constant and bucketing is unchanged bit-for-bit
+        key = (x.shape[1:], x.dtype, current_tier())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         # trace context + deadline captured at enqueue: the flush task
         # records each caller's queue wait as a span parented under ITS
@@ -152,7 +159,7 @@ class MicroBatcher:
         x = np.asarray(x)
         if x.ndim < 2:
             x = np.atleast_2d(x)
-        key = (x.shape[1:], x.dtype)
+        key = (x.shape[1:], x.dtype, current_tier())
         waiting = sum(len(e[0]) for e in self._buckets.get(key, ()))
         # FIFO: full flushes already queued ahead of us each cost one
         # rotation; the remainder coalesces into OUR flush
@@ -178,8 +185,11 @@ class MicroBatcher:
         """Point-in-time batcher state for ``/stats`` — queued rows per
         shape bucket plus the dispatch-slot picture."""
         buckets = {}
-        for (shape, dtype), entries in self._buckets.items():
-            buckets[f"{tuple(shape)}/{dtype}"] = {
+        for (shape, dtype, tier), entries in self._buckets.items():
+            label = f"{tuple(shape)}/{dtype}"
+            if tier != TIER_INTERACTIVE:
+                label += f"/{tier}"  # interactive keeps the legacy key
+            buckets[label] = {
                 "requests": len(entries),
                 "rows": sum(len(e[0]) for e in entries),
             }
@@ -193,13 +203,37 @@ class MicroBatcher:
             "atomic_chunks": self.atomic_chunks,
         }
 
+    def _higher_tier_waiting(self, tier: str) -> bool:
+        """Any bucket of a strictly higher-priority tier with queued
+        requests?  Interactive (rank 0) short-circuits to False — the
+        hot path pays nothing when everything is default-tier."""
+        rank = tier_rank(tier)
+        if rank == 0:
+            return False
+        return any(
+            entries and tier_rank(k[2]) < rank
+            for k, entries in self._buckets.items()
+        )
+
     async def _pump(self, key) -> None:
-        """One pump per shape bucket: take a dispatch slot, give same-burst
-        submitters a beat to land, stack what's waiting, dispatch, repeat.
-        The pump exits when its bucket drains (a later submit restarts it)."""
+        """One pump per (shape, tier) bucket: take a dispatch slot, give
+        same-burst submitters a beat to land, stack what's waiting,
+        dispatch, repeat.  Lower-tier pumps YIELD a just-acquired slot
+        whenever a higher-priority bucket has queued work — interactive
+        preempts batch/offline for flush slots (runtime/qos.py), bounded
+        by the higher tier actually having demand, so lower tiers drain
+        whenever interactive is idle.  The pump exits when its bucket
+        drains (a later submit restarts it)."""
         try:
             while self._buckets.get(key):
                 await self._sem.acquire()
+                if self._higher_tier_waiting(key[2]):
+                    # hand the slot back and let the interactive pump
+                    # (already awaiting the semaphore) take it; the
+                    # sleep bounds re-contention instead of hot-spinning
+                    self._sem.release()
+                    await asyncio.sleep(self.coalesce_s or 0.0005)
+                    continue
                 if self.coalesce_s > 0:
                     # the coalesce timer exists to merge a BURST: skip it
                     # when the device is idle and exactly one request is

@@ -71,6 +71,12 @@ __all__ = ["EngineService"]
 logger = logging.getLogger(__name__)
 
 
+def _brownout_snapshot() -> dict:
+    from seldon_core_tpu.runtime.brownout import BROWNOUT
+
+    return BROWNOUT.snapshot()
+
+
 def _meta_shape_ok(meta_in: dict) -> bool:
     """Fast-path precondition: the request meta must be representable by
     Meta.from_json_dict without coercion errors, otherwise we fall back so
@@ -411,6 +417,9 @@ class EngineService:
             "routers": router_quality(self.states()),
             # learned cost-model health (full table on GET /autopilot)
             "autopilot": AUTOPILOT.snapshot(),
+            # brownout ladder state (runtime/brownout.py): stage, live
+            # signals, recent typed transitions
+            "brownout": _brownout_snapshot(),
             "audit": self.audit.snapshot(),
             "staleness_s": round(staleness, 3),
         }
@@ -709,6 +718,23 @@ class EngineService:
         budget, shed with a typed 503 BEFORE the request burns a dispatch
         slot or device time — the answer could never arrive in time, and
         the 503 is retryable so another replica can still serve it."""
+        from seldon_core_tpu.runtime.brownout import (
+            BROWNOUT,
+            BROWNOUT_INFO_PREFIX,
+        )
+        from seldon_core_tpu.runtime.qos import current_tier
+
+        BROWNOUT.maybe_tick()
+        tier = current_tier()
+        if BROWNOUT.sheds_tier(tier):
+            # staged degradation (runtime/brownout.py): lower latency
+            # tiers shed with the same typed retryable 503 the autopilot
+            # uses, BEFORE queue or device time is spent
+            RECORDER.record_brownout_shed(tier)
+            raise LoadShedError(
+                f"{BROWNOUT_INFO_PREFIX}: {tier!r}-tier request shed at "
+                f"brownout stage {BROWNOUT.stage()} — retry later"
+            )
         timeout = self.dispatch_timeout_s
         rem = remaining_s()
         if rem is not None:
@@ -722,7 +748,11 @@ class EngineService:
                     self.batcher, "predicted_latency_s", None
                 )
                 est = predictor(rows) if predictor is not None else None
-                if est is not None and est > rem * shed_margin():
+                # brownout stage 3 tightens the margin (scale < 1):
+                # marginal requests shed earlier, certain ones still run
+                if est is not None and est > (
+                    rem * shed_margin() * BROWNOUT.shed_margin_scale()
+                ):
                     RECORDER.record_autopilot_shed("admission")
                     self.tracer.event(
                         "autopilot_shed",
@@ -894,6 +924,9 @@ class EngineService:
                         y_rows, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = str(e.http_code)
+                        # a shed is flow control, not an SLO error
+                        # (utils/metrics.py time_server)
+                        code["shed"] = isinstance(e, LoadShedError)
                         self._audit_request(
                             puid, "predict", e.http_code, t0,
                             rows=len(rows), lane="rest",
@@ -994,6 +1027,9 @@ class EngineService:
                         y, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = str(e.http_code)
+                        # a shed is flow control, not an SLO error
+                        # (utils/metrics.py time_server)
+                        code["shed"] = isinstance(e, LoadShedError)
                         self._audit_request(
                             puid, "predict", e.http_code, t0,
                             rows=len(rows), lane="grpc",
@@ -1060,6 +1096,9 @@ class EngineService:
                         y, (routing, tags) = await self._submit(rows)
                     except (SeldonMessageError, GraphSpecError) as e:
                         code["code"] = str(e.http_code)
+                        # a shed is flow control, not an SLO error
+                        # (utils/metrics.py time_server)
+                        code["shed"] = isinstance(e, LoadShedError)
                         self._audit_request(
                             puid, "predict", e.http_code, t0,
                             rows=len(rows), lane="grpc",
@@ -1149,6 +1188,9 @@ class EngineService:
             except (SeldonMessageError, GraphSpecError) as e:
                 http_code = getattr(e, "http_code", 400)
                 code["code"] = str(http_code)
+                # a shed is flow control, not an SLO error
+                # (utils/metrics.py time_server)
+                code["shed"] = isinstance(e, LoadShedError)
                 self._audit_request(
                     msg.meta.puid, "predict", http_code, t0, rows=n_rows,
                     lane="object",

@@ -59,6 +59,10 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from seldon_core_tpu.messages import LoadShedError
+from seldon_core_tpu.runtime.autopilot import SHED_INFO_PREFIX
+from seldon_core_tpu.runtime.brownout import BROWNOUT, BROWNOUT_INFO_PREFIX
+from seldon_core_tpu.runtime.qos import current_tier, tier_rank
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
@@ -171,10 +175,14 @@ class GenRequest:
     Future holding the assembled ``[B, max_new]`` token array (unary) or
     a bounded queue of ``[B, <=chunk]`` arrays (streaming)."""
 
-    def __init__(self, rows: int, chunk: Optional[int], max_new: int):
+    def __init__(self, rows: int, chunk: Optional[int], max_new: int,
+                 tier: Optional[str] = None):
         self.rows = rows
         self.chunk = chunk              # None = unary
         self.max_new = int(max_new)
+        #: latency tier (runtime/qos.py): admission prefers interactive
+        #: sequences, and preemption prefers victims from lower tiers
+        self.tier = tier or "interactive"
         self.seqs: List[_Sequence] = []
         self.future: concurrent.futures.Future = concurrent.futures.Future()
         # unbounded on purpose: a stream buffers at most max_new tokens
@@ -250,6 +258,12 @@ class GenServer:
         self.span = span or _env_int("SELDON_TPU_GEN_SPAN", 8)
         self.prefill_chunk = prefill_chunk or _env_int(
             "SELDON_TPU_GEN_PREFILL_CHUNK", 128)
+        # bounded admission queue: sustained overload must fail typed
+        # (retryable 503 via LoadShedError) with flat memory, never grow
+        # the waiting deques without limit.  Generous by default — the
+        # bound exists to cap the failure mode, not to shape traffic
+        # (token buckets and the brownout ladder do that)
+        self.max_waiting = _env_int("SELDON_TPU_GEN_MAX_WAITING", 4096)
         # dispatch-latency-aware adaptive chunking: prefill_chunk is the
         # FLOOR (the guaranteed interleave grain); when a prefill tick's
         # wall time is dispatch-dominated — doubling the chunk leaves the
@@ -288,22 +302,41 @@ class GenServer:
         self.preempted_total = 0
         self.steps_total: Dict[str, int] = {}
         self.tokens_emitted_total = 0
+        # this scheduler's waiting queue is an overload signal: the
+        # brownout ladder reads it as queue depth.  Registered through a
+        # weakref (and finalized) so the registry never pins a scheduler
+        # a test dropped without stop()
+        import weakref
+
+        self._brownout_key = f"genserver:{id(self)}"
+        ref = weakref.ref(self)
+        BROWNOUT.register_depth(
+            self._brownout_key,
+            # len() on deques is safe without the lock; this is a
+            # signal read, not an invariant
+            lambda: (lambda s: 0 if s is None else
+                     len(s._waiting) + len(s._arrivals))(ref()),
+        )
+        weakref.finalize(self, BROWNOUT.unregister_depth,
+                         self._brownout_key)
 
     # -- client surface (any thread) ------------------------------------
 
-    def submit(self, rows, max_new: Optional[int] = None) -> GenRequest:
+    def submit(self, rows, max_new: Optional[int] = None,
+               tier: Optional[str] = None) -> GenRequest:
         """Unary generation: rows [B, S] (float wire rows fine — the
         sanitize_prompt clamp applies).  Returns the request handle; its
         ``future`` resolves to the eos-padded int32 ``[B, max_new]``
         array — exactly ``generate()``'s output contract."""
-        return self._enqueue(rows, chunk=None, max_new=max_new)
+        return self._enqueue(rows, chunk=None, max_new=max_new, tier=tier)
 
-    def stream(self, rows, chunk: int = 8, max_new: Optional[int] = None):
+    def stream(self, rows, chunk: int = 8, max_new: Optional[int] = None,
+               tier: Optional[str] = None):
         """Streaming generation: a plain generator of ``[B, <=chunk]``
         int32 arrays whose concatenation equals the unary output —
         the stream_tokens contract, served by the scheduler."""
         req = self._enqueue(rows, chunk=max(1, int(chunk)),
-                            max_new=max_new)
+                            max_new=max_new, tier=tier)
 
         def _iter():
             try:
@@ -322,7 +355,18 @@ class GenServer:
 
         return _iter()
 
-    def _enqueue(self, rows, chunk, max_new) -> GenRequest:
+    def _enqueue(self, rows, chunk, max_new,
+                 tier: Optional[str] = None) -> GenRequest:
+        tier = tier or current_tier()
+        if BROWNOUT.sheds_tier(tier):
+            # typed, retryable, BEFORE anything is allocated or queued —
+            # the ladder's contract (runtime/brownout.py)
+            RECORDER.record_brownout_shed(tier)
+            raise LoadShedError(
+                f"{BROWNOUT_INFO_PREFIX}: {tier!r}-tier generation shed "
+                f"at brownout stage {BROWNOUT.stage()} — retry later or "
+                "resubmit as a higher tier"
+            )
         rows = np.asarray(rows, dtype=np.float64)
         if rows.ndim < 2:
             rows = rows.reshape(1, -1)
@@ -330,11 +374,35 @@ class GenServer:
         prompts = np.clip(
             np.nan_to_num(rows), 0, self.cfg.vocab - 1
         ).astype(np.int32)
-        req = GenRequest(len(prompts), chunk,
-                         max_new or self.max_new_tokens)
+        max_new = int(max_new or self.max_new_tokens)
+        scale = BROWNOUT.gen_max_new_scale()
+        if scale < 1.0:
+            # stage-2 degradation: shorter generations free KV blocks and
+            # slots sooner; clamped at admission so a request's contract
+            # (its future's [B, max_new] shape) is consistent throughout
+            max_new = max(1, int(max_new * scale))
+        req = GenRequest(len(prompts), chunk, max_new, tier=tier)
         with self._wake:
             if self._stopped:
                 raise RuntimeError("generation scheduler stopped")
+            waiting = len(self._waiting) + len(self._arrivals)
+            if (self.max_waiting > 0
+                    and waiting + len(prompts) > self.max_waiting):
+                # bounded admission: beyond the cap the queue would only
+                # grow memory, never goodput — fail typed and retryable
+                # (503 downstream; composes with breakers/retry budget)
+                RECORDER.record_autopilot_shed("gen_queue")
+                # the shed prefix is the wire contract (autopilot.py):
+                # without it the gateway would count this deliberate
+                # backpressure as a replica fault AND feed the ~1 ms
+                # refusal into the routing EWMA, herding MORE traffic
+                # onto the saturated replica
+                raise LoadShedError(
+                    f"{SHED_INFO_PREFIX}: generation admission queue "
+                    f"full ({waiting}/{self.max_waiting} sequences "
+                    "waiting; grow SELDON_TPU_GEN_MAX_WAITING or add "
+                    "replicas)"
+                )
             for r, p in enumerate(prompts):
                 self._seq_counter += 1
                 seq = _Sequence(self._seq_counter, req, r, p, req.max_new)
@@ -375,11 +443,19 @@ class GenServer:
         with self._lock:
             waiting = len(self._waiting) + len(self._arrivals)
             inflight = len(self._active) + len(self._prefilling)
+            tiers: Dict[str, int] = {}
+            for coll in (self._waiting, self._arrivals,
+                         self._prefilling, self._active):
+                for s in coll:
+                    t = s.request.tier
+                    tiers[t] = tiers.get(t, 0) + 1
         doc = {
             "mode": "speculative" if self.spec else "decode",
             "slots": self.slots,
             "inflight_sequences": inflight,
             "waiting_sequences": waiting,
+            "max_waiting": self.max_waiting,
+            "sequences_by_tier": tiers,
             "kv_blocks": alloc.snapshot() if alloc is not None else {
                 "total": self.num_blocks - 1, "used": 0, "pinned": 0,
                 "high_water": 0,
@@ -401,6 +477,7 @@ class GenServer:
         return doc
 
     def stop(self) -> None:
+        BROWNOUT.unregister_depth(self._brownout_key)
         with self._wake:
             self._stopped = True
             self._wake.notify_all()
@@ -483,10 +560,11 @@ class GenServer:
             req = seq.request
             if not req.future.done():
                 req.future.set_exception(exc)
-            try:
-                req.queue.put_nowait(exc)
-            except queue.Full:
-                pass
+            # plain put, not put_nowait-under-except-Full: the per-request
+            # queues are unbounded today, so Full is impossible — but a
+            # future bounded-queue change must BLOCK here rather than
+            # silently drop the shutdown error a consumer is waiting on
+            req.queue.put(exc)
 
     # -- the scheduler step ----------------------------------------------
 
@@ -557,7 +635,12 @@ class GenServer:
                 if s is not exclude]
         if not pool:
             return None
-        return max(pool, key=lambda s: s.admit_order)  # youngest first
+        # tier-aware preempt-youngest: victims come from the LOWEST
+        # priority tier present (offline before batch before
+        # interactive), youngest-within-tier — interactive sequences
+        # keep their KV blocks while any lower-tier victim exists
+        return max(pool, key=lambda s: (tier_rank(s.request.tier),
+                                        s.admit_order))
 
     def _preempt(self, seq: _Sequence) -> None:
         """Evict a running sequence: free its blocks and push it to the
@@ -596,17 +679,33 @@ class GenServer:
             self._draft_allocator.free(seq.draft_blocks)
         seq.draft_blocks = []
 
+    def _next_waiting_index(self) -> int:
+        """Admission order: highest-priority tier first, FIFO within a
+        tier — the genserver's latency-tier lane.  With homogeneous
+        traffic (everything interactive, the default) this is index 0,
+        i.e. exactly the old FIFO."""
+        best, best_rank = 0, None
+        for i, s in enumerate(self._waiting):
+            r = tier_rank(s.request.tier)
+            if best_rank is None or r < best_rank:
+                best, best_rank = i, r
+                if r == 0:
+                    break  # nothing outranks interactive
+        return best
+
     def _admit(self) -> int:
-        """FIFO admission into free slots; a sequence whose FIRST chunk
-        of blocks cannot be allocated stays queued (pool exhaustion
-        queues, never crashes).  A sequence that cannot fit even with the
-        scheduler otherwise EMPTY can never be served — that one fails
-        with a typed error instead of deadlocking the queue."""
+        """Tier-priority FIFO admission into free slots; a sequence whose
+        FIRST chunk of blocks cannot be allocated stays queued (pool
+        exhaustion queues, never crashes).  A sequence that cannot fit
+        even with the scheduler otherwise EMPTY can never be served —
+        that one fails with a typed error instead of deadlocking the
+        queue."""
         admitted = 0
         while self._waiting and (
             len(self._active) + len(self._prefilling) < self.slots
         ):
-            seq = self._waiting[0]
+            idx = self._next_waiting_index()
+            seq = self._waiting[idx]
             first = min(len(seq.prompt), self.prefill_chunk)
             upto = self._prefix_len + first
             shared = len(self._prefix_blocks)
@@ -618,14 +717,14 @@ class GenServer:
                 if not self._active and not self._prefilling:
                     # nothing will ever retire to free blocks: the pool
                     # is smaller than one request's first chunk
-                    self._waiting.popleft()
+                    del self._waiting[idx]
                     self._finish_error(seq, RuntimeError(
                         f"KV pool ({self.num_blocks} blocks of "
                         f"{self.block_size}) cannot hold one prefill "
                         "chunk (grow SELDON_TPU_GEN_POOL_BLOCKS)"))
                     continue
                 break  # pool dry: wait for a retirement to free blocks
-            self._waiting.popleft()
+            del self._waiting[idx]
             seq.blocks = self._allocator.alloc(need) or []
             if self.spec:
                 seq.draft_blocks = (
@@ -687,7 +786,11 @@ class GenServer:
         )
 
         t0 = time.perf_counter()
-        C = self._chunk_eff
+        # brownout stage >= 2: drop to the floor grain (the guaranteed
+        # interleave) so in-flight decode stalls minimally; the adaptive
+        # probe pauses rather than learning from degraded-mode walls
+        floored = BROWNOUT.gen_chunk_floor()
+        C = self.prefill_chunk if floored else self._chunk_eff
         # capacity pass first: eviction inside it may requeue OTHER
         # prefilling sequences, so the batch is built only afterwards
         for seq in list(self._prefilling):
@@ -791,7 +894,7 @@ class GenServer:
                 emitted += 1
             seq.state = _Sequence.RUNNING
             self._active.append(seq)
-        if max(widths) == C:
+        if max(widths) == C and not floored:
             # only adapt on SATURATED ticks: short prompts never use a
             # wider executable, so probing one would compile it for
             # nothing (and the wall of an unsaturated tick says nothing
@@ -1046,10 +1149,9 @@ class GenServer:
         req = seq.request
         if not req.future.done():
             req.future.set_exception(exc)
-        try:
-            req.queue.put_nowait(exc)
-        except queue.Full:
-            pass
+        # plain put (see _fail_all): the unbounded queue makes Full
+        # impossible, and a silent drop here would hang a stream consumer
+        req.queue.put(exc)
         # the request is dead: its sibling rows must not keep decoding
         # (or holding KV blocks) for a client that already got the error
         # — _drop_cancelled sweeps them at the next tick

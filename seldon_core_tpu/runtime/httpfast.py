@@ -337,6 +337,16 @@ async def _with_trace(coro, ctx):
         return await coro
 
 
+async def _with_qos(coro, tenant, tier):
+    """Run a route handler under the caller's tenant/tier identity
+    (Seldon-Tenant / Seldon-Tier — runtime/qos.py), same
+    inside-the-task requirement as the deadline/trace wrappers."""
+    from seldon_core_tpu.runtime.qos import qos_scope
+
+    with qos_scope(tenant, tier):
+        return await coro
+
+
 def _header_value(lower: bytes, name: bytes) -> Optional[bytes]:
     """Value of ``name`` (lower-case, colon included) anchored at a line
     start — an unanchored substring search would match inside other header
@@ -618,6 +628,15 @@ class _FastHttpProtocol(asyncio.Protocol):
         )
         if trace_ctx is not None:
             coro = _with_trace(coro, trace_ctx)
+        # tenant/tier identity: forwarded by the gateway's remote lane
+        tenv = _header_value(lower, b"seldon-tenant:")
+        tiv = _header_value(lower, b"seldon-tier:")
+        if tenv is not None or tiv is not None:
+            coro = _with_qos(
+                coro,
+                tenv.decode("latin-1").strip() if tenv is not None else None,
+                tiv.decode("latin-1").strip() if tiv is not None else None,
+            )
         task = asyncio.get_running_loop().create_task(coro)
         self.queue.put_nowait((task, close))
 

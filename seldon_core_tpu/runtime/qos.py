@@ -1,0 +1,481 @@
+"""Multi-tenant QoS primitives — tenant identity, latency tiers, fair
+admission.
+
+"Millions of users" means nothing while every request is anonymous and
+equal: one greedy caller fills the admission queue and everyone else's
+p99 pays for it.  This module gives the serving path the three
+primitives the overload-survival layer (gateway/apife.py fair admission,
+runtime/brownout.py staged degradation, runtime/genserver.py tier lanes)
+is built from:
+
+  * **Tenant identity** — the ``Seldon-Tenant`` header, falling back to
+    the auth principal (the deployment's oauth key) and finally
+    ``"anon"``.  The id rides a contextvar parallel to the deadline
+    budget (runtime/resilience.py) so every layer below the gateway can
+    read it without signature churn, and is threaded onto request spans
+    and firehose lines for auditability.
+  * **Latency tiers** — ``interactive`` > ``batch`` > ``offline``
+    (the ``Seldon-Tier`` header).  Tiers are a *scheduling* contract:
+    interactive traffic preempts lower tiers for flush slots
+    (runtime/batching.py) and KV blocks (genserver preemption prefers
+    victims from lower tiers), and the brownout ladder sheds lower
+    tiers first.  An unknown tier reads as ``interactive`` — mislabeled
+    traffic must degrade to today's behaviour, never to silent
+    deprioritization.
+  * **Fair admission** (:class:`TenantGovernor`) — per-tenant token
+    buckets (a hog's excess is refused with a typed 429 before it
+    queues anywhere) plus weighted start-time fair queueing over the
+    gateway's dispatch slots (when ``SELDON_TPU_GW_FAIR_INFLIGHT`` > 0):
+    each tenant's requests carry virtual start/finish tags advanced by
+    ``1/weight`` per request, and a freed slot always goes to the
+    pending request with the smallest tag — a 10x hog holds a 10x-later
+    virtual clock, so a well-behaved tenant's request jumps the hog's
+    backlog by construction.
+
+Kill switch: ``SELDON_TPU_TENANCY=0`` disables admission enforcement
+(and the fair queue) entirely; with no tenant header and default knobs
+(no rate limit, fair queue off) the enforcement path is also inert —
+today's behaviour bit-for-bit.
+
+Knobs (docs/operations.md "Surviving overload"):
+
+  * ``SELDON_TPU_TENANCY``            kill switch (default on)
+  * ``SELDON_TPU_TENANT_RATE``        per-tenant token rate, req/s
+                                      (0 = unlimited, the default)
+  * ``SELDON_TPU_TENANT_BURST``       bucket depth (default 2x rate)
+  * ``SELDON_TPU_TENANT_WEIGHTS``     JSON {tenant: weight} for the
+                                      fair queue (default weight 1.0)
+  * ``SELDON_TPU_TENANT_OVERRIDES``   JSON {tenant: {rate, burst,
+                                      weight}} per-tenant policy
+  * ``SELDON_TPU_GW_FAIR_INFLIGHT``   gateway fair-queue concurrency
+                                      (0 = fair queue off, the default)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Callable, Dict, Optional
+
+from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
+
+__all__ = [
+    "TENANT_HEADER",
+    "TIER_HEADER",
+    "TIER_INTERACTIVE",
+    "TIER_BATCH",
+    "TIER_OFFLINE",
+    "TIERS",
+    "THROTTLE_INFO_PREFIX",
+    "tenancy_enabled",
+    "parse_tier",
+    "tier_rank",
+    "current_tenant",
+    "current_tier",
+    "qos_scope",
+    "resolve_tenant",
+    "TokenBucket",
+    "TenantGovernor",
+]
+
+TENANT_HEADER = "Seldon-Tenant"
+TIER_HEADER = "Seldon-Tier"
+
+TIER_INTERACTIVE = "interactive"
+TIER_BATCH = "batch"
+TIER_OFFLINE = "offline"
+#: priority order: lower rank preempts higher rank
+_TIER_RANK = {TIER_INTERACTIVE: 0, TIER_BATCH: 1, TIER_OFFLINE: 2}
+TIERS = (TIER_INTERACTIVE, TIER_BATCH, TIER_OFFLINE)
+
+#: every tenant-throttle FAILURE message starts with this — like the
+#: autopilot's SHED_INFO_PREFIX, it is how the wire recognizes a
+#: policy refusal (429, retry-later) rather than a sick replica
+THROTTLE_INFO_PREFIX = "tenant throttled"
+
+_TENANT: ContextVar[Optional[str]] = ContextVar("seldon_tenant",
+                                                default=None)
+_TIER: ContextVar[str] = ContextVar("seldon_tier",
+                                    default=TIER_INTERACTIVE)
+
+
+def tenancy_enabled() -> bool:
+    """``SELDON_TPU_TENANCY=0`` disables admission enforcement (token
+    buckets, fair queue, throttle 429s).  Identity still resolves — the
+    per-tenant accounting rows stay, only enforcement stops."""
+    return os.environ.get("SELDON_TPU_TENANCY", "1").strip() != "0"
+
+
+def parse_tier(value: Optional[str]) -> str:
+    """Header value -> tier name; anything unknown is ``interactive``
+    (mislabeled traffic must never be silently deprioritized)."""
+    if not value:
+        return TIER_INTERACTIVE
+    tier = str(value).strip().lower()
+    return tier if tier in _TIER_RANK else TIER_INTERACTIVE
+
+
+def tier_rank(tier: Optional[str]) -> int:
+    """0 = interactive (highest priority).  Unknown -> 0."""
+    return _TIER_RANK.get(tier or "", 0)
+
+
+def current_tenant() -> Optional[str]:
+    return _TENANT.get()
+
+
+def current_tier() -> str:
+    return _TIER.get()
+
+
+@contextmanager
+def qos_scope(tenant: Optional[str], tier: Optional[str] = None):
+    """Bind tenant/tier for the enclosed request — the edge lanes
+    (gateway + engine REST) wrap handlers in this, parallel to
+    ``deadline_scope``/``trace_scope``."""
+    t_tok = _TENANT.set(tenant or None)
+    l_tok = _TIER.set(parse_tier(tier))
+    try:
+        yield
+    finally:
+        _TENANT.reset(t_tok)
+        _TIER.reset(l_tok)
+
+
+def bind_qos(tenant: Optional[str], tier: Optional[str] = None) -> None:
+    """Set tenant/tier for the CURRENT task without a scope — for
+    handlers that run in their own asyncio task (aiohttp), where the
+    context dies with the task and an unwound reset buys nothing.
+    Anywhere contexts outlive the request, use :func:`qos_scope`."""
+    _TENANT.set(tenant or None)
+    _TIER.set(parse_tier(tier))
+
+
+def resolve_tenant(header_value: Optional[str],
+                   principal: Optional[str] = None) -> str:
+    """The tenant-identity rule: explicit header, else the auth
+    principal, else ``anon``.  Ids are bounded (64 chars) so a
+    header-spraying client can't explode label cardinality downstream
+    (the governor's LRU bounds row count; this bounds row width)."""
+    tenant = (header_value or "").strip()
+    if not tenant:
+        tenant = (principal or "").strip() or "anon"
+    return tenant[:64]
+
+
+class TokenBucket:
+    """Monotonic-clock token bucket.  ``rate <= 0`` means unlimited —
+    the default, so an unconfigured governor admits everything."""
+
+    __slots__ = ("rate", "burst", "tokens", "_t")
+
+    def __init__(self, rate: float, burst: float,
+                 now: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0) if rate > 0 else 0.0
+        # starts FULL: the first requests of a well-behaved tenant must
+        # be admitted, not bootstrap the refill (the shadow-mirror
+        # budget learned this the hard way)
+        self.tokens = self.burst
+        self._t = now if now is not None else time.monotonic()
+
+    def take(self, n: float = 1.0, now: Optional[float] = None) -> bool:
+        if self.rate <= 0:
+            return True
+        now = now if now is not None else time.monotonic()
+        if now > self._t:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._t) * self.rate)
+        self._t = now
+        if self.tokens >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_json(name: str) -> dict:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return {}
+    try:
+        doc = json.loads(raw)
+        return doc if isinstance(doc, dict) else {}
+    except ValueError:
+        return {}
+
+
+class _Tenant:
+    """One tenant's admission state + accounting row."""
+
+    __slots__ = (
+        "name", "bucket", "weight", "vfinish", "requests", "throttled",
+        "shed", "errors", "latency_ms", "tiers", "last_seen",
+    )
+
+    def __init__(self, name: str, rate: float, burst: float,
+                 weight: float):
+        self.name = name
+        self.bucket = TokenBucket(rate, burst)
+        self.weight = max(float(weight), 1e-6)
+        self.vfinish = 0.0          # fair-queue virtual clock
+        self.requests = 0
+        self.throttled = 0
+        self.shed = 0
+        self.errors = 0
+        self.latency_ms = Reservoir(512)
+        self.tiers: Dict[str, int] = {}
+        self.last_seen = 0.0
+
+
+class TenantGovernor:
+    """Per-tenant token buckets + weighted start-time fair queueing.
+
+    Bounded: at most ``MAX_TENANTS`` rows, LRU-evicted — an
+    id-spraying client recycles rows instead of ballooning the gateway.
+    All bucket/accounting ops are plain dict work under the GIL; the
+    fair queue is event-loop-only state (futures created and resolved
+    on the gateway's loop)."""
+
+    MAX_TENANTS = 256
+
+    def __init__(
+        self,
+        rate: Optional[float] = None,
+        burst: Optional[float] = None,
+        weights: Optional[Dict[str, float]] = None,
+        overrides: Optional[Dict[str, dict]] = None,
+        fair_inflight: Optional[int] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = (
+            rate if rate is not None
+            else _env_float("SELDON_TPU_TENANT_RATE", 0.0)
+        )
+        self.burst = (
+            burst if burst is not None
+            else _env_float("SELDON_TPU_TENANT_BURST",
+                            2.0 * self.rate if self.rate > 0 else 0.0)
+        )
+        self.weights = dict(
+            weights if weights is not None
+            else _env_json("SELDON_TPU_TENANT_WEIGHTS")
+        )
+        self.overrides = dict(
+            overrides if overrides is not None
+            else _env_json("SELDON_TPU_TENANT_OVERRIDES")
+        )
+        self.fair_inflight = int(
+            fair_inflight if fair_inflight is not None
+            else _env_float("SELDON_TPU_GW_FAIR_INFLIGHT", 0)
+        )
+        self._now = now_fn
+        self._tenants: "OrderedDict[str, _Tenant]" = OrderedDict()
+        self.evicted = 0
+        # fair-queue state (event loop only)
+        self._inflight = 0
+        self._vtime = 0.0
+        self._queues: Dict[str, deque] = {}  # tenant -> [(tag, future)]
+
+    # -- tenant table ----------------------------------------------------
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is not None:
+            self._tenants.move_to_end(name)
+            return t
+        while len(self._tenants) >= self.MAX_TENANTS:
+            # LRU eviction: the id-spraying hog recycles ITS rows; a
+            # steadily-active tenant is always recently used
+            self._tenants.popitem(last=False)
+            self.evicted += 1
+        ov = self.overrides.get(name) or {}
+        rate = float(ov.get("rate", self.rate))
+        t = self._tenants[name] = _Tenant(
+            name,
+            rate,
+            float(ov.get("burst",
+                         self.burst if rate == self.rate
+                         else 2.0 * rate)),
+            float(ov.get("weight", self.weights.get(name, 1.0))),
+        )
+        return t
+
+    def set_policy(self, tenant: str, *, rate: Optional[float] = None,
+                   burst: Optional[float] = None,
+                   weight: Optional[float] = None) -> None:
+        """Programmatic per-tenant override (tests / control plane)."""
+        ov = self.overrides.setdefault(tenant, {})
+        if rate is not None:
+            ov["rate"] = float(rate)
+        if burst is not None:
+            ov["burst"] = float(burst)
+        if weight is not None:
+            ov["weight"] = float(weight)
+        self._tenants.pop(tenant, None)  # rebuilt with the new policy
+
+    # -- admission -------------------------------------------------------
+
+    def admit(self, tenant: str, tier: str) -> Optional[str]:
+        """One admission decision.  Returns ``None`` (admitted) or the
+        refusal reason (``"rate"``).  Always accounts the attempt."""
+        t = self._tenant(tenant)
+        t.requests += 1
+        t.tiers[tier] = t.tiers.get(tier, 0) + 1
+        t.last_seen = self._now()
+        RECORDER.record_tenant_request(tenant)
+        if not tenancy_enabled():
+            return None
+        if not t.bucket.take(1.0, self._now()):
+            t.throttled += 1
+            RECORDER.record_tenant_throttled(tenant)
+            return "rate"
+        return None
+
+    def note_result(self, tenant: str, latency_s: float,
+                    error: bool) -> None:
+        t = self._tenant(tenant)
+        t.latency_ms.observe(latency_s * 1e3)
+        if error:
+            t.errors += 1
+
+    def note_shed(self, tenant: str) -> None:
+        self._tenant(tenant).shed += 1
+
+    # -- weighted fair queue ---------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Requests parked in the fair queue — a brownout depth signal."""
+        return sum(len(q) for q in self._queues.values())
+
+    def slot(self, tenant: str):
+        """``async with governor.slot(tenant):`` — a dispatch slot under
+        start-time fair queueing.  With ``fair_inflight <= 0`` (default)
+        or tenancy off this is an inert context manager: zero added
+        awaits, today's behaviour bit-for-bit."""
+        return _FairSlot(self, tenant)
+
+    def _tag(self, tenant: str) -> float:
+        """Virtual start-tag for one request: ``max(vtime, tenant's last
+        finish)``; the tenant's finish clock then advances ``1/weight``
+        — the SFQ rule.  A tenant pushing 10x its share advances its own
+        clock 10x faster, so its backlog always sorts behind a
+        well-behaved tenant's next request."""
+        t = self._tenant(tenant)
+        start = max(self._vtime, t.vfinish)
+        t.vfinish = start + 1.0 / t.weight
+        return start
+
+    def _acquire_nowait(self, tenant: str) -> bool:
+        if self._inflight < self.fair_inflight:
+            self._inflight += 1
+            self._vtime = max(self._vtime, self._tag(tenant))
+            return True
+        return False
+
+    def _enqueue(self, tenant: str) -> "asyncio.Future":
+        fut = asyncio.get_running_loop().create_future()
+        tag = self._tag(tenant)
+        self._queues.setdefault(tenant, deque()).append((tag, fut))
+        return fut
+
+    def _release(self) -> None:
+        self._inflight -= 1
+        # hand the freed slot to the pending request with the smallest
+        # virtual tag across tenants (FIFO within a tenant)
+        best_key, best_tag = None, None
+        for name, q in self._queues.items():
+            while q and q[0][1].cancelled():
+                q.popleft()
+            if q and (best_tag is None or q[0][0] < best_tag):
+                best_key, best_tag = name, q[0][0]
+        if best_key is None:
+            self._queues = {k: q for k, q in self._queues.items() if q}
+            return
+        _tag, fut = self._queues[best_key].popleft()
+        if not self._queues[best_key]:
+            del self._queues[best_key]
+        self._inflight += 1
+        self._vtime = max(self._vtime, best_tag)
+        fut.set_result(None)
+
+    # -- surfaces --------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The gateway ``/stats`` tenants block — bounded by MAX_TENANTS
+        by construction."""
+        rows = {}
+        for name, t in self._tenants.items():
+            rows[name] = {
+                "requests": t.requests,
+                "throttled": t.throttled,
+                "shed": t.shed,
+                "errors": t.errors,
+                "tiers": dict(t.tiers),
+                "weight": t.weight,
+                "rate": t.bucket.rate,
+                "latency_ms": t.latency_ms.snapshot(),
+            }
+        return {
+            "enabled": tenancy_enabled(),
+            "fair_inflight": self.fair_inflight,
+            "queue_depth": self.queue_depth(),
+            "tenants_tracked": len(self._tenants),
+            "evicted": self.evicted,
+            "tenants": rows,
+        }
+
+    def reset(self) -> None:
+        """Tests only."""
+        self._tenants = OrderedDict()
+        self._queues = {}
+        self._inflight = 0
+        self._vtime = 0.0
+        self.evicted = 0
+
+
+class _FairSlot:
+    """Async context manager for one fair-queue slot."""
+
+    __slots__ = ("gov", "tenant", "_held")
+
+    def __init__(self, gov: TenantGovernor, tenant: str):
+        self.gov = gov
+        self.tenant = tenant
+        self._held = False
+
+    async def __aenter__(self):
+        gov = self.gov
+        if gov.fair_inflight <= 0 or not tenancy_enabled():
+            return self
+        if gov._acquire_nowait(self.tenant):
+            self._held = True
+            return self
+        fut = gov._enqueue(self.tenant)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # cancelled while queued: the future may have been resolved
+            # (slot granted) in the same tick — give the slot back so
+            # the queue drains instead of leaking capacity
+            if fut.done() and not fut.cancelled():
+                gov._release()
+            raise
+        self._held = True
+        return self
+
+    async def __aexit__(self, *exc):
+        if self._held:
+            self.gov._release()
+        return False

@@ -88,6 +88,21 @@ def _request_trace_scope(request: web.Request):
     return trace_scope(parse_traceparent(request.headers.get(TRACEPARENT_HEADER)))
 
 
+def _request_qos_scope(request: web.Request):
+    """Adopt the caller's tenant/tier identity (``Seldon-Tenant`` /
+    ``Seldon-Tier`` — the gateway forwards both) so engine-side
+    admission, the brownout ladder and the genserver's tier lanes see
+    the same QoS identity the ingress resolved."""
+    from seldon_core_tpu.runtime.qos import (
+        TENANT_HEADER,
+        TIER_HEADER,
+        qos_scope,
+    )
+
+    return qos_scope(request.headers.get(TENANT_HEADER),
+                     request.headers.get(TIER_HEADER))
+
+
 async def _quality_reference(request: web.Request) -> web.Response:
     """POST /quality/reference — freeze/reset the drift reference window
     (one handler shared by the engine and unit apps; the fast lane
@@ -115,7 +130,8 @@ def make_engine_app(engine: EngineService) -> web.Application:
     async def predictions(request: web.Request) -> web.Response:
         try:
             with _request_trace_scope(request), \
-                    maybe_deadline_scope(_request_budget_s(request)):
+                    maybe_deadline_scope(_request_budget_s(request)), \
+                    _request_qos_scope(request):
                 text, status = await engine.predict_json(
                     await _payload_text(request)
                 )
@@ -254,14 +270,39 @@ def make_engine_app(engine: EngineService) -> web.Application:
             )
         except SeldonMessageError as e:
             return _error_response(str(e))
+        # tier rides task-locally for the stream's lifetime so the
+        # genserver admits it on the right lane (runtime/qos.py)
+        from seldon_core_tpu.runtime.qos import (
+            TENANT_HEADER,
+            TIER_HEADER,
+            bind_qos,
+        )
+
+        bind_qos(request.headers.get(TENANT_HEADER),
+                 request.headers.get(TIER_HEADER))
+        agen = engine.generate_stream(text, chunk=chunk)
+        # prime the generator BEFORE the 200 goes out: genserver
+        # admission sheds (brownout tier shed, SELDON_TPU_GEN_MAX_WAITING
+        # bound) raise on the first __anext__, and the shed contract
+        # promises a typed retryable 503 — not a 200 with an in-band
+        # error frame that status-code retry logic can never see
+        first = None
+        try:
+            first = await agen.__anext__()
+        except StopAsyncIteration:
+            pass
+        except SeldonMessageError as e:
+            await agen.aclose()
+            return _error_response(str(e), code=e.http_code)
         resp = web.StreamResponse(
             status=200,
             headers={"Content-Type": "text/event-stream",
                      "Cache-Control": "no-cache"},
         )
         await resp.prepare(request)
-        agen = engine.generate_stream(text, chunk=chunk)
         try:
+            if first is not None:
+                await resp.write(b"data: " + first.encode() + b"\n\n")
             async for event in agen:
                 await resp.write(b"data: " + event.encode() + b"\n\n")
         except Exception as e:  # mid-stream: terminal error frame

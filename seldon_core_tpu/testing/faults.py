@@ -35,7 +35,13 @@ from typing import Dict, List, Mapping, Optional, Union
 from seldon_core_tpu.graph.interpreter import NodeRuntime
 from seldon_core_tpu.messages import Feedback, SeldonMessage
 
-__all__ = ["FaultSpec", "FaultyNodeRuntime", "FaultyEngine", "InjectedFault"]
+__all__ = [
+    "FaultSpec",
+    "FaultyNodeRuntime",
+    "FaultyEngine",
+    "InjectedFault",
+    "drive_tenant",
+]
 
 
 class InjectedFault(Exception):
@@ -216,3 +222,81 @@ class FaultyEngine:
         # stats/ready/open_breakers/predict_json/... delegate untouched so
         # the wrapper stays a drop-in EngineService wherever one is used
         return getattr(self.inner, name)
+
+
+class ThrottledEngine:
+    """An ``EngineService`` wrapper with FIXED capacity: at most
+    ``concurrency`` predicts in service, each taking ``delay_s`` — a
+    deterministic stand-in for a saturated device, so overload tests
+    (tests/test_chaos.py fairness arm, scripts/overload_demo.py) have a
+    real bottleneck to fight over.  Excess callers queue FIFO on the
+    semaphore, which is exactly the starvation the QoS layer exists to
+    prevent."""
+
+    def __init__(self, inner, concurrency: int = 8,
+                 delay_s: float = 0.04):
+        self.inner = inner
+        self.delay_s = float(delay_s)
+        self._sem = asyncio.Semaphore(int(concurrency))
+        self.served = 0
+
+    async def predict(self, msg):
+        async with self._sem:
+            await asyncio.sleep(self.delay_s)
+            self.served += 1
+            return await self.inner.predict(msg)
+
+    async def send_feedback(self, feedback):
+        return await self.inner.send_feedback(feedback)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+async def drive_tenant(
+    gateway,
+    tenant: str,
+    n: int,
+    *,
+    tier: Optional[str] = None,
+    concurrency: int = 1,
+    n_features: int = 4,
+    msg_factory=None,
+):
+    """Fire ``n`` predicts at an in-process gateway AS one tenant —
+    the overload-fairness harness (tests/test_chaos.py hog/victim arms,
+    scripts/overload_demo.py, ``bench.py --fairness-gate``).
+
+    Returns ``(latencies_s, outcomes)``: per-request wall seconds and
+    the response status code (200 for SUCCESS).  ``concurrency`` > 1
+    models a greedy caller that keeps that many requests permanently in
+    flight; 1 models a polite sequential client."""
+    import time as _time
+
+    import numpy as np
+
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.runtime.qos import qos_scope
+
+    if msg_factory is None:
+        def msg_factory():
+            return SeldonMessage.from_array(
+                np.zeros((1, n_features), dtype=np.float64))
+
+    latencies: List[float] = []
+    outcomes: List[int] = []
+    sem = asyncio.Semaphore(max(int(concurrency), 1))
+
+    async def one():
+        async with sem:
+            t0 = _time.perf_counter()
+            with qos_scope(tenant, tier):
+                resp = await gateway.predict(msg_factory())
+            latencies.append(_time.perf_counter() - t0)
+            st = resp.status
+            outcomes.append(
+                200 if st is None or st.status == "SUCCESS"
+                else (st.code or 500))
+
+    await asyncio.gather(*(one() for _ in range(int(n))))
+    return latencies, outcomes

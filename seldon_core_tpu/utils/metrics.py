@@ -156,9 +156,16 @@ class MetricsRegistry:
                 # SLO engine (utils/quality.py): burn rates ride the same
                 # request stream this histogram observes; 5xx burns the
                 # error budget, anything over SELDON_TPU_SLO_P99_MS burns
-                # the latency budget
+                # the latency budget.  Policy refusals (code["shed"]:
+                # autopilot/brownout LoadShedError 503s) are flow
+                # control, not failures — counting them as SLO errors
+                # would latch the brownout ladder (shed -> error burn ->
+                # stay shed forever) and fail rollout burn gates on
+                # deliberate backpressure; they have their own counter
+                # families (seldon_tpu_{autopilot,brownout}_shed_total)
                 QUALITY.record_request(
-                    dt, error=code_holder["code"].startswith("5")
+                    dt, error=(code_holder["code"].startswith("5")
+                               and not code_holder.get("shed"))
                 )
             if self.registry is not None:
                 self._server_child(service, method, code_holder["code"]).observe(dt)

@@ -59,7 +59,7 @@ import os
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -428,7 +428,8 @@ class SloTracker:
     BURN_CAP = 1e6
 
     def __init__(self, p99_ms: Optional[float] = None,
-                 error_rate: Optional[float] = None):
+                 error_rate: Optional[float] = None,
+                 horizon: Optional[int] = None):
         self.p99_ms = (
             p99_ms if p99_ms is not None
             else _env_float("SELDON_TPU_SLO_P99_MS")
@@ -437,9 +438,16 @@ class SloTracker:
             error_rate if error_rate is not None
             else _env_float("SELDON_TPU_SLO_ERROR_RATE")
         )
+        # a smaller horizon shrinks the per-second ring (and drops the
+        # windows it can't cover) — the per-tenant trackers use 300 s so
+        # 256 tenants cost ~2.5 MB instead of ~30 MB
+        self.horizon = int(horizon) if horizon else self.HORIZON
+        self.windows = tuple(
+            (name, w) for name, w in self.WINDOWS if w <= self.horizon
+        ) or (self.WINDOWS[0],)
         self._lock = threading.Lock()
-        self._sec = np.zeros(self.HORIZON, dtype=np.int64)
-        self._counts = np.zeros((self.HORIZON, 3), dtype=np.int64)
+        self._sec = np.zeros(self.horizon, dtype=np.int64)
+        self._counts = np.zeros((self.horizon, 3), dtype=np.int64)
 
     @property
     def configured(self) -> bool:
@@ -448,7 +456,7 @@ class SloTracker:
     def record(self, latency_s: float, error: bool = False,
                now: Optional[float] = None) -> None:
         ts = int(now if now is not None else time.time())
-        i = ts % self.HORIZON
+        i = ts % self.horizon
         with self._lock:
             if self._sec[i] != ts:
                 self._sec[i] = ts
@@ -465,7 +473,7 @@ class SloTracker:
             sec = self._sec.copy()
             counts = self._counts.copy()
         out: Dict[str, Any] = {}
-        for name, w in self.WINDOWS:
+        for name, w in self.windows:
             mask = (sec > ts - w) & (sec <= ts)
             total, slow, errors = (int(v) for v in counts[mask].sum(axis=0))
             entry: Dict[str, Any] = {"requests": total}
@@ -663,6 +671,10 @@ class QualityObservatory:
         self._jit_warming: set = set()
         self._rng = random.Random(0xC0FFEE)
         self.slo = SloTracker()
+        # per-tenant SLO rings (runtime/qos.py tenancy): same objectives
+        # as the global tracker, 5m-horizon rings, LRU-bounded so an
+        # id-spraying client can't balloon the observatory
+        self._tenant_slo: "OrderedDict[str, SloTracker]" = OrderedDict()
         self.outlier = Reservoir(2048)
         self.outlier_total = 0
         self.outlier_exceeded = 0
@@ -1009,6 +1021,12 @@ class QualityObservatory:
 
     # -- SLO ---------------------------------------------------------------
 
+    #: bound on tracked tenant SLO rings (LRU past it — matches the
+    #: gateway governor's row bound)
+    MAX_TENANTS = 256
+    #: per-tenant ring horizon: covers the 5m fast-burn window only
+    TENANT_HORIZON_S = 300
+
     def record_request(self, latency_s: float, error: bool = False,
                        now: Optional[float] = None) -> None:
         """One served request's latency/outcome into the SLO engine (fed
@@ -1016,6 +1034,37 @@ class QualityObservatory:
         if not self.enabled:
             return
         self.slo.record(latency_s, error=error, now=now)
+
+    def record_tenant_request(self, tenant: str, latency_s: float,
+                              error: bool = False,
+                              now: Optional[float] = None) -> None:
+        """Per-tenant SLO accounting (the gateway's predict path feeds
+        this) — burn is per-tenant on ``GET /quality`` so one hog's
+        burned budget is attributable instead of smeared across the
+        global tracker."""
+        if not self.enabled or not tenant:
+            return
+        with self._lock:
+            t = self._tenant_slo.get(tenant)
+            if t is None:
+                while len(self._tenant_slo) >= self.MAX_TENANTS:
+                    self._tenant_slo.popitem(last=False)
+                t = self._tenant_slo[tenant] = SloTracker(
+                    p99_ms=self.slo.p99_ms,
+                    error_rate=self.slo.error_rate,
+                    horizon=self.TENANT_HORIZON_S,
+                )
+            else:
+                self._tenant_slo.move_to_end(tenant)
+        t.record(latency_s, error=error, now=now)
+
+    def tenant_slo_block(self) -> Dict[str, Any]:
+        """{tenant: burn windows} — bounded by MAX_TENANTS."""
+        with self._lock:
+            trackers = list(self._tenant_slo.items())
+        return {
+            tenant: tracker.burn_rates() for tenant, tracker in trackers
+        }
 
     def refresh_gauges(self) -> None:
         """Recompute the seldon_tpu_slo_burn_rate and drift gauges —
@@ -1083,6 +1132,9 @@ class QualityObservatory:
             "feedback": fb,
             "outliers": self.outlier_block(),
             "slo": self.slo.snapshot(),
+            # per-tenant burn (5m ring per tenant, LRU-bounded): which
+            # tenant is burning the budget, not just that it burns
+            "tenant_slo": self.tenant_slo_block(),
         }
 
     def snapshot(self) -> Dict[str, Any]:
@@ -1109,6 +1161,7 @@ class QualityObservatory:
             "feedback_count": fb_count,
             "outliers_scored": self.outlier_total,
             "slo_configured": self.slo.configured,
+            "tenants_tracked": len(self._tenant_slo),
             "errors": self.errors,
         }
 
@@ -1123,6 +1176,7 @@ class QualityObservatory:
             self.outlier_total = 0
             self.outlier_exceeded = 0
             self.errors = 0
+            self._tenant_slo = OrderedDict()
         self.slo.reset_events()
 
 

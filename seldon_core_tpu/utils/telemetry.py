@@ -162,6 +162,21 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_autopilot_shed_total": ("counter", ("where",)),
     "seldon_tpu_autopilot_mispredict_pct": ("gauge", ()),
     "seldon_tpu_autopilot_keys": ("gauge", ()),
+    # multi-tenant QoS (runtime/qos.py + gateway/apife.py): per-tenant
+    # admission flow and token-bucket refusals (the
+    # SeldonTPUTenantThrottled alert's axis).  Tenant label cardinality
+    # is bounded at the source: the governor LRU-caps tenant rows at 256
+    # and the recorder folds everything beyond its own cap into an
+    # "overflow" label, so an id-spraying client cannot balloon the
+    # exposition
+    "seldon_tpu_tenant_requests_total": ("counter", ("tenant",)),
+    "seldon_tpu_tenant_throttled_total": ("counter", ("tenant",)),
+    # brownout ladder (runtime/brownout.py): the current degradation
+    # stage (0 = normal; SeldonTPUBrownoutActive pages on sustained > 0),
+    # stage transitions, and requests shed by tier while degraded
+    "seldon_tpu_brownout_stage": ("gauge", ()),
+    "seldon_tpu_brownout_transitions_total": ("counter", ("stage",)),
+    "seldon_tpu_brownout_shed_total": ("counter", ("tier",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -326,6 +341,17 @@ class FlightRecorder:
         self.autopilot_sheds: Dict[str, int] = {}      # where -> n
         self.autopilot_mispredict_p50_pct: Optional[float] = None
         self.autopilot_keys = 0
+        # multi-tenant QoS mirrors (runtime/qos.py governor feeds these)
+        # + the brownout ladder's stage/transition/shed accounting
+        # (runtime/brownout.py).  Tenant label sets are capped here too
+        # (_TENANT_LABEL_CAP) independently of the governor's LRU — the
+        # recorder must stay bounded even if a future caller feeds it
+        # raw ids
+        self.tenant_requests: Dict[str, int] = {}      # tenant -> n
+        self.tenant_throttled: Dict[str, int] = {}     # tenant -> n
+        self.brownout_stage = 0
+        self.brownout_transitions: Dict[str, int] = {}  # stage -> n
+        self.brownout_sheds: Dict[str, int] = {}       # tier -> n
         # Prometheus high-water mark per hop: the counter is advanced by
         # deltas against THIS, not the snapshot mirror above — reset()
         # clears the mirror but must not rewind the monotone counter's
@@ -623,6 +649,36 @@ class FlightRecorder:
                 "Per-executable/pad-bucket latency models in the "
                 "autopilot table (GET /autopilot lists them)",
                 registry=self.registry)
+            self._p_tenant_requests = Counter(
+                "seldon_tpu_tenant_requests_total",
+                "Admission attempts per tenant at the gateway "
+                "(runtime/qos.py governor; label cardinality bounded "
+                "at the source)",
+                ["tenant"], registry=self.registry)
+            self._p_tenant_throttled = Counter(
+                "seldon_tpu_tenant_throttled_total",
+                "Requests refused with a typed 429 because the tenant's "
+                "token bucket ran dry — a hog's excess, refused before "
+                "it queues anywhere (SeldonTPUTenantThrottled alerts "
+                "on it)",
+                ["tenant"], registry=self.registry)
+            self._p_brownout_stage = Gauge(
+                "seldon_tpu_brownout_stage",
+                "Current brownout degradation stage (0 = normal, 1 = "
+                "offline tier shed, 2 = generation degraded, 3 = batch "
+                "tier shed — runtime/brownout.py; "
+                "SeldonTPUBrownoutActive pages on sustained > 0)",
+                registry=self.registry)
+            self._p_brownout_transitions = Counter(
+                "seldon_tpu_brownout_transitions_total",
+                "Brownout stage transitions, labelled by the stage "
+                "ENTERED — escalations and reverts both count",
+                ["stage"], registry=self.registry)
+            self._p_brownout_shed = Counter(
+                "seldon_tpu_brownout_shed_total",
+                "Requests shed by the brownout ladder, by latency tier "
+                "— typed retryable 503s, never silent drops",
+                ["tier"], registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -817,6 +873,59 @@ class FlightRecorder:
         page reads these concurrently with request threads writing."""
         with self._lock:
             return dict(self.autopilot_sheds), dict(self.autopilot_decisions)
+
+    # -- multi-tenant QoS + brownout (runtime/qos.py / brownout.py) ------
+
+    #: hard cap on distinct tenant labels the recorder itself will hold;
+    #: the governor's 256-row LRU is the primary bound, this is the
+    #: belt-and-braces one (everything beyond folds into "overflow")
+    _TENANT_LABEL_CAP = 512
+
+    def _tenant_label(self, table: Dict[str, int], tenant: str) -> str:
+        if tenant in table or len(table) < self._TENANT_LABEL_CAP:
+            return tenant
+        return "overflow"
+
+    def record_tenant_request(self, tenant: str) -> None:
+        with self._lock:
+            label = self._tenant_label(self.tenant_requests, tenant)
+            self.tenant_requests[label] = (
+                self.tenant_requests.get(label, 0) + 1)
+        if self.registry is not None:
+            self._p_tenant_requests.labels(tenant=label).inc()
+
+    def record_tenant_throttled(self, tenant: str) -> None:
+        self._gen += 1
+        with self._lock:
+            label = self._tenant_label(self.tenant_throttled, tenant)
+            self.tenant_throttled[label] = (
+                self.tenant_throttled.get(label, 0) + 1)
+        if self.registry is not None:
+            self._p_tenant_throttled.labels(tenant=label).inc()
+
+    def set_brownout_stage(self, stage: int) -> None:
+        self._gen += 1
+        with self._lock:
+            self.brownout_stage = int(stage)
+        if self.registry is not None:
+            self._p_brownout_stage.set(stage)
+
+    def record_brownout_transition(self, stage: int) -> None:
+        self._gen += 1
+        with self._lock:
+            key = str(int(stage))
+            self.brownout_transitions[key] = (
+                self.brownout_transitions.get(key, 0) + 1)
+        if self.registry is not None:
+            self._p_brownout_transitions.labels(stage=str(int(stage))).inc()
+
+    def record_brownout_shed(self, tier: str) -> None:
+        self._gen += 1
+        with self._lock:
+            self.brownout_sheds[tier] = (
+                self.brownout_sheds.get(tier, 0) + 1)
+        if self.registry is not None:
+            self._p_brownout_shed.labels(tier=tier).inc()
 
     def set_autopilot_model(self, mispredict_p50_pct: Optional[float],
                             keys: int) -> None:
@@ -1155,6 +1264,13 @@ class FlightRecorder:
                 "mispredict_p50_pct": self.autopilot_mispredict_p50_pct,
                 "keys": self.autopilot_keys,
             }
+            qos = {
+                "tenant_requests": dict(self.tenant_requests),
+                "tenant_throttled": dict(self.tenant_throttled),
+                "brownout_stage": self.brownout_stage,
+                "brownout_transitions": dict(self.brownout_transitions),
+                "brownout_sheds": dict(self.brownout_sheds),
+            }
             quality = {
                 "drift": dict(self.drift_scores),
                 "slo_burn": dict(self.slo_burn),
@@ -1178,6 +1294,7 @@ class FlightRecorder:
             "replicas": replicas,
             "traffic_lifecycle": lifecycle,
             "autopilot": autopilot,
+            "qos": qos,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -1294,6 +1411,11 @@ class FlightRecorder:
             self.autopilot_sheds = {}
             self.autopilot_mispredict_p50_pct = None
             self.autopilot_keys = 0
+            self.tenant_requests = {}
+            self.tenant_throttled = {}
+            self.brownout_stage = 0
+            self.brownout_transitions = {}
+            self.brownout_sheds = {}
 
 
 RECORDER = FlightRecorder()

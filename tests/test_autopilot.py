@@ -219,7 +219,12 @@ def test_predicted_latency_s_hand_computed():
     # idle batcher: dispatch + coalesce window, no slot wait
     assert mb.predicted_latency_s(x) == pytest.approx(0.004 + 0.0005)
     # with 3 rows already queued the request lands in the 4-bucket
-    mb._buckets[(x.shape[1:], x.dtype)] = deque([_entry(3)])
+    # (bucket keys carry the latency tier — runtime/qos.py — and
+    # default traffic is all-interactive)
+    from seldon_core_tpu.runtime.qos import TIER_INTERACTIVE
+
+    mb._buckets[(x.shape[1:], x.dtype, TIER_INTERACTIVE)] = \
+        deque([_entry(3)])
     assert mb.predicted_latency_s(x) == pytest.approx(0.007 + 0.0005)
 
 

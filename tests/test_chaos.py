@@ -541,3 +541,162 @@ def test_deadline_bounds_dispatch_timeout():
             await engine.close()
 
     asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant overload fairness (runtime/qos.py + runtime/brownout.py)
+# ---------------------------------------------------------------------------
+
+
+def _qos_spec(name="qos-chaos"):
+    return _deployment({"name": "m", "implementation": "SIMPLE_MODEL"})
+
+
+def _p99(latencies):
+    vals = sorted(latencies)
+    return vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+
+
+def _fair_gateway(engine, *, rate, burst, fair_inflight):
+    from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+    from seldon_core_tpu.runtime.qos import TenantGovernor
+
+    spec = _qos_spec()
+    store = DeploymentStore()
+    store.register(spec, {"p": engine})
+    gw = ApiGateway(store=store, require_auth=False)
+    gw.tenants = TenantGovernor(rate=rate, burst=burst,
+                                fair_inflight=fair_inflight)
+    return gw
+
+
+def test_hog_tenant_cannot_starve_victim():
+    """The acceptance A/B: over a fixed-capacity engine, a hog tenant
+    holding 10x its fair share in flight must not push a well-behaved
+    tenant's p99 past 1.5x its solo baseline (token buckets refuse the
+    hog's excess, the fair queue orders what remains) — while the
+    kill-switch arm shows the hog's FIFO backlog visibly starving the
+    victim.  Zero victim requests fail or hang in either arm."""
+    from seldon_core_tpu.messages import SeldonMessage
+    from seldon_core_tpu.runtime.qos import qos_scope
+    from seldon_core_tpu.testing.faults import ThrottledEngine, drive_tenant
+
+    spec = _qos_spec()
+    CAP, DELAY = 4, 0.05  # capacity 80 req/s
+
+    def msg():
+        import numpy as np
+
+        return SeldonMessage.from_array(np.zeros((1, 4)))
+
+    async def victim_run(gw, n=30):
+        lat, out = await drive_tenant(gw, "victim", n, concurrency=1)
+        assert all(o == 200 for o in out), out  # zero failures/hangs
+        return _p99(lat)
+
+    async def hog_pressure(gw, stop):
+        """~10x the hog's fair share kept permanently in flight, total
+        attempt rate ~2x the engine's saturation (the acceptance
+        criterion's load shape).  A throttled (429) attempt backs off
+        like a real retrying client — without the backoff the refusals
+        spin the event loop hot and the measurement prices CPU
+        starvation, not queueing."""
+        async def one():
+            while not stop.is_set():
+                with qos_scope("hog", None):
+                    resp = await gw.predict(msg())
+                st = resp.status
+                if st is not None and st.status == "FAILURE":
+                    # 16 tasks x 10 attempts/s = ~160/s = 2x the
+                    # engine's 80/s capacity
+                    await asyncio.sleep(0.1)
+        tasks = [asyncio.create_task(one()) for _ in range(4 * CAP)]
+        await stop.wait()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def arm(tenancy_on):
+        engine = ThrottledEngine(
+            EngineService(spec, "p"), concurrency=CAP, delay_s=DELAY)
+        # hog budget ~1 of the 4 slots (rate x service = 20/s x 50 ms =
+        # 1 in service, burst 2): its EXCESS is refused at admission,
+        # so the victim nearly always finds a free slot — the bucket,
+        # not the queue, is what holds the 1.5x bound
+        gw = _fair_gateway(engine, rate=20.0, burst=2.0,
+                           fair_inflight=CAP)
+        try:
+            await victim_run(gw, n=3)  # jit warmup: compile off the clock
+            solo = await victim_run(gw, n=20)
+            stop = asyncio.Event()
+            hog = asyncio.create_task(hog_pressure(gw, stop))
+            await asyncio.sleep(8 * DELAY)  # hog saturates the engine
+            contended = await victim_run(gw, n=30)
+            stop.set()
+            await hog
+            return solo, contended
+        finally:
+            await gw.close()
+
+    async def run():
+        import os
+
+        # best-of-5 like the TTFT gate's best-of-3, with more headroom:
+        # deep in the tier-1 run the process carries every prior test's
+        # global telemetry state, so a scheduling spike on the 2-core CI
+        # box can inflate one p99 sample by 100+ ms; a REAL fairness
+        # regression (broken bucket/fair queue: 5-10x, see the demo's
+        # kill-switch arm) fails every attempt
+        solo = contended = bound = None
+        for _attempt in range(5):
+            solo, contended = await arm(tenancy_on=True)
+            # the headline bound: <= 1.5x the solo baseline (floor
+            # absorbs scheduler noise relative to the service time)
+            bound = 1.5 * max(solo, DELAY)
+            if contended <= bound:
+                break
+        assert contended <= bound, (
+            f"victim p99 {contended * 1e3:.1f} ms exceeds 1.5x solo "
+            f"baseline {solo * 1e3:.1f} ms under a 10x hog"
+        )
+        # contrast arm: same load, tenancy killed — the hog's FIFO
+        # backlog (5*CAP in flight at CAP slots) starves the victim
+        os.environ["SELDON_TPU_TENANCY"] = "0"
+        try:
+            _solo_off, contended_off = await arm(tenancy_on=False)
+        finally:
+            os.environ.pop("SELDON_TPU_TENANCY", None)
+        assert contended_off > contended * 1.5, (
+            f"kill-switch arm should starve the victim "
+            f"(got {contended_off * 1e3:.1f} ms vs fair "
+            f"{contended * 1e3:.1f} ms)"
+        )
+
+    asyncio.run(run())
+
+
+def test_brownout_stages_engage_and_revert_in_order_under_queue_growth():
+    """The ladder driven by a REAL depth signal (a registered queue
+    gauge): stages engage 1 -> 2 -> 3 as the queue grows, revert
+    3 -> 2 -> 1 -> 0 after it drains, every transition typed and in
+    order."""
+    from seldon_core_tpu.runtime.brownout import BrownoutController
+
+    clock = [0.0]
+    depth = [0]
+    b = BrownoutController(burn_fn=lambda: None, now_fn=lambda: clock[0],
+                           enter_depth=10.0, dwell_s=0.0, revert_s=5.0,
+                           tick_interval_s=0.0)
+    b.register_depth("queue", lambda: depth[0])
+    seen = []
+    for t, d in ((0, 2), (1, 15), (2, 45), (3, 90), (4, 90)):
+        clock[0], depth[0] = t, d
+        seen.append(b.tick())
+    assert seen == [0, 1, 2, 3, 3]
+    depth[0] = 0
+    for t in (5, 11, 17, 23, 29):
+        clock[0] = t
+        seen.append(b.tick())
+    assert seen[-1] == 0
+    moves = [(tr.from_stage, tr.to_stage) for tr in b.transitions]
+    assert moves == [(0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]

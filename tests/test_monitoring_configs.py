@@ -144,5 +144,12 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_autopilot_shed_total",
         "seldon_tpu_autopilot_mispredict_pct",
         "seldon_tpu_autopilot_keys",
+        # multi-tenant QoS + brownout ladder (runtime/qos.py +
+        # runtime/brownout.py)
+        "seldon_tpu_tenant_requests_total",
+        "seldon_tpu_tenant_throttled_total",
+        "seldon_tpu_brownout_stage",
+        "seldon_tpu_brownout_shed_total",
+        "seldon_tpu_brownout_transitions_total",
     ):
         assert family in text, f"{family} missing from every dashboard"

@@ -1,0 +1,982 @@
+"""Multi-tenant QoS (runtime/qos.py), brownout ladder
+(runtime/brownout.py), genserver tier lanes / bounded admission, and
+predictive scale-ahead (operator/scaleahead.py + reconciler wiring).
+
+Unit contracts are deterministic (injected clocks/signals); the
+end-to-end overload fairness arm lives in tests/test_chaos.py."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.messages import LoadShedError, SeldonMessage
+from seldon_core_tpu.runtime.brownout import (
+    BROWNOUT,
+    BROWNOUT_INFO_PREFIX,
+    BrownoutController,
+    STAGE_NAMES,
+)
+from seldon_core_tpu.runtime.qos import (
+    THROTTLE_INFO_PREFIX,
+    TIER_BATCH,
+    TIER_INTERACTIVE,
+    TIER_OFFLINE,
+    TenantGovernor,
+    TokenBucket,
+    current_tenant,
+    current_tier,
+    parse_tier,
+    qos_scope,
+    resolve_tenant,
+    tier_rank,
+)
+from seldon_core_tpu.utils.telemetry import RECORDER, TPU_METRIC_FAMILIES
+
+N_FEATURES = 4
+
+
+# ---------------------------------------------------------------------------
+# identity + token buckets
+# ---------------------------------------------------------------------------
+
+
+def test_tier_parsing_and_ranking():
+    assert parse_tier(None) == TIER_INTERACTIVE
+    assert parse_tier(" Batch ") == TIER_BATCH
+    assert parse_tier("offline") == TIER_OFFLINE
+    # unknown tiers degrade to interactive, never to deprioritization
+    assert parse_tier("premium++") == TIER_INTERACTIVE
+    assert tier_rank(TIER_INTERACTIVE) < tier_rank(TIER_BATCH) \
+        < tier_rank(TIER_OFFLINE)
+
+
+def test_resolve_tenant_header_then_principal_then_anon():
+    assert resolve_tenant("acme", "key") == "acme"
+    assert resolve_tenant(None, "key") == "key"
+    assert resolve_tenant("  ", None) == "anon"
+    assert len(resolve_tenant("x" * 500, None)) == 64  # bounded width
+
+
+def test_qos_scope_binds_and_restores():
+    assert current_tenant() is None
+    with qos_scope("t1", "batch"):
+        assert current_tenant() == "t1"
+        assert current_tier() == TIER_BATCH
+    assert current_tenant() is None
+    assert current_tier() == TIER_INTERACTIVE
+
+
+def test_token_bucket_hand_math():
+    b = TokenBucket(rate=2.0, burst=4.0, now=0.0)
+    # starts full: 4 immediate takes pass, the 5th fails
+    assert all(b.take(1, now=0.0) for _ in range(4))
+    assert not b.take(1, now=0.0)
+    # 1 second at 2/s refills 2 tokens
+    assert b.take(1, now=1.0) and b.take(1, now=1.0)
+    assert not b.take(1, now=1.0)
+    # unlimited bucket never refuses
+    assert all(TokenBucket(0, 0).take(1) for _ in range(100))
+
+
+# ---------------------------------------------------------------------------
+# governor: buckets, LRU bound, weighted fair queue
+# ---------------------------------------------------------------------------
+
+
+def test_governor_throttles_over_rate_and_accounts():
+    clock = [0.0]
+    g = TenantGovernor(rate=1.0, burst=2.0, fair_inflight=0,
+                       now_fn=lambda: clock[0])
+    assert g.admit("hog", TIER_INTERACTIVE) is None
+    assert g.admit("hog", TIER_INTERACTIVE) is None
+    assert g.admit("hog", TIER_INTERACTIVE) == "rate"
+    assert g.admit("victim", TIER_INTERACTIVE) is None  # independent bucket
+    snap = g.snapshot()
+    assert snap["tenants"]["hog"]["throttled"] == 1
+    assert snap["tenants"]["hog"]["requests"] == 3
+    assert snap["tenants"]["victim"]["throttled"] == 0
+
+
+def test_governor_kill_switch_admits_everything(monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_TENANCY", "0")
+    g = TenantGovernor(rate=1.0, burst=1.0, fair_inflight=0)
+    assert all(g.admit("hog", TIER_INTERACTIVE) is None for _ in range(50))
+
+
+def test_governor_lru_bounds_tenant_table():
+    g = TenantGovernor(rate=0, burst=0, fair_inflight=0)
+    for i in range(g.MAX_TENANTS + 40):
+        g.admit(f"spray-{i}", TIER_INTERACTIVE)
+    snap = g.snapshot()
+    assert snap["tenants_tracked"] == g.MAX_TENANTS
+    assert snap["evicted"] == 40
+    # the most recent ids survived, the oldest were recycled
+    assert f"spray-{g.MAX_TENANTS + 39}" in snap["tenants"]
+    assert "spray-0" not in snap["tenants"]
+
+
+def test_fair_queue_victim_jumps_hog_backlog():
+    """SFQ ordering: with the hog holding the slot and three more hog
+    requests queued, a newly arriving victim request is granted FIRST on
+    release — its virtual clock is behind the hog's."""
+
+    async def run():
+        g = TenantGovernor(rate=0, burst=0, fair_inflight=1)
+        order = []
+
+        held = g.slot("hog")
+        await held.__aenter__()
+
+        async def worker(name, tenant):
+            async with g.slot(tenant):
+                order.append(name)
+
+        tasks = [asyncio.create_task(worker(f"hog-{i}", "hog"))
+                 for i in range(3)]
+        await asyncio.sleep(0)  # hog backlog enqueues first
+        tasks.append(asyncio.create_task(worker("victim", "victim")))
+        await asyncio.sleep(0)
+        assert g.queue_depth() == 4
+        await held.__aexit__(None, None, None)
+        await asyncio.gather(*tasks)
+        assert order[0] == "victim"
+        assert sorted(order[1:]) == ["hog-0", "hog-1", "hog-2"]
+
+    asyncio.run(run())
+
+
+def test_fair_slot_is_inert_when_disabled():
+    async def run():
+        g = TenantGovernor(rate=0, burst=0, fair_inflight=0)
+        async with g.slot("anyone"):
+            assert g.queue_depth() == 0
+            assert g._inflight == 0  # no accounting at all: pass-through
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+
+def _controller(burn, clock, **kw):
+    kw.setdefault("enter_burn", 2.0)
+    kw.setdefault("enter_depth", 100.0)
+    kw.setdefault("dwell_s", 0.0)
+    kw.setdefault("revert_s", 10.0)
+    kw.setdefault("tick_interval_s", 0.0)
+    return BrownoutController(
+        burn_fn=lambda: burn[0], now_fn=lambda: clock[0], **kw)
+
+
+def test_brownout_engages_and_reverts_in_order():
+    burn, clock = [0.0], [0.0]
+    b = _controller(burn, clock)
+    assert b.tick() == 0
+    # pressure 8x (burn 16 / enter 2) -> severity 3, but the ladder
+    # climbs ONE stage per tick
+    burn[0] = 16.0
+    stages = []
+    for t in (1.0, 2.0, 3.0, 4.0):
+        clock[0] = t
+        stages.append(b.tick())
+    assert stages == [1, 2, 3, 3]
+    # calm: severity 0, each step down needs its own revert hold
+    burn[0] = 0.0
+    down = []
+    for t in (5.0, 15.0, 15.5, 25.0, 35.0, 45.0):
+        clock[0] = t
+        down.append(b.tick())
+    assert down == [3, 2, 2, 1, 0, 0]
+    moves = [(tr.from_stage, tr.to_stage) for tr in b.transitions]
+    assert moves == [(0, 1), (1, 2), (2, 3), (3, 2), (2, 1), (1, 0)]
+    # transitions are typed and serializable
+    doc = b.snapshot()
+    assert doc["transitions"][-1]["to_name"] == STAGE_NAMES[0]
+
+
+def test_brownout_dwell_blocks_instant_ladder_climb():
+    burn, clock = [16.0], [0.0]
+    b = _controller(burn, clock, dwell_s=5.0)
+    assert b.tick() == 1          # 0 -> 1 is immediate (engage fast)
+    clock[0] = 1.0
+    assert b.tick() == 1          # dwell holds stage 2 back
+    clock[0] = 6.0
+    assert b.tick() == 2
+
+
+def test_brownout_severity_scales_with_pressure():
+    burn, clock = [0.0], [0.0]
+    b = _controller(burn, clock)
+    burn[0] = 2.0                 # pressure exactly 1x -> stage 1 only
+    clock[0] = 1.0
+    assert b.tick() == 1
+    clock[0] = 2.0
+    assert b.tick() == 1          # severity 1 == stage: no climb
+
+
+def test_brownout_depth_signal_and_registry():
+    burn, clock = [0.0], [0.0]
+    b = _controller(burn, clock, enter_depth=10.0)
+    depth = [0]
+    b.register_depth("q", lambda: depth[0])
+    assert b.tick() == 0
+    depth[0] = 25                 # pressure 2.5x -> climbs
+    clock[0] = 1.0
+    assert b.tick() == 1
+    b.unregister_depth("q")
+    depth[0] = 1000               # unregistered: signal gone, calm
+    burn[0] = 0.0
+    clock[0] = 12.0
+    assert b.tick() in (0, 1)     # no escalation without the signal
+
+
+def test_brownout_fail_closed_on_dead_signals():
+    """A raising burn feed must not escalate (and must count the
+    outage); sustained signal loss REVERTS — a telemetry bug must not
+    hold the system degraded."""
+    clock = [0.0]
+
+    def boom():
+        raise RuntimeError("scrape down")
+
+    b = BrownoutController(burn_fn=boom, now_fn=lambda: clock[0],
+                           dwell_s=0.0, revert_s=5.0,
+                           tick_interval_s=0.0)
+    assert b.tick() == 0
+    assert b.signals_unavailable == 1
+    # force a degraded state, then kill the signals: reverts on the hold
+    b._stage = 2
+    clock[0] = 1.0
+    b.tick()
+    clock[0] = 7.0
+    assert b.tick() == 1
+    clock[0] = 13.0
+    assert b.tick() == 0
+
+
+def test_brownout_kill_switch_neutralizes_effects(monkeypatch):
+    burn, clock = [100.0], [0.0]
+    b = _controller(burn, clock)
+    for t in (1.0, 2.0, 3.0):
+        clock[0] = t
+        b.tick()
+    assert b._stage == 3
+    monkeypatch.setenv("SELDON_TPU_BROWNOUT", "0")
+    assert b.stage() == 0
+    assert not b.sheds_tier(TIER_OFFLINE)
+    assert b.gen_max_new_scale() == 1.0
+    assert b.shed_margin_scale() == 1.0
+    assert not b.gen_chunk_floor()
+
+
+def test_brownout_effect_matrix():
+    burn, clock = [0.0], [0.0]
+    b = _controller(burn, clock)
+    for stage, (off, bat, scale_lt_1, margin_lt_1) in {
+        0: (False, False, False, False),
+        1: (True, False, False, False),
+        2: (True, False, True, False),
+        3: (True, True, True, True),
+    }.items():
+        b._stage = stage
+        assert b.sheds_tier(TIER_OFFLINE) is off
+        assert b.sheds_tier(TIER_BATCH) is bat
+        assert b.sheds_tier(TIER_INTERACTIVE) is False  # never
+        assert (b.gen_max_new_scale() < 1.0) is scale_lt_1
+        assert (b.shed_margin_scale() < 1.0) is margin_lt_1
+
+
+# ---------------------------------------------------------------------------
+# genserver: bounded admission + tier lanes
+# ---------------------------------------------------------------------------
+
+
+def _stub_server(max_waiting=None, monkeypatch=None):
+    """A GenServer whose worker thread never starts: submits park in the
+    arrival queue, so admission-queue behaviour is directly observable
+    with no device in the loop."""
+    from seldon_core_tpu.models.transformer import LMConfig
+    from seldon_core_tpu.runtime.genserver import GenServer
+
+    if max_waiting is not None and monkeypatch is not None:
+        monkeypatch.setenv("SELDON_TPU_GEN_MAX_WAITING", str(max_waiting))
+    import jax.numpy as jnp
+
+    cfg = LMConfig(vocab=32, d_model=8, n_heads=2, n_layers=1, d_ff=16,
+                   dtype=jnp.float32)
+    srv = GenServer(None, cfg, max_new_tokens=4, num_blocks=8)
+    srv._ensure_thread = lambda: None  # park everything in _arrivals
+    return srv
+
+
+def test_genserver_bounded_queue_sheds_typed_and_stays_flat(monkeypatch):
+    srv = _stub_server(max_waiting=4, monkeypatch=monkeypatch)
+    try:
+        for _ in range(4):
+            srv.submit(np.zeros((1, 4)))
+        before = len(srv._arrivals)
+        # sustained overload: every further submit is a typed, retryable
+        # refusal and the queue NEVER grows — flat memory, 503s, no OOM
+        from seldon_core_tpu.runtime.autopilot import SHED_INFO_PREFIX
+
+        for _ in range(200):
+            with pytest.raises(LoadShedError) as ei:
+                srv.submit(np.zeros((1, 4)))
+            assert "admission queue full" in str(ei.value)
+            # the shed prefix is the wire contract: without it the
+            # gateway counts this backpressure as a replica fault and
+            # feeds the ~1 ms refusal into the routing EWMA
+            assert str(ei.value).startswith(SHED_INFO_PREFIX)
+        assert len(srv._arrivals) == before == 4
+        assert srv.snapshot()["waiting_sequences"] == 4
+    finally:
+        srv.stop()
+
+
+def test_genserver_tier_rides_request_and_orders_admission(monkeypatch):
+    srv = _stub_server(max_waiting=0, monkeypatch=monkeypatch)
+    try:
+        srv.submit(np.zeros((1, 4)), tier=TIER_OFFLINE)
+        srv.submit(np.zeros((1, 4)), tier=TIER_BATCH)
+        with qos_scope("t", TIER_INTERACTIVE):
+            srv.submit(np.zeros((1, 4)))  # tier from context
+        srv._waiting.extend(srv._arrivals)
+        srv._arrivals.clear()
+        idx = srv._next_waiting_index()
+        assert srv._waiting[idx].request.tier == TIER_INTERACTIVE
+        del srv._waiting[idx]
+        assert srv._waiting[srv._next_waiting_index()].request.tier \
+            == TIER_BATCH
+    finally:
+        srv.stop()
+
+
+def test_genserver_victim_pick_prefers_lower_tiers(monkeypatch):
+    from seldon_core_tpu.runtime.genserver import GenRequest, _Sequence
+
+    srv = _stub_server(monkeypatch=monkeypatch)
+    try:
+        def seq(sid, tier, order):
+            req = GenRequest(1, None, 4, tier=tier)
+            s = _Sequence(sid, req, 0, np.zeros(4, np.int32), 4)
+            s.admit_order = order
+            return s
+
+        inter_old = seq(1, TIER_INTERACTIVE, 1)
+        inter_young = seq(2, TIER_INTERACTIVE, 9)
+        batch_old = seq(3, TIER_BATCH, 2)
+        offline_oldest = seq(4, TIER_OFFLINE, 0)
+        srv._active = [inter_old, inter_young, batch_old, offline_oldest]
+        # lowest tier evicts first even though it is the OLDEST
+        assert srv._pick_victim(exclude=inter_old) is offline_oldest
+        srv._active.remove(offline_oldest)
+        assert srv._pick_victim(exclude=inter_old) is batch_old
+        srv._active.remove(batch_old)
+        # within a tier: youngest, the pre-existing rule
+        assert srv._pick_victim(exclude=inter_old) is inter_young
+    finally:
+        srv.stop()
+
+
+def test_genserver_brownout_sheds_tier_and_clamps_max_new(monkeypatch):
+    srv = _stub_server(monkeypatch=monkeypatch)
+    try:
+        BROWNOUT._stage = 1
+        with pytest.raises(LoadShedError) as ei:
+            srv.submit(np.zeros((1, 4)), tier=TIER_OFFLINE)
+        assert str(ei.value).startswith(BROWNOUT_INFO_PREFIX)
+        # stage 2: interactive still admitted, but max_new halves
+        BROWNOUT._stage = 2
+        req = srv.submit(np.zeros((1, 4)), max_new=10)
+        assert req.max_new == 5
+        BROWNOUT._stage = 0
+        req2 = srv.submit(np.zeros((1, 4)), max_new=10)
+        assert req2.max_new == 10
+    finally:
+        BROWNOUT.reset()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher tier lanes
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_interactive_preempts_lower_tier_for_flush_slot():
+    """With one dispatch slot busy and both an offline and an
+    interactive request queued, the freed slot serves interactive first
+    — regardless of arrival order."""
+    from seldon_core_tpu.runtime.batching import MicroBatcher
+
+    async def run():
+        order = []
+        release = asyncio.Event()
+
+        async def batch_fn(x):
+            if x[0, 0] == 0:     # the blocker
+                await release.wait()
+            else:
+                order.append(int(x[0, 0]))
+            return x, {}
+
+        mb = MicroBatcher(batch_fn, max_inflight=1, coalesce_ms=0.0)
+        blocker = asyncio.create_task(mb.submit(np.zeros((1, 2))))
+        await asyncio.sleep(0.02)  # blocker owns the only slot
+        with qos_scope(None, TIER_OFFLINE):
+            offline = asyncio.create_task(
+                mb.submit(np.full((1, 2), 2.0)))
+        await asyncio.sleep(0.02)  # offline queued first
+        interactive = asyncio.create_task(mb.submit(np.full((1, 2), 1.0)))
+        await asyncio.sleep(0.02)
+        release.set()
+        await asyncio.gather(blocker, offline, interactive)
+        assert order == [1, 2]   # interactive jumped the offline queue
+
+    asyncio.run(run())
+
+
+def test_batcher_tiers_never_co_stack():
+    """Same shape, different tiers -> separate buckets (separate
+    dispatches), so batch-tier rows never ride an interactive flush."""
+    from seldon_core_tpu.runtime.batching import MicroBatcher
+
+    async def run():
+        batches = []
+
+        async def batch_fn(x):
+            batches.append(len(x))
+            return x, {}
+
+        mb = MicroBatcher(batch_fn, max_inflight=1, coalesce_ms=5.0)
+
+        async def one(tier):
+            with qos_scope(None, tier):
+                return await mb.submit(np.ones((1, 2)))
+
+        await asyncio.gather(one(TIER_INTERACTIVE), one(TIER_BATCH))
+        assert sorted(batches) == [1, 1]  # two buckets, not one stack
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# gateway integration
+# ---------------------------------------------------------------------------
+
+
+def _spec(name="qos-dep"):
+    from seldon_core_tpu.graph.defaulting import default_and_validate
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": name, "oauth_key": "k", "oauth_secret": "s",
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        }
+    })
+    default_and_validate(spec)
+    return spec
+
+
+def _gateway(spec, engine=None, **gov_kw):
+    from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+    from seldon_core_tpu.runtime.engine import EngineService
+
+    store = DeploymentStore()
+    store.register(spec, {"p": engine or EngineService(spec, "p")})
+    gw = ApiGateway(store=store, require_auth=False)
+    if gov_kw:
+        gw.tenants = TenantGovernor(**gov_kw)
+    return gw
+
+
+def _msg():
+    return SeldonMessage.from_array(np.zeros((1, N_FEATURES)))
+
+
+def test_gateway_throttles_hog_tenant_with_typed_429():
+    async def run():
+        gw = _gateway(_spec(), rate=1.0, burst=1.0, fair_inflight=0)
+        try:
+            with qos_scope("hog", None):
+                ok = await gw.predict(_msg())
+                throttled = await gw.predict(_msg())
+            assert ok.status.status == "SUCCESS"
+            assert throttled.status.status == "FAILURE"
+            assert throttled.status.code == 429
+            assert throttled.status.info.startswith(THROTTLE_INFO_PREFIX)
+            # a different tenant is untouched by the hog's dry bucket
+            with qos_scope("victim", None):
+                assert (await gw.predict(_msg())).status.status == "SUCCESS"
+            snap = gw.stats()["tenants"]["tenants"]
+            assert snap["hog"]["throttled"] == 1
+            assert snap["victim"]["throttled"] == 0
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_tenancy_kill_switch_never_throttles(monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_TENANCY", "0")
+
+    async def run():
+        gw = _gateway(_spec(), rate=1.0, burst=1.0, fair_inflight=0)
+        try:
+            with qos_scope("hog", None):
+                for _ in range(5):
+                    r = await gw.predict(_msg())
+                    assert r.status.status == "SUCCESS"
+        finally:
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_brownout_sheds_lower_tiers_only():
+    async def run():
+        gw = _gateway(_spec())
+        BROWNOUT._stage = 1
+        try:
+            with qos_scope("t", TIER_OFFLINE):
+                shed = await gw.predict(_msg())
+            assert shed.status.code == 503
+            assert shed.status.info.startswith(BROWNOUT_INFO_PREFIX)
+            with qos_scope("t", TIER_BATCH):
+                assert (await gw.predict(_msg())).status.status == "SUCCESS"
+            BROWNOUT._stage = 3
+            with qos_scope("t", TIER_BATCH):
+                assert (await gw.predict(_msg())).status.code == 503
+            with qos_scope("t", TIER_INTERACTIVE):
+                assert (await gw.predict(_msg())).status.status == "SUCCESS"
+        finally:
+            BROWNOUT.reset()
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_gateway_threads_tenant_into_quality_and_firehose():
+    from seldon_core_tpu.gateway.firehose import Firehose
+    from seldon_core_tpu.utils.quality import QUALITY
+
+    async def run():
+        lines = []
+        fh = Firehose(sink=lambda dep, event: lines.append(event))
+        gw = _gateway(_spec())
+        gw.firehose = fh
+        QUALITY.reset()
+        try:
+            fh.start()
+            with qos_scope("acme", TIER_BATCH):
+                await gw.predict(_msg())
+            await asyncio.sleep(0.05)  # firehose drains off-path
+            assert lines and lines[0]["tenant"] == "acme"
+            assert lines[0]["tier"] == TIER_BATCH
+            # per-tenant SLO ring exists on the /quality document
+            doc = QUALITY.document()
+            assert "acme" in doc["tenant_slo"]
+            assert "5m" in doc["tenant_slo"]["acme"]
+        finally:
+            QUALITY.reset()
+            await gw.close()
+
+    asyncio.run(run())
+
+
+def test_quality_tenant_rings_are_lru_bounded():
+    from seldon_core_tpu.utils.quality import QUALITY
+
+    QUALITY.reset()
+    try:
+        for i in range(QUALITY.MAX_TENANTS + 20):
+            QUALITY.record_tenant_request(f"t{i}", 0.01)
+        block = QUALITY.tenant_slo_block()
+        assert len(block) == QUALITY.MAX_TENANTS
+        assert "t0" not in block
+        assert f"t{QUALITY.MAX_TENANTS + 19}" in block
+        # the per-tenant rings only carry windows their horizon covers
+        assert list(block[f"t{QUALITY.MAX_TENANTS + 19}"]) == ["5m"]
+    finally:
+        QUALITY.reset()
+
+
+# ---------------------------------------------------------------------------
+# predictive scale-ahead
+# ---------------------------------------------------------------------------
+
+
+def test_planner_forecast_hand_math():
+    from seldon_core_tpu.operator.scaleahead import ScaleAheadPlanner
+
+    p = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    # load 0 at t=0, 10 at t=10: slope exactly 1/s
+    p.observe("d", queue_depth=0, now=0.0)
+    p.observe("d", queue_depth=10, now=10.0)
+    fc = p.forecast("d", horizon_s=30.0, now=10.0)
+    assert fc["slope_per_s"] == pytest.approx(1.0)
+    assert fc["current"] == 10.0
+    assert fc["predicted"] == pytest.approx(40.0)
+    # single sample: no trend, forecast = last observation
+    p2 = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    p2.observe("d", queue_depth=7, now=0.0)
+    assert p2.forecast("d", 300.0)["predicted"] == 7.0
+
+
+def test_planner_scales_out_ahead_of_burn_and_gates_scale_in():
+    from seldon_core_tpu.operator.scaleahead import (
+        AutoscalePolicy,
+        ScaleAheadPlanner,
+    )
+
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=8,
+                             target_inflight=4.0, horizon_s=100.0)
+    p = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    p.observe("d", queue_depth=2, now=0.0)
+    p.observe("d", queue_depth=6, now=10.0)  # +0.4/s -> 46 at +100s
+    d = p.desired_replicas("d", 1, policy)
+    assert d["desired_replicas"] == 8  # ceil(46/4)=12, clamped to max
+    assert d["reason"] == "queue-growth forecast"
+    # load recedes -> scale-in ... unless a rollout is active
+    p2 = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    p2.observe("d", queue_depth=2, now=0.0)
+    p2.observe("d", queue_depth=2, now=10.0)
+    gated = p2.desired_replicas("d", 6, policy, rollout_active=True)
+    assert gated["desired_replicas"] == 6
+    assert gated["reason"] == "scale-in rollout-gated"
+    free = p2.desired_replicas("d", 6, policy, rollout_active=False)
+    assert free["desired_replicas"] == 1
+    assert free["reason"] == "load receded"
+
+
+def test_planner_holds_fleet_on_missing_load_signal():
+    """No samples = no signal, not 'idle': an operator restart or a dead
+    scrape feed must hold the fleet at its current size, never write it
+    down to min_replicas mid-overload."""
+    from seldon_core_tpu.operator.scaleahead import (
+        AutoscalePolicy,
+        ScaleAheadPlanner,
+    )
+
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=8,
+                             target_inflight=4.0, horizon_s=300.0)
+    p = ScaleAheadPlanner(now_fn=lambda: 0.0)  # fresh: zero samples
+    d = p.desired_replicas("d", 8, policy)
+    assert d["desired_replicas"] == 8
+    assert d["reason"] == "no load signal (hold)"
+
+
+def test_planner_scale_in_hysteresis_holds_at_the_boundary():
+    from seldon_core_tpu.operator.scaleahead import (
+        AutoscalePolicy,
+        ScaleAheadPlanner,
+    )
+
+    policy = AutoscalePolicy(target_inflight=4.0, horizon_s=10.0,
+                             max_replicas=8)
+    p = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    # steady load 7.0: want = ceil(7/4) = 2, but 2 replicas' margin
+    # capacity is 2*4*0.85 = 6.8 < 7 -> hold the 3rd replica
+    p.observe("d", queue_depth=7, now=0.0)
+    p.observe("d", queue_depth=7, now=10.0)
+    d = p.desired_replicas("d", 3, policy)
+    assert d["desired_replicas"] == 3
+    assert d["reason"] == "scale-in hysteresis"
+
+
+def test_reconciler_writes_replicas_ahead_of_burn():
+    from seldon_core_tpu.operator.reconciler import FakeKubeApi, Reconciler
+    from seldon_core_tpu.operator.scaleahead import ScaleAheadPlanner
+
+    planner = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    api = FakeKubeApi()
+    rec = Reconciler(api, autoscaler=planner)
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "dep", "namespace": "default"},
+        "spec": {
+            "name": "dep",
+            "annotations": {
+                "seldon.io/autoscale": "true",
+                "seldon.io/autoscale-max": "6",
+                "seldon.io/autoscale-target-inflight": "4",
+            },
+            "predictors": [{
+                "name": "p", "replicas": 1,
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        },
+    }
+    api.create(cr)
+    for t, load in ((0.0, 2), (10.0, 10), (20.0, 20)):
+        planner.observe("dep", queue_depth=load, now=t)
+    rec.reconcile(api.get("SeldonDeployment", "default", "dep"))
+    dep = api.get("Deployment", "default", "dep-p-engine")
+    assert dep["spec"]["replicas"] == 6  # written BEFORE any burn
+    status = api.get("SeldonDeployment", "default", "dep")["status"]
+    assert status["autoscale"]["decisions"][0]["reason"] \
+        == "queue-growth forecast"
+    # steady state: a second reconcile with the same forecast is
+    # convergent (hash unchanged -> no Deployment writes)
+    api.clear_ops()
+    rec.reconcile(api.get("SeldonDeployment", "default", "dep"))
+    assert not any(op == "replace" and "Deployment" in ident
+                   for op, ident in api.ops)
+
+
+def test_reconciler_malformed_autoscale_annotation_fails_cr():
+    from seldon_core_tpu.operator.reconciler import FakeKubeApi, Reconciler
+    from seldon_core_tpu.operator.scaleahead import ScaleAheadPlanner
+
+    api = FakeKubeApi()
+    rec = Reconciler(api, autoscaler=ScaleAheadPlanner())
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "bad", "namespace": "default"},
+        "spec": {
+            "name": "bad",
+            "annotations": {"seldon.io/autoscale": "true",
+                            "seldon.io/autoscale-min": "zero"},
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        },
+    }
+    api.create(cr)
+    out = rec.reconcile(api.get("SeldonDeployment", "default", "bad"))
+    assert out.get("failed") == 1
+    status = api.get("SeldonDeployment", "default", "bad")["status"]
+    assert status["state"] == "Failed"
+    assert "autoscale" in status["description"]
+
+
+def test_reconciler_without_autoscaler_is_unchanged():
+    from seldon_core_tpu.operator.reconciler import FakeKubeApi, Reconciler
+
+    api = FakeKubeApi()
+    rec = Reconciler(api)
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "plain", "namespace": "default"},
+        "spec": {
+            "name": "plain",
+            "annotations": {"seldon.io/autoscale": "true"},
+            "predictors": [{
+                "name": "p", "replicas": 2,
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        },
+    }
+    api.create(cr)
+    rec.reconcile(api.get("SeldonDeployment", "default", "plain"))
+    dep = api.get("Deployment", "default", "plain-p-engine")
+    assert dep["spec"]["replicas"] == 2  # spec copied verbatim, as ever
+
+
+# ---------------------------------------------------------------------------
+# telemetry surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_qos_metric_families_are_exported():
+    for family in (
+        "seldon_tpu_tenant_requests_total",
+        "seldon_tpu_tenant_throttled_total",
+        "seldon_tpu_brownout_stage",
+        "seldon_tpu_brownout_transitions_total",
+        "seldon_tpu_brownout_shed_total",
+    ):
+        assert family in TPU_METRIC_FAMILIES
+    RECORDER.record_tenant_request("fam-test")
+    RECORDER.record_tenant_throttled("fam-test")
+    RECORDER.set_brownout_stage(2)
+    RECORDER.record_brownout_shed("offline")
+    try:
+        snap = RECORDER.snapshot()["qos"]
+        assert snap["tenant_requests"]["fam-test"] >= 1
+        assert snap["brownout_stage"] == 2
+        text = RECORDER.exposition().decode()
+        if text:  # prometheus_client installed
+            assert "seldon_tpu_brownout_stage 2.0" in text
+            assert 'seldon_tpu_tenant_throttled_total{tenant="fam-test"}' \
+                in text
+    finally:
+        RECORDER.set_brownout_stage(0)
+
+
+def test_brownout_kill_switch_quiets_operator_accounting(monkeypatch):
+    """With SELDON_TPU_BROWNOUT=0 the internal ladder may still move
+    (re-enable resumes live) but the Prometheus gauge must read the
+    EFFECTIVE stage (0) — a disabled ladder paging
+    SeldonTPUBrownoutActive while /stats reads 0 is a phantom page."""
+    burn, clock = [100.0], [0.0]
+    b = _controller(burn, clock)
+    monkeypatch.setenv("SELDON_TPU_BROWNOUT", "0")
+    try:
+        for t in (1.0, 2.0, 3.0):
+            clock[0] = t
+            b.tick()
+        assert b._stage == 3          # internal ladder tracked signals
+        assert b.stage() == 0         # effective stage: disabled
+        assert RECORDER.snapshot()["qos"]["brownout_stage"] == 0
+        monkeypatch.delenv("SELDON_TPU_BROWNOUT")
+        clock[0] = 4.0
+        b.tick()                      # re-enabled: gauge goes live
+        assert RECORDER.snapshot()["qos"]["brownout_stage"] == b._stage > 0
+    finally:
+        RECORDER.set_brownout_stage(0)
+
+
+def test_stream_shed_answers_typed_503_not_inband_200():
+    """Genserver admission sheds raise on the stream generator's FIRST
+    step: the REST lane must surface them as a typed retryable 503
+    BEFORE the 200 goes out, never as an error frame inside a 200."""
+    import aiohttp
+
+    from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
+
+    class ShedEngine:
+        def prepare_stream_request(self, payload):
+            return payload, 4
+
+        async def generate_stream(self, text, chunk=4):
+            raise LoadShedError("generation admission queue full (test)")
+            yield  # pragma: no cover - makes this an async generator
+
+    async def run():
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        runner = await serve_app(
+            make_engine_app(ShedEngine()), "127.0.0.1", port)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/generate/stream",
+                    json={"data": {"ndarray": [[1.0]]}},
+                ) as r:
+                    body = await r.json()
+                    assert r.status == 503
+                    assert "queue full" in body["status"]["info"]
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_reconciler_scale_in_judges_live_replicas_not_cr_baseline():
+    """Scale-in decisions compare against the LIVE Deployment's count
+    (the previous autoscale decision), not the re-rendered CR baseline —
+    else a receding load would snap an 8-replica fleet back to the CR's
+    1 in one tick with neither hysteresis nor the rollout gate ever
+    seeing a want < current transition."""
+    from seldon_core_tpu.operator.reconciler import FakeKubeApi, Reconciler
+    from seldon_core_tpu.operator.scaleahead import ScaleAheadPlanner
+
+    class ActiveRollouts:
+        def status_block(self, _dep):
+            return {"state": "running"}
+
+    planner = ScaleAheadPlanner(now_fn=lambda: 0.0)
+    api = FakeKubeApi()
+    rec = Reconciler(api, autoscaler=planner, rollouts=None)
+    cr = {
+        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+        "kind": "SeldonDeployment",
+        "metadata": {"name": "dep", "namespace": "default"},
+        "spec": {
+            "name": "dep",
+            "annotations": {
+                "seldon.io/autoscale": "true",
+                "seldon.io/autoscale-max": "6",
+                "seldon.io/autoscale-target-inflight": "4",
+            },
+            "predictors": [{
+                "name": "p", "replicas": 1,
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        },
+    }
+    api.create(cr)
+    for t, load in ((0.0, 2), (10.0, 10), (20.0, 20)):
+        planner.observe("dep", queue_depth=load, now=t)
+    rec.reconcile(api.get("SeldonDeployment", "default", "dep"))
+    assert api.get("Deployment", "default",
+                   "dep-p-engine")["spec"]["replicas"] == 6
+    # load recedes, a rollout is now active: the fleet must HOLD at the
+    # live 6, not snap back to the CR's rendered 1
+    rec.rollouts = ActiveRollouts()
+    planner.reset()
+    for t in (30.0, 40.0):
+        planner.observe("dep", queue_depth=1, now=t)
+    rec.reconcile(api.get("SeldonDeployment", "default", "dep"))
+    dep = api.get("Deployment", "default", "dep-p-engine")
+    assert dep["spec"]["replicas"] == 6
+    status = api.get("SeldonDeployment", "default", "dep")["status"]
+    assert status["autoscale"]["decisions"][0]["reason"] \
+        == "scale-in rollout-gated"
+
+
+def test_sheds_do_not_burn_the_slo_error_budget():
+    """A policy shed (brownout/autopilot LoadShedError 503) must not
+    count as an SLO error: shed -> error burn -> ladder stays engaged is
+    a self-sustaining latch (the shed traffic would hold the brownout at
+    stage >= 1 forever after the real overload passed)."""
+    from seldon_core_tpu.graph.defaulting import default_and_validate
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.utils.quality import QUALITY
+
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": "shed-slo",
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL"},
+            }],
+        }
+    })
+    default_and_validate(spec)
+    QUALITY.reset()
+    QUALITY.slo.error_rate = 0.01  # error budget configured
+    BROWNOUT._stage = 1
+
+    async def run():
+        engine = EngineService(spec, "p")
+        with qos_scope("t", TIER_OFFLINE):
+            resp = await engine.predict(
+                SeldonMessage.from_array(np.zeros((1, N_FEATURES))))
+        assert resp.status.code == 503
+        assert resp.status.info.startswith(BROWNOUT_INFO_PREFIX)
+
+    try:
+        asyncio.run(run())
+        burn = QUALITY.slo.burn_rates()
+        assert burn["5m"]["error_burn"] == 0.0  # shed != SLO error
+        assert burn["5m"]["requests"] >= 1     # but it WAS observed
+    finally:
+        BROWNOUT.reset()
+        QUALITY.reset()
+        QUALITY.slo.error_rate = None
+
+
+def test_recorder_tenant_label_overflow_cap():
+    for i in range(RECORDER._TENANT_LABEL_CAP + 10):
+        RECORDER.record_tenant_request(f"cap-{i}")
+    snap = RECORDER.snapshot()["qos"]["tenant_requests"]
+    assert len(snap) <= RECORDER._TENANT_LABEL_CAP + 1
+    assert snap.get("overflow", 0) >= 1
