@@ -73,6 +73,15 @@ canary-demo:
 overload-demo:
 	JAX_PLATFORMS=cpu python scripts/overload_demo.py --out overload_demo
 
+# disaggregated-generation demo: 1 prefill + 2 decode CPU replicas,
+# KV blocks streamed over the relay's OP_KVSTREAM lane — proves
+# token-identity vs unified, handoffs visible in /stats + the firehose,
+# the decode-direct typed 503, and the SELDON_TPU_DISAGG=0 kill switch.
+# Artifact disagg_demo/disagg.json (scripts/disagg_demo.py;
+# docs/operations.md "Disaggregated generation")
+disagg-demo:
+	JAX_PLATFORMS=cpu python scripts/disagg_demo.py --out disagg_demo
+
 bench:
 	python bench.py
 
@@ -148,4 +157,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo bench overhead-gate ttft-gate fairness-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo bench overhead-gate ttft-gate fairness-gate demos train-demo stack bundle images publish release-dryrun

@@ -978,6 +978,239 @@ async def _replica_probe_async(urls, uds_path, duration, workers, np):
     }
 
 
+def probe_disagg(smoke: bool) -> dict:
+    """Disaggregated prefill/decode arm (subprocess, CPU engines — this
+    arm prices the PHASE SPLIT and the KV-stream lane, not the device):
+    the same generator served 1×unified vs 1 prefill + 1 decode vs
+    1 prefill + 2 decode, KV blocks streamed over the UDS relay.  A
+    failed arm reports its error instead of aborting the bench."""
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--_probe_disagg"]
+        + (["--smoke"] if smoke else []),
+        capture_output=True, text=True, cwd=REPO, timeout=1800,
+    )
+    if out.returncode != 0:
+        print(f"disagg probe failed: {out.stderr[-2000:]}",
+              file=sys.stderr)
+        return {"disagg_probe_error": (out.stderr or "no output")[-300:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+GEN_CPU_DEPLOYMENT = {
+    "spec": {
+        "name": "bench-disagg",
+        "predictors": [{
+            "name": "main",
+            "graph": {"name": "gen", "type": "MODEL"},
+            "components": [{
+                "name": "gen", "runtime": "inprocess",
+                "class_path": "TransformerGenerator",
+                "parameters": [
+                    {"name": "vocab", "value": "128", "type": "INT"},
+                    {"name": "d_model", "value": "64", "type": "INT"},
+                    {"name": "n_heads", "value": "4", "type": "INT"},
+                    {"name": "n_layers", "value": "2", "type": "INT"},
+                    {"name": "d_ff", "value": "128", "type": "INT"},
+                    {"name": "max_new_tokens", "value": "32",
+                     "type": "INT"},
+                    {"name": "dtype", "value": "float32",
+                     "type": "STRING"},
+                ],
+            }],
+        }],
+    }
+}
+
+
+class _GenCpuEngine:
+    """One CPU generator engine process for the disagg arm — role-aware
+    (--gen-role / decode peers / relay socket for KV imports)."""
+
+    def __init__(self, rest_port: int, role: str = "unified",
+                 uds_path: str = "", decode_peers: str = ""):
+        self.tmp = tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        )
+        json.dump(GEN_CPU_DEPLOYMENT, self.tmp)
+        self.tmp.flush()
+        self.log = tempfile.NamedTemporaryFile(
+            "w+", suffix=".log", delete=False
+        )
+        env = dict(os.environ)
+        env.update({
+            "SELDON_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+            "ENGINE_HTTP_IMPL": "fast", "ENGINE_GRPC_IMPL": "fast",
+            "ENGINE_MAX_BATCH": "64", "ENGINE_BATCH_WAIT_MS": "0.5",
+            # per-role worker threads share the host: keep XLA modest
+            "XLA_FLAGS": env.get("XLA_FLAGS", ""),
+        })
+        if role != "unified":
+            env["ENGINE_GEN_ROLE"] = role
+        if uds_path:
+            env["ENGINE_UDS_PATH"] = uds_path
+        if decode_peers:
+            env["ENGINE_DECODE_PEERS"] = decode_peers
+        self.port = rest_port
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "seldon_core_tpu.runtime.engine_main",
+             "--file", self.tmp.name, "--host", "127.0.0.1",
+             "--rest-port", str(rest_port), "--grpc-port",
+             str(rest_port + 1000)],
+            stdout=self.log, stderr=subprocess.STDOUT, env=env, cwd=REPO,
+        )
+        _register_spawn(self.proc)
+
+    def wait_up(self, timeout_s: float = 180.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with open(self.log.name) as f:
+                text = f.read()
+            if "engine up" in text:
+                return
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"disagg engine died at boot:\n{text}")
+            time.sleep(0.5)
+        raise RuntimeError("disagg engine boot timed out")
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        os.unlink(self.tmp.name)
+
+
+async def _disagg_drive(url: str, requests_n: int, workers: int,
+                        prompt_len: int, max_new: int):
+    """Closed-loop unary generation load; returns (tok_s, wall_s,
+    errors).  Every request is one [1, prompt_len] prompt -> [1,
+    max_new] token row."""
+    import asyncio
+
+    import aiohttp
+
+    payload = json.dumps({
+        "data": {"ndarray": [[(i % 97) + 1 for i in range(prompt_len)]]}
+    })
+    done = {"n": 0, "errors": 0}
+    t0 = time.perf_counter()
+    async with aiohttp.ClientSession() as session:
+        async def worker():
+            while done["n"] + done["errors"] < requests_n:
+                done["n"] += 1  # claim a slot
+                try:
+                    async with session.post(
+                        url + "/api/v0.1/predictions", data=payload,
+                        headers={"Content-Type": "application/json"},
+                        timeout=aiohttp.ClientTimeout(total=300),
+                    ) as r:
+                        body = await r.json(content_type=None)
+                        if r.status != 200 or "data" not in body:
+                            done["n"] -= 1
+                            done["errors"] += 1
+                except Exception:  # noqa: BLE001 - counted, not fatal
+                    done["n"] -= 1
+                    done["errors"] += 1
+
+        await asyncio.gather(*(worker() for _ in range(workers)))
+    wall = time.perf_counter() - t0
+    tok_s = done["n"] * max_new / wall if wall > 0 else 0.0
+    return tok_s, wall, done["errors"]
+
+
+def _disagg_probe_main(smoke: bool) -> None:
+    """Price the disaggregated serving mesh on CPU engines:
+
+      * ``disagg_tok_s_unified``  — 1 unified engine (the PR-7 path)
+      * ``disagg_tok_s_1p1d``     — 1 prefill + 1 decode over the relay
+      * ``disagg_tok_s_1p2d``     — 1 prefill + 2 decode (the
+        separately-scaled decode pool the architecture exists for)
+      * ``disagg_tok_s_scaling``  — 1p2d / 1p1d: >= 1.0 when the host
+        has the cores to run the second decode replica (the curve and
+        ``disagg_host_cores`` document the ceiling otherwise — the PR-8
+        escape-hatch convention)
+      * ``kv_handoff_p50_ms`` / ``kv_handoff_bytes_per_tok`` — scraped
+        off the prefill replica's /stats disagg block.
+    """
+    import asyncio  # noqa: F401 - bound for the driver below
+
+    import urllib.request
+
+    n_requests = 8 if smoke else 48
+    workers = 4 if smoke else 8
+    prompt_len, max_new = 48, 32
+    base_port = 19480
+    uds_dir = tempfile.mkdtemp(prefix="seldon-disagg-")
+    socks = [os.path.join(uds_dir, f"decode{i}.sock") for i in range(2)]
+    doc = {"disagg_host_cores": _host_cores()}
+
+    def measure(engines, target):
+        for e in engines:
+            e.wait_up()
+        # one warmup request compiles the serving executables
+        asyncio.run(_disagg_drive(
+            f"http://127.0.0.1:{target.port}", 1, 1, prompt_len, max_new))
+        tok_s, wall, errors = asyncio.run(_disagg_drive(
+            f"http://127.0.0.1:{target.port}", n_requests, workers,
+            prompt_len, max_new))
+        if errors:
+            raise RuntimeError(f"{errors} failed generation requests")
+        return round(tok_s, 1)
+
+    # -- 1x unified ----------------------------------------------------
+    eng = _GenCpuEngine(base_port)
+    try:
+        doc["disagg_tok_s_unified"] = measure([eng], eng)
+    finally:
+        eng.stop()
+
+    # -- 1 prefill + 1 decode ------------------------------------------
+    d0 = _GenCpuEngine(base_port + 1, role="decode", uds_path=socks[0])
+    p0 = _GenCpuEngine(base_port + 2, role="prefill",
+                       decode_peers=f"uds:{socks[0]}")
+    try:
+        doc["disagg_tok_s_1p1d"] = measure([d0, p0], p0)
+    finally:
+        p0.stop()
+        d0.stop()
+
+    # -- 1 prefill + 2 decode ------------------------------------------
+    d0 = _GenCpuEngine(base_port + 3, role="decode", uds_path=socks[0])
+    d1 = _GenCpuEngine(base_port + 4, role="decode", uds_path=socks[1])
+    p0 = _GenCpuEngine(
+        base_port + 5, role="prefill",
+        decode_peers=f"uds:{socks[0]},uds:{socks[1]}")
+    try:
+        doc["disagg_tok_s_1p2d"] = measure([d0, d1, p0], p0)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{p0.port}/stats", timeout=10
+        ) as r:
+            stats = json.loads(r.read())
+        disagg = (stats.get("genserver") or {}).get("disagg") or {}
+        doc["kv_handoff_p50_ms"] = round(
+            disagg.get("handoff_ms_p50") or 0.0, 2)
+        doc["kv_handoff_bytes_per_tok"] = disagg.get("bytes_per_tok")
+        doc["kv_handoffs"] = disagg.get("handoffs")
+    finally:
+        p0.stop()
+        d0.stop()
+        d1.stop()
+
+    doc["disagg_tok_s_scaling"] = round(
+        doc["disagg_tok_s_1p2d"] / max(doc["disagg_tok_s_1p1d"], 1e-9), 2)
+    doc["disagg_methodology"] = (
+        "CPU generator engines (fast lane), unary generation closed "
+        "loop; prefill replica streams finished KV blocks to decode "
+        "replicas over the UDS relay's OP_KVSTREAM frames; scaling is "
+        "1p+2d over 1p+1d tok/s — on a host with fewer cores than "
+        "replicas the curve documents the host ceiling, not the "
+        "architecture (disagg_host_cores)"
+    )
+    print(json.dumps(doc))
+
+
 def probe_autopilot(smoke: bool) -> dict:
     """Learned cost-model autopilot A/B arm (subprocess, CPU engine —
     this arm measures the DECISION layer, not the device): the same
@@ -2468,6 +2701,13 @@ def main() -> None:
     parser.add_argument("--_probe_spec", action="store_true")
     parser.add_argument("--_probe_replicas", action="store_true")
     parser.add_argument(
+        "--_probe_disagg", action="store_true",
+        help="run only the disaggregated prefill/decode arm (1 unified "
+             "vs 1p+1d vs 1p+2d CPU generator engines, KV blocks "
+             "streamed over the UDS relay) and print its JSON — "
+             "CPU-friendly, no TPU needed",
+    )
+    parser.add_argument(
         "--_probe_autopilot", action="store_true",
         help="run only the learned-cost-model autopilot A/B arm "
              "(autopilot on vs off under a bimodal row-size + "
@@ -2532,6 +2772,9 @@ def main() -> None:
         return
     if args._probe_replicas:
         _replica_probe_main(args.smoke)
+        return
+    if args._probe_disagg:
+        _disagg_probe_main(args.smoke)
         return
     if args._probe_autopilot:
         _autopilot_probe_main(args.smoke)
@@ -2650,6 +2893,14 @@ def main() -> None:
         relay_uds_vs_tcp_x=scale.get("relay_uds_vs_tcp_x"),
         replica_inflight_max_over_mean=scale.get(
             "replica_inflight_max_over_mean"),
+    )
+
+    # ---- disaggregated prefill/decode mesh (CPU; phase-split axis) -------
+    disagg = probe_disagg(args.smoke)
+    emit_partial(
+        disagg_tok_s_scaling=disagg.get("disagg_tok_s_scaling"),
+        kv_handoff_p50_ms=disagg.get("kv_handoff_p50_ms"),
+        kv_handoff_bytes_per_tok=disagg.get("kv_handoff_bytes_per_tok"),
     )
 
     # ---- learned cost-model autopilot A/B (CPU; decision-layer axis) -----
@@ -2771,6 +3022,7 @@ def main() -> None:
         **spec,
         **served_gen,
         **scale,
+        **disagg,
         **autopilot,
         "duration_s": duration,
     }
@@ -2799,6 +3051,10 @@ def main() -> None:
         "relay_uds_p50_ms", "relay_uds_vs_tcp_x",
         "autopilot_goodput_x", "autopilot_shed_precision",
         "autopilot_mispredict_p50_pct",
+        "disagg_tok_s_scaling", "disagg_tok_s_unified",
+        "disagg_tok_s_1p1d", "disagg_tok_s_1p2d",
+        "kv_handoff_p50_ms", "kv_handoff_bytes_per_tok",
+        "disagg_host_cores",
     ]
     compact = {k: result[k] for k in compact_keys if k in result}
     compact["full_artifact"] = "BENCH_FULL.json"

@@ -92,6 +92,13 @@ class AuthError(Exception):
     pass
 
 
+def _not_decode(ep) -> bool:
+    """Client traffic never lands on a decode-only replica — decode
+    replicas serve KV handoffs from prefill peers, nothing else
+    (runtime/servingmesh.py phase routing)."""
+    return getattr(ep, "role", "unified") != "decode"
+
+
 def _release_brownout_sink(sink) -> None:
     """Detach a gateway's firehose event sink from the global brownout
     controller — only if it is still the installed one (a later gateway
@@ -394,7 +401,15 @@ class ApiGateway:
             entry = (reg.engines[idx][0], reg.engines[idx][2])
         name, engine = entry
         rs = self._replica_set(reg, name, engine)
-        endpoint, decision = rs.pick(eligible, rows=rows)
+        # phase-aware routing (runtime/servingmesh.py): decode-role
+        # replicas only import KV handoffs from prefill peers — client
+        # traffic routes prefill-first, never to a decode replica
+        if eligible is None:
+            elig = _not_decode
+        else:
+            def elig(ep, _e=eligible):
+                return _not_decode(ep) and _e(ep)
+        endpoint, decision = rs.pick(elig, rows=rows)
         self._ensure_scraper(rs)
         return name, rs, endpoint, decision
 
@@ -748,10 +763,14 @@ class ApiGateway:
         """One zero-copy relay round trip; transport failures surface the
         same 503 shape the TCP lane produces, and the caller's remaining
         deadline budget clamps the hop the same way _http_post's does (a
-        wedged engine fails at the deadline, not never).  The frame
-        format carries no headers, so the engine does not see the
-        deadline or traceparent — this lane's hop is bounded and traced
-        gateway-side only (the udsrelay.py scope contract)."""
+        wedged engine fails at the deadline, not never).  The request
+        frame now carries the metadata sidecar (udsrelay.py
+        current_relay_meta): deadline, traceparent and tenant/tier reach
+        the engine like they do on the HTTP lane, so engine-side clamps,
+        joined spans and tenant accounting survive the relay hop (the
+        PR-8 scope gap).  The gateway-side clamp stays as the backstop."""
+        from seldon_core_tpu.runtime.udsrelay import current_relay_meta
+
         total = 20.0
         rem = remaining_s()
         if rem is not None:
@@ -762,7 +781,9 @@ class ApiGateway:
             total = min(total, rem)
         try:
             body, _status = await asyncio.wait_for(
-                self._uds_client(path).call(op, payload.encode()),
+                self._uds_client(path).call(
+                    op, payload.encode(), meta=current_relay_meta()
+                ),
                 timeout=total,
             )
             return SeldonMessage.from_json(body.decode("utf-8", "replace"))

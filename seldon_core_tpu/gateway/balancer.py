@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import os
 import random
+import re
 import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
@@ -114,9 +115,10 @@ class ReplicaEndpoint:
 
     __slots__ = (
         "target", "base_url", "uds_path", "name", "index", "set_name",
-        "inflight", "batcher_inflight", "ewma_ms", "shape_ms", "picks",
-        "failures", "consec_failures", "fail_degraded_until",
-        "scraped_inflight", "scrape_ts", "scrape_failed", "breaker_open",
+        "role", "inflight", "batcher_inflight", "ewma_ms", "shape_ms",
+        "picks", "failures", "consec_failures", "fail_degraded_until",
+        "scraped_inflight", "scraped_free_kv", "scrape_ts",
+        "scrape_failed", "breaker_open",
     )
 
     #: minimum samples before a shape bucket's own EWMA is trusted
@@ -128,8 +130,24 @@ class ReplicaEndpoint:
     def __init__(self, target, index: int = 0, set_name: str = "default"):
         self.index = index
         self.set_name = set_name
+        #: generation role in a disaggregated mesh
+        #: (runtime/servingmesh.py): "prefill" / "decode" / "unified".
+        #: Decode replicas only import KV handoffs — the gateway's picks
+        #: exclude them from client traffic (phase-aware routing)
+        self.role = "unified"
         if isinstance(target, str):
-            self.base_url, self.uds_path = parse_endpoint_spec(target)
+            spec = target
+            # the +role: segment may sit anywhere among the spec's
+            # + suffixes (e.g. url+role:decode+uds:/e.sock): extract the
+            # segment, keep the rest — an order-sensitive parse would
+            # silently swallow whatever follows it
+            m = re.search(r"\+role:([a-zA-Z]+)", spec)
+            if m:
+                role = m.group(1).lower()
+                if role in ("prefill", "decode", "unified"):
+                    self.role = role
+                spec = spec[:m.start()] + spec[m.end():]
+            self.base_url, self.uds_path = parse_endpoint_spec(spec)
             self.target = target
             self.name = self.base_url or f"uds:{self.uds_path}"
         else:  # in-process EngineService-like object
@@ -137,12 +155,18 @@ class ReplicaEndpoint:
             self.uds_path = None
             self.target = target
             self.name = f"inprocess-{index}"
+            role = getattr(target, "gen_role", "unified")
+            if role in ("prefill", "decode", "unified"):
+                self.role = role
         self.inflight = 0
         # the subset of ``inflight`` that rides the engine's MicroBatcher
         # (unary predicts) — the only part the scraped engine-side
         # ``inflight_dispatches`` figure can also contain
         self.batcher_inflight = 0
         self.ewma_ms = 0.0  # 0 = no successful sample yet
+        #: free paged-KV blocks scraped off the /stats genserver block —
+        #: the decode-capacity headroom signal (None = not a generator)
+        self.scraped_free_kv: Optional[int] = None
         # per-request-shape latency models (autopilot cost-aware routing):
         # pad bucket (pow2 of row count) -> [ewma_ms, samples].  A 1-row
         # predict and a 512-row predict have wildly different walls; a
@@ -275,6 +299,8 @@ class ReplicaEndpoint:
         return {
             "endpoint": self.name,
             "uds_path": self.uds_path,
+            "role": self.role,
+            "free_kv_blocks": self.scraped_free_kv,
             "inflight": self.inflight,
             "scraped_inflight": self.scraped_inflight,
             "ewma_ms": round(self.ewma_ms, 3),
@@ -451,6 +477,21 @@ class ReplicaSet:
                     (br or {}).get("state") not in (None, "closed")
                     for br in breakers.values()
                 )
+                # free-KV-block headroom + role off the genserver block
+                # (disaggregated mesh: the decode-capacity signal and
+                # the role the endpoint actually serves)
+                gs = doc.get("genserver")
+                if isinstance(gs, dict):
+                    kvb = gs.get("kv_blocks") or {}
+                    try:
+                        ep.scraped_free_kv = max(
+                            0, int(kvb.get("total", 0))
+                            - int(kvb.get("used", 0)))
+                    except (TypeError, ValueError):
+                        ep.scraped_free_kv = None
+                    role = gs.get("role")
+                    if role in ("prefill", "decode", "unified"):
+                        ep.role = role
                 ep.scrape_ts = time.monotonic()
                 ep.scrape_failed = False
                 return 1

@@ -1498,6 +1498,11 @@ class TransformerGenerator(Unit):
             "max_new_tokens": self.max_new_tokens,
             "prefix_cache": state.get("prefix_cache"),
             "seed": self.seed,
+            # tensor-parallel dispatch (runtime/servingmesh.py): the
+            # scheduler lays its paged KV pool out over the same mesh
+            # the params are sharded on, so prefill/decode programs
+            # compile SPMD across the chips
+            "mesh": self.mesh,
         }
 
     def stream_tokens(self, state, X, chunk: int = 8):

@@ -496,6 +496,18 @@ class GenLane:
             raise
         return y.astype(np.float64), ({}, {})
 
+    def predicted_latency_s(self, x) -> "float | None":
+        """Deadline-aware admission hook (engine._submit): on a prefill
+        replica the request pays the FULL prefill -> handoff -> remote
+        decode chain, so admission prices the coordinator's rolling
+        chain EWMA — a request whose budget can't cover the chain sheds
+        typed before any prefill compute.  Unified replicas return None
+        (the PR-10 behaviour, unchanged)."""
+        coord = getattr(self.genserver, "coordinator", None)
+        if coord is None:
+            return None
+        return coord.chain_estimate_s()
+
     def snapshot(self) -> dict:
         # the canonical scheduler block lives under stats()["genserver"];
         # duplicating it here would serialize (and race) it twice a scrape

@@ -114,6 +114,8 @@ class EngineService:
         pipeline_depth: int = 8,
         dispatch_timeout_s: float = 30.0,
         audit: Optional[AuditLog] = None,
+        gen_role: Optional[str] = None,
+        decode_peers: Optional[list] = None,
     ):
         from seldon_core_tpu.utils.tracing import TRACER
 
@@ -207,6 +209,21 @@ class EngineService:
         # the in-flight decode batch, prompts prefill in chunks, and the
         # int8-KV/prefix/speculative levers ride the actual serving path.
         # SELDON_TPU_GEN_CONTINUOUS=0 is the kill switch (static path).
+        # disaggregated serving mesh (runtime/servingmesh.py): this
+        # replica's generation role.  "unified" is the PR-7 scheduler;
+        # "prefill" exports finished KV blocks to decode peers over the
+        # relay; "decode" only imports handoffs.  SELDON_TPU_DISAGG=0
+        # forces unified — the kill switch, bit-for-bit.
+        from seldon_core_tpu.runtime.servingmesh import (
+            parse_decode_peers,
+            resolve_gen_role,
+        )
+
+        self.gen_role = resolve_gen_role(gen_role)
+        self._decode_peers = (
+            list(decode_peers) if decode_peers is not None
+            else parse_decode_peers()
+        )
         self.genserver = None
         if (
             self.compiled is not None
@@ -223,12 +240,29 @@ class EngineService:
                             GenServer,
                         )
 
-                        self.genserver = GenServer(**cs)
+                        coordinator = None
+                        if self.gen_role == "prefill" and \
+                                self._decode_peers:
+                            from seldon_core_tpu.runtime.servingmesh \
+                                import DisaggCoordinator
+
+                            coordinator = DisaggCoordinator(
+                                self._decode_peers,
+                                event_sink=self._handoff_event,
+                            )
+                        self.genserver = GenServer(
+                            **cs, role=self.gen_role,
+                            coordinator=coordinator,
+                        )
                 except Exception:  # noqa: BLE001 - fall back to static path
                     logger.exception(
                         "continuous generation lane disabled "
                         "(static per-request path kept)"
                     )
+        if self.genserver is None:
+            # a role without a scheduler cannot serve its contract —
+            # surface as unified so routing/metrics stay truthful
+            self.gen_role = "unified"
         # micro-batching: coalesce concurrent requests into one device
         # dispatch (router-free compiled graphs only — routing is a
         # per-request decision in the reference semantics).  Generator
@@ -688,6 +722,82 @@ class EngineService:
                 self._known_good_widths.add(x.shape[1:])
                 compiled += 1
         return compiled
+
+    def _handoff_event(self, **fields) -> None:
+        """Handoff visibility in the flight recorder: one firehose line
+        per completed prefill->decode handoff (skipped when the audit
+        log is off — same contract as request lines)."""
+        if not self.audit.enabled:
+            return
+        self.audit.record(
+            puid="",
+            deployment=self.deployment.name,
+            predictor=self.predictor.name,
+            graph=self._graph_path,
+            method="kv_handoff",
+            status=200,
+            rows=None,
+            latency_ms=fields.pop("latency_ms", None),
+            mode=self.mode,
+            **fields,
+        )
+
+    # -- disaggregated KV handoff (relay OP_KVSTREAM) --------------------
+
+    async def kv_frame(self, payload: bytes) -> "tuple[int, bytes]":
+        """One KV-stream frame (runtime/kvstream.py wire format) off the
+        relay lane.  Only decode-role replicas accept block imports —
+        anything else is a typed 503 role misconfig.  KV_STATS answers
+        on every role (it is how peers and demos probe pool headroom)."""
+        import asyncio
+
+        from seldon_core_tpu.runtime import kvstream
+
+        try:
+            sub_op, hid, body = kvstream.parse_frame(payload)
+        except kvstream.KvWireError as e:
+            return 400, str(e).encode()
+        gs = self.genserver
+        if gs is None:
+            return 503, (b"this replica runs no generation scheduler "
+                         b"(KV handoffs need --gen-role decode)")
+        if sub_op == kvstream.KV_STATS:
+            s = gs.kv_stats()
+            return 200, kvstream.pack_stats(
+                s["free"], s["total"], s["waiting"], s["inflight"])
+        if gs.role != "decode":
+            RECORDER.record_kv_handoff("refused")
+            return 503, (
+                f"role misconfig: this replica is {gs.role!r}, KV "
+                f"handoffs import only at --gen-role decode replicas"
+            ).encode()
+        try:
+            if sub_op == kvstream.KV_BEGIN:
+                gs.kv_reserve(hid, kvstream.parse_begin(body))
+                return 200, b""
+            if sub_op == kvstream.KV_BLOCKS:
+                imp = gs._imports.get(hid)
+                if imp is None:
+                    raise kvstream.KvWireError(
+                        "unknown or expired handoff id")
+                first, layers = kvstream.parse_blocks(body, imp.meta)
+                gs.kv_receive(hid, first, layers)
+                return 200, b""
+            if sub_op == kvstream.KV_COMMIT:
+                req = gs.kv_commit(hid)
+                toks = await asyncio.wrap_future(req.future)
+                return 200, kvstream.pack_tokens(toks[0])
+            if sub_op == kvstream.KV_ABORT:
+                gs.kv_abort(hid)
+                return 200, b""
+        except LoadShedError as e:
+            return 503, str(e).encode()
+        except kvstream.KvWireError as e:
+            return 409, str(e).encode()
+        except Exception as e:  # noqa: BLE001 - surface typed, keep serving
+            logger.exception("KV handoff frame failed")
+            return 500, f"{type(e).__name__}: {e}".encode()
+        return 400, f"unknown KV sub-op {sub_op}".encode()
 
     def _predict_dispatch_s(self, padded_rows, x):
         """Autopilot prediction hook: the dispatch wall the learned model

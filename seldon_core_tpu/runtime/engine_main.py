@@ -89,7 +89,8 @@ def load_deployment_from_env(
 
 async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
                 host="0.0.0.0", rest_port=None, grpc_port=None,
-                uds_path=None, http_uds_path=None) -> None:
+                uds_path=None, http_uds_path=None, gen_role=None,
+                decode_peers=None, relay_tcp_port=None) -> None:
     from seldon_core_tpu.runtime.engine import EngineService
     from seldon_core_tpu.runtime.grpc_server import make_engine_grpc_server
     from seldon_core_tpu.runtime.rest import make_engine_app, serve_app
@@ -110,6 +111,11 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         dispatch_timeout_s=float(
             os.environ.get("ENGINE_DISPATCH_TIMEOUT_S", "30")
         ),
+        # disaggregated serving mesh (runtime/servingmesh.py): this
+        # replica's generation role and, for prefill replicas, the
+        # decode peers it streams finished KV blocks to
+        gen_role=gen_role,
+        decode_peers=decode_peers,
     )
     # boot-time shape compilation: ENGINE_PREWARM_WIDTHS="784,16" compiles
     # every batch bucket of those feature widths before the server binds,
@@ -197,6 +203,18 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         from seldon_core_tpu.runtime.udsrelay import serve_uds
 
         uds_server = await serve_uds(engine, uds_path)
+    # the framed relay on a TCP port: the cross-host lane decode
+    # replicas receive KV-block handoffs on (runtime/kvstream.py)
+    relay_tcp_server = None
+    relay_tcp_port = relay_tcp_port if relay_tcp_port is not None else int(
+        os.environ.get("ENGINE_RELAY_TCP_PORT", "0") or 0)
+    if relay_tcp_port:
+        from seldon_core_tpu.runtime.udsrelay import serve_relay_tcp
+
+        relay_tcp_server = await serve_relay_tcp(
+            engine, host if host != "0.0.0.0" else "0.0.0.0",
+            relay_tcp_port,
+        )
     # HTTP face on a unix socket: the node-mesh lane a sharded root's
     # `unix:` binding dials (runtime/client.py).  Bound regardless of the
     # main HTTP lane's impl — the native plane can't listen on a UDS
@@ -213,7 +231,11 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         f"rest=:{rest_port} grpc=:{grpc_port}"
         + (f" uds={uds_path}" if uds_server is not None else "")
         + (f" http-uds={http_uds_path}"
-           if http_uds_server is not None else ""),
+           if http_uds_server is not None else "")
+        + (f" relay-tcp=:{relay_tcp_server.port}"
+           if relay_tcp_server is not None else "")
+        + (f" gen-role={engine.gen_role}"
+           if engine.gen_role != "unified" else ""),
         flush=True,
     )
 
@@ -257,6 +279,8 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         await fast_server.stop()
     if uds_server is not None:
         await uds_server.stop()
+    if relay_tcp_server is not None:
+        await relay_tcp_server.stop()
     if http_uds_server is not None:
         await http_uds_server.stop()
     if native_plane is not None:
@@ -287,6 +311,23 @@ def main(argv=None) -> None:
              "node-mesh lane a sharded root's unix: binding dials "
              "(env ENGINE_HTTP_UDS_PATH)",
     )
+    parser.add_argument(
+        "--gen-role", default=None,
+        choices=["unified", "prefill", "decode"],
+        help="generation role in a disaggregated serving mesh (env "
+             "ENGINE_GEN_ROLE; SELDON_TPU_DISAGG=0 forces unified)",
+    )
+    parser.add_argument(
+        "--decode-peers", default=None,
+        help="comma-separated relay specs (uds:/path or tcp:host:port) "
+             "of decode replicas a prefill replica hands KV blocks to "
+             "(env ENGINE_DECODE_PEERS)",
+    )
+    parser.add_argument(
+        "--relay-tcp-port", type=int, default=None,
+        help="also bind the framed relay lane on this TCP port — the "
+             "cross-host KV-handoff receiver (env ENGINE_RELAY_TCP_PORT)",
+    )
     args = parser.parse_args(argv)
     if os.environ.get("SELDON_FORCE_CPU") == "1":
         # host-CPU serving for control-plane demos/tests: several engines
@@ -310,10 +351,17 @@ def main(argv=None) -> None:
         deployment = default_and_validate(
             node_subspec(deployment, node, args.predictor)
         )
+    decode_peers = None
+    if args.decode_peers is not None:
+        from seldon_core_tpu.runtime.servingmesh import parse_decode_peers
+
+        decode_peers = parse_decode_peers(args.decode_peers)
     asyncio.run(
         serve(deployment, args.predictor, args.host, args.rest_port,
               args.grpc_port, uds_path=args.uds_path,
-              http_uds_path=args.http_uds_path)
+              http_uds_path=args.http_uds_path, gen_role=args.gen_role,
+              decode_peers=decode_peers,
+              relay_tcp_port=args.relay_tcp_port)
     )
 
 

@@ -92,7 +92,19 @@ class BlockAllocator:
     never return a block every other sequence's table still references.
     Freed ids go back on the free list FIFO — fragmentation cannot exist
     by construction (any free block serves any sequence; the table adds
-    the indirection), which is the point of paging."""
+    the indirection), which is the point of paging.
+
+    Thread-safety + the remote-import path (runtime/servingmesh.py): the
+    relay handler reserves blocks for an in-flight KV handoff from the
+    event-loop thread while the scheduler thread allocs/frees for live
+    sequences, so every mutation takes the internal lock.  ``reserve``
+    puts blocks in a typed RESERVED state: they are out of the free list
+    (so eviction pressure cannot re-allocate them mid-import — victims
+    only ever free blocks owned by a live sequence, and a reserved block
+    belongs to none) and ``free`` REFUSES them until ``commit_reserved``
+    turns them into normally-owned blocks or ``release_reserved``
+    reclaims them (torn handoff) — a double release can't corrupt the
+    free list either way."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -100,6 +112,8 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self._free: deque = deque(range(1, self.num_blocks))
         self._pinned: set = set()
+        self._reserved: set = set()
+        self._lock = threading.Lock()
         self.high_water = 0
 
     @property
@@ -116,27 +130,55 @@ class BlockAllocator:
     def alloc(self, n: int) -> Optional[List[int]]:
         """n blocks or None — the caller queues (never crashes) on a full
         pool."""
-        if n < 0 or len(self._free) < n:
-            return None
-        out = [self._free.popleft() for _ in range(n)]
-        self.high_water = max(self.high_water, self.used)
-        return out
+        with self._lock:
+            if n < 0 or len(self._free) < n:
+                return None
+            out = [self._free.popleft() for _ in range(n)]
+            self.high_water = max(self.high_water, self.used)
+            return out
+
+    def reserve(self, n: int) -> Optional[List[int]]:
+        """Allocate n blocks into the RESERVED state for an in-flight
+        remote import — invisible to eviction, refused by ``free``."""
+        blocks = self.alloc(n)
+        if blocks is not None:
+            with self._lock:
+                self._reserved.update(blocks)
+        return blocks
+
+    def commit_reserved(self, blocks: List[int]) -> None:
+        """Reserved -> owned: the import committed and a live sequence's
+        table now references these blocks (normal free applies)."""
+        with self._lock:
+            self._reserved.difference_update(blocks)
+
+    def release_reserved(self, blocks: List[int]) -> None:
+        """Reclaim a torn handoff's reservation back to the free list."""
+        with self._lock:
+            for b in blocks:
+                if b in self._reserved:
+                    self._reserved.discard(b)
+                    self._free.append(b)
 
     def pin(self, blocks: List[int]) -> None:
-        self._pinned.update(blocks)
+        with self._lock:
+            self._pinned.update(blocks)
 
     def free(self, blocks: List[int]) -> None:
-        for b in blocks:
-            if b not in self._pinned:
-                self._free.append(b)
+        with self._lock:
+            for b in blocks:
+                if b not in self._pinned and b not in self._reserved:
+                    self._free.append(b)
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
-            "total": self.capacity,
-            "used": self.used,
-            "pinned": len(self._pinned),
-            "high_water": self.high_water,
-        }
+        with self._lock:
+            return {
+                "total": self.capacity,
+                "used": self.used,
+                "pinned": len(self._pinned),
+                "reserved": len(self._reserved),
+                "high_water": self.high_water,
+            }
 
 
 class _Sequence:
@@ -168,6 +210,41 @@ class _Sequence:
         self.key_data: Optional[np.ndarray] = None  # per-seq PRNG key
         self.admit_order = -1
         self.retire_reason = ""
+
+
+class _KvImport:
+    """One in-flight remote-block import on a decode replica: reserved
+    pool blocks + host-side staging buffers, keyed by handoff id.
+    reserve -> receive -> commit; a torn handoff (abort, or the TTL
+    reaper) releases the reservation with zero leaked blocks."""
+
+    __slots__ = ("hid", "meta", "blocks", "staged", "received",
+                 "created", "seq")
+
+    def __init__(self, hid: bytes, meta, blocks: List[int], staged):
+        self.hid = hid
+        self.meta = meta
+        self.blocks = blocks
+        self.staged = staged          # per-layer host arrays [n, bs, ...]
+        self.received = np.zeros((meta.n_blocks,), bool)
+        self.created = time.monotonic()
+        self.seq: Optional[_Sequence] = None
+
+    def receive(self, first: int, layers) -> None:
+        from seldon_core_tpu.runtime.kvstream import KvWireError
+
+        n = layers[0]["k"].shape[0] if layers else 0
+        if first < 0 or first + n > self.meta.n_blocks:
+            raise KvWireError(
+                f"block chunk [{first}, {first + n}) outside the "
+                f"announced {self.meta.n_blocks} blocks")
+        for stage, chunk in zip(self.staged, layers):
+            for name, arr in chunk.items():
+                stage[name][first:first + n] = arr
+        self.received[first:first + n] = True
+
+    def complete(self) -> bool:
+        return bool(self.received.all())
 
 
 class GenRequest:
@@ -230,6 +307,9 @@ class GenServer:
         slots: Optional[int] = None,
         span: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
+        mesh=None,
+        role: str = "unified",
+        coordinator=None,
     ):
         self.params = params
         self.cfg = cfg
@@ -250,6 +330,12 @@ class GenServer:
             # mirror speculative_generate's guards: greedy, float KV
             raise ValueError(
                 "speculative continuous mode is greedy/float-KV only")
+        if self.spec and role in ("prefill", "decode"):
+            # a handoff would need the draft pool streamed too — out of
+            # the disaggregation contract; serve speculative unified
+            raise ValueError(
+                "speculative decoding does not compose with "
+                "disaggregated prefill/decode roles")
         self.block_size = block_size or _env_int(
             "SELDON_TPU_GEN_BLOCK_SIZE", 16)
         self.num_blocks = num_blocks or _env_int(
@@ -289,6 +375,8 @@ class GenServer:
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
         self._pool = None
+        self._device_ready = False
+        self._device_init_lock = threading.Lock()
         self._draft_pool = None
         self._allocator: Optional[BlockAllocator] = None
         self._draft_allocator: Optional[BlockAllocator] = None
@@ -296,6 +384,32 @@ class GenServer:
         self._prefix_len = 0
         self._seq_counter = 0
         self._admit_counter = 0
+        # disaggregated serving mesh (runtime/servingmesh.py): the
+        # replica's generation role, the optional device mesh the paged
+        # pool (and the unit's params) shard over, and — prefill role —
+        # the coordinator that streams finished KV blocks to a decode
+        # peer.  Unified role with no mesh is bit-for-bit the PR-7 path.
+        self.role = role if role in ("unified", "prefill", "decode") \
+            else "unified"
+        self.mesh = mesh
+        self.coordinator = coordinator
+        #: finished handoffs coming back from the coordinator thread:
+        #: (seq, tokens-or-exception) drained on the scheduler thread
+        self._handoff_done: deque = deque()
+        #: sequences whose handoff is in flight (exported, not yet
+        #: drained) — they live in no scheduler list, so _fail_all must
+        #: fail them from here or their requests hang at stop()
+        self._handoff_seqs: "dict" = {}
+        self._handoff_inflight = 0
+        #: decode role: in-flight remote imports keyed by handoff id
+        #: (reserve -> receive -> commit; the TTL reaper reclaims torn
+        #: ones) and committed imports awaiting scheduler admission
+        self._imports: Dict[bytes, Any] = {}
+        self._remote_arrivals: deque = deque()
+        self._import_ttl_s = float(
+            _env_int("SELDON_TPU_KV_HANDOFF_TTL_S", 30))
+        self.imports_committed_total = 0
+        self.imports_reclaimed_total = 0
         # lifetime counters for /stats + the gen_* Prometheus families
         self.admitted_total = 0
         self.retired_total: Dict[str, int] = {}
@@ -357,6 +471,18 @@ class GenServer:
 
     def _enqueue(self, rows, chunk, max_new,
                  tier: Optional[str] = None) -> GenRequest:
+        if self.role == "decode":
+            # phase routing contract (runtime/servingmesh.py): decode
+            # replicas serve KV handoffs only — a client generation
+            # request landing here is a routing misconfig, answered
+            # typed + retryable so the gateway can re-route
+            from seldon_core_tpu.runtime.servingmesh import (
+                RoleMismatchError,
+            )
+
+            raise RoleMismatchError(
+                "this replica is decode-only (--gen-role decode): client "
+                "generation requests route to prefill/unified replicas")
         tier = tier or current_tier()
         if BROWNOUT.sheds_tier(tier):
             # typed, retryable, BEFORE anything is allocated or queued —
@@ -424,6 +550,11 @@ class GenServer:
         request per prompt width runs admission -> chunked prefill ->
         decode rounds end to end (backed by the persistent compile
         cache).  Returns the number of probes served."""
+        if self.role != "unified":
+            # prefill probes would fire real handoffs at peers that may
+            # not be up yet; decode replicas reject submits by contract.
+            # Both compile on first traffic (persistent compile cache).
+            return 0
         count = 0
         for width in list(widths) or [4]:
             w = width if isinstance(width, int) else int(np.prod(width))
@@ -451,6 +582,15 @@ class GenServer:
                     tiers[t] = tiers.get(t, 0) + 1
         doc = {
             "mode": "speculative" if self.spec else "decode",
+            # disaggregated serving mesh: this replica's generation role
+            # plus the handoff/import flow (the /stats block the
+            # gateway's scrape and the disagg runbook read)
+            "role": self.role,
+            "mesh": (
+                None if self.mesh is None
+                else dict(zip(self.mesh.axis_names,
+                              self.mesh.devices.shape))
+            ),
             "slots": self.slots,
             "inflight_sequences": inflight,
             "waiting_sequences": waiting,
@@ -474,6 +614,18 @@ class GenServer:
             dalloc = self._draft_allocator
             doc["draft_kv_blocks"] = (
                 dalloc.snapshot() if dalloc is not None else {})
+        if self.role == "prefill":
+            doc["disagg"] = (
+                self.coordinator.snapshot()
+                if self.coordinator is not None else None
+            )
+            doc["handoff_inflight"] = self._handoff_inflight
+        if self.role == "decode":
+            doc["imports"] = {
+                "pending": len(self._imports),
+                "committed_total": self.imports_committed_total,
+                "reclaimed_total": self.imports_reclaimed_total,
+            }
         return doc
 
     def stop(self) -> None:
@@ -484,6 +636,8 @@ class GenServer:
         t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout=10)
+        if self.coordinator is not None:
+            self.coordinator.close()
 
     # -- worker thread ---------------------------------------------------
 
@@ -494,8 +648,18 @@ class GenServer:
             self._thread.start()
 
     def _ensure_device(self) -> None:
-        if self._pool is not None:
+        if self._device_ready:
             return
+        with self._device_init_lock:
+            if not self._device_ready:
+                self._init_device()
+                self._device_ready = True
+
+    def _init_device(self) -> None:
+        # normally scheduler-thread-only; a decode replica's relay
+        # handler also lands here when a KV handoff arrives before any
+        # local tick ran (the init lock makes that safe — pool MUTATION
+        # stays scheduler-thread-only afterwards)
         from seldon_core_tpu.models.generate import (
             init_block_pool,
             paged_write_prefix_blocks_jit,
@@ -504,6 +668,15 @@ class GenServer:
         self._pool = init_block_pool(
             self.cfg, self.num_blocks, self.block_size)
         self._allocator = BlockAllocator(self.num_blocks)
+        if self.mesh is not None:
+            # tensor-parallel dispatch (runtime/servingmesh.py): the
+            # paged pool lays out over the unit's device mesh (KV heads
+            # over 'tp' when divisible) so the scheduler's compiled
+            # prefill/decode programs partition across chips together
+            # with the mesh-sharded params
+            from seldon_core_tpu.runtime.servingmesh import shard_gen_pool
+
+            self._pool = shard_gen_pool(self.mesh, self._pool)
         if self.spec:
             self._draft_pool = init_block_pool(
                 self.draft_cfg, self.num_blocks, self.block_size)
@@ -529,7 +702,15 @@ class GenServer:
             with self._wake:
                 while (not self._stopped and not self._arrivals
                        and not self._waiting and not self._prefilling
-                       and not self._active):
+                       and not self._active and not self._remote_arrivals
+                       and not self._handoff_done):
+                    if self._imports:
+                        # an in-flight remote import holds reserved
+                        # blocks: wake periodically so the TTL reaper
+                        # can reclaim a torn handoff even when no other
+                        # work arrives
+                        self._wake.wait(1.0)
+                        break
                     self._wake.wait()
                 if self._stopped:
                     break
@@ -550,11 +731,29 @@ class GenServer:
 
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
+            committed = list(self._remote_arrivals)
             seqs = (list(self._waiting) + list(self._prefilling)
-                    + list(self._active) + list(self._arrivals))
+                    + list(self._active) + list(self._arrivals)
+                    + [imp.seq for imp in committed]
+                    # sequences whose handoff is at the coordinator (or
+                    # already completed into _handoff_done): they live in
+                    # no scheduler list, but their requests still await
+                    + list(self._handoff_seqs))
+            seqs = list(dict.fromkeys(seqs))
             self._waiting.clear()
             self._arrivals.clear()
+            self._remote_arrivals.clear()
+            self._handoff_seqs.clear()
+            self._handoff_done.clear()
             self._prefilling, self._active = [], []
+            imports = list(self._imports.values())
+            self._imports.clear()
+        for imp in imports + committed:
+            # committed-but-unadmitted imports still hold RESERVED
+            # blocks (commit_reserved only runs at admission) — release
+            # them too or each aborted tick permanently shrinks the pool
+            if self._allocator is not None:
+                self._allocator.release_reserved(imp.blocks)
         for seq in seqs:
             self._release_blocks(seq)
             req = seq.request
@@ -577,6 +776,9 @@ class GenServer:
         self._ensure_device()
         self._drop_cancelled()
         admitted = self._admit()
+        admitted += self._import_admit()
+        handed_back = self._drain_handoff_done()
+        self._reap_stale_imports()
         kind = None
         tokens = 0
         if self._prefilling:
@@ -598,7 +800,8 @@ class GenServer:
             self.tokens_emitted_total += tokens
         self._publish(admitted, retired, kind or "idle", tokens,
                       time.perf_counter() - t0)
-        return kind is not None or admitted > 0 or retired > 0
+        return (kind is not None or admitted > 0 or retired > 0
+                or handed_back > 0)
 
     def _drop_cancelled(self) -> None:
         for coll in (self._waiting, self._prefilling, self._active):
@@ -892,8 +1095,16 @@ class GenServer:
                 seq.pending = first
                 self._emit_tokens(seq, [first])
                 emitted += 1
-            seq.state = _Sequence.RUNNING
-            self._active.append(seq)
+            if self.role == "prefill":
+                if seq.done:
+                    # the first token already finished the sequence
+                    # (max_new==1 / immediate eos): nothing to hand off
+                    self._retire(seq, seq.retire_reason or "length")
+                else:
+                    self._handoff_out(seq)
+            else:
+                seq.state = _Sequence.RUNNING
+                self._active.append(seq)
         if max(widths) == C and not floored:
             # only adapt on SATURATED ticks: short prompts never use a
             # wider executable, so probing one would compile it for
@@ -1069,6 +1280,261 @@ class GenServer:
         if accept_rounds:
             RECORDER.observe_accept_ratio(accept_sum / accept_rounds)
         return emitted
+
+    # -- disaggregated handoff: prefill side ------------------------------
+
+    def _handoff_out(self, seq: _Sequence) -> None:
+        """Export a finished prefill (its private KV blocks + sampling
+        state) and hand it to the coordinator; the blocks go straight
+        back to the pool — the prefill replica's whole point is that its
+        residency recycles at prompt cadence, not generation cadence."""
+        from seldon_core_tpu.runtime import kvstream
+        from seldon_core_tpu.runtime.servingmesh import HandoffError
+
+        if self.coordinator is None:
+            self._finish_error(seq, HandoffError(
+                "prefill-role replica has no decode peers configured "
+                "(--decode-peers / ENGINE_DECODE_PEERS)"))
+            return
+        l0 = self._pool["l0"]
+        meta = kvstream.KvBeginMeta(
+            n_layers=len(self._pool),
+            block_size=self.block_size,
+            kv_heads=int(l0["k"].shape[2]),
+            head_dim=int(l0["k"].shape[3]),
+            dtype=kvstream.pool_dtype_name(self._pool),
+            n_blocks=len(seq.blocks),
+            n_valid=seq.n_valid,
+            pending=int(seq.pending),
+            max_new=int(seq.max_new),
+            prefix_len=self._prefix_len,
+            prompt=np.asarray(seq.prompt, np.int32),
+            emitted=list(seq.emitted),
+            key_data=seq.key_data,
+            tier=seq.request.tier,
+        )
+        export = kvstream.KvExport(
+            meta=meta,
+            # device->host gather NOW, on the scheduler thread, before
+            # the pool is donated into the next dispatch
+            layers=kvstream.export_blocks(self._pool, seq.blocks),
+        )
+        self._release_blocks(seq)
+        seq.state = _Sequence.DONE
+        self._handoff_inflight += 1
+        self._handoff_seqs[seq] = True
+
+        def _done(result, seq=seq):
+            self._handoff_done.append((seq, result))
+            with self._wake:
+                self._wake.notify_all()
+
+        self.coordinator.submit(export, _done)
+
+    def _drain_handoff_done(self) -> int:
+        """Fold completed handoffs back into the request surfaces: the
+        decode peer's token array becomes the sequence's emitted stream
+        (first token unchanged — it was emitted at prefill time), or a
+        typed failure fails the request retryably."""
+        n = 0
+        while self._handoff_done:
+            seq, result = self._handoff_done.popleft()
+            self._handoff_seqs.pop(seq, None)
+            self._handoff_inflight -= 1
+            n += 1
+            if isinstance(result, BaseException):
+                self._finish_error(seq, result)
+                continue
+            toks = [int(t) for t in np.asarray(result).reshape(-1)]
+            prev = len(seq.emitted)
+            seq.emitted = toks[: seq.max_new]
+            if len(seq.emitted) < seq.max_new:
+                # defensive eos-padding; the decode side pads already
+                pad = (self.eos_token if self.eos_token >= 0
+                       else (seq.emitted[-1] if seq.emitted else 0))
+                seq.emitted += [pad] * (seq.max_new - len(seq.emitted))
+            self.tokens_emitted_total += max(0, len(seq.emitted) - prev)
+            seq.done = True
+            self._retire(seq, "handoff")
+        return n
+
+    # -- disaggregated handoff: decode side (relay-handler threads) -------
+
+    def kv_reserve(self, hid: bytes, meta) -> None:
+        """BEGIN: validate the handoff against this pool and reserve its
+        blocks.  Raises typed — KvWireError for geometry/dtype/prefix
+        mismatches (a deployment misconfig), LoadShedError when the pool
+        cannot hold the blocks (retryable: the prefill side's p2c walks
+        to the next peer)."""
+        from seldon_core_tpu.runtime import kvstream
+
+        self._ensure_device()
+        kvstream.validate_against_pool(
+            meta, self._pool, self.block_size, self._prefix_len)
+        blocks = self._allocator.reserve(meta.n_blocks)
+        if blocks is None:
+            RECORDER.record_kv_handoff("refused")
+            raise LoadShedError(
+                f"{SHED_INFO_PREFIX}: decode KV pool cannot hold "
+                f"{meta.n_blocks} handoff blocks "
+                f"({self._allocator.used}/{self._allocator.capacity} "
+                "used) — try another decode replica")
+        names = (("k", "v", "k_s", "v_s") if meta.dtype == "int8"
+                 else ("k", "v"))
+        dt = (np.int8 if meta.dtype == "int8"
+              else kvstream._np_dtype(meta.dtype))
+        staged = []
+        for _ in range(meta.n_layers):
+            layer = {}
+            for name in names:
+                if name.endswith("_s"):
+                    shape = (meta.n_blocks, meta.block_size,
+                             meta.kv_heads)
+                    layer[name] = np.zeros(shape, np.float32)
+                else:
+                    shape = (meta.n_blocks, meta.block_size,
+                             meta.kv_heads, meta.head_dim)
+                    layer[name] = np.zeros(shape, dt)
+            staged.append(layer)
+        imp = _KvImport(hid, meta, blocks, staged)
+        with self._wake:
+            if self._stopped:
+                self._allocator.release_reserved(blocks)
+                raise RuntimeError("generation scheduler stopped")
+            self._imports[hid] = imp
+            # the scheduler thread must run while a reservation is
+            # outstanding: it IS the TTL reaper for torn handoffs
+            self._ensure_thread()
+            self._wake.notify_all()
+
+    def kv_receive(self, hid: bytes, first: int, layers) -> None:
+        """KV_BLOCKS: stage one chunk host-side (nothing touches the
+        device pool until commit — the scheduler thread owns it)."""
+        from seldon_core_tpu.runtime.kvstream import KvWireError
+
+        imp = self._imports.get(hid)
+        if imp is None:
+            raise KvWireError("unknown or expired handoff id")
+        imp.receive(first, layers)
+
+    def kv_commit(self, hid: bytes) -> GenRequest:
+        """KV_COMMIT: the import is complete — build the sequence and
+        queue it for scheduler admission (the device scatter happens on
+        the scheduler thread).  Returns the request whose future
+        resolves to the finished ``[1, max_new]`` token array."""
+        from seldon_core_tpu.runtime.kvstream import KvWireError
+
+        # pop FIRST: the claim on this handoff must be atomic against
+        # the scheduler's TTL reaper (which also pops before releasing).
+        # A get-then-pop would let a commit landing exactly at the TTL
+        # admit a reservation the reaper already returned to the free
+        # list — two sequences sharing blocks, silently
+        imp = self._imports.pop(hid, None)
+        if imp is None:
+            raise KvWireError("unknown or expired handoff id")
+        if not imp.complete():
+            # torn: the sender committed before streaming every block
+            self._allocator.release_reserved(imp.blocks)
+            self.imports_reclaimed_total += 1
+            RECORDER.record_kv_handoff("reclaimed")
+            raise KvWireError(
+                "commit before every block was received — torn handoff "
+                "reclaimed")
+        meta = imp.meta
+        req = GenRequest(1, None, meta.max_new, tier=meta.tier)
+        with self._wake:
+            if self._stopped:
+                self._allocator.release_reserved(imp.blocks)
+                raise RuntimeError("generation scheduler stopped")
+            self._seq_counter += 1
+            seq = _Sequence(self._seq_counter, req, 0,
+                            np.asarray(meta.prompt, np.int32),
+                            meta.max_new)
+            seq.n_valid = int(meta.n_valid)
+            seq.pending = int(meta.pending)
+            seq.emitted = list(meta.emitted)
+            seq.key_data = (np.asarray(meta.key_data)
+                            if meta.key_data is not None else None)
+            req.seqs.append(seq)
+            imp.seq = seq
+            self._remote_arrivals.append(imp)
+            self._ensure_thread()
+            self._wake.notify_all()
+        return req
+
+    def kv_abort(self, hid: bytes) -> bool:
+        imp = self._imports.pop(hid, None)
+        if imp is None:
+            return False
+        self._allocator.release_reserved(imp.blocks)
+        self.imports_reclaimed_total += 1
+        RECORDER.record_kv_handoff("reclaimed")
+        return True
+
+    def kv_stats(self) -> Dict[str, int]:
+        """The free-KV-block score a prefill coordinator's p2c reads
+        (KV_STATS frame) — cheap enough to answer before the device pool
+        even exists."""
+        alloc = self._allocator
+        if alloc is not None:
+            snap = alloc.snapshot()
+            free = snap["total"] - snap["used"]
+            total = snap["total"]
+        else:
+            free = total = self.num_blocks - 1
+        with self._lock:
+            waiting = len(self._waiting) + len(self._arrivals)
+            inflight = len(self._active) + len(self._prefilling)
+        return {"free": free, "total": total, "waiting": waiting,
+                "inflight": inflight}
+
+    # -- disaggregated handoff: decode side (scheduler thread) ------------
+
+    def _import_admit(self) -> int:
+        """Committed imports enter the decode loop: one compiled chunk
+        scatter writes the staged blocks into the pool, the reservation
+        becomes ownership, and the sequence joins ``_active`` mid-
+        stream — exactly where the unified path would have put it after
+        local prefill."""
+        if not self._remote_arrivals:
+            return 0
+        from seldon_core_tpu.runtime import kvstream
+
+        n = 0
+        while self._remote_arrivals:
+            imp = self._remote_arrivals.popleft()
+            self._pool = kvstream.scatter_staged(
+                self._pool, imp.blocks, imp.staged)
+            self._allocator.commit_reserved(imp.blocks)
+            seq = imp.seq
+            seq.blocks = list(imp.blocks)
+            seq.state = _Sequence.RUNNING
+            self._admit_counter += 1
+            seq.admit_order = self._admit_counter
+            self._active.append(seq)
+            self.admitted_total += 1
+            self.imports_committed_total += 1
+            RECORDER.record_gen_admitted()
+            RECORDER.record_kv_handoff("imported")
+            n += 1
+        return n
+
+    def _reap_stale_imports(self) -> None:
+        """Torn-handoff backstop: a reservation never committed within
+        the TTL goes back to the pool — the leak bound is TTL, not
+        forever."""
+        if not self._imports:
+            return
+        now = time.monotonic()
+        for hid, imp in list(self._imports.items()):
+            if now - imp.created > self._import_ttl_s:
+                if self._imports.pop(hid, None) is not None:
+                    self._allocator.release_reserved(imp.blocks)
+                    self.imports_reclaimed_total += 1
+                    RECORDER.record_kv_handoff("reclaimed")
+                    logger.warning(
+                        "reclaimed torn KV handoff (%d blocks) after "
+                        "%.0fs TTL", len(imp.blocks), self._import_ttl_s)
 
     # -- emission / retirement --------------------------------------------
 

@@ -1,0 +1,443 @@
+"""Disaggregated prefill/decode serving mesh.
+
+ROADMAP item 1: everything so far serves one smallish model per engine
+on one chip, capping ``served_gen_tok_s`` at a single chip's decode
+ceiling.  This module splits the generation lane into separately-scaled
+replica pools (Podracer-style sheets of role-specialized workers, arxiv
+2104.06272; the Gemma-serving workload of arxiv 2605.25645) and lets one
+model span chips:
+
+* **Roles** — ``engine_main --gen-role {prefill,decode,unified}`` boots
+  role-specialized GenServers (runtime/genserver.py).  Prefill replicas
+  run chunked cross-sequence prefill only; each finished sequence's KV
+  blocks + sampling state export as a typed handoff.  Decode replicas
+  import those blocks (reserve -> receive -> commit, torn handoffs
+  reclaim) and run the continuous decode loop.  Unified replicas are
+  bit-for-bit the PR-7 scheduler; ``SELDON_TPU_DISAGG=0`` forces every
+  role back to unified — the kill switch.
+* **The coordinator** — :class:`DisaggCoordinator` runs on the prefill
+  side: it scores decode peers by FREE KV BLOCKS (scraped over the
+  relay's KV_STATS frame, the same signal the /stats genserver block
+  exposes), picks the handoff target power-of-two-choices, streams the
+  blocks chunked over the PR-8 relay lane (runtime/kvstream.py wire
+  format — length-prefixed tensor frames, no JSON/base64), and returns
+  the decoded tokens to the waiting request.
+* **Tensor-parallel dispatch** — :func:`shard_gen_pool` lays the paged
+  KV pool out over a ``parallel.mesh`` device mesh (KV heads sharded
+  over the ``tp`` axis when divisible) so the scheduler's compiled
+  prefill/decode executables partition across chips together with the
+  unit's mesh-sharded params (models/transformer.py param_shardings);
+  on CPU test platforms the same code runs over
+  ``jax_num_cpu_devices`` virtual devices and the compiled-vs-single-
+  device tokens are pinned identical (tests/test_servingmesh.py).
+
+Routing (gateway/balancer.py): replica endpoints carry a ``role``
+attribute (``+role:prefill`` endpoint-spec suffix); client generation
+traffic routes prefill-first — decode replicas never see a client
+request, they only import handoffs.  A generation request at a
+decode-only replica, a handoff at a non-decode replica, and a prefill
+replica with no reachable decode peer all answer a typed retryable 503
+(:class:`RoleMismatchError` / :class:`HandoffError`)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from seldon_core_tpu.messages import SeldonMessageError
+from seldon_core_tpu.runtime import kvstream
+from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
+
+__all__ = [
+    "GEN_ROLES",
+    "RoleMismatchError",
+    "HandoffError",
+    "disagg_enabled",
+    "resolve_gen_role",
+    "parse_decode_peers",
+    "DisaggCoordinator",
+    "resolve_gen_mesh",
+    "shard_gen_pool",
+]
+
+logger = logging.getLogger(__name__)
+
+GEN_ROLES = ("unified", "prefill", "decode")
+
+
+class RoleMismatchError(SeldonMessageError):
+    """A request landed on a replica whose generation role cannot serve
+    it (generation at a decode-only replica, a KV handoff at a
+    non-decode replica).  503: retryable — the right replica exists,
+    routing just has to find it."""
+
+    http_code = 503
+
+
+class HandoffError(SeldonMessageError):
+    """A prefill->decode handoff could not complete (no reachable peer,
+    peer pool full on every candidate, stream torn).  503: retryable —
+    another prefill replica, or the same one a moment later, may have a
+    healthy peer."""
+
+    http_code = 503
+
+
+def disagg_enabled() -> bool:
+    """Kill switch: ``SELDON_TPU_DISAGG=0`` forces every engine to the
+    unified single-replica generation path, bit-for-bit PR 7."""
+    return os.environ.get("SELDON_TPU_DISAGG", "1") != "0"
+
+
+def resolve_gen_role(requested: Optional[str]) -> str:
+    role = (requested or os.environ.get("ENGINE_GEN_ROLE", "")
+            ).strip().lower() or "unified"
+    if role not in GEN_ROLES:
+        raise ValueError(
+            f"unknown generation role {role!r} (expected one of "
+            f"{GEN_ROLES})")
+    if not disagg_enabled():
+        return "unified"
+    return role
+
+
+def parse_decode_peers(raw: Optional[str] = None) -> List[str]:
+    """``ENGINE_DECODE_PEERS`` — comma-separated relay specs
+    (``uds:/path`` or ``tcp:host:port``) of the decode replicas a
+    prefill replica may hand off to."""
+    raw = raw if raw is not None else os.environ.get(
+        "ENGINE_DECODE_PEERS", "")
+    return [p.strip() for p in raw.split(",") if p.strip()]
+
+
+# -- tensor-parallel dispatch -------------------------------------------
+
+def resolve_gen_mesh(mesh_axes: Optional[Dict[str, int]] = None):
+    """Build a device mesh for the generation lane: explicit axes, the
+    ``SELDON_TPU_GEN_MESH`` env (``tp=2`` syntax), or None (single
+    device — today's path)."""
+    if mesh_axes is None:
+        raw = os.environ.get("SELDON_TPU_GEN_MESH", "").strip()
+        if not raw:
+            return None
+        mesh_axes = {}
+        for part in raw.split(","):
+            name, _, val = part.partition("=")
+            mesh_axes[name.strip()] = int(val)
+    from seldon_core_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(dict(mesh_axes)))
+
+
+def shard_gen_pool(mesh, pool):
+    """Lay the paged KV pool out over the mesh: KV heads shard over the
+    ``tp`` axis when divisible (each device holds its heads' blocks —
+    attention per head stays device-local, so the compiled program's
+    per-element math is unchanged and collectives are pure data
+    movement), everything else replicates.  Composes with mesh-sharded
+    params: GSPMD partitions the whole prefill/decode executable."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = "tp" if "tp" in mesh.axis_names else None
+    tp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1) \
+        if axis else 1
+    out = {}
+    for li, layer in pool.items():
+        new = {}
+        for name, arr in layer.items():
+            kv = arr.shape[2] if arr.ndim >= 3 else 0
+            if axis and arr.ndim >= 3 and kv % tp == 0 and tp > 1:
+                spec = P(*([None, None, axis] + [None] * (arr.ndim - 3)))
+            else:
+                spec = P()
+            new[name] = jax.device_put(arr, NamedSharding(mesh, spec))
+        out[li] = new
+    return out
+
+
+# -- the prefill-side coordinator ---------------------------------------
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class DisaggCoordinator:
+    """Drives prefill->decode handoffs for one prefill-role GenServer.
+
+    Owns a private asyncio loop on a daemon thread (the scheduler thread
+    must never block on a peer); the scheduler submits finished-prefill
+    exports and gets the decoded tokens back through a completion
+    callback.  Peer choice is power-of-two-choices over the decode
+    replicas' FREE-KV-BLOCK score (KV_STATS over the relay, cached
+    ``SELDON_TPU_KV_STATS_TTL_S``) — the decode-side analogue of the
+    gateway's p2c, with pool headroom as the load signal because KV
+    residency, not CPU, is what a decode replica runs out of.
+
+    A peer that refuses a BEGIN (pool full / role misconfig) costs one
+    round trip and the next candidate is tried; a stream torn mid-flight
+    sends a best-effort ABORT (the decode side's TTL reaper is the
+    backstop) and the request fails typed + retryable."""
+
+    def __init__(self, peers: List[str], *,
+                 chunk_blocks: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 event_sink: Optional[Callable[..., None]] = None):
+        if not peers:
+            raise ValueError("DisaggCoordinator needs at least one peer")
+        self.peers = list(peers)
+        self.chunk_blocks = chunk_blocks or kvstream.chunk_blocks_default()
+        self.timeout_s = timeout_s or _env_float(
+            "SELDON_TPU_KV_HANDOFF_TIMEOUT_S", 120.0)
+        self.stats_ttl_s = _env_float("SELDON_TPU_KV_STATS_TTL_S", 1.0)
+        self._event_sink = event_sink
+        self._rng = random.Random(0xD15A66)
+        self._clients: Dict[str, Any] = {}
+        self._free: Dict[str, "tuple[int, float]"] = {}  # peer -> (free, ts)
+        self._lock = threading.Lock()
+        self.handoffs: Dict[str, int] = {}
+        self.inflight = 0
+        self.bytes_total = 0
+        self.tokens_total = 0
+        self.latency_ms = Reservoir(512)
+        #: rolling full-chain estimate (export+stream+remote decode) the
+        #: engine's deadline-aware admission prices requests with
+        self.chain_ewma_s = 0.0
+        import asyncio
+
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="disagg-coordinator",
+            daemon=True)
+        self._thread.start()
+
+    # -- client surface (scheduler thread) ------------------------------
+
+    def submit(self, export: kvstream.KvExport,
+               done_cb: Callable[[Any], None]) -> None:
+        """Fire one handoff; ``done_cb`` receives the decoded token
+        array (np int32 [max_new]) or an exception, from the coordinator
+        thread."""
+        import asyncio
+
+        asyncio.run_coroutine_threadsafe(
+            self._handoff(export, done_cb), self._loop)
+
+    def chain_estimate_s(self) -> Optional[float]:
+        return self.chain_ewma_s or None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = self.latency_ms.snapshot()
+            return {
+                "peers": list(self.peers),
+                "peer_free_blocks": {
+                    p: f for p, (f, _) in self._free.items()
+                },
+                "handoffs": dict(self.handoffs),
+                "inflight": self.inflight,
+                "bytes_total": self.bytes_total,
+                "tokens_total": self.tokens_total,
+                "handoff_ms_p50": lat.get("p50"),
+                "handoff_ms_p99": lat.get("p99"),
+                "bytes_per_tok": (
+                    round(self.bytes_total / self.tokens_total, 1)
+                    if self.tokens_total else None
+                ),
+                "chain_ewma_ms": round(self.chain_ewma_s * 1e3, 3),
+            }
+
+    def close(self) -> None:
+        import asyncio
+
+        async def _shutdown():
+            for c in self._clients.values():
+                try:
+                    await c.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            self._loop.stop()
+
+        if self._loop.is_running():
+            asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
+            self._thread.join(timeout=5)
+        if not self._loop.is_running():
+            self._loop.close()
+
+    # -- coordinator loop -------------------------------------------------
+
+    def _client(self, peer: str):
+        client = self._clients.get(peer)
+        if client is None or client.closed:
+            from seldon_core_tpu.runtime.udsrelay import make_relay_client
+
+            client = make_relay_client(peer)
+            self._clients[peer] = client
+        return client
+
+    def _account(self, outcome: str) -> None:
+        with self._lock:
+            self.handoffs[outcome] = self.handoffs.get(outcome, 0) + 1
+        RECORDER.record_kv_handoff(outcome)
+
+    async def _refresh_free(self, peer: str) -> int:
+        """Cached free-KV-block score for one peer; a dead scrape scores
+        it 0 (it still serves when every candidate is dead — the pick
+        never fails on stale health alone)."""
+        import asyncio
+
+        now = time.monotonic()
+        cached = self._free.get(peer)
+        if cached is not None and now - cached[1] < self.stats_ttl_s:
+            return cached[0]
+        free = 0
+        try:
+            body, status = await asyncio.wait_for(
+                self._client(peer).call(
+                    _OP_KVSTREAM(), kvstream.stats_frame(),
+                ), timeout=2.0,
+            )
+            if status == 200:
+                free = kvstream.unpack_stats(body)["free"]
+        except Exception:  # noqa: BLE001 - degraded peer scores 0
+            free = 0
+        with self._lock:
+            self._free[peer] = (free, now)
+        return free
+
+    async def _pick_order(self) -> List[str]:
+        """Peers in try-order: p2c by free-block score, remaining peers
+        appended as fallbacks (a refused BEGIN walks down the list)."""
+        if len(self.peers) == 1:
+            return list(self.peers)
+        i, j = self._rng.sample(range(len(self.peers)), 2)
+        a, b = self.peers[i], self.peers[j]
+        fa = await self._refresh_free(a)
+        fb = await self._refresh_free(b)
+        first, second = (a, b) if fa >= fb else (b, a)
+        rest = [p for p in self.peers if p not in (first, second)]
+        return [first, second] + rest
+
+    async def _handoff(self, export: kvstream.KvExport, done_cb) -> None:
+        t0 = time.perf_counter()
+        with self._lock:
+            self.inflight += 1
+        RECORDER.set_kv_handoff_inflight(self.inflight)
+        hid = uuid.uuid4().bytes
+        try:
+            tokens, peer, nbytes = await self._stream(export, hid)
+            wall = time.perf_counter() - t0
+            with self._lock:
+                self.inflight -= 1
+                self.bytes_total += nbytes
+                self.tokens_total += int(tokens.size)
+                self.latency_ms.observe(wall * 1e3)
+                a = 0.2
+                self.chain_ewma_s = (
+                    wall if self.chain_ewma_s == 0.0
+                    else (1 - a) * self.chain_ewma_s + a * wall
+                )
+            self._account("ok")
+            RECORDER.observe_kv_handoff(wall, nbytes)
+            RECORDER.set_kv_handoff_inflight(self.inflight)
+            if self._event_sink is not None:
+                try:
+                    self._event_sink(
+                        event="kv_handoff", peer=peer,
+                        tokens=int(tokens.size), bytes=nbytes,
+                        latency_ms=round(wall * 1e3, 3),
+                    )
+                except Exception:  # noqa: BLE001 - sink must not fail the hop
+                    pass
+            done_cb(tokens)
+        except Exception as e:  # noqa: BLE001 - surfaced typed per request
+            with self._lock:
+                self.inflight -= 1
+            self._account(
+                "torn" if isinstance(e, ConnectionError) else "error")
+            RECORDER.set_kv_handoff_inflight(self.inflight)
+            if isinstance(e, SeldonMessageError):
+                done_cb(e)
+            else:
+                done_cb(HandoffError(
+                    f"prefill->decode handoff failed: {e}"))
+
+    async def _stream(self, export: kvstream.KvExport, hid: bytes):
+        """BEGIN on the best peer (walking the p2c order on refusals),
+        then the chunked block stream and the COMMIT that answers with
+        the decoded tokens."""
+        import asyncio
+
+        order = await self._pick_order()
+        begin = kvstream.begin_frame(export, hid)
+        client = None
+        peer = None
+        last_refusal = "no decode peers configured"
+        for candidate in order:
+            try:
+                c = self._client(candidate)
+                body, status = await asyncio.wait_for(
+                    c.call(_OP_KVSTREAM(), begin), timeout=10.0,
+                )
+            except Exception as e:  # noqa: BLE001 - dead peer: next one
+                last_refusal = f"{candidate}: {e}"
+                continue
+            if status == 200:
+                client, peer = c, candidate
+                break
+            last_refusal = (
+                f"{candidate}: {body.decode('utf-8', 'replace')[:200]}")
+            self._account("refused")
+        if client is None:
+            raise HandoffError(
+                f"no decode peer accepted the handoff ({last_refusal})")
+        nbytes = len(begin)
+        try:
+            for frame in kvstream.block_frames(
+                    export, hid, self.chunk_blocks):
+                nbytes += len(frame)
+                body, status = await asyncio.wait_for(
+                    client.call(_OP_KVSTREAM(), frame),
+                    timeout=self.timeout_s,
+                )
+                if status != 200:
+                    raise HandoffError(
+                        f"decode peer {peer} rejected a block frame: "
+                        f"{body.decode('utf-8', 'replace')[:200]}")
+            body, status = await asyncio.wait_for(
+                client.call(_OP_KVSTREAM(), kvstream.commit_frame(hid)),
+                timeout=self.timeout_s,
+            )
+            if status != 200:
+                raise HandoffError(
+                    f"decode peer {peer} failed the commit: "
+                    f"{body.decode('utf-8', 'replace')[:200]}")
+            return kvstream.unpack_tokens(body), peer, nbytes
+        except (Exception, asyncio.CancelledError):
+            # torn mid-stream: best-effort abort frees the reservation
+            # now; the decode side's TTL reaper is the backstop
+            try:
+                await asyncio.wait_for(
+                    client.call(
+                        _OP_KVSTREAM(), kvstream.abort_frame(hid)),
+                    timeout=2.0,
+                )
+            except Exception:  # noqa: BLE001 - the reaper covers this
+                pass
+            raise
+
+
+def _OP_KVSTREAM() -> int:
+    from seldon_core_tpu.runtime.udsrelay import OP_KVSTREAM
+
+    return OP_KVSTREAM

@@ -21,18 +21,30 @@ Ops:
     OP_PREDICT   payload = SeldonMessage JSON  -> response JSON + status
     OP_FEEDBACK  payload = Feedback JSON       -> ack JSON + status
     OP_PING      empty                         -> b"pong", 200
+    OP_KVSTREAM  payload = binary KV-handoff frame (runtime/kvstream.py)
+                 -> binary body + status (disaggregated prefill->decode
+                 block streaming; bytes in, bytes out — never JSON)
 
-Scope (documented contract, tests/test_udsrelay.py): unary predict and
-feedback only — SSE streaming and the observability surfaces stay on the
-TCP lane (an endpoint spec ``http://..+uds:/path`` carries both).  The
-frame carries no headers, so deadline budgets and trace context do NOT
-propagate to the engine on this lane: the gateway clamps the hop to its
-remaining budget locally (apife._uds_call) and the hop is traced from
-the gateway span only.  Calls needing engine-side deadline clamping or
-joined engine spans belong on the TCP lane.  The
+Metadata sidecar: setting the high bit of the op byte (``op | 0x80``)
+marks the payload as ``uvarint(meta_len) | meta_block | body``.  The
+meta block (version byte first — old, sidecar-less frames still parse
+on new servers, and a version bump can't be confused for body bytes)
+carries the request deadline, W3C traceparent, tenant and tier, so
+deadline clamps, trace trees and tenant accounting survive the
+gateway->engine relay hop that PR 8 documented as a scope gap.  The
+server binds them around the handler exactly like the HTTP lanes bind
+headers; a client that sends no sidecar gets the old behaviour
+(gateway-side clamp only).
+
+Scope (documented contract, tests/test_udsrelay.py): unary predict,
+feedback and the KV-handoff stream — SSE streaming and the
+observability surfaces stay on the HTTP lane (an endpoint spec
+``http://..+uds:/path`` carries both).  The
 client pipelines nothing: each pooled connection carries one request at
 a time, so responses can never interleave.  ``SELDON_TPU_UDS=0``
-(gateway/balancer.py) keeps every dispatch on TCP.
+(gateway/balancer.py) keeps every dispatch on TCP.  The same framed
+protocol also binds on a TCP port (``serve_relay_tcp`` /
+:class:`TcpRelayClient`) so KV handoffs can cross hosts.
 """
 
 from __future__ import annotations
@@ -52,17 +64,34 @@ __all__ = [
     "OP_PREDICT",
     "OP_FEEDBACK",
     "OP_PING",
+    "OP_KVSTREAM",
+    "META_FLAG",
+    "RELAY_META_VERSION",
     "UdsEngineServer",
+    "TcpRelayServer",
     "UdsRelayClient",
+    "TcpRelayClient",
+    "make_relay_client",
+    "pack_relay_meta",
+    "unpack_relay_meta",
+    "current_relay_meta",
     "serve_uds",
+    "serve_relay_tcp",
 ]
 
 OP_PREDICT = 1
 OP_FEEDBACK = 2
 OP_PING = 3
+OP_KVSTREAM = 4
+
+#: high bit of the op byte: payload begins with a varint-prefixed
+#: metadata block (deadline/traceparent/tenant/tier sidecar)
+META_FLAG = 0x80
+RELAY_META_VERSION = 1
 
 _REQ_HEAD = struct.Struct("!IB")   # payload length, op
 _RESP_HEAD = struct.Struct("!IH")  # payload length, status
+_META_HEAD = struct.Struct("!Bd")  # version, deadline_ms (<=0 = absent)
 _MAX_FRAME = 256 * 1024 * 1024     # matches the HTTP lanes' body cap
 _JSON_500 = 500
 # per-connection backpressure: the shipped client never pipelines, but
@@ -73,6 +102,95 @@ _JSON_500 = 500
 # client's writes block.
 _PAUSE_PENDING = 64
 _RESUME_PENDING = 16
+
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_uvarint(view, off: int) -> "tuple[int, int]":
+    shift = 0
+    val = 0
+    while True:
+        if off >= len(view):
+            raise ValueError("truncated varint")
+        b = view[off]
+        off += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, off
+        shift += 7
+        if shift > 35:
+            raise ValueError("varint too long")
+
+
+def _pack_str(s: "str | None") -> bytes:
+    raw = (s or "").encode("utf-8", "replace")
+    return _uvarint(len(raw)) + raw
+
+
+def pack_relay_meta(deadline_ms=None, traceparent=None, tenant=None,
+                    tier=None) -> bytes:
+    """The request-frame metadata sidecar: deadline budget, W3C trace
+    context, tenant and tier, packed version-first so a future field can
+    ride behind a version bump without breaking old parsers."""
+    return (
+        _META_HEAD.pack(RELAY_META_VERSION,
+                        float(deadline_ms) if deadline_ms else -1.0)
+        + _pack_str(traceparent) + _pack_str(tenant) + _pack_str(tier)
+    )
+
+
+def unpack_relay_meta(view) -> dict:
+    """Lenient sidecar parse: a malformed or future-versioned block
+    degrades to 'no metadata' — bad metadata must never fail a request
+    that would otherwise serve (the deadline-header rule)."""
+    out = {"deadline_ms": None, "traceparent": None, "tenant": None,
+           "tier": None}
+    try:
+        version, deadline_ms = _META_HEAD.unpack_from(view, 0)
+        if version != RELAY_META_VERSION:
+            return out
+        if deadline_ms > 0:
+            out["deadline_ms"] = deadline_ms
+        off = _META_HEAD.size
+        for key in ("traceparent", "tenant", "tier"):
+            n, off = _read_uvarint(view, off)
+            raw = bytes(view[off:off + n])
+            off += n
+            if raw:
+                out[key] = raw.decode("utf-8", "replace")
+    except (struct.error, ValueError):
+        return {"deadline_ms": None, "traceparent": None, "tenant": None,
+                "tier": None}
+    return out
+
+
+def current_relay_meta() -> "bytes | None":
+    """The calling context's deadline/trace/tenant/tier as a sidecar
+    block, or None when nothing is bound (the frame then goes out in the
+    old, sidecar-less format — wire bytes identical to PR 8)."""
+    from seldon_core_tpu.runtime.qos import current_tenant, current_tier
+    from seldon_core_tpu.runtime.resilience import remaining_s
+    from seldon_core_tpu.utils.tracing import traceparent_header_value
+
+    rem = remaining_s()
+    traceparent = traceparent_header_value()
+    tenant = current_tenant()
+    tier = current_tier()
+    if rem is None and traceparent is None and tenant is None \
+            and tier == "interactive":
+        return None
+    return pack_relay_meta(
+        deadline_ms=max(rem * 1e3, 1.0) if rem is not None else None,
+        traceparent=traceparent, tenant=tenant, tier=tier,
+    )
 
 
 class _UdsServerProtocol(asyncio.Protocol):
@@ -194,20 +312,38 @@ class _UdsServerProtocol(asyncio.Protocol):
                 # the payload is sliced as a view of the receive buffer
                 # and decoded exactly once — the engine's predict_json
                 # contract is str, and that decode is the lane's only
-                # copy.  release() before the buffer trim below: a live
-                # export would make the bytearray unresizable.
+                # copy (binary ops take ONE bytes copy instead — no
+                # base64, no JSON).  release() before the buffer trim
+                # below: a live export would make the bytearray
+                # unresizable.
+                meta = None
+                has_meta = bool(op & META_FLAG)
+                op &= ~META_FLAG
                 with view[start: start + length] as payload:
-                    text = str(payload, "utf-8", "replace")
-                self._dispatch(op, text)
+                    lo = 0
+                    if has_meta:
+                        try:
+                            meta_len, off = _read_uvarint(payload, 0)
+                            with payload[off:off + meta_len] as mv:
+                                meta = unpack_relay_meta(mv)
+                            lo = off + meta_len
+                        except ValueError:
+                            meta = None
+                    with payload[lo:] as body:
+                        if op == OP_KVSTREAM:
+                            data: "str | bytes" = bytes(body)
+                        else:
+                            data = str(body, "utf-8", "replace")
+                self._dispatch(op, data, meta)
                 consumed = start + length
         finally:
             view.release()
         if consumed:
             del self.buf[:consumed]
 
-    def _dispatch(self, op: int, text: str):
+    def _dispatch(self, op: int, data, meta=None):
         task = asyncio.get_running_loop().create_task(
-            self._handle(op, text)
+            self._handle(op, data, meta)
         )
         # the writer normally consumes the result; if it is cancelled
         # mid-await (client hung up) the in-flight handler finishes
@@ -221,16 +357,46 @@ class _UdsServerProtocol(asyncio.Protocol):
             self.paused = True
             self.transport.pause_reading()
 
-    async def _handle(self, op: int, text: str):
+    async def _handle(self, op: int, data, meta=None):
+        if meta is not None:
+            # bind the sidecar exactly like the HTTP lanes bind headers:
+            # deadline clamps tighten-only, trace joins the caller's
+            # tree, tenant/tier feed accounting and the tier lanes
+            from contextlib import AsyncExitStack
+
+            from seldon_core_tpu.runtime.qos import qos_scope
+            from seldon_core_tpu.runtime.resilience import (
+                maybe_deadline_scope,
+            )
+            from seldon_core_tpu.utils.tracing import (
+                parse_traceparent,
+                trace_scope,
+            )
+
+            async with AsyncExitStack() as stack:
+                dl = meta.get("deadline_ms")
+                stack.enter_context(
+                    maybe_deadline_scope(dl / 1e3 if dl else None))
+                stack.enter_context(trace_scope(
+                    parse_traceparent(meta.get("traceparent"))))
+                stack.enter_context(
+                    qos_scope(meta.get("tenant"), meta.get("tier")))
+                return await self._handle(op, data, None)
         if op == OP_PREDICT:
-            text_out, status = await self.engine.predict_json(text)
+            text_out, status = await self.engine.predict_json(data)
             return status or 200, text_out.encode()
         if op == OP_FEEDBACK:
-            fb = Feedback.from_json(text)
+            fb = Feedback.from_json(data)
             ack = await self.engine.send_feedback(fb)
             ok = ack.status is None or ack.status.status == "SUCCESS"
             status = 200 if ok else (ack.status.code or 200)
             return status or 200, ack.to_json().encode()
+        if op == OP_KVSTREAM:
+            handler = getattr(self.engine, "kv_frame", None)
+            if handler is None:
+                return 503, b"engine does not accept KV handoffs"
+            status, body = await handler(data)
+            return status or 200, body
         if op == OP_PING:
             return 200, b"pong"
         return 400, SeldonMessage.failure(
@@ -285,6 +451,48 @@ async def serve_uds(engine, path: str) -> UdsEngineServer:
     return server
 
 
+class TcpRelayServer:
+    """The same framed relay protocol on a TCP port — the cross-host
+    lane for KV-block handoffs (a decode replica on another host cannot
+    share a unix socket).  Everything above the transport is identical
+    to the UDS server."""
+
+    def __init__(self, engine, host: str, port: int):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._protocols: set = set()
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(
+            lambda: _UdsServerProtocol(self.engine, self._protocols),
+            self.host, self.port,
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.close()
+        for proto in list(self._protocols):
+            if proto.transport is not None:
+                proto.transport.close()
+        try:
+            await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+        except asyncio.TimeoutError:
+            pass
+        self._server = None
+
+
+async def serve_relay_tcp(engine, host: str, port: int) -> TcpRelayServer:
+    server = TcpRelayServer(engine, host, port)
+    await server.start()
+    return server
+
+
 class UdsRelayClient:
     """Pooled relay client: up to ``pool`` persistent connections to one
     engine socket, each carrying one request at a time (acquire ->
@@ -301,6 +509,9 @@ class UdsRelayClient:
         self._open = 0
         self._lock = asyncio.Lock()
         self.closed = False
+
+    async def _connect(self):
+        return await asyncio.open_unix_connection(self.path)
 
     async def _acquire(self):
         while True:
@@ -320,7 +531,7 @@ class UdsRelayClient:
                 if self._open < self.pool:
                     self._open += 1
                     try:
-                        return await asyncio.open_unix_connection(self.path)
+                        return await self._connect()
                     except (OSError, asyncio.CancelledError):
                         # CancelledError: a deadline timeout landed mid-
                         # dial — the slot must go back or N timeouts
@@ -351,12 +562,20 @@ class UdsRelayClient:
             return
         self._idle.put_nowait(conn)
 
-    async def call(self, op: int, payload: bytes) -> "tuple[bytes, int]":
-        """One framed round trip; returns ``(body, status)``."""
+    async def call(self, op: int, payload: bytes,
+                   meta: "bytes | None" = None) -> "tuple[bytes, int]":
+        """One framed round trip; returns ``(body, status)``.  ``meta``
+        (pack_relay_meta) rides the sidecar: the op byte's high bit is
+        set and the payload is prefixed with the varint-length metadata
+        block.  None keeps the PR-8 wire bytes exactly."""
         if self.closed:
             raise ConnectionError("relay client closed")
         conn = await self._acquire()
         reader, writer = conn
+        if meta:
+            op |= META_FLAG
+            prefix = _uvarint(len(meta)) + meta
+            payload = prefix + payload
         try:
             writer.write(_REQ_HEAD.pack(len(payload), op))
             if payload:
@@ -399,3 +618,34 @@ class UdsRelayClient:
                 continue
             self._open -= 1
             conn[1].close()
+
+
+class TcpRelayClient(UdsRelayClient):
+    """The pooled relay client over TCP — dial semantics aside,
+    identical to the UDS client (one request per pooled connection,
+    broken connections release a capacity token)."""
+
+    def __init__(self, host: str, port: int, pool: int = 8):
+        super().__init__(f"tcp:{host}:{port}", pool=pool)
+        self.host = host
+        self.port = int(port)
+
+    async def _connect(self):
+        return await asyncio.open_connection(self.host, self.port)
+
+
+def make_relay_client(spec: str, pool: int = 8) -> UdsRelayClient:
+    """Relay client for a peer spec: ``uds:/path`` (or a bare path) dials
+    the unix socket, ``tcp:host:port`` the TCP lane."""
+    spec = spec.strip()
+    if spec.startswith("tcp:"):
+        rest = spec[len("tcp:"):]
+        host, _, port = rest.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"bad tcp relay spec {spec!r}")
+        return TcpRelayClient(host, int(port), pool=pool)
+    if spec.startswith("uds:"):
+        spec = spec[len("uds:"):]
+    if not spec:
+        raise ValueError("empty relay peer spec")
+    return UdsRelayClient(spec, pool=pool)

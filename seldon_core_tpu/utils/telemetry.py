@@ -177,6 +177,16 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_brownout_stage": ("gauge", ()),
     "seldon_tpu_brownout_transitions_total": ("counter", ("stage",)),
     "seldon_tpu_brownout_shed_total": ("counter", ("tier",)),
+    # disaggregated prefill/decode serving mesh (runtime/servingmesh.py
+    # + runtime/kvstream.py): KV-block handoff outcomes (prefill side:
+    # ok|refused|torn|error; decode side: imported|reclaimed), the
+    # handoff wall-clock distribution, streamed bytes, and in-flight
+    # handoffs — the SeldonTPUKVHandoffStall alert pages when handoffs
+    # sit in flight with no completion for minutes
+    "seldon_tpu_kv_handoff_total": ("counter", ("outcome",)),
+    "seldon_tpu_kv_handoff_seconds": ("histogram", ()),
+    "seldon_tpu_kv_handoff_bytes_total": ("counter", ()),
+    "seldon_tpu_kv_handoff_inflight": ("gauge", ()),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -319,6 +329,13 @@ class FlightRecorder:
         self.gen_admitted = 0
         self.gen_retired: Dict[str, int] = {}
         self.gen_steps: Dict[str, int] = {}
+        # disaggregated serving-mesh mirrors (runtime/servingmesh.py
+        # coordinator + runtime/genserver.py import path): handoff
+        # outcomes, latency reservoir, streamed bytes, in-flight gauge
+        self.kv_handoffs: Dict[str, int] = {}          # outcome -> n
+        self.kv_handoff_latency = Reservoir()
+        self.kv_handoff_bytes = 0
+        self.kv_handoff_inflight = 0
         # serving-mesh mirrors (gateway/balancer.py feeds these): per-
         # set per-replica gateway-side inflight + lifetime picks,
         # hindsight mispicks, and gateway->engine requests by relay lane
@@ -573,6 +590,26 @@ class FlightRecorder:
                 "Scheduler steps executed, by kind (prefill / decode / "
                 "spec / mixed)",
                 ["kind"], registry=self.registry)
+            self._p_kv_handoff = Counter(
+                "seldon_tpu_kv_handoff_total",
+                "Disaggregated KV-block handoffs by outcome (prefill "
+                "side: ok / refused / torn / error; decode side: "
+                "imported / reclaimed — runtime/servingmesh.py)",
+                ["outcome"], registry=self.registry)
+            self._p_kv_handoff_seconds = Histogram(
+                "seldon_tpu_kv_handoff_seconds",
+                "Wall-clock of one prefill->decode handoff (export + "
+                "chunked block stream + remote decode admission)",
+                registry=self.registry, buckets=_DISPATCH_BUCKETS)
+            self._p_kv_handoff_bytes = Counter(
+                "seldon_tpu_kv_handoff_bytes_total",
+                "KV bytes streamed over the relay's OP_KVSTREAM frames",
+                registry=self.registry)
+            self._p_kv_handoff_inflight = Gauge(
+                "seldon_tpu_kv_handoff_inflight",
+                "Handoffs currently in flight on this prefill replica "
+                "(the SeldonTPUKVHandoffStall axis)",
+                registry=self.registry)
             self._p_replica_inflight = Gauge(
                 "seldon_tpu_replica_inflight",
                 "Gateway-side in-flight requests per engine replica "
@@ -771,6 +808,31 @@ class FlightRecorder:
             self.gen_steps[kind] = self.gen_steps.get(kind, 0) + n
         if self.registry is not None:
             self._p_gen_steps.labels(kind=kind).inc(n)
+
+    # -- disaggregated serving mesh (runtime/servingmesh.py) -------------
+
+    def record_kv_handoff(self, outcome: str, n: int = 1) -> None:
+        self._gen += 1
+        with self._lock:
+            self.kv_handoffs[outcome] = \
+                self.kv_handoffs.get(outcome, 0) + n
+        if self.registry is not None:
+            self._p_kv_handoff.labels(outcome=outcome).inc(n)
+
+    def observe_kv_handoff(self, seconds: float, nbytes: int) -> None:
+        self._gen += 1
+        with self._lock:
+            self.kv_handoff_latency.observe(seconds * 1e3)
+            self.kv_handoff_bytes += int(nbytes)
+        if self.registry is not None:
+            self._p_kv_handoff_seconds.observe(seconds)
+            self._p_kv_handoff_bytes.inc(nbytes)
+
+    def set_kv_handoff_inflight(self, n: int) -> None:
+        with self._lock:
+            self.kv_handoff_inflight = int(n)
+        if self.registry is not None:
+            self._p_kv_handoff_inflight.set(n)
 
     # -- serving-mesh balancer (gateway/balancer.py feeds these) ---------
 
@@ -1306,6 +1368,10 @@ class FlightRecorder:
                 "speculative_accept_ratio": self.accept_ratio.snapshot(),
                 "kv_cache_slots": kv,
                 "continuous": gen_sched,
+                "kv_handoffs": dict(self.kv_handoffs),
+                "kv_handoff_ms": self.kv_handoff_latency.snapshot(),
+                "kv_handoff_bytes": self.kv_handoff_bytes,
+                "kv_handoff_inflight": self.kv_handoff_inflight,
             },
             "compile_cache_events": cc,
             "trace_spans": trace_spans,
@@ -1398,6 +1464,10 @@ class FlightRecorder:
             self.gen_admitted = 0
             self.gen_retired = {}
             self.gen_steps = {}
+            self.kv_handoffs = {}
+            self.kv_handoff_latency = Reservoir()
+            self.kv_handoff_bytes = 0
+            self.kv_handoff_inflight = 0
             self.replica_inflight = {}
             self.replica_picks = {}
             self.replica_mispicks = 0

@@ -151,5 +151,11 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_brownout_stage",
         "seldon_tpu_brownout_shed_total",
         "seldon_tpu_brownout_transitions_total",
+        # disaggregated prefill/decode serving mesh
+        # (runtime/servingmesh.py + runtime/kvstream.py)
+        "seldon_tpu_kv_handoff_total",
+        "seldon_tpu_kv_handoff_seconds",
+        "seldon_tpu_kv_handoff_bytes_total",
+        "seldon_tpu_kv_handoff_inflight",
     ):
         assert family in text, f"{family} missing from every dashboard"

@@ -423,3 +423,157 @@ def test_relay_oversized_frame_413_ordered_behind_pending(tmp_path):
             await server.stop()
 
     asyncio.run(run())
+
+
+def test_relay_meta_sidecar_binds_deadline_tenant_trace(tmp_path):
+    """The varint-prefixed metadata block (op | META_FLAG) binds the
+    deadline, trace context and tenant/tier around the engine handler —
+    the PR-8 scope gap closed.  A slow engine sees the clamped budget
+    and the tenant lands in the handler's context."""
+    from seldon_core_tpu.runtime.qos import current_tenant, current_tier
+    from seldon_core_tpu.runtime.resilience import remaining_s
+    from seldon_core_tpu.runtime.udsrelay import pack_relay_meta
+
+    seen = {}
+
+    class Probe:
+        async def predict_json(self, text):
+            seen["remaining"] = remaining_s()
+            seen["tenant"] = current_tenant()
+            seen["tier"] = current_tier()
+            from seldon_core_tpu.utils.tracing import (
+                current_trace_context,
+            )
+
+            ctx = current_trace_context()
+            seen["trace_id"] = None if ctx is None else ctx.trace_id
+            return json.dumps({"ok": True}), 200
+
+    async def run():
+        path = str(tmp_path / "probe.sock")
+        server = await serve_uds(Probe(), path)
+        client = UdsRelayClient(path)
+        try:
+            meta = pack_relay_meta(
+                deadline_ms=1500.0,
+                traceparent=(
+                    "00-0123456789abcdef0123456789abcdef-"
+                    "0123456789abcdef-01"
+                ),
+                tenant="acme", tier="batch",
+            )
+            body, status = await client.call(
+                OP_PREDICT, payload().encode(), meta=meta)
+            assert status == 200
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+    assert seen["remaining"] is not None and 0 < seen["remaining"] <= 1.5
+    assert seen["tenant"] == "acme"
+    assert seen["tier"] == "batch"
+    assert seen["trace_id"] == "0123456789abcdef0123456789abcdef"
+
+
+def test_relay_old_format_frames_still_parse(tmp_path):
+    """Sidecar-less frames (the PR-8 wire bytes exactly) keep working on
+    a sidecar-aware server — and bind NO context."""
+    from seldon_core_tpu.runtime.qos import current_tenant
+    from seldon_core_tpu.runtime.resilience import remaining_s
+
+    seen = {}
+
+    class Probe:
+        async def predict_json(self, text):
+            seen["remaining"] = remaining_s()
+            seen["tenant"] = current_tenant()
+            return json.dumps({"ok": True}), 200
+
+    async def run():
+        path = str(tmp_path / "probe.sock")
+        server = await serve_uds(Probe(), path)
+        client = UdsRelayClient(path)
+        try:
+            body, status = await client.call(
+                OP_PREDICT, payload().encode())  # no meta: old format
+            assert status == 200
+        finally:
+            await client.close()
+            await server.stop()
+
+    asyncio.run(run())
+    assert seen["remaining"] is None
+    assert seen["tenant"] is None
+
+
+def test_gateway_uds_call_ships_meta_sidecar(tmp_path):
+    """The gateway's relay hop now carries its deadline/tenant context
+    to the engine (apife._uds_call -> current_relay_meta)."""
+    from seldon_core_tpu.runtime.qos import qos_scope
+    from seldon_core_tpu.runtime.resilience import (
+        deadline_scope,
+        remaining_s,
+    )
+
+    seen = {}
+
+    class Probe:
+        async def predict_json(self, text):
+            seen["remaining"] = remaining_s()
+            from seldon_core_tpu.runtime.qos import current_tenant
+
+            seen["tenant"] = current_tenant()
+            return json.dumps(
+                {"meta": {}, "status": {"code": 200,
+                                        "status": "SUCCESS"}}), 200
+
+    async def run():
+        path = str(tmp_path / "probe.sock")
+        server = await serve_uds(Probe(), path)
+        store = DeploymentStore()
+        store.register(sigmoid_spec(), engines={"p": [f"uds:{path}"]})
+        gw = ApiGateway(store, require_auth=False)
+        try:
+            with deadline_scope(2.0), qos_scope("acme", "batch"):
+                resp = await gw.predict(
+                    SeldonMessage(data=DefaultData(
+                        array=np.zeros((1, 4)))))
+            assert resp.status is None or resp.status.code in (None, 200)
+        finally:
+            await gw.close()
+            await server.stop()
+
+    asyncio.run(run())
+    assert seen["remaining"] is not None and seen["remaining"] <= 2.0
+    assert seen["tenant"] == "acme"
+
+
+def test_tcp_relay_lane_matches_uds():
+    """The framed relay over TCP (the cross-host KV-handoff lane) speaks
+    the identical protocol."""
+    from seldon_core_tpu.runtime.udsrelay import (
+        TcpRelayClient,
+        make_relay_client,
+        serve_relay_tcp,
+    )
+
+    async def run():
+        engine = EngineService(sigmoid_spec(), max_batch=8,
+                               max_wait_ms=0.5)
+        server = await serve_relay_tcp(engine, "127.0.0.1", 0)
+        client = TcpRelayClient("127.0.0.1", server.port)
+        try:
+            assert await client.ping()
+            text, status = await client.predict(payload())
+            assert status == 200
+            assert json.loads(text)["data"]["ndarray"]
+        finally:
+            await client.close()
+            await server.stop()
+            await engine.close()
+        # the spec parser picks the right transport
+        c = make_relay_client(f"tcp:127.0.0.1:{server.port}")
+        assert isinstance(c, TcpRelayClient)
+
+    asyncio.run(run())
