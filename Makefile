@@ -82,6 +82,17 @@ overload-demo:
 disagg-demo:
 	JAX_PLATFORMS=cpu python scripts/disagg_demo.py --out disagg_demo
 
+# fleet observability demo: a disaggregated generation traced END TO
+# END through the gateway's federated /trace (one causal tree across
+# gateway, prefill, KV-handoff and decode processes, critical path
+# summing to the root), a +30ms FaultyEngine replica surfacing as the
+# /fleet outlier, a coordinated profile window manifest with overlap
+# refusal, and the SELDON_TPU_FLEET=0 kill-switch contrast.  Artifacts
+# fleet_demo/fleet.json + trace_perfetto.json (scripts/fleet_demo.py;
+# docs/operations.md "The fleet observability plane")
+fleet-demo:
+	JAX_PLATFORMS=cpu python scripts/fleet_demo.py --out fleet_demo
+
 bench:
 	python bench.py
 
@@ -157,4 +168,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo bench overhead-gate ttft-gate fairness-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate demos train-demo stack bundle images publish release-dryrun

@@ -293,6 +293,10 @@ class ApiGateway:
         # bounded accounting.  Inert with default knobs (no rate limit,
         # fair queue off) — today's behaviour bit-for-bit
         self.tenants = TenantGovernor()
+        #: the coordinated-profiling manifest (gateway/fleet.py): the
+        #: latest window's per-source artifact paths; None until the
+        #: first POST /profile/start
+        self._profile_manifest = None
         # the fair queue's backlog is an overload signal for the
         # brownout ladder; the firehose carries its typed transitions
         self._brownout_key = f"gateway:{id(self)}"
@@ -912,6 +916,13 @@ class ApiGateway:
                 for _fp, rs in list(self._replica_sets.values()):
                     if len(rs) > 1:
                         await rs.scrape_once(self._get_session())
+                # fleet outlier gauges refresh off the docs the pass
+                # just stashed — zero extra polling (gateway/fleet.py)
+                from seldon_core_tpu.gateway.fleet import (
+                    refresh_outlier_gauges,
+                )
+
+                refresh_outlier_gauges(self)
             except Exception:
                 # a malformed /stats body (proxy interposing, engine
                 # mid-deploy) must not kill the loop: the task is never
@@ -1393,6 +1404,73 @@ def make_gateway_app(gateway: ApiGateway):
             **SPINE.overhead_document(),
         })
 
+    # -- the mesh-wide observability plane (gateway/fleet.py) ----------
+
+    async def trace(request):
+        # federated trace assembly: the named trace/puid fans out to
+        # every registered replica + relay peer and answers ONE merged
+        # causal tree; SELDON_TPU_FLEET=0 answers local spans only
+        from seldon_core_tpu.gateway.fleet import (
+            federated_trace_document,
+        )
+
+        doc = await federated_trace_document(
+            gateway,
+            trace_id=request.query.get("trace_id", ""),
+            puid=request.query.get("puid", ""),
+            limit=int(request.query.get("limit", "100") or 100),
+        )
+        return web.json_response(doc)
+
+    async def trace_export(request):
+        # the merged tree as Perfetto trace JSON, one process track per
+        # participant (replica/role)
+        from seldon_core_tpu.gateway.fleet import (
+            federated_export_document,
+        )
+
+        doc = await federated_export_document(
+            gateway,
+            trace_id=request.query.get("trace_id", ""),
+            puid=request.query.get("puid", ""),
+            limit=int(request.query.get("limit", "1000") or 1000),
+        )
+        return web.json_response(doc)
+
+    async def fleet(_):
+        # per-deployment rollups of every replica's /stats + /perf +
+        # /quality, with per-replica outlier deltas vs the set median
+        from seldon_core_tpu.gateway.fleet import fleet_document
+
+        return web.json_response(await fleet_document(gateway))
+
+    async def profile_start(request):
+        from seldon_core_tpu.gateway.fleet import profile_start as start
+
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 - empty body = defaults
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        status, doc = await start(
+            gateway,
+            deployment=body.get("deployment"),
+            duration_s=body.get("duration_s"),
+        )
+        return web.json_response(doc, status=status)
+
+    async def profile_stop(_):
+        from seldon_core_tpu.gateway.fleet import profile_stop as stop
+
+        status, doc = await stop(gateway)
+        return web.json_response(doc, status=status)
+
+    async def profile_get(_):
+        from seldon_core_tpu.gateway.fleet import profile_status
+
+        return web.json_response(profile_status(gateway))
+
     app.router.add_post("/oauth/token", token)
     app.router.add_post("/api/v0.1/predictions", predictions)
     app.router.add_post("/api/v0.1/feedback", feedback)
@@ -1405,6 +1483,12 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/rollouts", rollouts)
     app.router.add_get("/quality", quality)
     app.router.add_get("/overhead", overhead)
+    app.router.add_get("/trace", trace)
+    app.router.add_get("/trace/export", trace_export)
+    app.router.add_get("/fleet", fleet)
+    app.router.add_get("/profile", profile_get)
+    app.router.add_post("/profile/start", profile_start)
+    app.router.add_post("/profile/stop", profile_stop)
 
     async def _cleanup(_app):
         await gateway.close()  # pooled upstream session/connector

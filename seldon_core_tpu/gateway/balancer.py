@@ -87,6 +87,18 @@ def uds_enabled() -> bool:
     return os.environ.get("SELDON_TPU_UDS", "1") != "0"
 
 
+def fleet_scrape_enabled() -> bool:
+    """Should the scrape pass retain fleet documents (gateway/fleet.py)?
+    Off with the federation kill switch (``SELDON_TPU_FLEET=0``) or
+    explicitly via ``SELDON_TPU_FLEET_SCRAPE=0`` (health scraping keeps
+    its original, lighter shape in both cases)."""
+    if os.environ.get("SELDON_TPU_FLEET_SCRAPE", "1") == "0":
+        return False
+    from seldon_core_tpu.gateway.fleet import fleet_enabled
+
+    return fleet_enabled()
+
+
 def parse_endpoint_spec(spec: str) -> Tuple[Optional[str], Optional[str]]:
     """``(base_url, uds_path)`` from an endpoint spec string.
 
@@ -118,7 +130,7 @@ class ReplicaEndpoint:
         "role", "inflight", "batcher_inflight", "ewma_ms", "shape_ms",
         "picks", "failures", "consec_failures", "fail_degraded_until",
         "scraped_inflight", "scraped_free_kv", "scrape_ts",
-        "scrape_failed", "breaker_open",
+        "scrape_failed", "breaker_open", "fleet_docs",
     )
 
     #: minimum samples before a shape bucket's own EWMA is trusted
@@ -182,6 +194,12 @@ class ReplicaEndpoint:
         self.scrape_ts = 0.0
         self.scrape_failed = False
         self.breaker_open = False
+        #: fleet-observability document stash (gateway/fleet.py): the
+        #: full /stats (+ /perf + /quality) docs the LAST scrape pass
+        #: retained, with a monotonic timestamp — /fleet rollups and the
+        #: seldon_tpu_fleet_* outlier gauges read from here so the
+        #: aggregation adds zero polling of its own
+        self.fleet_docs: Optional[dict] = None
 
     # -- health ----------------------------------------------------------
 
@@ -492,8 +510,44 @@ class ReplicaSet:
                     role = gs.get("role")
                     if role in ("prefill", "decode", "unified"):
                         ep.role = role
+                # health is settled HERE — the optional fleet-document
+                # fetches below must not delay the freshness stamp (two
+                # hung 1 s GETs per pass would age scrape_ts past the
+                # staleness window and falsely degrade a replica whose
+                # /stats answered fine)
                 ep.scrape_ts = time.monotonic()
                 ep.scrape_failed = False
+                # fleet observability rides the SAME pass: retain the
+                # /stats doc and pull /perf + /quality alongside it
+                # (concurrently — the pass stays bounded by ONE extra
+                # timeout, not two) so /fleet rollups and the outlier
+                # gauges need no polling of their own.  Failure here
+                # must not mark the replica degraded.
+                if fleet_scrape_enabled():
+                    docs = {"stats": doc, "perf": None, "quality": None,
+                            "ts": ep.scrape_ts}
+
+                    async def _doc(path):
+                        async with session.get(
+                                ep.base_url + path, timeout=timeout
+                        ) as r:
+                            return await r.json(content_type=None)
+
+                    # return_exceptions: one surface erroring (quality
+                    # observatory disabled, transient 500) must not
+                    # throw away the OTHER doc that fetched fine
+                    perf, quality = await asyncio.gather(
+                        _doc("/perf"), _doc("/quality"),
+                        return_exceptions=True,
+                    )
+                    if isinstance(perf, asyncio.CancelledError) or \
+                            isinstance(quality, asyncio.CancelledError):
+                        raise asyncio.CancelledError
+                    if not isinstance(perf, BaseException):
+                        docs["perf"] = perf
+                    if not isinstance(quality, BaseException):
+                        docs["quality"] = quality
+                    ep.fleet_docs = docs
                 return 1
             except asyncio.CancelledError:
                 raise

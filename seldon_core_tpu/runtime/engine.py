@@ -726,11 +726,14 @@ class EngineService:
     def _handoff_event(self, **fields) -> None:
         """Handoff visibility in the flight recorder: one firehose line
         per completed prefill->decode handoff (skipped when the audit
-        log is off — same contract as request lines)."""
+        log is off — same contract as request lines).  The coordinator
+        stamps ``trace_id``/``puid``/``tenant``/``tier`` into ``fields``
+        so firehose consumers can join handoff lines to federated
+        traces and tenant accounting."""
         if not self.audit.enabled:
             return
         self.audit.record(
-            puid="",
+            puid=fields.pop("puid", "") or "",
             deployment=self.deployment.name,
             predictor=self.predictor.name,
             graph=self._graph_path,
@@ -741,6 +744,37 @@ class EngineService:
             mode=self.mode,
             **fields,
         )
+
+    def process_track_name(self) -> str:
+        """This replica's Perfetto process-track label
+        (deployment/predictor + generation role) — stamps the engine's
+        ``/trace/export`` so mesh-merged exports render legibly."""
+        return (f"{self.deployment.name}/{self.predictor.name} "
+                f"({self.gen_role})")
+
+    def trace_json(self, query: str) -> str:
+        """The relay lane's trace surface (udsrelay.py ``OP_TRACE``):
+        the local trace document for a JSON query
+        ``{"trace_id"|"puid"|"limit"}`` — how federated trace assembly
+        (gateway/fleet.py) reaches replicas that serve no HTTP lane
+        (uds-only endpoints, relay-spec decode peers)."""
+        import json as _json
+
+        from seldon_core_tpu.utils.tracing import TRACER, trace_document
+
+        try:
+            q = _json.loads(query) if query.strip() else {}
+            if not isinstance(q, dict):
+                q = {}
+        except ValueError:
+            q = {}
+        doc = trace_document(
+            TRACER,
+            puid=str(q.get("puid", "") or ""),
+            trace_id=str(q.get("trace_id", "") or ""),
+            limit=int(q.get("limit", 100) or 100),
+        )
+        return _json.dumps(doc)
 
     # -- disaggregated KV handoff (relay OP_KVSTREAM) --------------------
 

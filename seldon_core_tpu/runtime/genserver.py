@@ -188,6 +188,7 @@ class _Sequence:
         "sid", "request", "row", "prompt", "prompt0", "max_new", "state",
         "n_valid", "blocks", "draft_blocks", "pending", "prefill_pos",
         "emitted", "done", "key_data", "admit_order", "retire_reason",
+        "t_start",
     )
     WAITING, PREFILL, RUNNING, DONE = range(4)
 
@@ -210,6 +211,7 @@ class _Sequence:
         self.key_data: Optional[np.ndarray] = None  # per-seq PRNG key
         self.admit_order = -1
         self.retire_reason = ""
+        self.t_start = 0.0              # epoch at admission (span base)
 
 
 class _KvImport:
@@ -219,7 +221,7 @@ class _KvImport:
     reaper) releases the reservation with zero leaked blocks."""
 
     __slots__ = ("hid", "meta", "blocks", "staged", "received",
-                 "created", "seq")
+                 "created", "created_epoch", "seq", "trace_ctx")
 
     def __init__(self, hid: bytes, meta, blocks: List[int], staged):
         self.hid = hid
@@ -228,7 +230,12 @@ class _KvImport:
         self.staged = staged          # per-layer host arrays [n, bs, ...]
         self.received = np.zeros((meta.n_blocks,), bool)
         self.created = time.monotonic()
+        self.created_epoch = time.time()
         self.seq: Optional[_Sequence] = None
+        #: the handoff span's context off the relay sidecar (the BEGIN
+        #: frame's traceparent) — decode-side import/decode spans parent
+        #: under the prefill side's kv_handoff span through this
+        self.trace_ctx = None
 
     def receive(self, first: int, layers) -> None:
         from seldon_core_tpu.runtime.kvstream import KvWireError
@@ -272,6 +279,15 @@ class GenRequest:
         self.t_submit = time.perf_counter()
         self.ttft_recorded = False
         self.admit_recorded = False
+        # the submitting request's trace context + QoS identity, captured
+        # on the CALLER's thread (contextvars don't cross into the
+        # scheduler thread): per-sequence prefill/decode spans parent
+        # under the request span, and handoff sidecars carry the tenant
+        from seldon_core_tpu.runtime.qos import current_tenant
+        from seldon_core_tpu.utils.tracing import current_trace_context
+
+        self.trace_ctx = current_trace_context()
+        self.tenant = current_tenant() or ""
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -948,6 +964,7 @@ class GenServer:
             seq.n_valid = self._prefix_len
             seq.state = _Sequence.PREFILL
             seq.prefill_pos = 0
+            seq.t_start = time.time()
             self._admit_counter += 1
             seq.admit_order = self._admit_counter
             self._prefilling.append(seq)
@@ -1078,6 +1095,11 @@ class GenServer:
                 continue
             # prompt fully consumed: sample (or restore) the first token
             self._prefilling.remove(seq)
+            # the per-sequence prefill span (admission -> prompt fully
+            # cached): the "prefill dispatch" leg of a federated trace's
+            # critical path.  One record per sequence, trace-gated — the
+            # per-step hot-path budget is untouched when tracing is off
+            self._record_seq_span(seq, "prefill", "prefill")
             if seq.pending is None:
                 if self.temperature > 0.0:
                     key = jax.random.wrap_key_data(
@@ -1318,7 +1340,20 @@ class GenServer:
             # device->host gather NOW, on the scheduler thread, before
             # the pool is donated into the next dispatch
             layers=kvstream.export_blocks(self._pool, seq.blocks),
+            tenant=getattr(seq.request, "tenant", "") or "",
         )
+        # mint the kv_handoff span's identity UP FRONT: its traceparent
+        # rides the relay sidecar on every frame, so the decode side's
+        # import/decode spans parent under a span id that already exists
+        # when they are recorded; the coordinator records the span itself
+        # when the stream completes (runtime/servingmesh.py)
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        req_ctx = getattr(seq.request, "trace_ctx", None)
+        if req_ctx is not None and req_ctx.sampled and TRACER.enabled:
+            export.trace_ctx = req_ctx.child(req_ctx.puid)
+            export.parent_span_id = req_ctx.span_id
+            export.puid = req_ctx.puid
         self._release_blocks(seq)
         seq.state = _Sequence.DONE
         self._handoff_inflight += 1
@@ -1397,6 +1432,13 @@ class GenServer:
                     layer[name] = np.zeros(shape, dt)
             staged.append(layer)
         imp = _KvImport(hid, meta, blocks, staged)
+        # the relay sidecar bound the BEGIN frame's traceparent around
+        # this handler (udsrelay.py): capture it so the import + decode
+        # spans of this handoff parent under the prefill side's
+        # kv_handoff span
+        from seldon_core_tpu.utils.tracing import current_trace_context
+
+        imp.trace_ctx = current_trace_context()
         with self._wake:
             if self._stopped:
                 self._allocator.release_reserved(blocks)
@@ -1442,6 +1484,22 @@ class GenServer:
                 "reclaimed")
         meta = imp.meta
         req = GenRequest(1, None, meta.max_new, tier=meta.tier)
+        if imp.trace_ctx is not None:
+            # parent the decode-side spans under the kv_handoff span the
+            # BEGIN sidecar named (the COMMIT may arrive on a different
+            # relay connection — the BEGIN-time capture is authoritative)
+            req.trace_ctx = imp.trace_ctx
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        if imp.trace_ctx is not None and TRACER.enabled:
+            # the import leg: reserve -> every block staged -> commit
+            TRACER.record_span(
+                "kv_import", kind="kv_import", method="kv_handoff",
+                start_s=imp.created_epoch,
+                duration_ms=(time.time() - imp.created_epoch) * 1e3,
+                ctx=imp.trace_ctx, blocks=len(imp.blocks),
+                n_valid=int(meta.n_valid),
+            )
         with self._wake:
             if self._stopped:
                 self._allocator.release_reserved(imp.blocks)
@@ -1509,6 +1567,7 @@ class GenServer:
             seq = imp.seq
             seq.blocks = list(imp.blocks)
             seq.state = _Sequence.RUNNING
+            seq.t_start = time.time()
             self._admit_counter += 1
             seq.admit_order = self._admit_counter
             self._active.append(seq)
@@ -1603,9 +1662,33 @@ class GenServer:
             retired += 1
         return retired
 
+    def _record_seq_span(self, seq: _Sequence, name: str,
+                         method: str) -> None:
+        """One per-sequence span (prefill / decode leg) parented under
+        the request's captured trace context — the scheduler's phases
+        become visible legs of a (federated) trace tree.  No-op unless
+        tracing is on AND the request's trace was sampled; ``record_span``
+        enforces both."""
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        ctx = getattr(seq.request, "trace_ctx", None)
+        if ctx is None or not TRACER.enabled or seq.t_start <= 0.0:
+            return
+        TRACER.record_span(
+            name, kind="dispatch", method=method, start_s=seq.t_start,
+            duration_ms=(time.time() - seq.t_start) * 1e3, ctx=ctx,
+            rows=1, n_valid=seq.n_valid, tokens=len(seq.emitted),
+            role=self.role,
+        )
+
     def _retire(self, seq: _Sequence, reason: str) -> None:
         self._release_blocks(seq)
         seq.state = _Sequence.DONE
+        if self.role == "decode" and reason not in ("cancelled",):
+            # the decode leg of a disaggregated generation: one span per
+            # imported sequence, parented under the prefill side's
+            # kv_handoff span (the context rode the relay sidecar)
+            self._record_seq_span(seq, "decode", "decode")
         self.retired_total[reason] = self.retired_total.get(reason, 0) + 1
         RECORDER.record_gen_retired(reason)
         self._deliver(seq.request)
@@ -1644,8 +1727,24 @@ class GenServer:
         )
         if kind != "idle":
             RECORDER.record_gen_step(kind)
+        # a traced sequence in this step tags the record so the step's
+        # seldon_tpu_dispatch_seconds observation carries its trace_id as
+        # an OpenMetrics exemplar — on a decode replica that is the
+        # handoff's trace, so exemplars join handoffs to federated traces
+        trace_id = ""
+        if kind != "idle":
+            from seldon_core_tpu.utils.tracing import TRACER
+
+            if TRACER.enabled:
+                for s in self._active + self._prefilling:
+                    ctx = getattr(s.request, "trace_ctx", None)
+                    if ctx is not None and ctx.sampled:
+                        trace_id = ctx.trace_id
+                        break
         SPINE.record_gen_step(
             kind=kind, duration_s=duration_s, active=inflight,
             waiting=waiting, admitted=admitted, retired=retired,
             blocks_used=used, blocks_total=total, tokens=tokens,
+            executable="" if kind == "idle" else f"gen_step:{kind}",
+            trace_id=trace_id,
         )

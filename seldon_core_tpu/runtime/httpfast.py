@@ -125,6 +125,8 @@ class _EngineRoutes:
             b"/trace/enable": self._trace_enable,
             b"/trace/disable": self._trace_disable,
             b"/quality/reference": self._quality_reference,
+            b"/profile/start": self._profile_start,
+            b"/profile/stop": self._profile_stop,
         }
         self.get: Dict[bytes, Handler] = {
             b"/ping": self._ping,
@@ -142,6 +144,7 @@ class _EngineRoutes:
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
             # window for mutation-via-GET is closed (POST-only now)
             b"/api/v0.1/events": self._events,
+            b"/profile": self._profile,
         }
 
     async def _events(self, body, ctype, query) -> Result:
@@ -304,8 +307,45 @@ class _EngineRoutes:
             puid=q.get("puid", [""])[0],
             trace_id=q.get("trace_id", [""])[0],
             limit=int(q.get("limit", ["1000"])[0]),
+            process_name=self.engine.process_track_name(),
         )
         return 200, _json.dumps(doc).encode(), _JSON
+
+    async def _profile_start(self, body, ctype, query) -> Result:
+        # the per-engine half of a coordinated fleet profile window
+        # (gateway/fleet.py): bounded jax.profiler window, 409 on overlap
+        import json as _json
+
+        from seldon_core_tpu.utils.tracing import (
+            ProfileBusyError,
+            profile_window_start_request,
+        )
+
+        try:
+            payload = _json.loads(body.decode("utf-8", "replace") or "{}")
+        except ValueError:
+            payload = {}
+        if not isinstance(payload, dict):
+            payload = {}
+        try:
+            doc = profile_window_start_request(payload)
+        except ProfileBusyError as e:
+            return 409, _json.dumps({"error": str(e)}).encode(), _JSON
+        return 200, _json.dumps(doc).encode(), _JSON
+
+    async def _profile_stop(self, body, ctype, query) -> Result:
+        import json as _json
+
+        from seldon_core_tpu.utils.tracing import profile_window_stop
+
+        return 200, _json.dumps(profile_window_stop()).encode(), _JSON
+
+    async def _profile(self, body, ctype, query) -> Result:
+        import json as _json
+
+        from seldon_core_tpu.utils.tracing import profile_window_status
+
+        return 200, _json.dumps(profile_window_status()).encode(), _JSON
 
     async def _trace_enable(self, body, ctype, query) -> Result:
         from seldon_core_tpu.utils.tracing import TRACER

@@ -123,10 +123,27 @@ class KvExport:
     """A finished prefill, lifted off the device: per-layer block tensors
     plus the sequence's sampling state.  Built on the prefill scheduler
     thread (the device->host gather happens here, before the pool is
-    donated into the next dispatch), then handed to the coordinator."""
+    donated into the next dispatch), then handed to the coordinator.
+
+    ``trace_ctx`` is the handoff's pre-minted span context (the
+    ``kind="kv_handoff"`` span the coordinator records when the stream
+    completes): its traceparent rides the relay METADATA SIDECAR on
+    every frame of this handoff — not the kvstream wire format — so the
+    decode replica's import/decode spans parent under the handoff span
+    and one federated tree covers both processes.  ``tenant`` rides the
+    same sidecar for decode-side accounting."""
 
     meta: KvBeginMeta
     layers: List[Dict[str, np.ndarray]] = field(default_factory=list)
+    #: utils/tracing.TraceContext of the kv_handoff span (None = the
+    #: request was unsampled or tracing is off — ship no sidecar trace)
+    trace_ctx: Any = None
+    #: parent span id the kv_handoff span links under (the request span)
+    parent_span_id: str = ""
+    #: resolved tenant of the originating request ("" = unknown/anon)
+    tenant: str = ""
+    #: correlation id of the originating request
+    puid: str = ""
 
     @property
     def nbytes(self) -> int:

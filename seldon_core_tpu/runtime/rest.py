@@ -239,7 +239,9 @@ def make_engine_app(engine: EngineService) -> web.Application:
         ))
 
     async def trace_export(request: web.Request) -> web.Response:
-        # Chrome trace-event JSON — load in Perfetto / chrome://tracing
+        # Chrome trace-event JSON — load in Perfetto / chrome://tracing.
+        # The process track is named replica/role so exports merged
+        # across the mesh (the gateway's federated export) read legibly
         from seldon_core_tpu.utils.tracing import TRACER, export_document
 
         return web.json_response(export_document(
@@ -247,6 +249,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
             puid=request.query.get("puid", ""),
             trace_id=request.query.get("trace_id", ""),
             limit=int(request.query.get("limit", "1000")),
+            process_name=engine.process_track_name(),
         ))
 
     async def trace_enable(_):
@@ -260,6 +263,36 @@ def make_engine_app(engine: EngineService) -> web.Application:
 
         TRACER.disable()
         return web.Response(text="tracing disabled")
+
+    async def profile_start(request: web.Request) -> web.Response:
+        # the per-engine half of a coordinated fleet profile window
+        # (gateway/fleet.py): open a bounded jax.profiler trace in THIS
+        # process; overlapping windows answer 409, never queue
+        from seldon_core_tpu.utils.tracing import (
+            ProfileBusyError,
+            profile_window_start_request,
+        )
+
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 - empty body = defaults
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        try:
+            return web.json_response(profile_window_start_request(body))
+        except ProfileBusyError as e:
+            return web.json_response({"error": str(e)}, status=409)
+
+    async def profile_stop(_):
+        from seldon_core_tpu.utils.tracing import profile_window_stop
+
+        return web.json_response(profile_window_stop())
+
+    async def profile_get(_):
+        from seldon_core_tpu.utils.tracing import profile_window_status
+
+        return web.json_response(profile_window_status())
 
     async def generate_stream(request: web.Request):
         """SSE token streaming (beyond-reference; see engine.generate_stream).
@@ -345,6 +378,9 @@ def make_engine_app(engine: EngineService) -> web.Application:
     # is closed — GET /trace/enable|disable now answers 405
     app.router.add_post("/trace/enable", trace_enable)
     app.router.add_post("/trace/disable", trace_disable)
+    app.router.add_get("/profile", profile_get)
+    app.router.add_post("/profile/start", profile_start)
+    app.router.add_post("/profile/stop", profile_stop)
     return app
 
 

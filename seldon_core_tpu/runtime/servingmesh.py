@@ -328,12 +328,60 @@ class DisaggCoordinator:
         rest = [p for p in self.peers if p not in (first, second)]
         return [first, second] + rest
 
+    @staticmethod
+    def _handoff_meta(export: kvstream.KvExport) -> "bytes | None":
+        """The relay metadata sidecar every frame of this handoff ships:
+        the kv_handoff span's traceparent (so decode-side spans parent
+        under it), plus tenant/tier for decode-side accounting.  None
+        when there is nothing to carry — the wire bytes then match the
+        sidecar-less PR-12 frames exactly."""
+        from seldon_core_tpu.runtime.udsrelay import pack_relay_meta
+
+        ctx = export.trace_ctx
+        traceparent = None
+        if ctx is not None and ctx.trace_id and ctx.span_id:
+            traceparent = "00-%s-%s-01" % (ctx.trace_id, ctx.span_id)
+        tenant = export.tenant or None
+        tier = export.meta.tier or None
+        if traceparent is None and tenant is None and \
+                (tier in (None, "interactive")):
+            return None
+        return pack_relay_meta(
+            traceparent=traceparent, tenant=tenant, tier=tier)
+
+    def _record_handoff_span(self, export: kvstream.KvExport, peer: str,
+                             nbytes: int, tokens: int, start_s: float,
+                             wall_s: float, outcome: str) -> None:
+        """The prefill-side ``kind="kv_handoff"`` span — recorded with
+        the PRE-MINTED span id the sidecar already announced, so the
+        decode replica's import/decode spans (recorded before this one
+        finishes) land under it in the assembled federated tree."""
+        from seldon_core_tpu.utils.tracing import TRACER, Span
+
+        ctx = export.trace_ctx
+        if ctx is None or not TRACER.enabled:
+            return
+        attrs = {
+            "peer": peer or "", "bytes": int(nbytes),
+            "tokens": int(tokens), "outcome": outcome,
+        }
+        TRACER.add(Span(
+            puid=export.puid, name="kv_handoff", kind="kv_handoff",
+            method="kv_handoff", start_s=start_s,
+            duration_ms=wall_s * 1e3, attrs=attrs,
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
+            parent_span_id=export.parent_span_id,
+        ))
+
     async def _handoff(self, export: kvstream.KvExport, done_cb) -> None:
         t0 = time.perf_counter()
+        start_epoch = time.time()
         with self._lock:
             self.inflight += 1
         RECORDER.set_kv_handoff_inflight(self.inflight)
         hid = uuid.uuid4().bytes
+        trace_id = (export.trace_ctx.trace_id
+                    if export.trace_ctx is not None else "")
         try:
             tokens, peer, nbytes = await self._stream(export, hid)
             wall = time.perf_counter() - t0
@@ -350,22 +398,32 @@ class DisaggCoordinator:
             self._account("ok")
             RECORDER.observe_kv_handoff(wall, nbytes)
             RECORDER.set_kv_handoff_inflight(self.inflight)
+            self._record_handoff_span(
+                export, peer, nbytes, int(tokens.size), start_epoch,
+                wall, "ok")
             if self._event_sink is not None:
                 try:
                     self._event_sink(
                         event="kv_handoff", peer=peer,
                         tokens=int(tokens.size), bytes=nbytes,
                         latency_ms=round(wall * 1e3, 3),
+                        # join keys for firehose consumers: the trace the
+                        # handoff belongs to + the request's identity
+                        trace_id=trace_id, puid=export.puid,
+                        tenant=export.tenant, tier=export.meta.tier,
                     )
                 except Exception:  # noqa: BLE001 - sink must not fail the hop
                     pass
             done_cb(tokens)
         except Exception as e:  # noqa: BLE001 - surfaced typed per request
+            wall = time.perf_counter() - t0
             with self._lock:
                 self.inflight -= 1
-            self._account(
-                "torn" if isinstance(e, ConnectionError) else "error")
+            outcome = "torn" if isinstance(e, ConnectionError) else "error"
+            self._account(outcome)
             RECORDER.set_kv_handoff_inflight(self.inflight)
+            self._record_handoff_span(
+                export, "", 0, 0, start_epoch, wall, outcome)
             if isinstance(e, SeldonMessageError):
                 done_cb(e)
             else:
@@ -380,6 +438,11 @@ class DisaggCoordinator:
 
         order = await self._pick_order()
         begin = kvstream.begin_frame(export, hid)
+        # deadline/trace/tenant sidecar: the BEGIN frame announces the
+        # kv_handoff span's traceparent so the decode side's spans join
+        # the federated tree; the COMMIT repeats it (the decode round
+        # runs inside that call).  BLOCKS frames skip it — pure payload.
+        meta = self._handoff_meta(export)
         client = None
         peer = None
         last_refusal = "no decode peers configured"
@@ -387,7 +450,8 @@ class DisaggCoordinator:
             try:
                 c = self._client(candidate)
                 body, status = await asyncio.wait_for(
-                    c.call(_OP_KVSTREAM(), begin), timeout=10.0,
+                    c.call(_OP_KVSTREAM(), begin, meta=meta),
+                    timeout=10.0,
                 )
             except Exception as e:  # noqa: BLE001 - dead peer: next one
                 last_refusal = f"{candidate}: {e}"
@@ -415,7 +479,8 @@ class DisaggCoordinator:
                         f"decode peer {peer} rejected a block frame: "
                         f"{body.decode('utf-8', 'replace')[:200]}")
             body, status = await asyncio.wait_for(
-                client.call(_OP_KVSTREAM(), kvstream.commit_frame(hid)),
+                client.call(_OP_KVSTREAM(), kvstream.commit_frame(hid),
+                            meta=meta),
                 timeout=self.timeout_s,
             )
             if status != 200:

@@ -24,6 +24,11 @@ Ops:
     OP_KVSTREAM  payload = binary KV-handoff frame (runtime/kvstream.py)
                  -> binary body + status (disaggregated prefill->decode
                  block streaming; bytes in, bytes out — never JSON)
+    OP_TRACE     payload = trace query JSON ({"trace_id"|"puid"|"limit"})
+                 -> the engine's local trace document JSON — the read
+                 lane federated trace assembly (gateway/fleet.py) uses
+                 to reach uds-only replicas and relay-spec decode peers
+                 that serve no HTTP surface
 
 Metadata sidecar: setting the high bit of the op byte (``op | 0x80``)
 marks the payload as ``uvarint(meta_len) | meta_block | body``.  The
@@ -65,6 +70,7 @@ __all__ = [
     "OP_FEEDBACK",
     "OP_PING",
     "OP_KVSTREAM",
+    "OP_TRACE",
     "META_FLAG",
     "RELAY_META_VERSION",
     "UdsEngineServer",
@@ -83,6 +89,7 @@ OP_PREDICT = 1
 OP_FEEDBACK = 2
 OP_PING = 3
 OP_KVSTREAM = 4
+OP_TRACE = 5
 
 #: high bit of the op byte: payload begins with a varint-prefixed
 #: metadata block (deadline/traceparent/tenant/tier sidecar)
@@ -397,6 +404,14 @@ class _UdsServerProtocol(asyncio.Protocol):
                 return 503, b"engine does not accept KV handoffs"
             status, body = await handler(data)
             return status or 200, body
+        if op == OP_TRACE:
+            # federated trace assembly's relay lane: uds-only replicas
+            # and decode peers answer their local trace document here
+            handler = getattr(self.engine, "trace_json", None)
+            if handler is None:
+                return 404, b"engine serves no trace surface"
+            text = handler(data)
+            return 200, text.encode()
         if op == OP_PING:
             return 200, b"pong"
         return 400, SeldonMessage.failure(

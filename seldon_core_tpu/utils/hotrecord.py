@@ -507,6 +507,8 @@ class TelemetrySpine:
         blocks_used: int,
         blocks_total: int,
         tokens: int,
+        executable: str = "",
+        trace_id: str = "",
     ) -> bool:
         """ONE record per continuous-batching scheduler step
         (runtime/genserver.py): the step picture — kind, in-flight/
@@ -530,6 +532,8 @@ class TelemetrySpine:
         rec.requests = int(waiting)
         rec.start_s = time.time() - duration_s
         rec.duration_s = float(duration_s)
+        rec.executable = executable
+        rec.trace_id = trace_id
         rec.gen = (int(admitted), int(retired), int(blocks_used),
                    int(blocks_total), int(tokens))
         return self._append(rec)
@@ -673,7 +677,18 @@ class TelemetrySpine:
             return
         if rec.hop == HOP_GEN_STEP:
             # gauges/counters were set by the scheduler itself (one call
-            # per step); the fold's job is the TRACE face of the step
+            # per step); the fold's job is the TRACE face of the step —
+            # plus the dispatch-latency histogram observation whose
+            # bucket carries the step's trace_id as an OpenMetrics
+            # exemplar (on a decode replica that joins the KV handoff's
+            # federated trace to the slow bucket that served it)
+            if rec.executable and rec.flags & WANT_RECORDER:
+                t0 = pc()
+                RECORDER.observe_dispatch(
+                    rec.executable, rec.duration_s,
+                    trace_id=rec.trace_id or None,
+                )
+                self.fold_cost["recorder"].observe(pc() - t0)
             if rec.flags & WANT_TRACE:
                 t0 = pc()
                 admitted, retired, used, total, tokens = rec.gen

@@ -187,6 +187,14 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_kv_handoff_seconds": ("histogram", ()),
     "seldon_tpu_kv_handoff_bytes_total": ("counter", ()),
     "seldon_tpu_kv_handoff_inflight": ("gauge", ()),
+    # fleet observability plane (gateway/fleet.py): per-replica
+    # worse-than-set-median ratio (the worst metric's ratio — 2.0 reads
+    # "this replica is 2x worse than its siblings"; the
+    # SeldonTPUReplicaOutlier alert pages on it), replica count per set,
+    # and how stale each replica's scraped fleet documents are
+    "seldon_tpu_fleet_outlier_ratio": ("gauge", ("set", "replica")),
+    "seldon_tpu_fleet_replicas": ("gauge", ("set",)),
+    "seldon_tpu_fleet_staleness_seconds": ("gauge", ("set", "replica")),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -343,6 +351,10 @@ class FlightRecorder:
         self.replica_picks: Dict[str, Dict[str, int]] = {}
         self.replica_mispicks = 0
         self.lane_requests: Dict[str, int] = {}
+        # fleet observability mirrors (gateway/fleet.py): per-replica
+        # worst worse-than-median ratio + replica counts per set
+        self.fleet_outliers: Dict[str, Dict[str, float]] = {}
+        self.fleet_replicas: Dict[str, int] = {}
         # traffic-lifecycle mirrors (gateway/shadow.py mirror outcomes +
         # divergence, operator/rollouts.py rollbacks and stage weights)
         self.shadow_requests: Dict[str, int] = {}      # outcome -> n
@@ -627,6 +639,24 @@ class FlightRecorder:
                 "candidate's EWMA latency at decision time (ratio vs "
                 "seldon_tpu_replica_picks_total audits the balancer)",
                 registry=self.registry)
+            self._p_fleet_outlier = Gauge(
+                "seldon_tpu_fleet_outlier_ratio",
+                "Worst worse-than-set-median ratio of one replica "
+                "across the fleet outlier metrics (dispatch p99, "
+                "gateway EWMA, drift, MFU, free KV blocks — "
+                "gateway/fleet.py; 2.0 = this replica is 2x worse "
+                "than its siblings)",
+                ["set", "replica"], registry=self.registry)
+            self._p_fleet_replicas = Gauge(
+                "seldon_tpu_fleet_replicas",
+                "Replicas participating in one set's fleet rollup "
+                "(GET /fleet)",
+                ["set"], registry=self.registry)
+            self._p_fleet_staleness = Gauge(
+                "seldon_tpu_fleet_staleness_seconds",
+                "Age of one replica's scraped fleet documents at the "
+                "last rollup (how far behind the /fleet view may be)",
+                ["set", "replica"], registry=self.registry)
             self._p_lane_requests = Counter(
                 "seldon_tpu_relay_lane_requests_total",
                 "Gateway->engine dispatches by relay lane "
@@ -857,6 +887,30 @@ class FlightRecorder:
             self._p_replica_picks.labels(
                 set=set_name, replica=replica
             ).inc()
+
+    def set_fleet_outlier(self, set_name: str, replica: str,
+                          ratio: float) -> None:
+        """The replica's WORST worse-than-median ratio across the fleet
+        outlier metrics (gateway/fleet.py) — refreshed on the existing
+        scrape tick and on every /fleet query, never per request."""
+        with self._lock:
+            self.fleet_outliers.setdefault(set_name, {})[replica] = \
+                float(ratio)
+        if self.registry is not None:
+            self._p_fleet_outlier.labels(
+                set=set_name, replica=replica).set(ratio)
+
+    def set_fleet_replicas(self, set_name: str, n: int) -> None:
+        with self._lock:
+            self.fleet_replicas[set_name] = int(n)
+        if self.registry is not None:
+            self._p_fleet_replicas.labels(set=set_name).set(n)
+
+    def set_fleet_staleness(self, set_name: str, replica: str,
+                            seconds: float) -> None:
+        if self.registry is not None:
+            self._p_fleet_staleness.labels(
+                set=set_name, replica=replica).set(seconds)
 
     def record_replica_mispick(self) -> None:
         with self._lock:
@@ -1314,6 +1368,9 @@ class FlightRecorder:
                 },
                 "mispicks": self.replica_mispicks,
                 "lanes": dict(self.lane_requests),
+                "fleet_outliers": {
+                    s: dict(d) for s, d in self.fleet_outliers.items()
+                },
             }
             lifecycle = {
                 "shadow": dict(self.shadow_requests),
@@ -1472,6 +1529,8 @@ class FlightRecorder:
             self.replica_picks = {}
             self.replica_mispicks = 0
             self.lane_requests = {}
+            self.fleet_outliers = {}
+            self.fleet_replicas = {}
             self.shadow_requests = {}
             self.shadow_disagreement = Reservoir()
             self.shadow_latency = Reservoir()
@@ -1542,6 +1601,7 @@ class AuditLog:
         self._queue: deque = deque()
         self._wakeup: Optional[Any] = None  # asyncio.Event, loop-bound
         self._task = None
+        self._loop = None  # the loop the drain task currently runs on
 
     def record(self, **event: Any) -> bool:
         """Enqueue one audit event; returns False when disabled or
@@ -1566,11 +1626,28 @@ class AuditLog:
             loop = asyncio.get_running_loop()
         except RuntimeError:
             return  # no loop: events wait in the bounded deque
-        if self._task is None or self._task.done():
+        # the drain task binds to the loop that first recorded — which
+        # may be a SIDE loop (the disagg coordinator's thread records
+        # kv_handoff lines) or one a test already tore down.  Re-home
+        # ONLY when the bound task/loop is actually dead: two LIVE loops
+        # recording concurrently (serving + coordinator) must share one
+        # drain task, not cancel-and-recreate it per alternation
+        if (self._task is None or self._task.done()
+                or self._loop is None or self._loop.is_closed()):
             self._wakeup = asyncio.Event()
+            self._loop = loop
             self._task = loop.create_task(self._drain())
         if self._wakeup is not None:
-            self._wakeup.set()
+            if self._loop is loop:
+                self._wakeup.set()
+            else:
+                # asyncio primitives are not thread-safe: wake the
+                # owning loop's drain from ITS thread
+                try:
+                    self._loop.call_soon_threadsafe(self._wakeup.set)
+                except RuntimeError:
+                    pass  # owner died between the check and the wake;
+                    # the next record re-homes the drain
 
     async def _drain(self) -> None:
         import asyncio

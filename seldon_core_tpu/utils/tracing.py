@@ -68,12 +68,19 @@ __all__ = [
     "traceparent_header_value",
     "trace_scope",
     "assemble_tree",
+    "assembly_fields",
     "critical_path",
     "phase_decomposition",
     "chrome_trace",
     "trace_document",
     "export_document",
+    "span_from_json_dict",
+    "partial_markers",
     "device_profile",
+    "profile_window_start",
+    "profile_window_stop",
+    "profile_window_status",
+    "ProfileBusyError",
 ]
 
 #: wire name of the trace context (W3C Trace Context, level 1).  The same
@@ -224,6 +231,26 @@ class Span:
         if self.events:
             out["events"] = self.events
         return out
+
+
+def span_from_json_dict(d: dict) -> Span:
+    """Rebuild a :class:`Span` from its ``to_json_dict`` form — the
+    federated-trace merge path (gateway/fleet.py) deserializes remote
+    participants' spans with this so assembly/critical-path code runs on
+    one in-memory shape regardless of which process recorded a span."""
+    return Span(
+        puid=str(d.get("puid", "") or ""),
+        name=str(d.get("name", "") or ""),
+        kind=str(d.get("kind", "") or ""),
+        method=str(d.get("method", "") or ""),
+        start_s=float(d.get("start_s", 0.0) or 0.0),
+        duration_ms=float(d.get("duration_ms", 0.0) or 0.0),
+        attrs=dict(d.get("attrs") or {}),
+        trace_id=str(d.get("trace_id", "") or ""),
+        span_id=str(d.get("span_id", "") or ""),
+        parent_span_id=str(d.get("parent_span_id", "") or ""),
+        events=list(d.get("events") or []),
+    )
 
 
 class SpanHandle(dict):
@@ -623,15 +650,25 @@ def phase_decomposition(segments: List[Tuple[Span, float]]) -> Dict[str, float]:
     return phases
 
 
-def chrome_trace(spans: List[Span]) -> dict:
+def chrome_trace(
+    spans: List[Span],
+    process_name: Optional[str] = None,
+    pid: int = 0,
+    base_s: Optional[float] = None,
+) -> dict:
     """Chrome trace-event JSON (the ``{"traceEvents": [...]}`` object
     format) — loadable in Perfetto / chrome://tracing.  Spans become
     complete ('X') events on one lane per (kind, name); span events become
-    instant ('i') marks on the owner's lane."""
+    instant ('i') marks on the owner's lane.
+
+    ``process_name`` labels this span set's Perfetto process track
+    (replica/role — the federated export gives every participant its own
+    ``pid`` so a multi-process tree renders legibly); ``base_s`` pins the
+    timestamp origin so several processes' events share one timeline."""
     events: List[dict] = []
     if not spans:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
-    base = min(s.start_s for s in spans)
+    base = base_s if base_s is not None else min(s.start_s for s in spans)
     lanes: Dict[Tuple[str, str], int] = {}
     for s in sorted(spans, key=lambda x: x.start_s):
         tid = lanes.setdefault((s.kind, s.name), len(lanes) + 1)
@@ -648,7 +685,7 @@ def chrome_trace(spans: List[Span]) -> dict:
             "ph": "X",
             "ts": round((s.start_s - base) * 1e6, 1),
             "dur": round(s.duration_ms * 1e3, 1),
-            "pid": 0,
+            "pid": pid,
             "tid": tid,
             "args": args,
         })
@@ -659,16 +696,46 @@ def chrome_trace(spans: List[Span]) -> dict:
                 "ph": "i",
                 "s": "t",
                 "ts": round((float(ev.get("ts", s.start_s)) - base) * 1e6, 1),
-                "pid": 0,
+                "pid": pid,
                 "tid": tid,
                 "args": ev.get("attrs", {}),
             })
     for (kind, name), tid in lanes.items():
         events.append({
-            "name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": f"{kind}:{name}"},
         })
+    if process_name:
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": process_name},
+        })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def partial_markers(spans: List[Span], named_query: bool) -> dict:
+    """The partial-trace contract (fleet observability): a query that
+    names a specific request must never answer an empty or silently
+    truncated result when the ring evicted part (or all) of the subtree.
+    Returns ``{"partial": bool, "missing": [...]}`` — ``missing`` lists
+    the parent span ids that are referenced but absent (evicted locally
+    or living in a process this tracer can't see)."""
+    if not named_query:
+        return {"partial": False, "missing": []}
+    present = {s.span_id for s in spans if s.span_id}
+    orphans = sorted({
+        s.parent_span_id for s in spans
+        if s.parent_span_id and s.parent_span_id not in present
+    })
+    missing: List[Any] = [
+        {"parent_span_id": p, "reason": "parent span not found "
+         "(evicted from the ring or recorded in another process)"}
+        for p in orphans
+    ]
+    if not spans:
+        missing.append({"reason": "no spans found for this query "
+                        "(evicted from the ring, or never sampled)"})
+    return {"partial": bool(missing), "missing": missing}
 
 
 def _select_spans(
@@ -691,6 +758,34 @@ def _select_spans(
     return sorted(spans, key=lambda s: s.start_s)
 
 
+def assembly_fields(spans: List[Span]) -> Dict[str, Any]:
+    """The named-query assembly block shared by the local and federated
+    ``GET /trace`` bodies: partial markers, nested tree, critical path,
+    per-phase decomposition, root identity.  One implementation so the
+    two surfaces can never drift."""
+    doc: Dict[str, Any] = {}
+    # a named query whose subtree was (partly) evicted answers the
+    # partial tree with an explicit marker, never a silent empty
+    doc.update(partial_markers(spans, named_query=True))
+    doc["tree"] = assemble_tree(spans)
+    root, segments = critical_path(spans)
+    doc["critical_path"] = [
+        {
+            "span_id": sp.span_id,
+            "name": sp.name,
+            "kind": sp.kind,
+            "method": sp.method,
+            "self_ms": round(self_ms, 3),
+        }
+        for sp, self_ms in segments
+    ]
+    doc["phases"] = phase_decomposition(segments)
+    if root is not None:
+        doc["root_span_id"] = root.span_id
+        doc["root_duration_ms"] = round(root.duration_ms, 3)
+    return doc
+
+
 def trace_document(
     tracer: Tracer, puid: str = "", trace_id: str = "", limit: int = 100
 ) -> dict:
@@ -704,30 +799,21 @@ def trace_document(
         "spans": [s.to_json_dict() for s in spans],
     }
     if puid or trace_id:
-        doc["tree"] = assemble_tree(spans)
-        root, segments = critical_path(spans)
-        doc["critical_path"] = [
-            {
-                "span_id": sp.span_id,
-                "name": sp.name,
-                "kind": sp.kind,
-                "method": sp.method,
-                "self_ms": round(self_ms, 3),
-            }
-            for sp, self_ms in segments
-        ]
-        doc["phases"] = phase_decomposition(segments)
-        if root is not None:
-            doc["root_span_id"] = root.span_id
-            doc["root_duration_ms"] = round(root.duration_ms, 3)
+        doc.update(assembly_fields(spans))
     return doc
 
 
 def export_document(
-    tracer: Tracer, puid: str = "", trace_id: str = "", limit: int = 1000
+    tracer: Tracer, puid: str = "", trace_id: str = "",
+    limit: int = 1000, process_name: Optional[str] = None,
 ) -> dict:
-    """The ``GET /trace/export`` body — Chrome trace-event JSON."""
-    return chrome_trace(_select_spans(tracer, puid, trace_id, limit))
+    """The ``GET /trace/export`` body — Chrome trace-event JSON.
+    ``process_name`` labels this process's Perfetto track (replica/role)
+    so exports merged across a mesh render legibly."""
+    return chrome_trace(
+        _select_spans(tracer, puid, trace_id, limit),
+        process_name=process_name,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -770,3 +856,160 @@ def device_profile(logdir: str):
             jax.profiler.stop_trace()
     finally:
         _PROFILE_LOCK.release()
+
+
+# ---------------------------------------------------------------------------
+# Coordinated profiling windows (fleet observability)
+# ---------------------------------------------------------------------------
+
+class ProfileBusyError(RuntimeError):
+    """A profile window (or a ``device_profile`` block) is already
+    active in this process — overlapping windows are refused, never
+    queued: the second window's data would be attributed to the first."""
+
+
+#: hard ceiling on a window's duration — a start whose stop never
+#: arrives must not profile forever (profiling has real overhead)
+def _profile_max_s() -> float:
+    try:
+        return float(os.environ.get("SELDON_TPU_PROFILE_MAX_S", "") or 60.0)
+    except ValueError:
+        return 60.0
+
+
+_WINDOW_STATE_LOCK = threading.Lock()
+_WINDOW: Dict[str, Any] = {
+    "active": False, "logdir": None, "started_s": 0.0,
+    "duration_s": 0.0, "window": "", "timer": None, "last": None,
+}
+
+
+def profile_window_start(logdir: str, duration_s: float = 0.0,
+                         window: str = "") -> Dict[str, Any]:
+    """Open a bounded-duration ``jax.profiler`` trace for THIS process —
+    the per-engine half of a coordinated fleet profile window
+    (gateway/fleet.py fans one ``POST /profile/start`` out to every
+    replica so the mesh is captured simultaneously).
+
+    Holds the module profile lock for the window's lifetime, so a
+    concurrent ``device_profile`` block degrades to a span event exactly
+    as it does against any active profiler session.  The window closes
+    on ``profile_window_stop()`` or automatically after ``duration_s``
+    (clamped to ``SELDON_TPU_PROFILE_MAX_S``).  Raises
+    :class:`ProfileBusyError` when a window/profile is already active —
+    overlapping windows are refused by contract."""
+    import jax
+
+    duration_s = float(duration_s or 0.0)
+    max_s = _profile_max_s()
+    if duration_s <= 0.0 or duration_s > max_s:
+        duration_s = max_s
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        raise ProfileBusyError(
+            "a profile window or device_profile block is already active "
+            "in this process — stop it before opening another")
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+    except BaseException:
+        _PROFILE_LOCK.release()
+        raise
+    with _WINDOW_STATE_LOCK:
+        _WINDOW.update(
+            active=True, logdir=str(logdir), started_s=time.time(),
+            duration_s=duration_s, window=window or new_span_id(),
+        )
+        timer = threading.Timer(duration_s, profile_window_stop)
+        timer.daemon = True
+        _WINDOW["timer"] = timer
+        timer.start()
+        return {
+            "active": True, "window": _WINDOW["window"],
+            "artifact": _WINDOW["logdir"],
+            "started_s": _WINDOW["started_s"],
+            "duration_s": duration_s,
+        }
+
+
+def profile_window_stop() -> Dict[str, Any]:
+    """Close the active window (idempotent — the auto-stop timer and an
+    explicit stop may race; whichever runs second is a no-op).  Returns
+    the finished window's manifest entry, or the LAST one when no window
+    is active."""
+    import jax
+
+    with _WINDOW_STATE_LOCK:
+        if not _WINDOW["active"]:
+            return {"active": False, "last": _WINDOW["last"]}
+        timer = _WINDOW.pop("timer", None)
+        if timer is not None:
+            timer.cancel()
+        _WINDOW["timer"] = None
+        _WINDOW["active"] = False
+        entry = {
+            "window": _WINDOW["window"],
+            "artifact": _WINDOW["logdir"],
+            "started_s": _WINDOW["started_s"],
+            "duration_s": round(time.time() - _WINDOW["started_s"], 3),
+        }
+        _WINDOW["last"] = entry
+    try:
+        jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001 - backend already stopped
+        entry = dict(entry, error=f"{type(e).__name__}: {e}")
+        with _WINDOW_STATE_LOCK:
+            _WINDOW["last"] = entry
+    finally:
+        _PROFILE_LOCK.release()
+    return {"active": False, "last": entry}
+
+
+def profile_window_start_request(body: dict) -> Dict[str, Any]:
+    """The engine-side ``POST /profile/start`` contract shared by the
+    aiohttp and fast HTTP lanes: body ``{"duration_s", "window",
+    "logdir"}`` (all optional) opens a bounded window in THIS process
+    and returns its manifest entry.  Raises :class:`ProfileBusyError`
+    on overlap — the route answers 409."""
+    import tempfile
+
+    window = str(body.get("window", "") or "") or new_span_id()
+    base = os.environ.get("SELDON_TPU_PROFILE_DIR", "") or \
+        os.path.join(tempfile.gettempdir(), "seldon-tpu-profiles")
+    logdir = str(body.get("logdir", "") or "")
+    # a caller-supplied logdir must stay INSIDE the configured profile
+    # dir — the route is reachable by any client that can reach the
+    # engine, and an arbitrary path would let it create directories and
+    # write profiler artifacts anywhere the engine user can.  Anything
+    # escaping the base falls back to the derived default.
+    if logdir:
+        base_real = os.path.realpath(base)
+        if not os.path.realpath(
+                os.path.join(base, logdir)).startswith(
+                base_real + os.sep):
+            logdir = ""
+        else:
+            logdir = os.path.join(base, logdir)
+    if not logdir:
+        logdir = os.path.join(base, window, f"engine-{os.getpid()}")
+    try:
+        duration_s = float(body.get("duration_s", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        duration_s = 0.0
+    return profile_window_start(logdir, duration_s, window=window)
+
+
+def profile_window_status() -> Dict[str, Any]:
+    """The process-local window state for ``GET /profile``."""
+    with _WINDOW_STATE_LOCK:
+        return {
+            "active": _WINDOW["active"],
+            "window": _WINDOW["window"] if _WINDOW["active"] else None,
+            "artifact": _WINDOW["logdir"] if _WINDOW["active"] else None,
+            "started_s": (
+                _WINDOW["started_s"] if _WINDOW["active"] else None
+            ),
+            "duration_s": (
+                _WINDOW["duration_s"] if _WINDOW["active"] else None
+            ),
+            "last": _WINDOW["last"],
+        }

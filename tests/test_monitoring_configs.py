@@ -157,5 +157,9 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_kv_handoff_seconds",
         "seldon_tpu_kv_handoff_bytes_total",
         "seldon_tpu_kv_handoff_inflight",
+        # fleet observability plane (gateway/fleet.py)
+        "seldon_tpu_fleet_outlier_ratio",
+        "seldon_tpu_fleet_replicas",
+        "seldon_tpu_fleet_staleness_seconds",
     ):
         assert family in text, f"{family} missing from every dashboard"
