@@ -127,6 +127,23 @@ ttft-gate:
 fairness-gate:
 	JAX_PLATFORMS=cpu python bench.py --fairness-gate
 
+# binary wire contract gate: JSON vs application/x-seldon-tensor over
+# the same socket/engine (bench.py --wire-gate, best-of-3).  Fails when
+# the binary-lane floor exceeds SELDON_TPU_WIRE_FLOOR_REL (default
+# 0.6) x the JSON floor AND bytes-copied-per-request dropped < 4x (the
+# host-bound-container escape hatch; SELDON_TPU_WIRE_GATE_STRICT=1
+# disables it).  CPU-friendly (docs/benchmarking.md "binary wire A/B").
+wire-gate:
+	JAX_PLATFORMS=cpu python bench.py --wire-gate --smoke
+
+# binary-wire demo: sequential bit-exact JSON-vs-binary parity through
+# gateway->relay->engine, a coalesced burst (N requests, fewer relay
+# frames), the floor/copy A/B, and the SELDON_TPU_WIRE=0 kill switch.
+# Artifact wire_demo/wire.json (scripts/wire_demo.py; docs/
+# external-api.md "binary tensor wire contract")
+wire-demo:
+	JAX_PLATFORMS=cpu python scripts/wire_demo.py --out wire_demo
+
 # regenerate every artifact-quoted doc figure from the committed round
 # snapshot / fail when the docs drift from it (CI runs docs-check)
 docs-sync:
@@ -168,4 +185,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo demos train-demo stack bundle images publish release-dryrun

@@ -67,7 +67,7 @@ from seldon_core_tpu.runtime.qos import (
     resolve_tenant,
     tenancy_enabled,
 )
-from seldon_core_tpu.runtime.udsrelay import OP_FEEDBACK, OP_PREDICT
+from seldon_core_tpu.runtime.udsrelay import OP_FEEDBACK, OP_PREDICT, OP_WIRE
 from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
 # importing the spine at module load wires the global TRACER's ring sink
 # BEFORE the gateway serves its first request — a gateway-only process
@@ -120,6 +120,144 @@ class _Registration:
     #: (gateway/shadow.py) — that predictor serves weight-0 live traffic
     #: and receives the sampled fire-and-forget copies instead
     shadow: Optional[ShadowConfig] = None
+
+
+class _WireCoalescer:
+    """Co-arriving binary predicts for ONE engine socket ride a single
+    multi-tensor relay frame (runtime/wire.py MULTI): the first arrival
+    opens a ``SELDON_TPU_WIRE_COALESCE_US`` window; everything that
+    lands inside it (capped at ``SELDON_TPU_WIRE_COALESCE_MAX``) is
+    packed into one ``OP_WIRE`` hop and de-coalesced positionally from
+    the response's sub-frames — per-request hop cost amortizes exactly
+    where the engine's MicroBatcher would have re-batched the rows
+    anyway.  A window of 0 sends every frame solo.  Sub-request failures
+    are per-slot typed frames; a transport failure fails the whole batch
+    with the error every caller would have seen solo."""
+
+    def __init__(self, client, window_s: float, max_n: int):
+        self.client = client
+        self.window_s = window_s
+        self.max_n = max_n
+        self._pending: list = []  # [(frame_bytes, future)]
+        self._flush_task: Optional[asyncio.Task] = None
+        # STRONG refs to in-flight flush/send tasks: the event loop only
+        # holds tasks weakly, and a task whose last reference is dropped
+        # mid-await is garbage-collected mid-flight (GeneratorExit) —
+        # every waiter would then hang to its deadline
+        self._tasks: set = set()
+
+    def _track(self, task: asyncio.Task) -> asyncio.Task:
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        task.add_done_callback(
+            lambda t: None if t.cancelled() else t.exception())
+        return task
+
+    async def call(self, frame: bytes) -> "tuple[bytes, int]":
+        if self.window_s <= 0:
+            return await self.client.call(OP_WIRE, frame)
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.append((frame, fut))
+        if len(self._pending) >= self.max_n:
+            batch = self._take()
+            self._track(loop.create_task(self._send(batch)))
+        elif self._flush_task is None:
+            self._flush_task = self._track(
+                loop.create_task(self._delayed_flush()))
+        return await fut
+
+    def _take(self) -> list:
+        batch, self._pending = self._pending, []
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        return batch
+
+    async def _delayed_flush(self) -> None:
+        try:
+            await asyncio.sleep(self.window_s)
+        except asyncio.CancelledError:
+            raise
+        self._flush_task = None
+        batch, self._pending = self._pending, []
+        if batch:
+            await self._send(batch)
+
+    async def _send(self, batch: list) -> None:
+        from seldon_core_tpu.runtime import wire as wirelib
+
+        try:
+            if len(batch) == 1:
+                body, status = await self.client.call(OP_WIRE, batch[0][0])
+                self._resolve(batch[0][1], (body, status))
+                return
+            RECORDER.record_wire_coalesced(len(batch))
+            multi = wirelib.join_parts(
+                wirelib.encode_multi([f for f, _fut in batch]))
+            body, status = await self.client.call(OP_WIRE, multi)
+            if status == 415:
+                # peer doesn't speak OP_WIRE: hand every caller the 415
+                # so each negotiates down to its JSON fallback
+                for _f, fut in batch:
+                    self._resolve(fut, (body, status))
+                return
+            try:
+                frame = wirelib.decode_frame(body)
+            except wirelib.WireError:
+                # a NON-FRAME answer (e.g. a pre-wire relay's JSON
+                # 'unknown relay op' 400): hand every caller the raw
+                # body+status so each runs the solo path's JSON-parse /
+                # negotiate-down logic — raising here would 502 the
+                # whole batch and never trigger the fallback
+                for _f, fut in batch:
+                    self._resolve(fut, (body, status))
+                return
+            if not frame.is_multi or len(frame.subframes) != len(batch):
+                # a frame, but not our batch — every caller gets the
+                # typed 502 it would have gotten solo
+                raise wirelib.WireError(
+                    "coalesced response is not a %d-frame multi"
+                    % len(batch)
+                )
+            for (_f, fut), sub in zip(batch, frame.subframes):
+                # materialize each slot out of the shared buffer: the
+                # response bytearray is one wire read, the slot copy is
+                # what lets callers outlive it
+                self._resolve(fut, (bytes(sub), status))
+        except asyncio.CancelledError:
+            # gateway shutdown cancelled the flush mid-call: every
+            # waiter must fail FAST, not sit out its 20 s deadline
+            self._fail_batch(batch, ConnectionError(
+                "wire coalescer cancelled (gateway shutting down)"))
+            raise
+        except Exception as e:  # noqa: BLE001 - fan the failure out typed
+            self._fail_batch(batch, e)
+            # the exceptions ARE consumed (every caller awaits its
+            # future) — but a caller that timed out already has a
+            # cancelled future, and its slot's exception dies here
+            return
+
+    def shutdown(self) -> None:
+        """Cancel in-flight flush/send tasks and fail everything still
+        pending — callers get an immediate typed 503, not a 20 s hang."""
+        for t in list(self._tasks):
+            t.cancel()
+        batch, self._pending = self._pending, []
+        self._flush_task = None
+        self._fail_batch(batch, ConnectionError(
+            "wire coalescer closed (gateway shutting down)"))
+
+    @staticmethod
+    def _fail_batch(batch: list, exc: Exception) -> None:
+        for _f, fut in batch:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    @staticmethod
+    def _resolve(fut, result) -> None:
+        if not fut.done():
+            fut.set_result(result)
 
 
 class DeploymentStore:
@@ -266,6 +404,12 @@ class ApiGateway:
         # health off the engines' /stats surfaces.
         self._replica_sets: Dict[Tuple[str, str], Tuple[tuple, ReplicaSet]] = {}
         self._uds_clients: Dict[str, object] = {}
+        # binary wire lane (runtime/wire.py): one coalescer per engine
+        # socket (co-arriving predicts ride ONE multi-tensor relay
+        # frame), plus the TCP endpoints that declined binary (a 4xx
+        # non-frame answer) so we stop offering it to them
+        self._wire_coalescers: Dict[str, "_WireCoalescer"] = {}
+        self._wire_json_only: set = set()
         self._scrape_task: Optional[asyncio.Task] = None
         self._pruned_for = None  # store-change marker at last prune
         # feedback ingress accounting: engines may live in other
@@ -715,7 +859,25 @@ class ApiGateway:
 
             client = UdsRelayClient(path)
             self._uds_clients[path] = client
+            # a re-dialed client is a NEW peer process: invalidate the
+            # coalescer bound to the old one AND forget a stale json-only
+            # negotiation — an engine restarted wire-enabled must not
+            # stay pinned to the slow lane for the gateway's lifetime
+            self._wire_coalescers.pop(path, None)
+            self._wire_json_only.discard(path)
         return client
+
+    def _wire_coalescer(self, path: str) -> _WireCoalescer:
+        from seldon_core_tpu.runtime import wire as wirelib
+
+        client = self._uds_client(path)
+        co = self._wire_coalescers.get(path)
+        window, max_n = wirelib.coalesce_window_s(), wirelib.coalesce_max()
+        if (co is None or co.client is not client
+                or co.window_s != window or co.max_n != max_n):
+            co = _WireCoalescer(client, window, max_n)
+            self._wire_coalescers[path] = co
+        return co
 
     def _lane_for(self, endpoint: ReplicaEndpoint) -> str:
         if hasattr(endpoint.target, "predict"):
@@ -732,11 +894,23 @@ class ApiGateway:
         advertises: in-process call, framed UDS relay, or HTTP POST.
         ``obj`` is the SeldonMessage/Feedback, ``method`` its in-process
         method name, ``relay_op``/``path`` the lane-specific addresses."""
+        from seldon_core_tpu.runtime import wire as wirelib
+
         lane = self._lane_for(endpoint)
         RECORDER.record_lane_request(lane)
         if lane == "inprocess":
             return await getattr(endpoint.target, method)(obj)
+        # the binary tensor lane (runtime/wire.py) carries unary predicts
+        # with a numeric payload — no JSON composition, no JSON parse on
+        # either side; feedback and non-tensor payloads stay on JSON
+        wire_ok = (
+            method == "predict"
+            and wirelib.wire_enabled()
+            and wirelib.frame_eligible(obj)
+        )
         if lane == "uds":
+            if wire_ok and endpoint.uds_path not in self._wire_json_only:
+                return await self._wire_uds_call(endpoint.uds_path, obj)
             return await self._uds_call(
                 endpoint.uds_path, relay_op, obj.to_json()
             )
@@ -745,6 +919,8 @@ class ApiGateway:
                 "endpoint has no TCP url and the UDS lane is disabled "
                 "(SELDON_TPU_UDS=0)", code=503,
             )
+        if wire_ok and endpoint.base_url not in self._wire_json_only:
+            return await self._wire_http_post(endpoint.base_url, path, obj)
         return await self._http_post(
             endpoint.base_url + path, obj.to_json()
         )
@@ -802,6 +978,138 @@ class ApiGateway:
         except SeldonMessageError as e:
             return SeldonMessage.failure(
                 f"engine error: bad relay response: {e}", code=502
+            )
+
+    async def _wire_uds_call(self, path: str,
+                             msg: SeldonMessage) -> SeldonMessage:
+        """One binary predict over the framed relay — the zero-JSON hop.
+        The request frame's sidecar carries puid/deadline/traceparent/
+        tenant/tier (the relay-meta semantics, wire-native); co-arriving
+        calls for the same socket coalesce into one multi-tensor frame
+        and de-coalesce by slot, verified against the echoed puid.  The
+        gateway-side deadline clamp stays as the backstop, exactly like
+        ``_uds_call``."""
+        from seldon_core_tpu.messages import new_puid
+        from seldon_core_tpu.runtime import wire as wirelib
+
+        total = 20.0
+        rem = remaining_s()
+        if rem is not None:
+            if rem <= 0:
+                return SeldonMessage.failure(
+                    "request deadline exhausted at gateway", code=504
+                )
+            total = min(total, rem)
+        if not msg.meta.puid:
+            # the echo the de-coalescer is verified against
+            msg.meta.puid = new_puid()
+        frame = wirelib.join_parts(
+            wirelib.frame_from_message(msg, sidecar=True))
+        RECORDER.record_wire_request("dispatch-uds", "binary")
+        try:
+            body, _status = await asyncio.wait_for(
+                self._wire_coalescer(path).call(frame), timeout=total,
+            )
+        except asyncio.TimeoutError:
+            return SeldonMessage.failure(
+                f"engine timeout after {total:.1f}s on uds relay", code=504
+            )
+        except (ConnectionError, OSError) as e:
+            return SeldonMessage.failure(
+                f"engine unreachable: {e}", code=503
+            )
+        except wirelib.WireError as e:
+            return SeldonMessage.failure(
+                f"engine error: bad wire response: {e}", code=502
+            )
+        if _status == 415:
+            # the peer doesn't speak OP_WIRE (kill-switched engine):
+            # negotiate down PERMANENTLY for this socket and serve the
+            # request over JSON
+            self._wire_json_only.add(path)
+            return await self._uds_call(path, OP_PREDICT, msg.to_json())
+        try:
+            resp = wirelib.message_from_frame(wirelib.decode_frame(body))
+        except wirelib.WireError:
+            # not a frame: a relay-writer-level failure body is JSON
+            try:
+                parsed = SeldonMessage.from_json(
+                    body.decode("utf-8", "replace"))
+            except SeldonMessageError as e:
+                return SeldonMessage.failure(
+                    f"engine error: bad wire response: {e}", code=502
+                )
+            if (
+                parsed.status is not None
+                and "unknown relay op" in (parsed.status.info or "")
+            ):
+                # a PRE-WIRE engine build: its relay answers op 6 with
+                # the unknown-op 400 — same negotiate-down as 415, or a
+                # rolling upgrade would fail every predict to it forever
+                self._wire_json_only.add(path)
+                return await self._uds_call(
+                    path, OP_PREDICT, msg.to_json())
+            return parsed
+        if resp.meta.puid and resp.meta.puid != msg.meta.puid:
+            return SeldonMessage.failure(
+                "coalesced wire response puid mismatch (got "
+                f"{resp.meta.puid!r})", code=502,
+            )
+        return resp
+
+    async def _wire_http_post(self, base_url: str, path: str,
+                              msg: SeldonMessage) -> SeldonMessage:
+        """Binary predict over the TCP lane: same pooled session and
+        deadline clamp as ``_http_post``, body = one wire frame instead
+        of JSON.  A peer that answers 4xx with a non-frame body doesn't
+        speak the contract — it is remembered as json-only and this call
+        (and every later one) rides the JSON path."""
+        import aiohttp
+
+        from seldon_core_tpu.runtime import wire as wirelib
+
+        session = self._get_session()
+        total = 20.0
+        headers = {"Content-Type": wirelib.WIRE_CONTENT_TYPE}
+        rem = remaining_s()
+        if rem is not None:
+            if rem <= 0:
+                return SeldonMessage.failure(
+                    "request deadline exhausted at gateway", code=504
+                )
+            total = min(total, rem)
+            headers[DEADLINE_HEADER] = deadline_header_value()
+        RECORDER.record_wire_request("dispatch-tcp", "binary")
+        frame = wirelib.join_parts(
+            wirelib.frame_from_message(msg, sidecar=True))
+        try:
+            async with session.post(
+                base_url + path, data=frame,
+                timeout=aiohttp.ClientTimeout(total=total), headers=headers,
+            ) as r:
+                raw = await r.read()
+                if r.content_type == wirelib.WIRE_CONTENT_TYPE:
+                    return wirelib.message_from_frame(
+                        wirelib.decode_frame(raw))
+                if r.status in (400, 404, 405, 415, 501):
+                    # the peer declined the contract (older build or
+                    # kill-switched): negotiate down PERMANENTLY and
+                    # serve this request over JSON
+                    self._wire_json_only.add(base_url)
+                    return await self._http_post(
+                        base_url + path, msg.to_json())
+                return SeldonMessage.from_json(
+                    raw.decode("utf-8", "replace"))
+        except aiohttp.ClientConnectorError:
+            # connection establishment failed before any bytes moved:
+            # delegate to the JSON lane, which owns the connect-retry
+            # choreography (3 attempts) — no double-apply risk
+            return await self._http_post(base_url + path, msg.to_json())
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return SeldonMessage.failure(f"engine error: {e}", code=503)
+        except (wirelib.WireError, SeldonMessageError) as e:
+            return SeldonMessage.failure(
+                f"engine error: bad wire response: {e}", code=502
             )
 
     def _get_session(self):
@@ -1070,6 +1378,9 @@ class ApiGateway:
         if self._scrape_task is not None:
             self._scrape_task.cancel()
             self._scrape_task = None
+        for co in self._wire_coalescers.values():
+            co.shutdown()
+        self._wire_coalescers = {}
         for client in self._uds_clients.values():
             await client.close()
         self._uds_clients = {}
@@ -1127,6 +1438,10 @@ def make_gateway_app(gateway: ApiGateway):
     )
 
     async def predictions(request):
+        from seldon_core_tpu.runtime import wire as wirelib
+
+        if (request.content_type or "") == wirelib.WIRE_CONTENT_TYPE:
+            return await predictions_wire(request)
         try:
             msg = SeldonMessage.from_json(await _payload_text(request))
         except SeldonMessageError as e:
@@ -1137,6 +1452,7 @@ def make_gateway_app(gateway: ApiGateway):
             trace_scope,
         )
 
+        RECORDER.record_wire_request("ingress", "json")
         try:
             # deadline set at the gateway governs the whole request tree;
             # an incoming traceparent makes the gateway span the caller's
@@ -1157,6 +1473,71 @@ def make_gateway_app(gateway: ApiGateway):
             resp.status.code or 500
         )
         return _msg_response(resp, status=status)
+
+    async def predictions_wire(request):
+        """Binary tensor ingress (``Content-Type:
+        application/x-seldon-tensor``, runtime/wire.py): the client's
+        frame parses into the routing layer with ONE frombuffer view —
+        no JSON anywhere between the client socket and the engine's
+        device dispatch when the engine hop rides the wire lane too.
+        The frame sidecar carries deadline/trace/tenant/tier (HTTP
+        headers still honored as the fallback); responses are framed
+        from the engine's response tensor and answer with the same
+        content type."""
+        from seldon_core_tpu.runtime import wire as wirelib
+        from seldon_core_tpu.utils.tracing import (
+            TRACEPARENT_HEADER,
+            parse_traceparent,
+            trace_scope,
+        )
+
+        if not wirelib.wire_enabled():
+            return _error_response(
+                "binary wire lane disabled (SELDON_TPU_WIRE=0)", code=415
+            )
+        body = await request.read()
+        RECORDER.record_wire_request("ingress", "binary")
+        wirelib.account_copy(len(body))
+        try:
+            frame = wirelib.decode_frame(body)
+            if frame.is_multi:
+                raise wirelib.WireError(
+                    "multi frames are a gateway->engine contract; "
+                    "ingress takes single frames"
+                )
+            msg = wirelib.message_from_frame(frame)
+        except wirelib.WireError as e:
+            return _error_response(str(e), code=e.http_code)
+        smeta = frame.meta
+        dl_ms = smeta.get("deadline_ms")
+        budget_s = (
+            dl_ms / 1e3 if dl_ms else
+            deadline_ms_header(request.headers.get(DEADLINE_HEADER))
+        )
+        try:
+            with trace_scope(parse_traceparent(
+                smeta.get("traceparent")
+                or request.headers.get(TRACEPARENT_HEADER)
+            )), maybe_deadline_scope(budget_s), qos_scope(
+                smeta.get("tenant") or request.headers.get(TENANT_HEADER),
+                smeta.get("tier") or request.headers.get(TIER_HEADER),
+            ):
+                resp = await gateway.predict(msg, _bearer(request))
+        except AuthError as e:
+            return _error_response(str(e), code=401)
+        status = 200 if resp.status is None or resp.status.status == "SUCCESS" else (
+            resp.status.code or 500
+        )
+        if resp.data is not None and not wirelib.frame_eligible(resp):
+            # a non-tensor answer (object/ragged payload) can't frame —
+            # degrade to JSON; the status code still tells the story
+            return _msg_response(resp, status=status)
+        parts = wirelib.frame_from_message(resp, response=True,
+                                           sidecar=False)
+        return web.Response(
+            body=wirelib.join_parts(parts), status=status,
+            content_type=wirelib.WIRE_CONTENT_TYPE,
+        )
 
     async def feedback(request):
         from seldon_core_tpu.utils.tracing import (

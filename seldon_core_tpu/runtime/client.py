@@ -185,6 +185,12 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
             "Seldon-model-version": version,
         }
         self._session: Optional[aiohttp.ClientSession] = None
+        # binary wire negotiation (runtime/wire.py): predicts with a
+        # numeric payload try the frame contract first; a peer that
+        # answers 4xx with a non-frame body (unit microservices, older
+        # builds, kill-switched engines) is remembered as json-only and
+        # every later call goes straight to JSON
+        self._wire_ok = True
 
     async def _get_session(self):
         import aiohttp
@@ -207,24 +213,36 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
             await self._session.close()
 
     async def _post(
-        self, path: str, payload: str, puid: str = "", method: str = "predict"
+        self, path: str, payload: "str | None", puid: str = "",
+        method: str = "predict", wire_msg: Optional[SeldonMessage] = None,
     ) -> SeldonMessage:
         from seldon_core_tpu.utils.tracing import TRACER, current_trace_puid
 
         rem = remaining_s()
         with TRACER.span(
             puid or current_trace_puid(), self.node.name, kind="client",
-            method=path.strip("/"), transport="rest",
+            method=path.strip("/"),
+            transport="wire" if wire_msg is not None else "rest",
             **(
                 {} if rem is None
                 else {"deadline_remaining_ms": round(rem * 1e3, 1)}
             ),
         ):
-            return await self._post_traced(path, payload, method)
+            return await self._post_traced(path, payload, method, wire_msg)
 
     async def _post_traced(
-        self, path: str, payload: str, method: str
+        self, path: str, payload: "str | None", method: str,
+        wire_msg: Optional[SeldonMessage] = None,
     ) -> SeldonMessage:
+        """The resilient attempt loop.  ``wire_msg`` switches the
+        TRANSPORT of each attempt to the binary wire frame
+        (runtime/wire.py) — same breaker gate, same deadline clamp, same
+        retry budget; only the bytes differ.  A peer that answers a
+        negotiation-shaped 4xx with a non-frame body flips this runtime
+        to json-only PERMANENTLY and the same attempt re-sends as JSON
+        (one extra hop, once per runtime lifetime — never per call).
+        ``payload`` may be None while the wire lane is active; the JSON
+        composition happens lazily only if the fallback is taken."""
         import aiohttp
 
         from seldon_core_tpu.utils.tracing import (
@@ -236,6 +254,25 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
         policy = self.retry_policy
         guard = _BreakerGuard(self.breaker)
         attempt = 0
+        wire_body = None
+
+        def accept_json_200(path_, body_, attempt_):
+            # the ONE 200-JSON acceptance rule both transports share: a
+            # malformed 200 body is deterministic misbehaviour (breaker
+            # failure, no retry); a clean first-attempt success deposits
+            # into the shared retry budget
+            try:
+                out_ = SeldonMessage.from_json(body_)
+            except SeldonMessageError as e_:
+                guard.record(False)
+                raise RemoteCallError(
+                    self.node.name, path_, f"bad response: {e_}"
+                ) from e_
+            guard.record(True)
+            if self.retry_budget is not None and attempt_ == 0:
+                self.retry_budget.deposit()
+            return out_
+
         try:
             while True:
                 # per-attempt admission: a breaker that opened mid-loop
@@ -256,36 +293,87 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
                 tp = traceparent_header_value()
                 if tp is not None:
                     headers[TRACEPARENT_HEADER] = tp
-                headers = headers or None
+                use_wire = wire_msg is not None and self._wire_ok
                 retryable = False
                 try:
-                    async with session.post(
-                        self.base + path,
-                        data={"json": payload, "isDefault": "false"},
-                        timeout=aiohttp.ClientTimeout(total=att_timeout),
-                        headers=headers,
-                    ) as resp:
-                        body = await resp.text()
-                        if resp.status == 200:
-                            try:
-                                out = SeldonMessage.from_json(body)
-                            except SeldonMessageError as e:
-                                # malformed 200 body: the node is
-                                # misbehaving deterministically — a breaker
-                                # failure, not a retry candidate
-                                guard.record(False)
-                                raise RemoteCallError(
-                                    self.node.name, path, f"bad response: {e}"
-                                ) from e
-                            guard.record(True)
-                            if self.retry_budget is not None and attempt == 0:
-                                self.retry_budget.deposit()
-                            return out
-                        # non-200: 5xx/429 count against the breaker and
-                        # may retry; 4xx are the caller's fault — neither
-                        retryable = policy.retryable_http(resp.status)
-                        guard.record(not (retryable or resp.status >= 500))
-                        last_err = f"HTTP {resp.status}: {body[:200]}"
+                    if use_wire:
+                        from seldon_core_tpu.runtime import wire as wirelib
+
+                        if wire_body is None:
+                            wire_body = wirelib.join_parts(
+                                wirelib.frame_from_message(
+                                    wire_msg, sidecar=True))
+                        headers["Content-Type"] = wirelib.WIRE_CONTENT_TYPE
+                        async with session.post(
+                            self.base + path, data=wire_body,
+                            timeout=aiohttp.ClientTimeout(total=att_timeout),
+                            headers=headers,
+                        ) as resp:
+                            if (
+                                resp.status == 200
+                                and resp.content_type
+                                == wirelib.WIRE_CONTENT_TYPE
+                            ):
+                                raw = await resp.read()
+                                try:
+                                    out = wirelib.message_from_frame(
+                                        wirelib.decode_frame(raw))
+                                except wirelib.WireError as e:
+                                    guard.record(False)
+                                    raise RemoteCallError(
+                                        self.node.name, path,
+                                        f"bad wire response: {e}",
+                                    ) from e
+                                guard.record(True)
+                                if self.retry_budget is not None \
+                                        and attempt == 0:
+                                    self.retry_budget.deposit()
+                                RECORDER.record_wire_request(
+                                    "node", "binary")
+                                return out
+                            body = await resp.text()
+                            if resp.status == 200:
+                                # a JSON answer to a binary request: the
+                                # peer ignored the content type (lenient
+                                # stubs/unit apps) — take the answer and
+                                # speak JSON from now on
+                                self._wire_ok = False
+                                return accept_json_200(path, body, attempt)
+                            if resp.status in (400, 404, 405, 415, 501) \
+                                    and resp.content_type \
+                                    != wirelib.WIRE_CONTENT_TYPE:
+                                # the peer doesn't speak the contract
+                                # (unit microservice, older build,
+                                # kill-switched): negotiate down and
+                                # re-send THIS attempt as JSON.  The
+                                # answer proves the node is alive — a
+                                # breaker success, not a failure
+                                self._wire_ok = False
+                                guard.record(True)
+                                continue
+                            retryable = policy.retryable_http(resp.status)
+                            guard.record(
+                                not (retryable or resp.status >= 500))
+                            last_err = f"HTTP {resp.status}: {body[:200]}"
+                    else:
+                        if payload is None:
+                            payload = wire_msg.to_json()
+                        async with session.post(
+                            self.base + path,
+                            data={"json": payload, "isDefault": "false"},
+                            timeout=aiohttp.ClientTimeout(total=att_timeout),
+                            headers=headers or None,
+                        ) as resp:
+                            body = await resp.text()
+                            if resp.status == 200:
+                                return accept_json_200(path, body, attempt)
+                            # non-200: 5xx/429 count against the breaker
+                            # and may retry; 4xx are the caller's fault —
+                            # neither
+                            retryable = policy.retryable_http(resp.status)
+                            guard.record(
+                                not (retryable or resp.status >= 500))
+                            last_err = f"HTTP {resp.status}: {body[:200]}"
                 except (aiohttp.ClientError, asyncio.TimeoutError) as e:
                     # transport failure (connect refused, reset, attempt
                     # timeout): always a breaker failure, retryable for
@@ -307,6 +395,19 @@ class RestNodeRuntime(_ResilientCallMixin, NodeRuntime):
     # -- NodeRuntime API ----------------------------------------------------
 
     async def predict(self, msg: SeldonMessage) -> SeldonMessage:
+        from seldon_core_tpu.runtime import wire as wirelib
+
+        if (
+            self._wire_ok
+            and wirelib.wire_enabled()
+            and wirelib.frame_eligible(msg)
+        ):
+            # binary transport (payload composed lazily ONLY if the
+            # peer negotiates the attempt down to JSON)
+            return await self._post(
+                "/predict", None, msg.meta.puid, "predict", wire_msg=msg,
+            )
+        RECORDER.record_wire_request("node", "json")
         return await self._post("/predict", msg.to_json(), msg.meta.puid, "predict")
 
     async def transform_input(self, msg: SeldonMessage) -> SeldonMessage:

@@ -58,6 +58,7 @@ from seldon_core_tpu.runtime.autopilot import (
 from seldon_core_tpu.runtime.resilience import (
     CircuitBreaker,
     RetryBudget,
+    maybe_deadline_scope,
     remaining_s,
 )
 from seldon_core_tpu.utils.hotrecord import SPINE
@@ -1145,6 +1146,147 @@ class EngineService:
         resp = await self.predict(msg)
         ok = resp.status is None or resp.status.status == "SUCCESS"
         return resp.to_json(), 200 if ok else (resp.status.code or 400)
+
+    async def predict_wire(self, payload) -> "tuple[int, list]":
+        """Binary-lane wire-to-wire predict (runtime/wire.py): one frame
+        in, ``(http_status, response frame parts)`` out.
+
+        The request tensor is an ``np.frombuffer`` VIEW over the wire
+        bytes — no JSON round trip, no value-by-value materialization —
+        and the response is framed straight from the device readback
+        buffer (the parts list keeps header and payload separate so the
+        transport writes them writev-style).  A MULTI frame (the
+        gateway's coalesced hop) fans its sub-frames out concurrently;
+        the MicroBatcher re-coalesces the rows into one device dispatch
+        exactly as it would have for separate arrivals, so de/coalescing
+        is a pure hop-cost optimization, never a numerics change.
+
+        Raises :class:`~seldon_core_tpu.runtime.wire.WireError` (400) /
+        ``WireFrameTooLarge`` (413) for bytes that cannot be parsed as a
+        frame at all; a parseable frame always answers with a typed
+        response frame, per-sub-request on the coalesced path."""
+        from seldon_core_tpu.runtime import wire
+
+        frame = wire.decode_frame(payload)
+        if frame.is_multi:
+            results = await asyncio.gather(
+                *(self._predict_wire_sub(sub) for sub in frame.subframes)
+            )
+            subs = [wire.join_parts(parts) for _status, parts in results]
+            return 200, wire.encode_multi(subs)
+        return await self._predict_wire_single(frame)
+
+    async def _predict_wire_sub(self, buf) -> "tuple[int, list]":
+        """One coalesced sub-frame: ANY failure — torn bytes, an
+        unexpected model exception, an unencodable result — answers ITS
+        slot with a typed error frame instead of failing its
+        co-travellers (up to COALESCE_MAX requests ride one frame; one
+        bad slot must never 502 the batch)."""
+        from seldon_core_tpu.runtime import wire
+
+        try:
+            frame = wire.decode_frame(buf)
+            if frame.is_multi:
+                raise wire.WireError("nested multi frames are not allowed")
+        except wire.WireError as e:
+            return e.http_code, wire.encode_frame(
+                None, status=e.http_code, response=True,
+                meta_bytes=wire.pack_wire_meta(extra={"error": str(e)}),
+            )
+        try:
+            return await self._predict_wire_single(frame)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - slot-isolated 500
+            return 500, wire.encode_frame(
+                None, status=500, response=True,
+                meta_bytes=wire.pack_wire_meta(
+                    puid=frame.meta.get("puid"),
+                    extra={"error": str(e)},
+                ),
+            )
+
+    def _wire_error_frame(self, puid: str, e: Exception,
+                          code: int) -> "tuple[int, list]":
+        from seldon_core_tpu.runtime import wire
+
+        return code, wire.encode_frame(
+            None, status=code, response=True,
+            meta_bytes=wire.pack_wire_meta(puid=puid,
+                                           extra={"error": str(e)}),
+        )
+
+    async def _predict_wire_single(self, frame) -> "tuple[int, list]":
+        from seldon_core_tpu.runtime import wire
+        from seldon_core_tpu.runtime.qos import qos_scope
+        from seldon_core_tpu.utils.tracing import (
+            parse_traceparent,
+            trace_scope,
+        )
+
+        meta = frame.meta
+        puid = meta.get("puid") or new_puid()
+        t0 = time.perf_counter()
+        # the sidecar binds exactly like the HTTP lanes bind headers:
+        # deadline clamps tighten-only, trace joins the caller's tree.
+        # QoS binds ONLY when the sidecar names an identity — a bare
+        # scope would reset what an HTTP header already bound
+        from contextlib import ExitStack
+        with ExitStack() as stack:
+            dl = meta.get("deadline_ms")
+            stack.enter_context(
+                maybe_deadline_scope(dl / 1e3 if dl else None))
+            stack.enter_context(
+                trace_scope(parse_traceparent(meta.get("traceparent"))))
+            if meta.get("tenant") is not None or meta.get("tier") is not None:
+                stack.enter_context(
+                    qos_scope(meta.get("tenant"), meta.get("tier")))
+            code = stack.enter_context(
+                self.metrics.time_server("predictions", "POST"))
+            stack.enter_context(self.tracer.span(
+                puid, "request", kind="request", method="predict",
+                mode=self.mode,
+            ))
+            try:
+                rows = frame.rows()
+            except wire.WireError as e:
+                code["code"] = "400"
+                return self._wire_error_frame(puid, e, 400)
+            try:
+                y_rows, (routing, tags) = await self._submit(rows)
+            except (SeldonMessageError, GraphSpecError) as e:
+                http_code = getattr(e, "http_code", 400)
+                code["code"] = str(http_code)
+                code["shed"] = isinstance(e, LoadShedError)
+                self._audit_request(
+                    puid, "predict", http_code, t0,
+                    rows=len(rows), lane="wire",
+                )
+                return self._wire_error_frame(puid, e, http_code)
+            self._audit_request(
+                puid, "predict", 200, t0, rows=len(rows), lane="wire",
+            )
+            in_extra = frame.extra()
+            extra: dict = {}
+            if self._static_names:
+                extra["names"] = list(self._static_names)
+            if in_extra.get("kind"):
+                extra["kind"] = in_extra["kind"]
+            if tags or in_extra.get("tags"):
+                extra["tags"] = {
+                    **(in_extra.get("tags") or {}),
+                    **pythonize_tags(tags or {}),
+                }
+            if routing or in_extra.get("routing"):
+                extra["routing"] = {
+                    **(in_extra.get("routing") or {}),
+                    **{k: int(v) for k, v in (routing or {}).items()},
+                }
+            return 200, wire.encode_frame(
+                np.asarray(y_rows), status=200, response=True,
+                meta_bytes=wire.pack_wire_meta(puid=puid,
+                                               extra=extra or None),
+            )
 
     async def predict_proto_wire(self, wire: bytes) -> bytes:
         """Proto wire bytes -> proto wire bytes — the zero-object gRPC lane.

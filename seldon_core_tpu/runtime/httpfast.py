@@ -62,6 +62,7 @@ from seldon_core_tpu.utils.tracing import parse_traceparent, trace_scope
 __all__ = ["FastHttpServer", "serve_fast"]
 
 _JSON = "application/json"
+_WIRE_CTYPE = "application/x-seldon-tensor"  # runtime/wire.py contract
 _MAX_BODY = 256 * 1024 * 1024  # matches rest.py client_max_size
 _MAX_HEAD = 64 * 1024
 
@@ -87,7 +88,8 @@ _STATUS_LINE = {
     for code, text in {
         200: "OK", 400: "Bad Request", 404: "Not Found",
         405: "Method Not Allowed", 411: "Length Required",
-        413: "Payload Too Large", 500: "Internal Server Error",
+        413: "Payload Too Large", 415: "Unsupported Media Type",
+        500: "Internal Server Error",
         501: "Not Implemented", 503: "Service Unavailable",
         504: "Gateway Timeout",
     }.items()
@@ -153,6 +155,8 @@ class _EngineRoutes:
         return 200, b"Not Implemented", "text/plain"
 
     async def _predictions(self, body, ctype, query) -> Result:
+        if ctype.startswith(_WIRE_CTYPE):
+            return await self._predictions_wire(body)
         try:
             text, status = await self.engine.predict_json(
                 _payload_text(body, ctype)
@@ -165,6 +169,44 @@ class _EngineRoutes:
                 _JSON,
             )
         return status or 200, text.encode(), _JSON
+
+    async def _predictions_wire(self, body) -> Result:
+        """Binary tensor frame in, binary tensor frame out (runtime/
+        wire.py) — no JSON round trip.  The request tensor is a
+        frombuffer view over ``body`` (the ONE copy this lane pays is the
+        receive-buffer materialization, accounted); the response parts
+        ride the writer as separate buffers, framed straight from the
+        device readback array.  A torn/over-length frame answers a typed
+        400/413 through the same FIFO writer every response rides — the
+        connection keeps serving (or closes AFTER the queued responses
+        drain, never before)."""
+        from seldon_core_tpu.runtime import wire
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        if not wire.wire_enabled():
+            return (
+                415,
+                SeldonMessage.failure(
+                    "binary wire lane disabled (SELDON_TPU_WIRE=0)",
+                    code=415,
+                ).to_json().encode(),
+                _JSON,
+            )
+        RECORDER.record_wire_request("fast", "binary")
+        wire.account_copy(len(body))
+        try:
+            status, parts = await self.engine.predict_wire(body)
+        except wire.WireError as e:
+            # unparseable bytes: the peer may not even decode frames —
+            # the typed failure goes back as JSON it can always read
+            return (
+                e.http_code,
+                SeldonMessage.failure(
+                    str(e), code=e.http_code
+                ).to_json().encode(),
+                _JSON,
+            )
+        return status, parts, _WIRE_CTYPE
 
     async def _generate_stream(self, body, ctype, query):
         """SSE token streaming (beyond-reference: the reference predates
@@ -533,17 +575,27 @@ class _FastHttpProtocol(asyncio.Protocol):
     def _write_response(self, status, body, ctype, close, extra=b""):
         if self.transport is None or self.transport.is_closing():
             return
+        # body may be a LIST of buffer parts (the binary wire lane's
+        # header + device-readback payload view): written sequentially,
+        # no concatenation copy — the transport coalesces into writev
+        parts = body if isinstance(body, (list, tuple)) else None
+        blen = sum(len(p) for p in parts) if parts is not None else len(body)
         head = (
             _STATUS_LINE.get(status) or f"HTTP/1.1 {status} X\r\n".encode()
         ) + (
             b"Content-Length: %d\r\nContent-Type: %s\r\n%s%s\r\n"
             % (
-                len(body),
+                blen,
                 ctype.encode(),
                 extra,
                 b"Connection: close\r\n" if close else b"",
             )
         )
+        if parts is not None:
+            self.transport.write(head)
+            for p in parts:
+                self.transport.write(p)
+            return
         self.transport.write(head + body)
 
     # -- parsing -------------------------------------------------------------
@@ -559,7 +611,10 @@ class _FastHttpProtocol(asyncio.Protocol):
                 if len(self.buf) - consumed < self._head_len + self.body_need:
                     break
                 start = consumed + self._head_len
-                body = bytes(self.buf[start: start + self.body_need])
+                # one copy out of the receive buffer (a bytearray slice
+                # would copy twice: slice then bytes); the view is a
+                # temporary, gone before the prefix trim below
+                body = bytes(memoryview(self.buf)[start: start + self.body_need])
                 consumed = start + self.body_need
                 self.body_need = -1
                 self._dispatch(self._head, self._lower, body)
@@ -599,7 +654,7 @@ class _FastHttpProtocol(asyncio.Protocol):
                 self.body_need = clen
                 break
             start = end + 4
-            body = bytes(self.buf[start: start + clen])
+            body = bytes(memoryview(self.buf)[start: start + clen])
             consumed = start + clen
             self._dispatch(head, lower, body)
         if consumed:

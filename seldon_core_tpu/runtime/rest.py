@@ -49,6 +49,9 @@ from seldon_core_tpu.utils.tracing import (
 
 __all__ = ["make_engine_app", "make_unit_app", "serve_app"]
 
+#: binary tensor wire contract (runtime/wire.py)
+_WIRE_CTYPE = "application/x-seldon-tensor"
+
 
 async def _payload_text(request: web.Request) -> str:
     """JSON body or form-encoded ``json=`` field.  curl sends
@@ -128,6 +131,8 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app = web.Application(client_max_size=256 * 1024 * 1024)
 
     async def predictions(request: web.Request) -> web.Response:
+        if (request.content_type or "") == _WIRE_CTYPE:
+            return await predictions_wire(request)
         try:
             with _request_trace_scope(request), \
                     maybe_deadline_scope(_request_budget_s(request)), \
@@ -139,6 +144,36 @@ def make_engine_app(engine: EngineService) -> web.Application:
             return _error_response(str(e), code=e.http_code)
         return web.Response(
             text=text, status=status or 200, content_type="application/json"
+        )
+
+    async def predictions_wire(request: web.Request) -> web.Response:
+        """``Content-Type: application/x-seldon-tensor`` — the binary
+        tensor wire contract (runtime/wire.py): frame in, frame out, no
+        JSON round trip.  Header-bound deadline/trace/QoS still apply
+        (the frame sidecar tightens/joins them, never loosens)."""
+        from seldon_core_tpu.runtime import wire
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        if not wire.wire_enabled():
+            return _error_response(
+                "binary wire lane disabled (SELDON_TPU_WIRE=0)", code=415
+            )
+        body = await request.read()
+        RECORDER.record_wire_request("rest", "binary")
+        wire.account_copy(len(body))
+        try:
+            with _request_trace_scope(request), \
+                    maybe_deadline_scope(_request_budget_s(request)), \
+                    _request_qos_scope(request):
+                status, parts = await engine.predict_wire(body)
+        except wire.WireError as e:
+            # unparseable bytes answer as JSON the peer can always read
+            return _error_response(str(e), code=e.http_code)
+        except SeldonMessageError as e:
+            return _error_response(str(e), code=e.http_code)
+        return web.Response(
+            body=wire.join_parts(parts), status=status,
+            content_type=_WIRE_CTYPE,
         )
 
     async def predict_alias(request: web.Request) -> web.Response:

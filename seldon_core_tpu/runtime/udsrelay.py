@@ -29,6 +29,11 @@ Ops:
                  lane federated trace assembly (gateway/fleet.py) uses
                  to reach uds-only replicas and relay-spec decode peers
                  that serve no HTTP surface
+    OP_WIRE      payload = binary tensor frame (runtime/wire.py; single
+                 or gateway-coalesced MULTI) -> binary response frame —
+                 the zero-JSON predict lane; bytes in, bytes out, the
+                 response parts framed straight from the device readback
+                 buffer
 
 Metadata sidecar: setting the high bit of the op byte (``op | 0x80``)
 marks the payload as ``uvarint(meta_len) | meta_block | body``.  The
@@ -71,6 +76,7 @@ __all__ = [
     "OP_PING",
     "OP_KVSTREAM",
     "OP_TRACE",
+    "OP_WIRE",
     "META_FLAG",
     "RELAY_META_VERSION",
     "UdsEngineServer",
@@ -90,6 +96,7 @@ OP_FEEDBACK = 2
 OP_PING = 3
 OP_KVSTREAM = 4
 OP_TRACE = 5
+OP_WIRE = 6
 
 #: high bit of the op byte: payload begins with a varint-prefixed
 #: metadata block (deadline/traceparent/tenant/tier sidecar)
@@ -111,35 +118,13 @@ _PAUSE_PENDING = 64
 _RESUME_PENDING = 16
 
 
-def _uvarint(n: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        out.append(b | (0x80 if n else 0))
-        if not n:
-            return bytes(out)
-
-
-def _read_uvarint(view, off: int) -> "tuple[int, int]":
-    shift = 0
-    val = 0
-    while True:
-        if off >= len(view):
-            raise ValueError("truncated varint")
-        b = view[off]
-        off += 1
-        val |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return val, off
-        shift += 7
-        if shift > 35:
-            raise ValueError("varint too long")
-
-
-def _pack_str(s: "str | None") -> bytes:
-    raw = (s or "").encode("utf-8", "replace")
-    return _uvarint(len(raw)) + raw
+# framing helpers shared with the binary tensor wire codec — ONE uvarint
+# implementation for both framed lanes (runtime/wire.py owns it)
+from seldon_core_tpu.runtime.wire import (  # noqa: E402
+    pack_str as _pack_str,
+    read_uvarint as _read_uvarint,
+    uvarint as _uvarint,
+)
 
 
 def pack_relay_meta(deadline_ms=None, traceparent=None, tenant=None,
@@ -269,11 +254,20 @@ class _UdsServerProtocol(asyncio.Protocol):
                 ).to_json().encode()
             if self.transport is None or self.transport.is_closing():
                 continue
-            # one head + one body write — the transport coalesces into a
-            # single writev; no intermediate head+body concatenation copy
-            self.transport.write(_RESP_HEAD.pack(len(body), status))
-            if body:
-                self.transport.write(body)
+            # one head write + one write per body part — the transport
+            # coalesces into a single writev; no intermediate
+            # concatenation copy.  A LIST body is the binary wire lane's
+            # (header, device-readback payload) parts
+            if isinstance(body, (list, tuple)):
+                blen = sum(len(p) for p in body)
+                self.transport.write(_RESP_HEAD.pack(blen, status))
+                for p in body:
+                    if p:
+                        self.transport.write(p)
+            else:
+                self.transport.write(_RESP_HEAD.pack(len(body), status))
+                if body:
+                    self.transport.write(body)
             if self.close_after_drain and self.queue.empty():
                 # the terminal 413 (and everything queued before it) is
                 # out; now the connection can die
@@ -337,7 +331,7 @@ class _UdsServerProtocol(asyncio.Protocol):
                         except ValueError:
                             meta = None
                     with payload[lo:] as body:
-                        if op == OP_KVSTREAM:
+                        if op in (OP_KVSTREAM, OP_WIRE):
                             data: "str | bytes" = bytes(body)
                         else:
                             data = str(body, "utf-8", "replace")
@@ -404,6 +398,23 @@ class _UdsServerProtocol(asyncio.Protocol):
                 return 503, b"engine does not accept KV handoffs"
             status, body = await handler(data)
             return status or 200, body
+        if op == OP_WIRE:
+            # binary tensor predict (runtime/wire.py): bytes in, frame
+            # parts out — the writer sends them writev-style.  Frame
+            # errors surface typed through the writer's
+            # SeldonMessageError catch (WireError 400 / TooLarge 413),
+            # riding the FIFO like every other response
+            from seldon_core_tpu.runtime import wire as wirelib
+
+            handler = getattr(self.engine, "predict_wire", None)
+            if handler is None or not wirelib.wire_enabled():
+                return 415, b"binary wire lane unavailable"
+            from seldon_core_tpu.utils.telemetry import RECORDER
+
+            RECORDER.record_wire_request("relay", "binary")
+            wirelib.account_copy(len(data))
+            status, parts = await handler(data)
+            return status or 200, parts
         if op == OP_TRACE:
             # federated trace assembly's relay lane: uds-only replicas
             # and decode peers answer their local trace document here

@@ -141,6 +141,14 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_replica_picks_total": ("counter", ("set", "replica")),
     "seldon_tpu_replica_mispicks_total": ("counter", ()),
     "seldon_tpu_relay_lane_requests_total": ("counter", ("lane",)),
+    # binary tensor wire contract (runtime/wire.py): predict traffic per
+    # lane split by wire format (json vs binary — says which contract
+    # the bytes actually rode), host-side bytes copied by the codec and
+    # its feeding lanes (the bench's bytes_copied_per_request axis), and
+    # requests that rode a gateway-coalesced multi-tensor engine frame
+    "seldon_tpu_wire_requests_total": ("counter", ("lane", "format")),
+    "seldon_tpu_wire_bytes_copied_total": ("counter", ()),
+    "seldon_tpu_wire_coalesced_total": ("counter", ()),
     # traffic lifecycle (gateway/shadow.py + operator/rollouts.py):
     # shadow-mirror outcomes and live-vs-shadow divergence, the shadow
     # hop's own latency (never on the live response path), canary
@@ -351,6 +359,12 @@ class FlightRecorder:
         self.replica_picks: Dict[str, Dict[str, int]] = {}
         self.replica_mispicks = 0
         self.lane_requests: Dict[str, int] = {}
+        # binary wire mirrors (runtime/wire.py): "lane/format" -> n,
+        # codec copy accounting, coalesced-request count
+        self.wire_requests: Dict[str, int] = {}
+        self.wire_bytes_copied = 0
+        self.wire_copies = 0
+        self.wire_coalesced = 0
         # fleet observability mirrors (gateway/fleet.py): per-replica
         # worst worse-than-median ratio + replica counts per set
         self.fleet_outliers: Dict[str, Dict[str, float]] = {}
@@ -662,6 +676,22 @@ class FlightRecorder:
                 "Gateway->engine dispatches by relay lane "
                 "(uds / tcp / inprocess — runtime/udsrelay.py)",
                 ["lane"], registry=self.registry)
+            self._p_wire_requests = Counter(
+                "seldon_tpu_wire_requests_total",
+                "Predict traffic by lane and wire format (json vs "
+                "binary application/x-seldon-tensor — runtime/wire.py)",
+                ["lane", "format"], registry=self.registry)
+            self._p_wire_bytes_copied = Counter(
+                "seldon_tpu_wire_bytes_copied_total",
+                "Host-side bytes copied by the binary wire codec and "
+                "the lanes feeding it (the bytes_copied_per_request "
+                "bench axis — docs/benchmarking.md)",
+                registry=self.registry)
+            self._p_wire_coalesced = Counter(
+                "seldon_tpu_wire_coalesced_total",
+                "Requests that rode a gateway-coalesced multi-tensor "
+                "engine frame (SELDON_TPU_WIRE_COALESCE_US window)",
+                registry=self.registry)
             self._p_shadow_requests = Counter(
                 "seldon_tpu_shadow_requests_total",
                 "Shadow-mirror outcomes (gateway/shadow.py): mirrored / "
@@ -923,6 +953,35 @@ class FlightRecorder:
             self.lane_requests[lane] = self.lane_requests.get(lane, 0) + 1
         if self.registry is not None:
             self._p_lane_requests.labels(lane=lane).inc()
+
+    # -- binary wire contract (runtime/wire.py feeds these) --------------
+
+    def record_wire_request(self, lane: str, format: str) -> None:
+        """One predict served/dispatched on ``lane`` in ``format`` (json
+        or binary) — the A/B visibility for the wire rollout."""
+        key = f"{lane}/{format}"
+        with self._lock:
+            self.wire_requests[key] = self.wire_requests.get(key, 0) + 1
+        if self.registry is not None:
+            self._p_wire_requests.labels(lane=lane, format=format).inc()
+
+    def record_wire_copy(self, nbytes: int) -> None:
+        """One host-side byte copy made by the wire codec or a lane
+        feeding it (wire.account_copy) — deliberately does NOT bump the
+        stats-cache generation: it moves per request under traffic."""
+        with self._lock:
+            self.wire_bytes_copied += int(nbytes)
+            self.wire_copies += 1
+        if self.registry is not None:
+            self._p_wire_bytes_copied.inc(nbytes)
+
+    def record_wire_coalesced(self, n: int) -> None:
+        """``n`` requests rode one coalesced multi-tensor engine frame
+        (gateway/apife.py WireCoalescer)."""
+        with self._lock:
+            self.wire_coalesced += int(n)
+        if self.registry is not None:
+            self._p_wire_coalesced.inc(n)
 
     # -- traffic lifecycle (gateway/shadow.py / operator/rollouts.py) ----
 
@@ -1372,6 +1431,12 @@ class FlightRecorder:
                     s: dict(d) for s, d in self.fleet_outliers.items()
                 },
             }
+            wire = {
+                "requests": dict(self.wire_requests),
+                "bytes_copied": self.wire_bytes_copied,
+                "copies": self.wire_copies,
+                "coalesced": self.wire_coalesced,
+            }
             lifecycle = {
                 "shadow": dict(self.shadow_requests),
                 "rollbacks": dict(self.rollbacks),
@@ -1411,6 +1476,7 @@ class FlightRecorder:
             "feedback": feedback,
             "quality": quality,
             "replicas": replicas,
+            "wire": wire,
             "traffic_lifecycle": lifecycle,
             "autopilot": autopilot,
             "qos": qos,
@@ -1529,6 +1595,10 @@ class FlightRecorder:
             self.replica_picks = {}
             self.replica_mispicks = 0
             self.lane_requests = {}
+            self.wire_requests = {}
+            self.wire_bytes_copied = 0
+            self.wire_copies = 0
+            self.wire_coalesced = 0
             self.fleet_outliers = {}
             self.fleet_replicas = {}
             self.shadow_requests = {}
