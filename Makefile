@@ -144,6 +144,24 @@ wire-gate:
 wire-demo:
 	JAX_PLATFORMS=cpu python scripts/wire_demo.py --out wire_demo
 
+# whole-graph fusion gate: the fused dispatch path (graph/fuse.py) must
+# stay bit-identical to the interpreter on the probe graphs AND keep the
+# fused 4-node-chain p50 <= SELDON_TPU_FUSION_REL (default 0.7) x the
+# interpreted p50, best-of-3.  Escape hatch for host-core-bound runners:
+# relax SELDON_TPU_FUSION_REL toward 1.0 — equivalence and the
+# graph_hops_eliminated N->1 accounting still gate.  CPU-friendly
+# (docs/operations.md "The fused graph path").
+fusion-gate:
+	JAX_PLATFORMS=cpu python bench.py --fusion-gate --smoke
+
+# whole-graph fusion demo: fused-vs-interpreter equivalence on a served
+# graph, the fusion plan off /stats, the /perf per-node phase
+# decomposition, and the SELDON_TPU_GRAPH_FUSE=0 kill switch.  Artifact
+# fusion_demo/fusion.json (scripts/fusion_demo.py; docs/operations.md
+# "The fused graph path")
+fusion-demo:
+	JAX_PLATFORMS=cpu python scripts/fusion_demo.py --out fusion_demo
+
 # regenerate every artifact-quoted doc figure from the committed round
 # snapshot / fail when the docs drift from it (CI runs docs-check)
 docs-sync:
@@ -185,4 +203,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun

@@ -1,5 +1,8 @@
 """Inference-graph layer: spec (CRD-equivalent), defaulting/validation,
-built-in units, host interpreter and compiled-graph executor."""
+built-in units, host interpreter, compiled-graph executor, and the
+whole-graph fusion pass (graph/fuse.py: one XLA program per predictor,
+partial fusion of eligible subtrees, SELDON_TPU_GRAPH_FUSE kill
+switch)."""
 
 from seldon_core_tpu.graph.spec import (  # noqa: F401
     ComponentBinding,

@@ -329,29 +329,39 @@ class CompiledGraph:
         observatory); returns (key, executable-or-None).  None means a
         concurrent build is in flight or AOT failed — the caller
         dispatches through ``_jit_predict`` with identical semantics."""
+        from seldon_core_tpu.utils.perf import OBSERVATORY
+
+        if not OBSERVATORY.enabled:
+            return "", None
+        key = self.executable_key(X)
+        return key, self._aot_build(key, self._jit_predict, (self.states, X))
+
+    def _aot_build(self, key: str, jitted, args: tuple):
+        """The shared per-shape AOT path: ``jitted(*args)`` lowered and
+        compiled once under ``key``, compile wall + cost features folded
+        into the perf observatory, result cached in the bounded ``_aot``
+        table.  Shared by this executor and the fused executor
+        (graph/fuse.py) so both ride one compile-cache discipline."""
         from seldon_core_tpu.utils.perf import (
             OBSERVATORY,
             extract_cost_features,
         )
 
-        if not OBSERVATORY.enabled:
-            return "", None
-        key = self.executable_key(X)
         with self._aot_lock:
             if key in self._aot:
-                return key, self._aot[key]
+                return self._aot[key]
             if key in self._aot_building or len(self._aot) >= self._aot_cap:
                 # first dispatch of this shape is mid-compile in another
                 # thread (ride the jit path rather than wait), or the
                 # bounded cache is full (novel shapes go uncaptured)
-                return key, None
+                return None
             self._aot_building.add(key)
         compiled = None
         features = None
         compile_s = None
         try:
             t0 = time.perf_counter()
-            lowered = self._jit_predict.lower(self.states, X)
+            lowered = jitted.lower(*args)
             compiled = lowered.compile()
             compile_s = time.perf_counter() - t0
             try:
@@ -371,7 +381,7 @@ class CompiledGraph:
                 self._aot[key] = compiled
                 self._aot_building.discard(key)
         OBSERVATORY.record_compile(key, features, compile_s)
-        return key, compiled
+        return compiled
 
     def predict_arrays(
         self, X, update_states=True

@@ -1,0 +1,880 @@
+"""Whole-graph fusion — one XLA program per predictor (ROADMAP item 5).
+
+Every non-trivial inference graph used to interpret node-by-node: an
+N-node MODEL/TRANSFORMER/COMBINER chain paid N eager unit dispatches, N
+pad-bucket decisions and N device->host->device round-trips per request.
+This module is the compiler pass that closes that gap, in the spirit of
+full-program compilation (arxiv 1810.09868): walk a validated
+:class:`~seldon_core_tpu.graph.spec.PredictorSpec`, decide *fusion
+eligibility* per subtree, and emit ONE jitted callable per (graph,
+input-shape bucket):
+
+  * MODEL/TRANSFORMER chains compose functionally — intermediates stay
+    in device registers/HBM, never round-tripping through host memory;
+  * COMBINER fan-outs become stacked in-program reductions XLA is free
+    to fuse;
+  * host ROUTERs lower to ``lax.switch`` with the autopilot's predicted
+    per-branch costs (arxiv 2008.01040) threaded in as **runtime
+    arguments**, so cost-aware branch demotion — previously a
+    host-ROUTER-only feature (graph/interpreter.py ``_autopilot_branch``)
+    — now runs *inside* the compiled program without retracing when the
+    learned costs move;
+  * the request tensor's device buffer is donated to the program on
+    TPU/GPU backends (``SELDON_TPU_FUSE_DONATE``), so even the program's
+    input does not survive as a second copy.
+
+Three layers consume this pass:
+
+  * **Full fusion** (``runtime/engine.py``): a fully-eligible graph
+    serves through :class:`FusedGraph` (engine mode ``fused``) — a
+    drop-in :class:`~seldon_core_tpu.graph.compiled.CompiledGraph`
+    with the cost-threaded program, per-shape AOT warmup through the
+    same compile-cache plumbing, and a per-node *phase decomposition*
+    stamped onto the fused dispatch hotrecord (utils/hotrecord.py) so
+    one record still explains where the program's time goes.
+  * **Partial fusion** (``graph/interpreter.py``): graphs with a
+    remote/rest-bound leaf, a ``quorum``/``fallback`` degradation
+    policy, or an impure unit keep those subtrees on the host
+    interpreter, while every *maximal eligible subtree* (>= 2 nodes)
+    collapses into a :class:`FusedSubtreeRuntime` — the interpreter
+    recursion stops at the fused root and pays one device dispatch for
+    the whole subtree.
+  * **Kill switch**: ``SELDON_TPU_GRAPH_FUSE=0`` disables the pass
+    entirely and restores the pre-fusion dispatch bit-for-bit (the
+    interpreter for any graph the interpreter served; the legacy
+    compiled executor elsewhere).
+
+Equivalence is pinned against the interpreter per graph shape
+(tests/test_graph_fusion.py): per-unit PRNG keys derive from the unit's
+NAME exactly as the interpreter derives them (``unit_rngs`` crc32 fold —
+the PR-8 sharding discipline), so fusion is never a numerics change.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seldon_core_tpu.messages import Meta, SeldonMessage
+from seldon_core_tpu.graph.compiled import (
+    NOT_ROUTED,
+    CompiledGraph,
+    _set_state,
+)
+from seldon_core_tpu.graph.interpreter import (
+    effective_type,
+    methods_for,
+    pythonize_tags,
+)
+from seldon_core_tpu.graph.spec import (
+    ComponentBinding,
+    GraphSpecError,
+    PredictiveUnit,
+    PredictorSpec,
+    UnitImplementation,
+    UnitMethod,
+    UnitType,
+)
+from seldon_core_tpu.graph.units import UNIT_REGISTRY, normalize_output
+
+__all__ = [
+    "fuse_enabled",
+    "FUSE_ANNOTATION",
+    "FusionPlan",
+    "plan_fusion",
+    "FusedGraph",
+    "FusedSubtreeRuntime",
+    "build_partial_fusion",
+]
+
+logger = logging.getLogger(__name__)
+
+#: predictor annotation opting one deployment out of fusion without the
+#: process-wide env kill switch (spec.annotations / metadata.annotations)
+FUSE_ANNOTATION = "seldon.io/graph-fuse"
+
+
+def fuse_enabled() -> bool:
+    """Kill switch: ``SELDON_TPU_GRAPH_FUSE=0`` disables the fusion pass
+    and restores the pre-fusion dispatch bit-for-bit (host interpreter
+    for any graph the interpreter served; the legacy compiled executor
+    for fully in-process pure graphs)."""
+    return os.environ.get("SELDON_TPU_GRAPH_FUSE", "1") != "0"
+
+
+def _donate_enabled() -> bool:
+    """Buffer donation for the request tensor: on by default where XLA
+    honours it (TPU/GPU), off on CPU (donation is a no-op there and jax
+    warns).  ``SELDON_TPU_FUSE_DONATE=1|0`` overrides either way."""
+    env = os.environ.get("SELDON_TPU_FUSE_DONATE", "")
+    if env:
+        return env != "0"
+    try:
+        return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+    except Exception:  # noqa: BLE001 - no backend: no donation
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Fusion planning
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FusionPlan:
+    """Per-node eligibility + the maximal fused subtrees of one graph.
+
+    ``reasons`` names why a node itself blocks fusion (a node with no
+    reason of its own can still sit outside every fused root because a
+    descendant blocks it).  ``fused_roots`` are the maximal subtrees the
+    pass will compile (>= 2 nodes each).  ``fused_nodes`` counts the
+    subtrees' static size; ``fused_dispatches`` counts the unit
+    dispatches the interpreter would pay PER REQUEST for those subtrees
+    (a ROUTER executes itself plus ONE branch, so only the cheapest
+    branch is guaranteed — the conservative figure), which makes
+    ``hops_eliminated`` the per-request N->1 saving, not the static
+    node count."""
+
+    n_nodes: int = 0
+    reasons: Dict[str, str] = field(default_factory=dict)
+    fused_roots: List[str] = field(default_factory=list)
+    fused_nodes: int = 0
+    fused_dispatches: int = 0
+    full: bool = False
+
+    @property
+    def hops_eliminated(self) -> int:
+        return max(self.fused_dispatches - len(self.fused_roots), 0)
+
+    def summary(self) -> Dict[str, Any]:
+        """The ``/stats`` engine-block face of the plan."""
+        return {
+            "full": self.full,
+            "nodes": self.n_nodes,
+            "fused_nodes": self.fused_nodes,
+            "fused_roots": list(self.fused_roots),
+            "hops_eliminated": self.hops_eliminated,
+            "blocked": dict(self.reasons),
+        }
+
+
+def _node_block_reason(
+    node: PredictiveUnit,
+    comp_map: Dict[str, ComponentBinding],
+    skip: frozenset,
+) -> Optional[str]:
+    """Why THIS node cannot enter a fused program (None = eligible).
+
+    Eligibility reads CLASS-level facts only (``pure`` is a class
+    attribute; plain user-object classes always get the impure
+    ``as_unit`` adapter) — units are never instantiated here, so
+    planning a graph of heavy-``__init__`` units costs nothing and the
+    one real construction stays in ``build_units``.  A unit whose
+    constructor then fails at build time falls back to the interpreter
+    (``build_partial_fusion`` catches and logs)."""
+    from seldon_core_tpu.graph.units import Unit, resolve_unit_class
+
+    if node.name in skip:
+        return "external node runtime supplied"
+    if node.quorum is not None:
+        return "quorum degradation policy is host-mode only"
+    if node.fallback is not None:
+        return "fallback degradation policy is host-mode only"
+    if node.implementation is not UnitImplementation.UNKNOWN_IMPLEMENTATION:
+        cls = UNIT_REGISTRY.get(node.implementation.value)
+        if cls is None:
+            return f"no registered unit for {node.implementation.value}"
+    else:
+        binding = comp_map.get(node.name)
+        if binding is None:
+            return "no implementation, binding, or runtime"
+        if binding.runtime != "inprocess":
+            return f"remote {binding.runtime} binding"
+        try:
+            cls = resolve_unit_class(binding.class_path)
+        except ValueError as e:
+            return f"unresolvable unit class: {e}"
+    is_unit = isinstance(cls, type) and issubclass(cls, Unit)
+    if not is_unit and not hasattr(cls, "pure"):
+        # reference-style plain user object: bound through the as_unit
+        # adapter, which is host-mode only (units.instantiate_bound_unit)
+        return (
+            f"user-object class {getattr(cls, '__name__', cls)!r} "
+            f"serves host-mode only"
+        )
+    if not getattr(cls, "pure", False):
+        return f"impure unit {getattr(cls, '__name__', cls)}"
+    return None
+
+
+def _per_request_dispatches(node: PredictiveUnit) -> int:
+    """Unit dispatches the interpreter pays for ONE request through
+    this subtree: a ROUTER runs itself + exactly one branch (the
+    cheapest branch is the guaranteed floor), everything else runs
+    itself + all children."""
+    if not node.children:
+        return 1
+    if UnitMethod.ROUTE in methods_for(node):
+        return 1 + min(
+            _per_request_dispatches(c) for c in node.children
+        )
+    return 1 + sum(_per_request_dispatches(c) for c in node.children)
+
+
+def plan_fusion(
+    predictor: PredictorSpec, skip: Optional[set] = None
+) -> FusionPlan:
+    """Walk the validated spec and mark every maximal fusible subtree.
+
+    A subtree is fusible iff the root and every descendant is an
+    in-process *pure* unit with no declared degradation policy
+    (``quorum``/``fallback`` stay on the interpreter, which is the only
+    layer that can absorb a mid-graph call failure).  ``skip`` names
+    nodes whose runtime the caller supplies externally (test harnesses,
+    pooled remote clients) — those pin their subtree to the host path.
+    The predictor annotation ``seldon.io/graph-fuse: "false"`` opts the
+    whole deployment out."""
+    plan = FusionPlan(n_nodes=sum(1 for _ in predictor.graph.walk()))
+    if str(predictor.annotations.get(FUSE_ANNOTATION, "")).lower() in (
+        "false", "0", "off",
+    ):
+        plan.reasons[predictor.graph.name] = (
+            f"predictor annotation {FUSE_ANNOTATION}=false"
+        )
+        return plan
+    comp_map = predictor.component_map()
+    skip_f = frozenset(skip or ())
+    fusible: Dict[str, bool] = {}
+
+    def visit(node: PredictiveUnit) -> bool:
+        reason = _node_block_reason(node, comp_map, skip_f)
+        if reason is not None:
+            plan.reasons[node.name] = reason
+        ok = reason is None
+        for c in node.children:
+            ok = visit(c) and ok
+        fusible[node.name] = ok
+        return ok
+
+    plan.full = visit(predictor.graph)
+
+    def collect_roots(node: PredictiveUnit) -> None:
+        n_sub = sum(1 for _ in node.walk())
+        if fusible[node.name] and n_sub >= 2:
+            plan.fused_roots.append(node.name)
+            plan.fused_nodes += n_sub
+            plan.fused_dispatches += _per_request_dispatches(node)
+            return  # maximal: don't descend into a fused subtree
+        for c in node.children:
+            collect_roots(c)
+
+    collect_roots(predictor.graph)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# The fused executor
+# ---------------------------------------------------------------------------
+
+
+class FusedGraph(CompiledGraph):
+    """A :class:`CompiledGraph` whose program threads the autopilot's
+    per-branch cost predictions and the request's demotion budget in as
+    runtime arguments:
+
+        (states, X, costs, budget) -> (Y, states', raw_routing,
+                                       routing, tags)
+
+    ``costs`` maps each router name to a float32 ``[n_children]`` vector
+    of predicted branch walls (NaN = no prediction); ``budget`` is the
+    margin-scaled remaining deadline (``+inf`` = no deadline / autopilot
+    off).  Inside the program each router's raw choice is demoted to the
+    cheapest predicted-to-fit branch exactly when the host interpreter
+    would demote it (graph/interpreter.py ``_autopilot_branch``): a raw
+    branch predicted over budget moves to the min-cost alternative
+    predicted within budget; unknown (NaN) predictions neither trigger
+    nor receive demotion.  With no deadline, no predictions, or the
+    autopilot kill switch off, the program is the identity of the plain
+    compiled program — never a numerics change.
+
+    ``raw_routing`` carries the router's own (pre-demotion) choice for
+    host-side range validation; ``routing`` carries the branch that
+    actually executed, which is what lands in ``meta.routing`` so the
+    feedback pass trains the branch that served (interpreter parity).
+    """
+
+    #: perf/autopilot executable-key program name; the engine's full
+    #: graph keeps "predict" so autopilot seed priors and observatory
+    #: rows stay continuous with the legacy compiled mode
+    def __init__(
+        self,
+        predictor: PredictorSpec,
+        rng=None,
+        mesh=None,
+        key_name: str = "predict",
+        require_plan: bool = True,
+    ):
+        if require_plan:
+            plan = plan_fusion(predictor)
+            if not plan.full:
+                blocked = "; ".join(
+                    f"{n}: {r}" for n, r in sorted(plan.reasons.items())
+                ) or "ineligible subtree"
+                raise GraphSpecError(
+                    f"graph {predictor.graph.name!r} is not fully "
+                    f"fuse-eligible ({blocked})"
+                )
+            self.plan = plan
+        else:
+            self.plan = plan_fusion(predictor)
+        super().__init__(predictor, rng=rng, mesh=mesh)
+        self._key_name = key_name
+        fused_fn = self._build_fused(predictor.graph)
+        all_routers = self._all_routers
+
+        def run(states, X, costs, budget):
+            y, states2, raw, eff, tags = fused_fn(states, X, costs, budget)
+            raw = {
+                r: raw.get(r, jnp.int32(NOT_ROUTED)) for r in all_routers
+            }
+            eff = {
+                r: eff.get(r, jnp.int32(NOT_ROUTED)) for r in all_routers
+            }
+            return y, states2, raw, eff, tags
+
+        self._donate = _donate_enabled()
+        #: buffer donation: X (argnum 1) is consumed by the program so
+        #: the request tensor never exists twice on device; states stay
+        #: undonated (they are re-read when the write-back is vetoed)
+        self._jit_fused = (
+            jax.jit(run, donate_argnums=(1,)) if self._donate
+            else jax.jit(run)
+        )
+        #: the UNJITTED program: the phase-decomposition capture pass
+        #: must re-trace the Python builder (a jitted eval_shape reuses
+        #: the cached jaxpr and the capture hooks never fire)
+        self._fused_run = run
+        #: approximate per-node share of the fused program's cost —
+        #: computed lazily at first AOT build (``_phase_weights``),
+        #: stamped onto every fused dispatch hotrecord
+        self.phases: Optional[Dict[str, float]] = None
+        self._phases_done = False
+        #: trace-time capture sink for per-node input avals (see
+        #: ``_phase_weights``); None outside the capture pass
+        self._capture: Optional[List[Tuple[str, str, tuple, Any]]] = None
+
+    # -- trace-time builder -------------------------------------------------
+
+    def _cap(self, name: str, method: str, arr) -> None:
+        if self._capture is not None:
+            self._capture.append(
+                (name, method, tuple(arr.shape), arr.dtype)
+            )
+
+    def _build_fused(self, node: PredictiveUnit):
+        from seldon_core_tpu.graph.compiled import _routers_in
+
+        unit = self.units[node.name]
+        methods = methods_for(node)
+        is_model = effective_type(node) is UnitType.MODEL
+        child_fns = [self._build_fused(c) for c in node.children]
+        name = node.name
+        static_tags = dict(unit.static_tags or {})
+        n_children = len(node.children)
+
+        def fn(states, X, costs, budget):
+            raw_routing: Dict[str, Any] = {}
+            routing: Dict[str, Any] = {}
+            tags: Dict[str, Any] = dict(static_tags)
+            y = X
+            if UnitMethod.TRANSFORM_INPUT in methods:
+                m = unit.predict if is_model else unit.transform_input
+                self._cap(name, "predict" if is_model else "transform_input", y)
+                out = m(states.get(name), y)
+                y, new_state, t = normalize_output(out, states.get(name))
+                states = _set_state(states, name, new_state)
+                tags.update(t)
+
+            if node.children:
+                if UnitMethod.ROUTE in methods:
+                    self._cap(name, "route", y)
+                    out = unit.route(states.get(name), y)
+                    branch, new_state, _ = normalize_output(
+                        out, states.get(name)
+                    )
+                    states = _set_state(states, name, new_state)
+                    raw_branch = jnp.asarray(branch, dtype=jnp.int32)
+                    clamped = jnp.clip(raw_branch, 0, n_children - 1)
+                    # in-program branch demotion: the compiled analogue
+                    # of interpreter._autopilot_branch, driven entirely
+                    # by the runtime cost/budget arguments so learned
+                    # predictions never retrigger a compile
+                    c = costs.get(name)
+                    if c is not None:
+                        pred = c[clamped]
+                        need = pred > budget  # NaN pred -> False: keep
+                        cand = jnp.where(
+                            jnp.isnan(c) | (c > budget), jnp.inf, c
+                        )
+                        cand = cand.at[clamped].set(jnp.inf)
+                        alt = jnp.argmin(cand).astype(jnp.int32)
+                        has_alt = cand[alt] < jnp.inf
+                        eff = jnp.where(need & has_alt, alt, clamped)
+                    else:
+                        eff = clamped
+                    sub_routers = sorted(
+                        {r for ch in node.children for r in _routers_in(ch)}
+                    )
+
+                    def make_branch(cf):
+                        def bf(operand):
+                            states_, x_ = operand
+                            yc, s2, r, er, t = cf(states_, x_, costs, budget)
+                            full_r = {
+                                rn: r.get(rn, jnp.int32(NOT_ROUTED))
+                                for rn in sub_routers
+                            }
+                            full_er = {
+                                rn: er.get(rn, jnp.int32(NOT_ROUTED))
+                                for rn in sub_routers
+                            }
+                            return yc, s2, full_r, full_er, t
+
+                        return bf
+
+                    try:
+                        y, states, child_r, child_er, child_tags = (
+                            jax.lax.switch(
+                                eff,
+                                [make_branch(cf) for cf in child_fns],
+                                (states, y),
+                            )
+                        )
+                    except TypeError as e:
+                        if "structure" in str(e) or "pytree" in str(e):
+                            raise GraphSpecError(
+                                f"router {name!r}: children return "
+                                f"mismatched structures (shapes/tags must "
+                                f"agree across branches for compiled "
+                                f"routing): {e}"
+                            ) from e
+                        raise GraphSpecError(
+                            f"in subgraph of {name!r}: {e}"
+                        ) from e
+                    raw_routing[name] = raw_branch
+                    routing[name] = eff
+                    raw_routing.update(child_r)
+                    routing.update(child_er)
+                    tags.update(child_tags)
+                else:
+                    ys = []
+                    for cf in child_fns:
+                        yc, states, r, er, t = cf(states, y, costs, budget)
+                        ys.append(yc)
+                        raw_routing.update(r)
+                        routing.update(er)
+                        tags.update(t)
+                    if UnitMethod.AGGREGATE in methods:
+                        stacked = jnp.stack(ys, axis=0)
+                        self._cap(name, "aggregate", stacked)
+                        out = unit.aggregate(states.get(name), stacked)
+                        y, new_state, t = normalize_output(
+                            out, states.get(name)
+                        )
+                        states = _set_state(states, name, new_state)
+                        tags.update(t)
+                    elif len(ys) == 1:
+                        y = ys[0]
+                    else:
+                        raise GraphSpecError(
+                            f"node {name!r} has {len(ys)} children but no "
+                            f"AGGREGATE method to merge them"
+                        )
+
+            if UnitMethod.TRANSFORM_OUTPUT in methods:
+                self._cap(name, "transform_output", y)
+                out = unit.transform_output(states.get(name), y)
+                y, new_state, t = normalize_output(out, states.get(name))
+                states = _set_state(states, name, new_state)
+                tags.update(t)
+            return y, states, raw_routing, routing, tags
+
+        return fn
+
+    # -- runtime cost arguments --------------------------------------------
+
+    def _cost_args(
+        self, rows: int, budget_s: Optional[float]
+    ) -> Tuple[Dict[str, Any], Any]:
+        """The per-request (costs, budget) argument pair.  Shapes are
+        static per graph — only VALUES change per request, so learned
+        predictions moving never retraces the program."""
+        from seldon_core_tpu.runtime.autopilot import (
+            autopilot_enabled,
+            branch_cost_vector,
+            shed_margin,
+        )
+
+        active = (
+            budget_s is not None
+            and budget_s > 0
+            and autopilot_enabled()
+            and bool(self._router_children)
+        )
+        costs: Dict[str, Any] = {}
+        for r, n in self._router_children.items():
+            if active:
+                vec = branch_cost_vector(r, n, rows)
+                costs[r] = jnp.asarray(
+                    [math.nan if v is None else float(v) for v in vec],
+                    dtype=jnp.float32,
+                )
+            else:
+                costs[r] = jnp.full((n,), math.nan, dtype=jnp.float32)
+        budget = jnp.float32(
+            budget_s * shed_margin() if active else math.inf
+        )
+        return costs, budget
+
+    # -- execution ----------------------------------------------------------
+
+    def executable_key(self, X) -> str:
+        from seldon_core_tpu.utils.perf import executable_key
+
+        dtype = getattr(X, "dtype", None)
+        if dtype is None:
+            dtype = np.asarray(X).dtype
+        return executable_key(self._key_name, np.shape(X), dtype)
+
+    def _ensure_executable(self, X, costs=None, budget=None):
+        """Per-shape AOT build of the FUSED program through the same
+        compile-cache/observatory plumbing the plain compiled executor
+        uses (graph/compiled.py ``_aot_build``); also computes the
+        per-node phase decomposition once, off the hot path."""
+        from seldon_core_tpu.utils.perf import OBSERVATORY
+
+        if not OBSERVATORY.enabled:
+            return "", None
+        if costs is None or budget is None:
+            costs, budget = self._cost_args(1, None)
+        key = self.executable_key(X)
+        executable = self._aot_build(
+            key, self._jit_fused, (self.states, X, costs, budget)
+        )
+        if not self._phases_done:
+            self._phases_done = True
+            self.phases = self._phase_weights(X)
+        if self.phases is not None:
+            OBSERVATORY.note_phases(key, self.phases)
+        return key, executable
+
+    def _phase_weights(self, X) -> Optional[Dict[str, float]]:
+        """Approximate per-node share of the fused program's FLOPs: one
+        capture trace records every unit method's input aval, then each
+        node's isolated program is lowered for its ``cost_analysis()``
+        FLOPs.  Degrades to a uniform split when the backend yields no
+        features; None only for single-node graphs (nothing to
+        decompose).  Runs once per graph at first AOT build — never on
+        the dispatch path."""
+        from seldon_core_tpu.utils.perf import extract_cost_features
+
+        names = list(self.units)
+        if len(names) < 2:
+            return None
+        uniform = {n: round(1.0 / len(names), 4) for n in names}
+        if len(names) > 16:
+            return uniform  # decomposition capped: lowering N programs
+        try:
+            self._capture = []
+            costs, budget = self._cost_args(1, None)
+            # a FRESH wrapper per call: jax caches traces by function
+            # identity, and both the jit lowering and any earlier pass
+            # have already traced `_fused_run` — a cached trace would
+            # skip the Python builder and the capture hooks with it
+            jax.eval_shape(
+                lambda s, x, c, b: self._fused_run(s, x, c, b),
+                self.states, jnp.asarray(X), costs, budget,
+            )
+            captured, self._capture = self._capture, None
+            flops: Dict[str, float] = {}
+            for name, method, shape, dtype in captured:
+                unit = self.units[name]
+                state = self.states.get(name)
+                m = getattr(unit, method)
+
+                def iso(s, x, _m=m):
+                    return normalize_output(_m(s, x), s)[0]
+
+                cost = (
+                    jax.jit(iso)
+                    .lower(state, jax.ShapeDtypeStruct(shape, dtype))
+                    .cost_analysis()
+                )
+                feats = extract_cost_features(cost) or {}
+                flops[name] = flops.get(name, 0.0) + feats.get("flops", 0.0)
+            total = sum(flops.values())
+            if total <= 0:
+                return uniform
+            return {
+                n: round(flops.get(n, 0.0) / total, 4) for n in names
+            }
+        except Exception:  # noqa: BLE001 - decomposition is best-effort
+            self._capture = None
+            return uniform
+
+    def predict_arrays(
+        self,
+        X,
+        update_states=True,
+        budget_s: Optional[float] = None,
+        rows: Optional[int] = None,
+    ):
+        """Run the fused program; returns ``(Y, routing, tags)`` exactly
+        like the plain compiled executor, with ``routing`` carrying the
+        branches that actually executed (post-demotion) and demotion
+        stamped into ``tags`` the way the interpreter stamps it."""
+        from seldon_core_tpu.runtime.autopilot import (
+            AUTOPILOT,
+            branch_key,
+        )
+        from seldon_core_tpu.utils.telemetry import RECORDER
+
+        X_in = X  # pre-device original: the donation-safe retry source
+        X = jnp.asarray(X)
+        if rows is None:
+            shape = np.shape(X)
+            rows = int(shape[0]) if len(shape) >= 2 else 1
+        costs, budget = self._cost_args(rows, budget_s)
+        key, executable = self._ensure_executable(X, costs, budget)
+        t0 = time.perf_counter()
+        if executable is not None:
+            try:
+                y, new_states, raw, eff, tags = executable(
+                    self.states, X, costs, budget
+                )
+            except Exception:  # noqa: BLE001 - aval drift: jit path serves
+                with self._aot_lock:
+                    self._aot[key] = None
+                # the failed donating executable may already have
+                # consumed X's device buffer — re-materialize from the
+                # caller's original (every serving path hands numpy in)
+                y, new_states, raw, eff, tags = self._jit_fused(
+                    self.states, jnp.asarray(X_in), costs, budget
+                )
+        else:
+            y, new_states, raw, eff, tags = self._jit_fused(
+                self.states, X, costs, budget
+            )
+        raw_py = {k: int(v) for k, v in raw.items() if int(v) != NOT_ROUTED}
+        for r, v in raw_py.items():
+            if v < 0 or v >= self._router_children[r]:
+                raise GraphSpecError(
+                    f"router {r!r} chose branch {v} but has "
+                    f"{self._router_children[r]} children (broadcast "
+                    f"routing is host-mode only)"
+                )
+        routing_py = {
+            k: int(v) for k, v in eff.items() if int(v) != NOT_ROUTED
+        }
+        demoted = {
+            r: b for r, b in routing_py.items() if raw_py.get(r, b) != b
+        }
+        if demoted:
+            tags = dict(tags)
+            for r, b in demoted.items():
+                # interpreter-parity bookkeeping: the decision counter,
+                # an audit-grade span event, and the reroute tag — the
+                # demotion happened on device, the record happens here
+                RECORDER.record_autopilot_decision("route")
+                from seldon_core_tpu.utils.tracing import TRACER
+
+                TRACER.event(
+                    "autopilot_reroute", node=r,
+                    from_branch=int(raw_py[r]), to_branch=int(b),
+                    in_program=True,
+                )
+                tags[f"seldon.autopilot.reroute.{r}"] = int(b)
+        if callable(update_states):
+            jax.block_until_ready(new_states)
+            do_update = update_states()
+        else:
+            do_update = update_states
+        if do_update:
+            self.states = new_states
+        # per-branch latency learning, interpreter-parity: the branch
+        # that served this request's shape bucket observes the fused
+        # wall (learning is never gated by the autopilot kill switch)
+        if routing_py:
+            wall = time.perf_counter() - t0
+            for r, b in routing_py.items():
+                AUTOPILOT.observe(branch_key(r, b, rows), wall)
+        return y, routing_py, tags
+
+    # -- SeldonMessage API --------------------------------------------------
+
+    def predict(
+        self, msg: SeldonMessage, budget_s: Optional[float] = None
+    ) -> SeldonMessage:
+        from seldon_core_tpu.messages import Status
+
+        # hand predict_arrays a HOST array: its donation-failure retry
+        # re-materializes from the caller's original, which must not be
+        # the device buffer the failed executable already consumed
+        y, routing, tags = self.predict_arrays(
+            np.atleast_2d(np.asarray(msg.array())), budget_s=budget_s
+        )
+        leaf_names = self._output_names(self.predictor.graph, routing)
+        resp = msg.with_array(y, names=leaf_names)
+        resp.meta = Meta(
+            puid=msg.meta.puid,
+            tags={**msg.meta.tags, **pythonize_tags(tags)},
+            routing={**msg.meta.routing, **routing},
+            requestPath=dict(msg.meta.requestPath),
+        )
+        resp.status = Status()
+        return resp
+
+
+# ---------------------------------------------------------------------------
+# Partial fusion: fused subtrees inside the host interpreter
+# ---------------------------------------------------------------------------
+
+
+def _subtree_spec(
+    predictor: PredictorSpec, root: PredictiveUnit
+) -> PredictorSpec:
+    """A PredictorSpec scoped to one subtree.  Unit PRNG keys derive
+    from unit NAMES (graph/interpreter.py ``unit_rngs``), so the
+    sub-spec's units initialise bit-identically to the same units inside
+    the full graph — subsetting is a pure topology change."""
+    names = {u.name for u in root.walk()}
+    return PredictorSpec(
+        name=f"{predictor.name}/{root.name}",
+        graph=root,
+        components=[c for c in predictor.components if c.name in names],
+        annotations=dict(predictor.annotations),
+    )
+
+
+class FusedSubtreeRuntime:
+    """One fused subtree executed as a single device dispatch from
+    inside the host interpreter (graph/interpreter.py stops its
+    recursion at this node).  Emits ONE fused dispatch hotrecord with
+    the per-node phase decomposition — N interpreter hops become one
+    record, not zero."""
+
+    def __init__(
+        self, predictor: PredictorSpec, root: PredictiveUnit, rng=None
+    ):
+        self.root = root
+        self.graph = FusedGraph(
+            _subtree_spec(predictor, root),
+            rng=rng,
+            key_name=f"fused:{root.name}",
+            require_plan=False,
+        )
+
+    async def run(self, msg: SeldonMessage) -> SeldonMessage:
+        from seldon_core_tpu.runtime.resilience import remaining_s
+        from seldon_core_tpu.utils.hotrecord import SPINE
+
+        # the HOST copy is what predict_arrays re-materializes from on a
+        # failed donating executable, and what the telemetry record
+        # holds: the donated device buffer is dead after dispatch, and a
+        # drainer folding a deleted jax array would silently lose every
+        # quality/trace fold on exactly the backends donation targets
+        X = np.atleast_2d(np.asarray(msg.array()))
+        rows = int(X.shape[0])
+        wants = SPINE.dispatch_wants()
+        t0 = time.perf_counter()
+        start_s = time.time()
+        try:
+            y, routing, tags = self.graph.predict_arrays(
+                X, budget_s=remaining_s(), rows=rows
+            )
+        except GraphSpecError:
+            raise
+        except (TypeError, ValueError) as e:
+            # trace-time shape/type rejection: the interpreter's eager
+            # path would surface the unit's own error inline; the fused
+            # path names the subtree so the 400 stays actionable
+            raise GraphSpecError(
+                f"fused subtree {self.root.name!r} rejected input of "
+                f"shape {tuple(X.shape)}: {e}"
+            ) from e
+        y = np.asarray(y)
+        if wants.any:
+            SPINE.record_dispatch(
+                wants,
+                executable=self.graph.executable_key(X),
+                seconds=time.perf_counter() - t0,
+                start_s=start_s,
+                rows=rows,
+                real_rows=rows,
+                method="fused",
+                quality_node=self.root.name,
+                X=X,
+                Y=y,
+                phases=self.graph.phases,
+            )
+        resp = msg.with_array(
+            y, names=self.graph._output_names(self.root, routing)
+        )
+        resp.meta = Meta(
+            puid=msg.meta.puid,
+            tags={**msg.meta.tags, **pythonize_tags(tags)},
+            routing={**msg.meta.routing, **routing},
+            requestPath=dict(msg.meta.requestPath),
+        )
+        return resp
+
+    async def feedback(self, feedback) -> None:
+        routing = (
+            feedback.response.meta.routing
+            if feedback.response is not None
+            else {}
+        )
+        X = None
+        if feedback.request is not None and feedback.request.data is not None:
+            X = feedback.request.array()
+        truth = feedback.truth_array()
+        self.graph.feedback_arrays(X, routing, feedback.reward, truth)
+
+
+def build_partial_fusion(
+    predictor: PredictorSpec,
+    skip: Optional[set] = None,
+    rng=None,
+) -> Tuple[Dict[str, FusedSubtreeRuntime], FusionPlan]:
+    """Plan + build the fused subtree runtimes for a host-mode graph.
+    Returns ``({root_name: runtime}, plan)``; an empty dict means the
+    interpreter serves every node (nothing eligible, or the kill switch
+    off — callers check :func:`fuse_enabled` themselves)."""
+    plan = plan_fusion(predictor, skip=skip)
+    fused: Dict[str, FusedSubtreeRuntime] = {}
+    for root_name in plan.fused_roots:
+        root = predictor.graph.find(root_name)
+        try:
+            fused[root_name] = FusedSubtreeRuntime(predictor, root, rng=rng)
+        except Exception:  # noqa: BLE001 - a subtree that fails to trace
+            # keeps the interpreter path; fusion is an optimization, the
+            # interpreter is the always-available fallback
+            logger.exception(
+                "partial fusion of subtree %r failed; interpreter keeps it",
+                root_name,
+            )
+            plan.reasons[root_name] = "fused build failed (see logs)"
+            plan.fused_nodes -= sum(1 for _ in root.walk())
+            plan.fused_dispatches -= _per_request_dispatches(root)
+            plan.fused_roots = [
+                r for r in plan.fused_roots if r != root_name
+            ]
+    return fused, plan

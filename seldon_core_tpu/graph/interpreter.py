@@ -302,15 +302,37 @@ class GraphExecutor:
         extra_runtimes: Optional[Dict[str, NodeRuntime]] = None,
         rng=None,
         tracer=None,
+        fuse: bool = False,
     ):
         from seldon_core_tpu.utils.tracing import TRACER
 
         self.predictor = predictor
         self.tracer = tracer if tracer is not None else TRACER
         self.runtimes: Dict[str, NodeRuntime] = {}
+        # partial fusion (graph/fuse.py): every maximal fuse-eligible
+        # subtree collapses into ONE device dispatch; the recursion in
+        # _get_output/_send_feedback stops at a fused root.  Opt-in
+        # (the engine turns it on) so a directly-constructed executor
+        # stays the pure per-node interpreter — the fallback/kill-switch
+        # semantics every fused path is pinned against.
+        self.fused: Dict[str, Any] = {}
+        self.fusion_plan = None
+        if fuse:
+            from seldon_core_tpu.graph.fuse import build_partial_fusion
+
+            self.fused, self.fusion_plan = build_partial_fusion(
+                predictor, skip=set(extra_runtimes or ()), rng=rng
+            )
+        covered = {
+            u.name
+            for frt in self.fused.values()
+            for u in frt.root.walk()
+        }
         comp_map = predictor.component_map()
         rngs = unit_rngs([u.name for u in predictor.graph.walk()], rng)
         for node in predictor.graph.walk():
+            if node.name in covered:
+                continue  # the fused subtree runtime owns this node
             if extra_runtimes and node.name in extra_runtimes:
                 self.runtimes[node.name] = extra_runtimes[node.name]
                 continue
@@ -363,6 +385,13 @@ class GraphExecutor:
             raise DeadlineExceededError(
                 f"request deadline exhausted before node {node.name!r}"
             )
+
+        frt = self.fused.get(node.name)
+        if frt is not None:
+            # fused subtree: one device dispatch replaces the recursion
+            # below for every node under this root (graph/fuse.py)
+            with self.tracer.span(msg.meta.puid, node.name, method="fused"):
+                return await frt.run(msg)
 
         methods = methods_for(node)
         rt = self.runtimes[node.name]
@@ -636,6 +665,12 @@ class GraphExecutor:
         return ack
 
     async def _send_feedback(self, node: PredictiveUnit, feedback: Feedback) -> None:
+        frt = self.fused.get(node.name)
+        if frt is not None:
+            # on-device feedback for the whole fused subtree, replaying
+            # the recorded routing exactly like the compiled executor
+            await frt.feedback(feedback)
+            return
         methods = methods_for(node)
         rt = self.runtimes[node.name]
         routing = (
@@ -664,17 +699,24 @@ class GraphExecutor:
     # -- state access (persistence / compiled-mode handoff) -----------------
 
     def states(self) -> Dict[str, Any]:
-        return {
+        out = {
             name: rt.state
             for name, rt in self.runtimes.items()
             if isinstance(rt, InProcessNodeRuntime) and rt.state is not None
         }
+        for frt in self.fused.values():
+            out.update(frt.graph.states)
+        return out
 
     def load_states(self, states: Dict[str, Any]) -> None:
         for name, st in states.items():
             rt = self.runtimes.get(name)
             if isinstance(rt, InProcessNodeRuntime):
                 rt.state = st
+        for frt in self.fused.values():
+            for name in list(frt.graph.states):
+                if name in states:
+                    frt.graph.states[name] = states[name]
 
 
 def _msg_rows(msg: SeldonMessage) -> Optional[int]:

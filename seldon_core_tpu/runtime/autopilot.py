@@ -25,9 +25,13 @@ three decision points:
     breakers and the global retry budget instead of bypassing them.
   * **Cost-aware routing**: the gateway's p2c scores blend a
     per-replica latency prediction for the *actual request shape*
-    (gateway/balancer.py), and host-mode ROUTER nodes learn per-branch
-    latency so a routed branch predicted to blow the deadline is
-    demoted to a predicted-to-fit branch (graph/interpreter.py).
+    (gateway/balancer.py), and ROUTER nodes learn per-branch latency so
+    a routed branch predicted to blow the deadline is demoted to a
+    predicted-to-fit branch — on the host path inline
+    (graph/interpreter.py) and, for fused graphs, INSIDE the compiled
+    program: the per-branch cost vector rides in as a runtime argument
+    to the one-XLA-program dispatch (graph/fuse.py), so demotion
+    composes with whole-graph compilation instead of being lost to it.
 
 The model is deliberately tiny — one robust online location/scale
 estimate per key (EWMA with Huber-clipped residuals: a single straggler
@@ -77,6 +81,7 @@ __all__ = [
     "shed_margin",
     "pad_bucket",
     "branch_key",
+    "branch_cost_vector",
     "message_rows",
     "SHED_INFO_PREFIX",
 ]
@@ -125,6 +130,21 @@ def branch_key(node: str, branch: int, rows: Optional[int]) -> str:
     the per-branch analogue of the per-executable key."""
     bucket = pad_bucket(rows) if rows else 1
     return f"branch:{node}/{int(branch)}[{bucket}]"
+
+
+def branch_cost_vector(node: str, n_children: int,
+                       rows: Optional[int]) -> "List[Optional[float]]":
+    """Predicted wall seconds for EVERY branch of one router at one
+    request-shape bucket (None = no prediction) — the shared rule behind
+    both demotion sites: the host interpreter prices branches one
+    ``predict_s`` at a time (graph/interpreter.py ``_autopilot_branch``)
+    and the fused program receives this whole vector as a runtime
+    argument (graph/fuse.py), so the two paths can never bucket or key a
+    branch differently."""
+    return [
+        AUTOPILOT.predict_s(branch_key(node, b, rows))
+        for b in range(int(n_children))
+    ]
 
 
 def message_rows(msg) -> Optional[int]:

@@ -19,6 +19,13 @@ decisions don't change under concatenation — MODEL / TRANSFORMER / COMBINER
 chains.  ROUTER graphs make one routing decision per *request* in the
 reference (engine PredictiveUnitBean.java:91), so the engine only enables
 auto-batching for router-free graphs (checked by ``graph_is_batchable``).
+
+With whole-graph fusion (graph/fuse.py) a batchable N-node graph is ONE
+XLA program, so the batcher's pad-bucket choice is made once per request
+for the whole graph — the interpreter's N per-node pad decisions (and
+the N per-node dispatches they padded for) no longer exist.  The
+autopilot flush-sizing hook (``predict_s_fn``) therefore prices the
+fused program's executable key directly; nothing here is per-node.
 """
 
 from __future__ import annotations

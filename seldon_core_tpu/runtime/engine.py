@@ -164,12 +164,42 @@ class EngineService:
         self.mode = "host"
         self.compiled: Optional[CompiledGraph] = None
         self.executor: Optional[GraphExecutor] = None
+        # whole-graph fusion (graph/fuse.py): the default dispatch path
+        # for fuse-eligible graphs — one XLA program per predictor, with
+        # in-program autopilot branch demotion.  SELDON_TPU_GRAPH_FUSE=0
+        # is the kill switch: fully-eligible graphs fall back to the
+        # legacy compiled executor, everything else to the pure
+        # interpreter — the pre-fusion dispatch, bit-for-bit.
+        from seldon_core_tpu.graph.fuse import FusedGraph, fuse_enabled
+
+        self._fuse = fuse_enabled() and not force_host
+        self.fusion_plan = None
+        multi_node = bool(self.predictor.graph.children)
         if not force_host and not extra_runtimes:
-            try:
-                self.compiled = CompiledGraph(self.predictor, rng=rng)
-                self.mode = "compiled"
-            except GraphSpecError:
-                pass
+            if self._fuse and multi_node:
+                # multi-node graphs get the fused program (single
+                # nodes have no hops to fuse — the legacy compiled
+                # executor is already one program for those)
+                try:
+                    fg = FusedGraph(self.predictor, rng=rng)
+                    self.compiled = fg
+                    self.fusion_plan = fg.plan
+                    self.mode = "fused"
+                except GraphSpecError:
+                    # not fully fuse-eligible (opt-out annotation, an
+                    # impure unit, a degradation policy): the legacy
+                    # compiled executor is still the right one-program
+                    # path whenever it applies — fall through, keeping
+                    # the plan so /stats names what blocked fusion
+                    from seldon_core_tpu.graph.fuse import plan_fusion
+
+                    self.fusion_plan = plan_fusion(self.predictor)
+            if self.compiled is None:
+                try:
+                    self.compiled = CompiledGraph(self.predictor, rng=rng)
+                    self.mode = "compiled"
+                except GraphSpecError:
+                    pass
         # resilience layer: ONE retry budget shared by every node client of
         # this predictor (retries cannot amplify an outage across the
         # fan-out) and one circuit breaker per remote node
@@ -202,8 +232,14 @@ class EngineService:
                 if br is not None and name not in self.breakers:
                     self.breakers[name] = br
             self.executor = GraphExecutor(
-                self.predictor, extra_runtimes=runtimes, rng=rng
+                self.predictor, extra_runtimes=runtimes, rng=rng,
+                # partial fusion: maximal fuse-eligible subtrees (a
+                # remote/rest-bound leaf, quorum/fallback policy, or
+                # impure unit keeps ITS subtree on the interpreter)
+                # collapse to one device dispatch each
+                fuse=self._fuse,
             )
+            self.fusion_plan = self.executor.fusion_plan
         # continuous-batching generation lane (runtime/genserver.py): a
         # single-generator graph serves through a paged-KV per-step
         # scheduler instead of per-request generate() — streams admit into
@@ -433,6 +469,16 @@ class EngineService:
                 "known_good_widths": sorted(
                     str(w) for w in self._known_good_widths
                 ),
+                # whole-graph fusion state (graph/fuse.py): whether the
+                # pass is on, and the plan (fused roots / blocked nodes /
+                # per-request dispatch hops eliminated) when one exists
+                "graph_fuse": {
+                    "enabled": self._fuse,
+                    "plan": (
+                        None if self.fusion_plan is None
+                        else self.fusion_plan.summary()
+                    ),
+                },
             },
             "batcher": None if self.batcher is None else self.batcher.snapshot(),
             # continuous-batching generation scheduler: in-flight/waiting
@@ -1024,6 +1070,10 @@ class EngineService:
                     if deadline is not None else None
                 ),
                 compile_cache=cc,
+                # fused mode: ONE record for the whole graph's dispatch,
+                # carrying the per-node phase decomposition so the span
+                # still explains where the program's time goes
+                phases=getattr(self.compiled, "phases", None),
             )
         return y, (routing, tags)
 
@@ -1465,9 +1515,20 @@ class EngineService:
                 if self.compiled is not None:
                     # device dispatch is synchronous but brief; keep the loop
                     # responsive by running it in the default executor
+                    if self.mode == "fused":
+                        # the demotion budget reads the deadline
+                        # contextvar, which does not cross the executor
+                        # thread — capture it here so in-program branch
+                        # demotion sees the caller's remaining budget
+                        budget = remaining_s()
+                        call = lambda: self.compiled.predict(  # noqa: E731
+                            msg, budget_s=budget
+                        )
+                    else:
+                        call = lambda: self.compiled.predict(msg)  # noqa: E731
                     async with self._device_lock:
                         resp = await asyncio.get_running_loop().run_in_executor(
-                            None, self.compiled.predict, msg
+                            None, call
                         )
                 else:
                     resp = await self.executor.predict(msg)

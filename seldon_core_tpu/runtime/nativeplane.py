@@ -372,6 +372,12 @@ class NativeDataPlane:
                             rows=rows, real_rows=rows, method="native",
                             quality_node=engine._quality_node,
                             X=xq, Y=y,
+                            # fused graphs: the per-node phase
+                            # decomposition rides the native lane's
+                            # record too (engine lane parity)
+                            phases=getattr(
+                                engine.compiled, "phases", None
+                            ),
                         )
                     if routing or tags:
                         # data-dependent tags slipped past the static

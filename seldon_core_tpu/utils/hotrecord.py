@@ -124,6 +124,9 @@ class HotRecord:
         "requests",       # callers coalesced into a flush
         "predicted_s",    # autopilot-predicted wall of a planned flush
         "quality_node", "batch_x", "batch_y",
+        "phases",         # fused-graph per-node phase decomposition
+                          # ({node: share}, graph/fuse.py) — one record
+                          # still explains a whole-graph dispatch
         "error",          # exception type name of a FAILED dispatch
         "span",           # prebuilt Span (HOP_SPAN only)
         "gen",            # (admitted, retired, blocks_used, blocks_total,
@@ -154,6 +157,7 @@ class HotRecord:
         self.quality_node = ""
         self.batch_x = None
         self.batch_y = None
+        self.phases = None
         self.error = None
         self.span = None
         self.gen = None
@@ -439,6 +443,7 @@ class TelemetrySpine:
         deadline_remaining_s: Optional[float] = None,
         compile_cache: Optional[str] = None,
         error: Optional[str] = None,
+        phases: Optional[Dict[str, float]] = None,
     ) -> bool:
         """THE fused dispatch-hop write: span identity + phase timing +
         executable key + batch references in one append.  The drainer
@@ -458,6 +463,7 @@ class TelemetrySpine:
         rec.deadline_remaining_s = deadline_remaining_s
         rec.compile_cache = compile_cache
         rec.error = error
+        rec.phases = phases
         if wants.trace:
             ctx = current_trace_context()
             if ctx is not None:
@@ -753,6 +759,10 @@ class TelemetrySpine:
                 t0 = pc()
                 if rec.error:
                     attrs["error"] = rec.error
+                if rec.phases:
+                    # fused whole-graph dispatch: the span carries the
+                    # per-node phase decomposition (graph/fuse.py)
+                    attrs["phases"] = dict(rec.phases)
                 if rec.compile_cache:
                     attrs["compile_cache"] = rec.compile_cache
                 if rec.deadline_remaining_s is not None:

@@ -118,13 +118,17 @@ class _ExecutableStats:
 
     __slots__ = (
         "key", "cost", "compile_s", "calls", "rows_total", "latency",
-        "ratio", "calibration", "last", "anomalies",
+        "ratio", "calibration", "last", "anomalies", "phases",
     )
 
     def __init__(self, key: str):
         self.key = key
         self.cost: Optional[Dict[str, float]] = None
         self.compile_s: Optional[float] = None
+        #: fused-graph per-node phase decomposition ({node: share of the
+        #: program's FLOPs}, graph/fuse.py) — how a one-program-per-graph
+        #: executable still itemizes on the /perf table
+        self.phases: Optional[Dict[str, float]] = None
         self.calls = 0
         self.rows_total = 0
         self.latency = Reservoir(512)
@@ -274,6 +278,17 @@ class PerfObservatory:
 
             if not _telemetry._compile_duration_listener_installed:
                 RECORDER.record_compile_seconds(compile_s)
+
+    def note_phases(self, key: str, phases: Dict[str, float]) -> None:
+        """Attach a fused graph's per-node phase decomposition to one
+        executable row (graph/fuse.py) so the /perf table itemizes a
+        one-program-per-graph dispatch per node."""
+        if not self.enabled or not phases:
+            return
+        ent = self._entry(key)
+        with self._lock:
+            if ent.key != self.OVERFLOW_KEY:
+                ent.phases = dict(phases)
 
     def observe_dispatch(
         self,
@@ -496,6 +511,8 @@ class PerfObservatory:
             ),
             "anomalies": ent.anomalies,
         }
+        if ent.phases:
+            row["phases"] = dict(ent.phases)
         cost = ent.cost
         if cost:
             row["flops"] = cost.get("flops")

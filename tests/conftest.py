@@ -64,3 +64,34 @@ def pytest_collection_modifyitems(config, items):
     config.hook.pytest_deselected(items=dropped)  # 'N deselected' summary
     print(f"[TEST_SHARD {shard}] running {len(keep)} tests, "
           f"{len(dropped)} in other shards")
+
+
+@pytest.fixture(autouse=True)
+def _reset_learned_singletons():
+    """Isolate the process-global LEARNED/STAGED singletons per test.
+
+    The autopilot's per-key latency table and the brownout ladder's
+    stage are process-global and change *decisions* (flush sizing,
+    admission sheds, branch demotion, tier sheds) — state trained by one
+    test must not steer a later one.  The concrete flake this fixes:
+    ``test_chaos.py::test_hog_tenant_cannot_starve_victim`` left the
+    AUTOPILOT trained on its throttled-engine latencies, and
+    ``test_traffic_lifecycle.py::test_shadow_mirrors_and_diffs_live_-
+    traffic`` then co-batched drained shadow mirrors differently enough
+    to flip a near-0.5 argmax and score a spurious disagreement.
+
+    The spine drains FIRST so a previous test's pending dispatch
+    records fold into the OLD table, not the freshly-reset one.  The
+    observation-only observatories (RECORDER / OBSERVATORY / QUALITY /
+    TRACER / SPINE reservoirs) are left alone: they accumulate but do
+    not decide, and tests that assert on them reset them explicitly —
+    an autouse reset there would mask what those tests pin.
+    """
+    from seldon_core_tpu.runtime.autopilot import AUTOPILOT
+    from seldon_core_tpu.runtime.brownout import BROWNOUT
+    from seldon_core_tpu.utils.hotrecord import SPINE
+
+    SPINE.drain()
+    AUTOPILOT.reset()
+    BROWNOUT.reset()
+    yield

@@ -1,0 +1,495 @@
+"""Whole-graph fusion (graph/fuse.py): the fused-equals-interpreted
+equivalence matrix, partial fusion, in-program branch demotion, and the
+SELDON_TPU_GRAPH_FUSE kill switch.
+
+Every matrix case pins the fused program BIT-IDENTICAL to the host
+interpreter (np.testing.assert_array_equal, not allclose): per-unit PRNG
+keys derive from unit names in both modes (interpreter.unit_rngs), so
+fusion must never be a numerics change.
+"""
+
+import asyncio
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.fuse import (
+    FUSE_ANNOTATION,
+    FusedGraph,
+    build_partial_fusion,
+    fuse_enabled,
+    plan_fusion,
+)
+from seldon_core_tpu.graph.interpreter import GraphExecutor
+from seldon_core_tpu.graph.spec import (
+    GraphSpecError,
+    SeldonDeploymentSpec,
+)
+from seldon_core_tpu.graph.units import Unit, UnitAux, register_unit
+from seldon_core_tpu.messages import Feedback, SeldonMessage
+from seldon_core_tpu.runtime.autopilot import AUTOPILOT, branch_key
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.resilience import deadline_scope
+
+# reuse the registered test.* units (Scale/AddTag/CountingRouter/...)
+import tests.test_graph_exec  # noqa: F401
+
+
+@register_unit("fuse.Bias")
+class BiasOutput(Unit):
+    """OUTPUT_TRANSFORMER leg of the chain matrix case."""
+
+    def __init__(self, bias: float = 1.0):
+        self.bias = bias
+
+    def transform_output(self, state, Y):
+        return Y + self.bias, UnitAux(tags={"biased": jnp.float32(self.bias)})
+
+
+def deployment(graph, components=None, annotations=None):
+    return SeldonDeploymentSpec.from_json_dict(
+        {"spec": {"name": "fuse-t", "predictors": [{
+            "name": "p", "graph": graph,
+            "components": components or [],
+            "annotations": annotations or {},
+        }]}}
+    )
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop(
+    ).run_until_complete(coro)
+
+
+def scale(name, factor):
+    return {"name": name, "runtime": "inprocess",
+            "class_path": "test.Scale",
+            "parameters": [{"name": "factor", "value": str(factor),
+                            "type": "FLOAT"}]}
+
+
+CHAIN = {
+    "name": "t1", "type": "TRANSFORMER", "children": [{
+        "name": "t2", "type": "TRANSFORMER", "children": [{
+            "name": "m", "type": "MODEL", "children": [{
+                "name": "out", "type": "OUTPUT_TRANSFORMER"}],
+        }],
+    }],
+}
+CHAIN_COMPS = [
+    {"name": "t1", "runtime": "inprocess", "class_path": "test.AddTag"},
+    {"name": "t2", "runtime": "inprocess", "class_path": "test.AddTag"},
+    scale("m", 3.0),
+    {"name": "out", "runtime": "inprocess", "class_path": "fuse.Bias",
+     "parameters": [{"name": "bias", "value": "0.5", "type": "FLOAT"}]},
+]
+
+COMBINER = {
+    "name": "comb", "implementation": "AVERAGE_COMBINER",
+    "type": "COMBINER",
+    "children": [{"name": "s1", "type": "MODEL"},
+                 {"name": "s2", "type": "MODEL"},
+                 {"name": "s3", "type": "MODEL"}],
+}
+COMBINER_COMPS = [scale("s1", 2.0), scale("s2", 4.0), scale("s3", -1.0)]
+
+ROUTER = {
+    "name": "ab", "implementation": "RANDOM_ABTEST", "type": "ROUTER",
+    "parameters": [{"name": "ratioA", "value": "0.5", "type": "FLOAT"}],
+    "children": [{"name": "s1", "type": "MODEL"},
+                 {"name": "s2", "type": "MODEL"}],
+}
+ROUTER_COMPS = [scale("s1", 1.0), scale("s2", -1.0)]
+
+
+def _host_predict(pred, x, rng=None):
+    ex = GraphExecutor(pred, rng=rng)
+    return run(ex.predict(SeldonMessage.from_array(x)))
+
+
+# ---------------------------------------------------------------------------
+# the equivalence matrix
+# ---------------------------------------------------------------------------
+
+
+# Bit-identical pinning needs inputs whose every intermediate is exactly
+# representable (integer-valued floats, power-of-two-free of rounding):
+# XLA may fuse/reassociate float ops ACROSS the former node boundaries
+# (x*3 then +0.5 becomes one FMA), which is a different ROUNDING, not a
+# different function.  Exact arithmetic makes reassociation bitwise
+# invisible, so assert_array_equal pins the dataflow itself.
+def _int_valued(rng_seed, shape, lo=-8, hi=8):
+    return np.random.default_rng(rng_seed).integers(
+        lo, hi, size=shape
+    ).astype(np.float32)
+
+
+def test_matrix_chain_fused_equals_interpreter_bit_for_bit():
+    """OUT_TRANSFORMER(MODEL(TRANSFORMER(TRANSFORMER(x)))) — a 4-node
+    chain: one fused program, bit-identical output, tags merged the
+    interpreter's way."""
+    pred = deployment(CHAIN, CHAIN_COMPS).predictor()
+    x = _int_valued(0, (4, 5))
+    fg = FusedGraph(pred)
+    y, routing, tags = fg.predict_arrays(x)
+    host = _host_predict(pred, x)
+    np.testing.assert_array_equal(np.asarray(y), host.array())
+    assert routing == {}
+    assert float(np.asarray(tags["batch_mean"])) == host.meta.tags[
+        "batch_mean"
+    ]
+
+
+def test_matrix_combiner_fused_equals_interpreter_bit_for_bit():
+    pred = deployment(COMBINER, COMBINER_COMPS).predictor()
+    x = _int_valued(1, (8, 16))
+    fg = FusedGraph(pred)
+    y, _, _ = fg.predict_arrays(x)
+    host = _host_predict(pred, x)
+    np.testing.assert_array_equal(np.asarray(y), host.array())
+
+
+def test_matrix_router_prng_keys_derive_by_name_in_both_modes():
+    """A seeded RANDOM_ABTEST routes IDENTICALLY fused and interpreted
+    for the same rng: per-unit keys fold in the unit NAME, the PR-8
+    discipline that makes fusion a pure topology change."""
+    pred = deployment(ROUTER, ROUTER_COMPS).predictor()
+    x = np.ones((1, 2), np.float32)
+    fg = FusedGraph(pred, rng=jax.random.key(11))
+    host = GraphExecutor(pred, rng=jax.random.key(11))
+    fused_seq, host_seq = [], []
+    for _ in range(16):
+        y, routing, _ = fg.predict_arrays(x)
+        fused_seq.append((routing["ab"], float(np.asarray(y)[0, 0])))
+        resp = run(host.predict(SeldonMessage.from_array(x)))
+        host_seq.append((resp.meta.routing["ab"], float(resp.array()[0, 0])))
+    assert fused_seq == host_seq
+    assert {b for b, _ in fused_seq} == {0, 1}  # both branches exercised
+
+
+def test_matrix_router_demotion_parity_inside_the_program():
+    """The autopilot demotion decision — previously host-ROUTER-only —
+    runs inside the fused program off the cost/budget runtime arguments
+    and matches the interpreter's decision, routing, and tag stamp."""
+    g = {"name": "r", "type": "ROUTER",
+         "children": [{"name": "a", "type": "MODEL"},
+                      {"name": "b", "type": "MODEL"}]}
+    comps = [
+        {"name": "r", "runtime": "inprocess",
+         "class_path": "test.CountingRouter"},
+        scale("a", 10.0), scale("b", -10.0),
+    ]
+    pred = deployment(g, comps).predictor()
+    x = np.ones((1, 2), np.float32)
+    AUTOPILOT.reset()
+    for _ in range(10):  # trusted learned estimates for both branches
+        AUTOPILOT.observe(branch_key("r", 0, 1), 5.0)    # 5 s: doomed
+        AUTOPILOT.observe(branch_key("r", 1, 1), 0.001)  # fits easily
+
+    with deadline_scope(0.5):
+        host = _host_predict(pred, x)
+    assert host.meta.routing["r"] == 1  # demoted off the router's 0
+    assert host.meta.tags["seldon.autopilot.reroute.r"] == 1
+
+    fg = FusedGraph(pred)
+    y, routing, tags = fg.predict_arrays(x, budget_s=0.5)
+    assert routing == {"r": 1}
+    assert int(tags["seldon.autopilot.reroute.r"]) == 1
+    np.testing.assert_array_equal(np.asarray(y), host.array())
+
+    # no deadline -> neither mode demotes (kill-parity of the feature)
+    y2, routing2, tags2 = fg.predict_arrays(x)
+    host2 = _host_predict(pred, x)
+    assert routing2 == {"r": 0} == dict(host2.meta.routing)
+    assert "seldon.autopilot.reroute.r" not in tags2
+    np.testing.assert_array_equal(np.asarray(y2), host2.array())
+
+
+def test_matrix_partial_fusion_with_rest_bound_leaf():
+    """A COMBINER over a fusible 2-node chain and a rest-bound leaf:
+    the chain collapses to one fused dispatch, the remote leaf stays on
+    the interpreter, and the merged answer is bit-identical to the full
+    interpreter (the remote stubbed with the same in-process unit)."""
+    from seldon_core_tpu.graph.interpreter import InProcessNodeRuntime
+    from seldon_core_tpu.graph.units import UNIT_REGISTRY
+
+    g = {"name": "comb", "implementation": "AVERAGE_COMBINER",
+         "type": "COMBINER",
+         "children": [
+             {"name": "chain", "type": "TRANSFORMER",
+              "children": [{"name": "m1", "type": "MODEL"}]},
+             {"name": "rleaf", "type": "MODEL"},
+         ]}
+    comps = [
+        {"name": "chain", "runtime": "inprocess",
+         "class_path": "test.AddTag"},
+        scale("m1", 2.0),
+        {"name": "rleaf", "runtime": "rest",
+         "host": "127.0.0.1", "port": 9},
+    ]
+    pred = deployment(g, comps).predictor()
+
+    # both executors get the same local stand-in for the remote leaf
+    def leaf_rt():
+        node = pred.graph.find("rleaf")
+        return InProcessNodeRuntime(
+            node, UNIT_REGISTRY["test.Scale"](factor=4.0)
+        )
+
+    plain = GraphExecutor(pred, extra_runtimes={"rleaf": leaf_rt()})
+    assert not plain.fused  # default stays the pure interpreter
+    fused_ex = GraphExecutor(
+        pred, extra_runtimes={"rleaf": leaf_rt()}, fuse=True
+    )
+    assert list(fused_ex.fused) == ["chain"]
+    assert fused_ex.fusion_plan.hops_eliminated == 1
+    assert "chain" not in fused_ex.runtimes  # fused runtime owns it
+    x = _int_valued(2, (3, 4))
+    a = run(plain.predict(SeldonMessage.from_array(x)))
+    b = run(fused_ex.predict(SeldonMessage.from_array(x)))
+    np.testing.assert_array_equal(a.array(), b.array())
+    assert a.meta.tags["batch_mean"] == b.meta.tags["batch_mean"]
+
+
+def test_matrix_kill_switch_restores_interpreter_bit_for_bit(monkeypatch):
+    """SELDON_TPU_GRAPH_FUSE=0: the engine serves the pre-fusion path —
+    and its answers are bit-identical to the fused engine's."""
+    monkeypatch.delenv("SELDON_TPU_GRAPH_FUSE", raising=False)
+    assert fuse_enabled()
+    spec = deployment(COMBINER, COMBINER_COMPS)
+    payload = json.dumps(
+        {"data": {"ndarray": [[1.0, 2.0]] * 3}, "meta": {"puid": "pin"}}
+    )
+    on = EngineService(spec, batching=False)
+    assert on.mode == "fused"
+    text_on, code_on = run(on.predict_json(payload))
+
+    monkeypatch.setenv("SELDON_TPU_GRAPH_FUSE", "0")
+    assert not fuse_enabled()
+    off = EngineService(spec, batching=False)
+    assert off.mode == "compiled"  # the pre-fusion executor, untouched
+    text_off, code_off = run(off.predict_json(payload))
+    assert (code_on, text_on) == (code_off, text_off)
+
+    # and a mixed graph under the kill switch runs the PURE interpreter
+    mixed = deployment(
+        {"name": "comb", "implementation": "AVERAGE_COMBINER",
+         "type": "COMBINER",
+         "children": [
+             {"name": "chain", "type": "TRANSFORMER",
+              "children": [{"name": "m1", "type": "MODEL"}]},
+             {"name": "rleaf", "type": "MODEL"},
+         ]},
+        [{"name": "chain", "runtime": "inprocess",
+          "class_path": "test.AddTag"},
+         scale("m1", 2.0),
+         {"name": "rleaf", "runtime": "rest",
+          "host": "127.0.0.1", "port": 9}],
+    )
+    e = EngineService(mixed)
+    assert e.mode == "host" and e.executor.fused == {}
+
+
+# ---------------------------------------------------------------------------
+# eligibility rules
+# ---------------------------------------------------------------------------
+
+
+def test_quorum_and_fallback_subtrees_never_fuse():
+    """Declared degradation policies are interpreter-only semantics: a
+    quorum/fallback node blocks its subtree from every fused program,
+    in the plan, in FusedGraph, and in the engine's mode choice."""
+    quorum_graph = dict(COMBINER, quorum=2)
+    pred = deployment(quorum_graph, COMBINER_COMPS).predictor()
+    plan = plan_fusion(pred)
+    assert not plan.full and plan.fused_roots == []
+    assert "quorum" in plan.reasons["comb"]
+    with pytest.raises(GraphSpecError, match="fuse-eligible"):
+        FusedGraph(pred)
+
+    fallback_graph = dict(ROUTER)
+    fallback_graph["fallback"] = 1
+    pred_fb = deployment(fallback_graph, ROUTER_COMPS).predictor()
+    plan_fb = plan_fusion(pred_fb)
+    assert not plan_fb.full and plan_fb.fused_roots == []
+    assert "fallback" in plan_fb.reasons["ab"]
+
+    # engine: a pure-but-quorum graph never fuses, but it keeps the
+    # PRE-FUSION dispatch — the legacy compiled executor, exactly what
+    # served it before this pass existed (and what SELDON_TPU_GRAPH_FUSE=0
+    # serves) — and the policy node is named in the surfaced plan
+    e = EngineService(deployment(quorum_graph, COMBINER_COMPS))
+    assert e.mode == "compiled"
+    assert not isinstance(e.compiled, FusedGraph)
+    blocked = e.stats()["engine"]["graph_fuse"]["plan"]["blocked"]
+    assert "comb" in blocked
+
+
+def test_fuse_annotation_opts_a_predictor_out():
+    spec = deployment(
+        COMBINER, COMBINER_COMPS, annotations={FUSE_ANNOTATION: "false"}
+    )
+    pred = spec.predictor()
+    plan = plan_fusion(pred)
+    assert not plan.full and plan.fused_roots == []
+    fused, _ = build_partial_fusion(pred)
+    assert fused == {}
+    # the annotation pins the deployment to the PRE-FUSION path, which
+    # for a fully in-process pure graph is the legacy compiled executor
+    # — not the node-by-node interpreter (docs/operations.md)
+    e = EngineService(spec, batching=False)
+    assert e.mode == "compiled"
+
+
+@register_unit("fuse.Impure")
+class ImpureUnit(Unit):
+    pure = False
+
+    def predict(self, state, X):
+        return X
+
+
+@register_unit("fuse.BoomInit")
+class BoomInitUnit(Unit):
+    """Plan-eligible (pure at class level) but unconstructable: the
+    build-time fallback path's test double."""
+
+    pure = True
+
+    def __init__(self):
+        raise RuntimeError("constructor boom")
+
+    def predict(self, state, X):
+        return X
+
+
+def test_failed_subtree_build_falls_back_and_unwinds_the_plan():
+    """A subtree that plans as fusible but fails to BUILD stays on the
+    interpreter — and leaves the plan's accounting consistent: no
+    phantom hops_eliminated for a subtree that never fused."""
+    g = {"name": "chain", "type": "TRANSFORMER",
+         "children": [{"name": "boom", "type": "MODEL"}]}
+    comps = [
+        {"name": "chain", "runtime": "inprocess",
+         "class_path": "test.AddTag"},
+        {"name": "boom", "runtime": "inprocess",
+         "class_path": "fuse.BoomInit"},
+    ]
+    pred = deployment(g, comps).predictor()
+    assert plan_fusion(pred).full  # eligibility is class-level only
+    fused, plan = build_partial_fusion(pred)
+    assert fused == {}
+    assert plan.fused_roots == []
+    assert plan.fused_nodes == 0
+    assert plan.fused_dispatches == 0
+    assert plan.hops_eliminated == 0
+    assert "build failed" in plan.reasons["chain"]
+
+
+def test_impure_unit_blocks_its_subtree_only():
+    g = {"name": "comb", "implementation": "AVERAGE_COMBINER",
+         "type": "COMBINER",
+         "children": [
+             {"name": "chain", "type": "TRANSFORMER",
+              "children": [{"name": "m1", "type": "MODEL"}]},
+             {"name": "imp", "type": "MODEL"},
+         ]}
+    comps = [
+        {"name": "chain", "runtime": "inprocess",
+         "class_path": "test.AddTag"},
+        scale("m1", 2.0),
+        {"name": "imp", "runtime": "inprocess",
+         "class_path": "fuse.Impure"},
+    ]
+    plan = plan_fusion(deployment(g, comps).predictor())
+    assert not plan.full
+    assert plan.fused_roots == ["chain"]
+    assert "impure" in plan.reasons["imp"]
+
+
+# ---------------------------------------------------------------------------
+# state, feedback, and observability through the fused path
+# ---------------------------------------------------------------------------
+
+
+def test_fused_subtree_feedback_trains_on_device():
+    """Feedback through a fused subtree replays meta.routing on device
+    and matches the interpreter's resulting state bit-for-bit."""
+    g = {"name": "chain", "type": "TRANSFORMER", "children": [{
+        "name": "r", "type": "ROUTER",
+        "children": [{"name": "a", "type": "MODEL"},
+                     {"name": "b", "type": "MODEL"}]}]}
+    comps = [
+        {"name": "chain", "runtime": "inprocess",
+         "class_path": "test.AddTag"},
+        {"name": "r", "runtime": "inprocess",
+         "class_path": "test.CountingRouter"},
+        scale("a", 1.0), scale("b", -1.0),
+    ]
+    pred = deployment(g, comps).predictor()
+    x = np.ones((1, 2), np.float32)
+
+    host = GraphExecutor(pred)
+    fused_ex = GraphExecutor(pred, fuse=True)
+    assert list(fused_ex.fused) == ["chain"]
+    for ex in (host, fused_ex):
+        req = SeldonMessage.from_array(x)
+        resp = run(ex.predict(req))
+        run(ex.send_feedback(
+            Feedback(request=req, response=resp, reward=7.0)
+        ))
+    np.testing.assert_array_equal(
+        np.asarray(host.states()["r"]["rewards"]),
+        np.asarray(fused_ex.states()["r"]["rewards"]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fused_ex.states()["r"]["rewards"]), [7.0, 0.0]
+    )
+
+
+def test_fused_dispatch_emits_one_hotrecord_with_phase_decomposition():
+    """ONE dispatch record per fused dispatch, carrying the per-node
+    phase decomposition — visible on the dispatch span and the /perf
+    per-executable table."""
+    from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.perf import OBSERVATORY
+    from seldon_core_tpu.utils.tracing import TRACER
+
+    spec = deployment(CHAIN, CHAIN_COMPS)
+    e = EngineService(spec)
+    assert e.mode == "fused"
+    TRACER.enable()
+    try:
+        payload = json.dumps({"data": {"ndarray": [[1.0] * 5] * 2}})
+        text, code = run(e.predict_json(payload))
+        assert code == 200
+        SPINE.drain()
+        assert e.compiled.phases is not None
+        assert set(e.compiled.phases) == {"t1", "t2", "m", "out"}
+        assert sum(e.compiled.phases.values()) == pytest.approx(1.0, abs=0.01)
+        # the /perf row for the fused executable carries the breakdown
+        rows = [
+            r for r in OBSERVATORY.document()["executables"]
+            if set(r.get("phases") or ()) == {"t1", "t2", "m", "out"}
+        ]
+        assert rows, "no /perf row carried this graph's decomposition"
+        # and the dispatch span shows it
+        spans = [
+            s for s in TRACER.recent(200)
+            if s.kind == "dispatch" and s.attrs.get("phases")
+        ]
+        assert spans, "no dispatch span carried the phase decomposition"
+    finally:
+        TRACER.disable()
+
+
+def test_fused_engine_states_roundtrip_via_persistence_surface():
+    spec = deployment(COMBINER, COMBINER_COMPS)
+    e = EngineService(spec)
+    assert e.mode == "fused"
+    st = e.states()
+    e.load_states(st)  # the persistence handoff stays symmetric
