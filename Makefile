@@ -136,6 +136,26 @@ fairness-gate:
 wire-gate:
 	JAX_PLATFORMS=cpu python bench.py --wire-gate --smoke
 
+# served-decode flight-recorder gate: drives the REAL continuous-
+# batching scheduler at saturation (bench.py --decode-gate, best-of-3)
+# and holds the bubble ledger to SELDON_TPU_DECODE_BUBBLE_MAX (default
+# 0.25) and served/kernel decode throughput to
+# SELDON_TPU_SERVED_DECODE_REL (default 0.25), with a >=95% ledger-
+# integrity floor and a host-bound escape hatch
+# (SELDON_TPU_DECODE_GATE_STRICT=1 disables it).  CPU-friendly
+# (docs/benchmarking.md "served decode MFU").
+decode-gate:
+	JAX_PLATFORMS=cpu python bench.py --decode-gate --smoke
+
+# decode flight-recorder demo: saturated genserver run that prints the
+# per-tick timeline (kind, host/device split, bubbles by cause) and the
+# bubble-ledger breakdown, checks host+device+bubble accounts for >=95%
+# of scheduler wall, and writes the /genperf document.  Artifact
+# decode_demo/genperf.json (scripts/decode_demo.py; docs/operations.md
+# "Reading the /genperf page")
+decode-demo:
+	JAX_PLATFORMS=cpu python scripts/decode_demo.py --out decode_demo
+
 # binary-wire demo: sequential bit-exact JSON-vs-binary parity through
 # gateway->relay->engine, a coalesced burst (N requests, fewer relay
 # frames), the floor/copy A/B, and the SELDON_TPU_WIRE=0 kill switch.
@@ -203,4 +223,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun

@@ -532,6 +532,35 @@ class EngineService:
             **OBSERVATORY.document(),
         }
 
+    def genperf_document(self) -> dict:
+        """The ``GET /genperf`` body: the generation-lane flight
+        recorder (utils/genperf.py — per-tick-kind latency percentiles,
+        host/device phase splits, the bubble ledger, served decode
+        MFU/HBM-BW over real rows, idle duty cycle, KV-block residency)
+        under this engine's identity, plus the live scheduler picture
+        and the adaptive-chunk state the percentiles should be read
+        against.  Served whether or not the scheduler exists — a
+        kill-switched lane answers an empty recorder, not a 500."""
+        from seldon_core_tpu.utils.genperf import GENPERF
+
+        SPINE.drain()  # pending gen_step records fold into GENPERF first
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            "scheduler": (
+                None if self.genserver is None
+                else self.genserver.snapshot()
+            ),
+            "adaptive_chunk": (
+                None if self.genserver is None
+                else self.genserver.chunk_history()
+            ),
+            **GENPERF.document(),
+        }
+
     def autopilot_document(self) -> dict:
         """The ``GET /autopilot`` body: the process-global learned
         cost-model (per-executable/pad-bucket latency table, knobs,

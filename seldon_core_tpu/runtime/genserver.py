@@ -188,7 +188,7 @@ class _Sequence:
         "sid", "request", "row", "prompt", "prompt0", "max_new", "state",
         "n_valid", "blocks", "draft_blocks", "pending", "prefill_pos",
         "emitted", "done", "key_data", "admit_order", "retire_reason",
-        "t_start",
+        "t_start", "events",
     )
     WAITING, PREFILL, RUNNING, DONE = range(4)
 
@@ -212,6 +212,11 @@ class _Sequence:
         self.admit_order = -1
         self.retire_reason = ""
         self.t_start = 0.0              # epoch at admission (span base)
+        #: lifecycle timeline (enqueue -> admit -> prefill chunks ->
+        #: decode rounds -> retire, with preemption/recompute events) —
+        #: populated ONLY for sampled traces, emitted as one
+        #: "gen_sequence" span's events at retirement
+        self.events: List[Dict[str, Any]] = []
 
 
 class _KvImport:
@@ -432,6 +437,22 @@ class GenServer:
         self.preempted_total = 0
         self.steps_total: Dict[str, int] = {}
         self.tokens_emitted_total = 0
+        self.tick_errors_total = 0
+        # flight-recorder scratch (utils/genperf.py): the bubble ledger
+        # stamps the END of every tick and classifies the gap before the
+        # NEXT one by how this one ended; the per-tick accumulators are
+        # reset at tick start and folded into one enriched HOP_GEN_STEP
+        # record by _publish.  Scheduler thread only.
+        self._last_tick_end = 0.0
+        self._bubble_cause = "idle"
+        self._pool_dry = False               # _admit broke on a dry pool
+        self._dev_s: Dict[str, float] = {}   # phase -> fenced device s
+        self._tick_rows = 0                  # padded rows dispatched
+        self._tick_real_rows = 0             # real rows dispatched
+        self._tick_dev_steps = 0             # single-token device steps
+        self._tick_kv_pos = 0                # cache positions streamed
+        self._tick_kv_blocks = 0             # blocks the tables covered
+        self._tick_kv_ages: List[tuple] = []  # (n_blocks, age_s) freed
         # this scheduler's waiting queue is an overload signal: the
         # brownout ladder reads it as queue depth.  Registered through a
         # weakref (and finalized) so the registry never pins a scheduler
@@ -556,6 +577,8 @@ class GenServer:
                             jax.random.key(self.seed), self._seq_counter)
                     ))
                 req.seqs.append(seq)
+                # caller-thread stamp: the lifecycle timeline's origin
+                self._seq_event(seq, "enqueue", prompt_len=len(p))
                 self._arrivals.append(seq)
             self._ensure_thread()
             self._wake.notify_all()
@@ -625,6 +648,7 @@ class GenServer:
             "preempted_total": self.preempted_total,
             "steps_total": dict(self.steps_total),
             "tokens_emitted_total": self.tokens_emitted_total,
+            "tick_errors_total": self.tick_errors_total,
         }
         if self.spec:
             dalloc = self._draft_allocator
@@ -643,6 +667,21 @@ class GenServer:
                 "reclaimed_total": self.imports_reclaimed_total,
             }
         return doc
+
+    def chunk_history(self) -> Dict[str, Any]:
+        """The adaptive prefill-chunk probe's state for ``GET /genperf``:
+        floor/ceiling/effective width, whether the probe latched, and
+        the per-width EMA walls the latch decision was made from."""
+        return {
+            "floor": self.prefill_chunk,
+            "max": self.prefill_chunk_max,
+            "effective": self._chunk_eff,
+            "latched": self._chunk_latched,
+            "wall_ema_s": {
+                str(c): {"ema_s": round(v[0], 6), "ticks": v[1]}
+                for c, v in sorted(self._chunk_wall.items())
+            },
+        }
 
     def stop(self) -> None:
         BROWNOUT.unregister_depth(self._brownout_key)
@@ -697,6 +736,7 @@ class GenServer:
             self._draft_pool = init_block_pool(
                 self.draft_cfg, self.num_blocks, self.block_size)
             self._draft_allocator = BlockAllocator(self.num_blocks)
+        self._register_decode_costs()
         if self.prefix_cache is not None:
             P = int(self.prefix_cache["l0"]["k"].shape[2])
             self._prefix_len = P
@@ -712,6 +752,45 @@ class GenServer:
                     cfg=self.cfg)
                 self._allocator.pin(blocks)
                 self._prefix_blocks = blocks
+
+    def _register_decode_costs(self) -> None:
+        """Analytic per-token cost features for the SERVED decode lane,
+        registered once at device init under ``gen_decode_step`` — the
+        read side is ``OBSERVATORY.cost_features`` in utils/genperf.py,
+        which prices served decode MFU / HBM-BW utilization against
+        REAL tokens.  Same arithmetic as bench.py's kernel decode arm
+        (matmul weights at serving dtype, two KV tensors per position
+        plus int8 scales), so served-vs-kernel ratios compare like with
+        like.  Never raises: accounting must not block serving."""
+        try:
+            cfg = self.cfg
+            d, L = cfg.d_model, cfg.n_layers
+            ff, v = cfg.d_ff, cfg.vocab
+            kvh = getattr(cfg, "kv_heads", 0) or cfg.n_heads
+            hd = d // cfg.n_heads
+            qkv_out = d + 2 * kvh * hd
+            per_layer = d * qkv_out + d * d + 2 * d * ff
+            wb = 1 if getattr(cfg, "quant", "none") == "int8" else 2
+            kv_int8 = getattr(cfg, "kv_quant", "none") == "int8"
+            kvb = 1 if kv_int8 else 2
+            OBSERVATORY.record_compile("gen_decode_step", {
+                # matmul FLOPs per generated token (attention's
+                # position-dependent term excluded — documented in
+                # docs/benchmarking.md's served-MFU methodology)
+                "flops": float(2 * (L * per_layer + d * v)),
+                # HBM bytes ONE device step streams regardless of batch:
+                # every matmul'd weight once, the bf16 unembed once
+                "bytes_accessed": float(wb * L * per_layer + 2 * d * v),
+                "output_bytes": 0.0,
+                # HBM bytes per CACHE POSITION a step's attention reads
+                # (k + v across layers, + f32 scales when int8 KV)
+                "kv_bytes_per_position": float(
+                    L * (2 * kvh * hd * kvb + (8 * kvh if kv_int8 else 0))
+                ),
+            }, None)
+        except Exception:  # noqa: BLE001 - accounting must not block serving
+            logger.debug("decode cost-feature registration failed",
+                         exc_info=True)
 
     def _run(self) -> None:
         while True:
@@ -736,6 +815,16 @@ class GenServer:
                 progress = self._tick()
             except Exception as e:  # noqa: BLE001 - fail loudly per request
                 logger.exception("genserver tick failed")
+                # a silently-erroring scheduler must be visible beyond
+                # process logs: count it (/stats + the
+                # seldon_tpu_gen_tick_errors_total family) and stamp an
+                # error span into any sampled trace riding this tick
+                self.tick_errors_total += 1
+                RECORDER.record_gen_tick_error()
+                from seldon_core_tpu.utils.genperf import GENPERF
+
+                GENPERF.observe_tick_error()
+                self._stamp_tick_error(e)
                 self._fail_all(e)
                 progress = True
             if not progress:
@@ -786,38 +875,96 @@ class GenServer:
     def _tick(self) -> bool:
         """One scheduler iteration: admit, one prefill chunk, one decode
         round, retire, account.  Exactly one fused telemetry record per
-        step (utils/hotrecord.py HOP_GEN_STEP).  Returns False when no
-        work could run (the loop then backs off instead of spinning)."""
+        step (utils/hotrecord.py HOP_GEN_STEP) — enriched with the
+        flight-recorder decomposition: per-phase host walls, the fenced
+        device walls the phase methods accumulated, and the inter-tick
+        bubble classified by how the PREVIOUS tick ended.  Returns False
+        when no work could run (the loop then backs off instead of
+        spinning)."""
         t0 = time.perf_counter()
+        bubble_s = (max(t0 - self._last_tick_end, 0.0)
+                    if self._last_tick_end > 0.0 else 0.0)
+        bubble_cause = self._bubble_cause
+        self._pool_dry = False
+        self._dev_s = {}
+        self._tick_rows = self._tick_real_rows = 0
+        self._tick_dev_steps = self._tick_kv_pos = self._tick_kv_blocks = 0
         self._ensure_device()
         self._drop_cancelled()
+        ta = time.perf_counter()
         admitted = self._admit()
         admitted += self._import_admit()
         handed_back = self._drain_handoff_done()
         self._reap_stale_imports()
+        phases = {"admit": time.perf_counter() - ta}
         kind = None
         tokens = 0
         if self._prefilling:
             kind = "prefill"
+            tp = time.perf_counter()
             tokens = self._prefill_tick()
+            phases["prefill"] = time.perf_counter() - tp
         # a first token can finish a sequence (eos / max_new == 1): retire
         # BEFORE the round so it neither wastes a slot nor a dispatch
+        tr = time.perf_counter()
         retired = self._retire_finished()
+        phases["retire"] = time.perf_counter() - tr
         if self._active:
             if kind is None:
                 kind = "spec" if self.spec else "decode"
             else:
                 kind = "mixed"
+            td = time.perf_counter()
             tokens += (self._spec_round() if self.spec
                        else self._decode_round())
+            phases["decode"] = time.perf_counter() - td
+        tr = time.perf_counter()
         retired += self._retire_finished()
+        phases["retire"] += time.perf_counter() - tr
+        # idle spins count explicitly: a hot-spinning scheduler must
+        # read as a bubble on /genperf, not as silence in steps_total
+        self.steps_total[kind or "idle"] = (
+            self.steps_total.get(kind or "idle", 0) + 1)
         if kind is not None:
-            self.steps_total[kind] = self.steps_total.get(kind, 0) + 1
             self.tokens_emitted_total += tokens
-        self._publish(admitted, retired, kind or "idle", tokens,
-                      time.perf_counter() - t0)
-        return (kind is not None or admitted > 0 or retired > 0
-                or handed_back > 0)
+        wall = time.perf_counter() - t0
+        ages, self._tick_kv_ages = self._tick_kv_ages, []
+        detail = {
+            "wall_s": wall,
+            "device_s": sum(self._dev_s.values()),
+            "phases": phases,
+            "device_phases": dict(self._dev_s),
+            "rows": self._tick_rows,
+            "real_rows": self._tick_real_rows,
+            "tokens": tokens,
+            "steps": self._tick_dev_steps,
+            "kv_positions": self._tick_kv_pos,
+            "kv_blocks": self._tick_kv_blocks,
+            "kv_ages": tuple(ages),
+        }
+        if bubble_s > 0.0:
+            detail["bubble_s"] = bubble_s
+            detail["bubble_cause"] = bubble_cause
+        self._publish(admitted, retired, kind or "idle", tokens, wall,
+                      detail=detail)
+        progress = (kind is not None or admitted > 0 or retired > 0
+                    or handed_back > 0)
+        # the bubble ledger: stamp this tick's end and decide what the
+        # gap before the NEXT tick will mean.  Progress means the loop
+        # re-enters immediately — the gap is scheduler host work.  A dry
+        # pool means the device idles until a retirement frees blocks;
+        # queued-but-unadmitted work is an admission stall; otherwise
+        # the device is idle because there is simply no work.
+        self._last_tick_end = time.perf_counter()
+        if progress:
+            self._bubble_cause = "host"
+        elif self._pool_dry:
+            self._bubble_cause = "pool_exhaustion"
+        elif self._waiting or self._arrivals:
+            self._bubble_cause = "admission_stall"
+        else:
+            self._bubble_cause = "idle"
+        return progress
 
     def _drop_cancelled(self) -> None:
         for coll in (self._waiting, self._prefilling, self._active):
@@ -870,6 +1017,8 @@ class GenServer:
         for coll in (self._active, self._prefilling):
             if seq in coll:
                 coll.remove(seq)
+        self._seq_event(seq, "preempt", n_valid=seq.n_valid,
+                        emitted=len(seq.emitted))
         self._release_blocks(seq)
         if seq.emitted:
             # rebuild from the ORIGINAL prompt: emitted keeps growing, so
@@ -892,6 +1041,11 @@ class GenServer:
 
     def _release_blocks(self, seq: _Sequence) -> None:
         if self._allocator is not None and seq.blocks:
+            if seq.t_start > 0.0:
+                # KV residency at release — the pool-sizing histogram
+                # (seldon_tpu_gen_kv_block_age_seconds via the spine fold)
+                self._tick_kv_ages.append(
+                    (len(seq.blocks), time.time() - seq.t_start))
             self._allocator.free(seq.blocks)
         seq.blocks = []
         if self._draft_allocator is not None and seq.draft_blocks:
@@ -942,6 +1096,7 @@ class GenServer:
                         f"{self.block_size}) cannot hold one prefill "
                         "chunk (grow SELDON_TPU_GEN_POOL_BLOCKS)"))
                     continue
+                self._pool_dry = True   # bubble ledger: pool_exhaustion
                 break  # pool dry: wait for a retirement to free blocks
             del self._waiting[idx]
             seq.blocks = self._allocator.alloc(need) or []
@@ -965,6 +1120,8 @@ class GenServer:
             seq.state = _Sequence.PREFILL
             seq.prefill_pos = 0
             seq.t_start = time.time()
+            self._seq_event(seq, "admit", blocks=len(seq.blocks),
+                            recompute=bool(seq.emitted))
             self._admit_counter += 1
             seq.admit_order = self._admit_counter
             self._prefilling.append(seq)
@@ -1066,6 +1223,12 @@ class GenServer:
         for i, seq in enumerate(batch):
             tables[i] = self._table(seq, nblk)
         OBSERVATORY.note_padding(len(batch), B)
+        self._tick_rows += B
+        self._tick_real_rows += len(batch)
+        self._tick_kv_blocks += sum(
+            self._blocks_needed(int(start[i]) + widths[i])
+            for i in range(len(batch)))
+        td = time.perf_counter()
         logits, self._pool = paged_forward_jit(
             self.params, jnp.asarray(toks), self._pool,
             jnp.asarray(tables), jnp.asarray(start), jnp.asarray(width),
@@ -1086,10 +1249,18 @@ class GenServer:
                 jnp.asarray(d_tables), jnp.asarray(d_start),
                 jnp.asarray(width), cfg=self.draft_cfg, last_only=True,
             )
+        # flight recorder: fence the dispatched step.  The greedy path
+        # host-syncs these logits a few lines down anyway — this only
+        # MOVES the sync so device wall is attributable to the phase
+        jax.block_until_ready(logits)
+        self._dev_s["prefill"] = (
+            self._dev_s.get("prefill", 0.0) + time.perf_counter() - td)
         logits_host = None
         emitted = 0
         for i, seq in enumerate(batch):
             seq.prefill_pos += widths[i]
+            self._seq_event(seq, "prefill_chunk", pos=seq.prefill_pos,
+                            width=int(widths[i]))
             seq.n_valid = int(start[i]) + widths[i]
             if seq.prefill_pos < len(seq.prompt):
                 continue
@@ -1208,6 +1379,16 @@ class GenServer:
         else:
             keys = jnp.zeros((B,), jnp.uint32)
         OBSERVATORY.note_padding(len(batch), B)
+        self._tick_rows += B
+        self._tick_real_rows += len(batch)
+        self._tick_kv_blocks += sum(
+            self._blocks_needed(s.n_valid + self.span) for s in batch)
+        # cache positions the round streams (served HBM-BW accounting):
+        # each of the span steps attends over ~n_valid + step positions
+        self._tick_kv_pos += sum(
+            self.span * (s.n_valid + self.span // 2) for s in batch)
+        self._tick_dev_steps += self.span
+        td = time.perf_counter()
         toks, self._pool, _tok, _nv, _seen, keys_out = (
             paged_decode_round_jit(
                 self.params, self._pool, jnp.asarray(tables),
@@ -1218,6 +1399,11 @@ class GenServer:
                 eos_token=self.eos_token,
             )
         )
+        # fence = the sync np.asarray was about to pay anyway, moved
+        # here so decode device wall lands in its own phase
+        jax.block_until_ready(toks)
+        self._dev_s["decode"] = (
+            self._dev_s.get("decode", 0.0) + time.perf_counter() - td)
         toks = np.asarray(toks)  # the per-round host sync
         if self.temperature > 0.0:
             kd_out = np.asarray(jax.random.key_data(keys_out))
@@ -1230,12 +1416,15 @@ class GenServer:
             s.n_valid += self.span
             s.pending = int(toks[i, -1])
             self._emit_tokens(s, [int(t) for t in toks[i, :take]])
+            self._seq_event(s, "decode_round", n_valid=s.n_valid,
+                            take=take)
             emitted += take
         return emitted
 
     def _spec_round(self) -> int:
         """One speculative draft/verify round for every RUNNING sequence
         (greedy): up to k+1 tokens per row per device program."""
+        import jax
         import jax.numpy as jnp
 
         from seldon_core_tpu.models.generate import paged_spec_round_jit
@@ -1275,6 +1464,15 @@ class GenServer:
             n_valid[i] = s.n_valid
             active[i] = True
         OBSERVATORY.note_padding(len(batch), B)
+        self._tick_rows += B
+        self._tick_real_rows += len(batch)
+        self._tick_kv_blocks += sum(
+            self._blocks_needed(s.n_valid + W) for s in batch)
+        self._tick_kv_pos += sum(
+            W * (s.n_valid + W // 2) for s in batch)
+        # k sequential draft steps + one verify pass per round
+        self._tick_dev_steps += W
+        td = time.perf_counter()
         new_toks, gained, corrected, self._pool, self._draft_pool = (
             paged_spec_round_jit(
                 self.params, self.draft_params, self._pool,
@@ -1284,6 +1482,9 @@ class GenServer:
                 self.cfg, self.draft_cfg, k=self.spec_k,
             )
         )
+        jax.block_until_ready(new_toks)
+        self._dev_s["decode"] = (
+            self._dev_s.get("decode", 0.0) + time.perf_counter() - td)
         new_toks = np.asarray(new_toks)
         gained = np.asarray(gained)
         corrected = np.asarray(corrected)
@@ -1296,6 +1497,8 @@ class GenServer:
             s.n_valid += g
             s.pending = int(corrected[i])
             self._emit_tokens(s, [int(t) for t in new_toks[i, :take]])
+            self._seq_event(s, "decode_round", n_valid=s.n_valid,
+                            take=take, gained=g)
             emitted += take
             accept_sum += (g - 1) / max(self.spec_k, 1)
             accept_rounds += 1
@@ -1354,6 +1557,7 @@ class GenServer:
             export.trace_ctx = req_ctx.child(req_ctx.puid)
             export.parent_span_id = req_ctx.span_id
             export.puid = req_ctx.puid
+        self._seq_event(seq, "handoff", n_valid=seq.n_valid)
         self._release_blocks(seq)
         seq.state = _Sequence.DONE
         self._handoff_inflight += 1
@@ -1568,6 +1772,8 @@ class GenServer:
             seq.blocks = list(imp.blocks)
             seq.state = _Sequence.RUNNING
             seq.t_start = time.time()
+            self._seq_event(seq, "admit", blocks=len(seq.blocks),
+                            imported=True)
             self._admit_counter += 1
             seq.admit_order = self._admit_counter
             self._active.append(seq)
@@ -1681,6 +1887,67 @@ class GenServer:
             role=self.role,
         )
 
+    def _seq_event(self, seq: _Sequence, name: str, **attrs: Any) -> None:
+        """Append one lifecycle event to a SAMPLED sequence's timeline.
+        Strictly a no-op for untraced requests — the per-tick hot path
+        pays one attribute read and one boolean test."""
+        ctx = getattr(seq.request, "trace_ctx", None)
+        if ctx is None or not ctx.sampled:
+            return
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        if not TRACER.enabled or len(seq.events) >= 512:
+            return
+        ev: Dict[str, Any] = {"name": name, "ts": round(time.time(), 6)}
+        if attrs:
+            ev["attrs"] = attrs
+        seq.events.append(ev)
+
+    def _emit_seq_timeline(self, seq: _Sequence, reason: str) -> None:
+        """One ``gen_sequence`` span per retired SAMPLED sequence,
+        carrying the whole lifecycle (enqueue -> admit -> prefill chunks
+        -> decode rounds -> retire, preemptions included) as span events
+        — the per-sequence leg of the causal trace tree."""
+        if not seq.events:
+            return
+        ctx = getattr(seq.request, "trace_ctx", None)
+        if ctx is None or not ctx.sampled:
+            return
+        from seldon_core_tpu.utils.tracing import TRACER, Span, new_span_id
+
+        if not TRACER.enabled:
+            return
+        start_s = seq.events[0]["ts"]
+        TRACER.add(Span(
+            puid=ctx.puid, name="gen_sequence", kind="gen_seq",
+            method=reason, start_s=start_s,
+            duration_ms=(time.time() - start_s) * 1e3,
+            attrs={"sid": seq.sid, "row": seq.row,
+                   "tokens": len(seq.emitted), "n_valid": seq.n_valid,
+                   "role": self.role},
+            trace_id=ctx.trace_id, span_id=new_span_id(),
+            parent_span_id=ctx.span_id, events=list(seq.events),
+        ))
+        seq.events = []
+
+    def _stamp_tick_error(self, exc: BaseException) -> None:
+        """Error-path visibility in traces: stamp one ``gen_tick_error``
+        span under any sampled request riding the failing tick (the
+        batch is about to be failed wholesale by ``_fail_all``)."""
+        from seldon_core_tpu.utils.tracing import TRACER
+
+        if not TRACER.enabled:
+            return
+        for s in list(self._active) + list(self._prefilling):
+            ctx = getattr(s.request, "trace_ctx", None)
+            if ctx is not None and ctx.sampled:
+                TRACER.record_span(
+                    "gen_tick_error", kind="gen_step", method="error",
+                    start_s=time.time(), duration_ms=0.0, ctx=ctx,
+                    error=repr(exc)[:200],
+                )
+                return
+
     def _retire(self, seq: _Sequence, reason: str) -> None:
         self._release_blocks(seq)
         seq.state = _Sequence.DONE
@@ -1691,6 +1958,9 @@ class GenServer:
             self._record_seq_span(seq, "decode", "decode")
         self.retired_total[reason] = self.retired_total.get(reason, 0) + 1
         RECORDER.record_gen_retired(reason)
+        self._seq_event(seq, "retire", reason=reason,
+                        emitted=len(seq.emitted))
+        self._emit_seq_timeline(seq, reason)
         self._deliver(seq.request)
 
     def _finish_error(self, seq: _Sequence, exc: BaseException) -> None:
@@ -1709,7 +1979,8 @@ class GenServer:
     # -- accounting --------------------------------------------------------
 
     def _publish(self, admitted: int, retired: int, kind: str,
-                 tokens: int, duration_s: float) -> None:
+                 tokens: int, duration_s: float,
+                 detail: Optional[Dict[str, Any]] = None) -> None:
         alloc = self._allocator
         used = alloc.used if alloc is not None else 0
         total = alloc.capacity if alloc is not None else 0
@@ -1725,8 +1996,10 @@ class GenServer:
             active=used * self.block_size,
             reserved=(total - used) * self.block_size,
         )
-        if kind != "idle":
-            RECORDER.record_gen_step(kind)
+        # idle spins included: steps_total["idle"] + the /genperf duty
+        # cycle make a hot-spinning scheduler visible (satellite of the
+        # flight-recorder PR — idle used to be invisible here)
+        RECORDER.record_gen_step(kind)
         # a traced sequence in this step tags the record so the step's
         # seldon_tpu_dispatch_seconds observation carries its trace_id as
         # an OpenMetrics exemplar — on a decode replica that is the
@@ -1746,5 +2019,5 @@ class GenServer:
             waiting=waiting, admitted=admitted, retired=retired,
             blocks_used=used, blocks_total=total, tokens=tokens,
             executable="" if kind == "idle" else f"gen_step:{kind}",
-            trace_id=trace_id,
+            trace_id=trace_id, detail=detail,
         )

@@ -20,6 +20,8 @@ Semantics match ``rest.py:make_engine_app`` route for route:
   POST /quality/reference      freeze/reset the drift reference window
   GET  /ping /ready /pause /unpause /prometheus /stats
   GET  /perf                   performance observatory (utils/perf.py)
+  GET  /genperf                generation-lane flight recorder
+                               (utils/genperf.py)
   GET  /quality                prediction-quality observatory
                                (utils/quality.py)
   GET  /overhead               telemetry overhead budget
@@ -138,6 +140,7 @@ class _EngineRoutes:
             b"/prometheus": self._prometheus,
             b"/stats": self._stats,
             b"/perf": self._perf,
+            b"/genperf": self._genperf,
             b"/quality": self._quality,
             b"/overhead": self._overhead,
             b"/autopilot": self._autopilot,
@@ -279,6 +282,15 @@ class _EngineRoutes:
         import json as _json
 
         return 200, _json.dumps(self.engine.perf_document()).encode(), _JSON
+
+    async def _genperf(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return (
+            200,
+            _json.dumps(self.engine.genperf_document()).encode(),
+            _JSON,
+        )
 
     async def _quality(self, body, ctype, query) -> Result:
         import json as _json

@@ -245,6 +245,13 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # + HBM watermarks (utils/perf.py; docs/operations.md runbook)
         return web.json_response(engine.perf_document())
 
+    async def genperf(_):
+        # generation-lane flight recorder: per-tick latency percentiles,
+        # host/device phase splits, bubble ledger, served decode MFU,
+        # KV-block residency (utils/genperf.py; docs/operations.md
+        # "reading the /genperf page" runbook)
+        return web.json_response(engine.genperf_document())
+
     async def quality(_):
         # prediction-quality observatory: per-node drift table, feedback
         # reward/accuracy, outlier bridge, SLO burn rates
@@ -403,6 +410,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/prometheus", prometheus)
     app.router.add_get("/stats", stats)
     app.router.add_get("/perf", perf)
+    app.router.add_get("/genperf", genperf)
     app.router.add_get("/quality", quality)
     app.router.add_get("/overhead", overhead)
     app.router.add_get("/autopilot", autopilot)

@@ -131,6 +131,9 @@ class HotRecord:
         "span",           # prebuilt Span (HOP_SPAN only)
         "gen",            # (admitted, retired, blocks_used, blocks_total,
                           # tokens) of one scheduler step (HOP_GEN_STEP)
+        "gen_detail",     # flight-recorder per-tick decomposition dict
+                          # (host/device/phase splits, bubble ledger,
+                          # real rows, KV accounting — utils/genperf.py)
     )
 
     def __init__(self, hop: str, flags: int):
@@ -161,6 +164,7 @@ class HotRecord:
         self.error = None
         self.span = None
         self.gen = None
+        self.gen_detail = None
 
 
 class ThreadRing:
@@ -515,6 +519,7 @@ class TelemetrySpine:
         tokens: int,
         executable: str = "",
         trace_id: str = "",
+        detail: Optional[Dict[str, Any]] = None,
     ) -> bool:
         """ONE record per continuous-batching scheduler step
         (runtime/genserver.py): the step picture — kind, in-flight/
@@ -523,7 +528,15 @@ class TelemetrySpine:
         ``gen_step`` tracer span off-path.  The scheduler sets its gauges
         directly (one set per step is batcher-precedent cheap); this
         record exists so traces and the hop accounting see the scheduler
-        the way they see every other hop."""
+        the way they see every other hop.
+
+        ``detail`` is the flight recorder's per-tick decomposition
+        (host/device phase splits, bubble ledger entry, real-vs-padded
+        rows, KV-block accounting) — folded into ``GENPERF``
+        (utils/genperf.py) and the ``seldon_tpu_gen_step_seconds`` /
+        ``gen_bubble`` / ``kv_block_age`` families off-path.  The same
+        kill-switch contract applies: with flags == 0 the record never
+        touches the ring and GENPERF sees nothing."""
         want_trace = TRACER.enabled and (
             TRACER.sample >= 1.0 or self._rng.random() < TRACER.sample
         )
@@ -542,6 +555,7 @@ class TelemetrySpine:
         rec.trace_id = trace_id
         rec.gen = (int(admitted), int(retired), int(blocks_used),
                    int(blocks_total), int(tokens))
+        rec.gen_detail = detail
         return self._append(rec)
 
     def record_quality(self, node: str, X, Y,
@@ -695,19 +709,58 @@ class TelemetrySpine:
                     trace_id=rec.trace_id or None,
                 )
                 self.fold_cost["recorder"].observe(pc() - t0)
+            detail = rec.gen_detail
+            if detail is not None and rec.flags & WANT_RECORDER:
+                # the flight recorder's per-tick decomposition: bubble
+                # ledger, phase splits, KV-block ages — aggregated in
+                # GENPERF (the /genperf surface) and mirrored into the
+                # gen_step_seconds / gen_bubble / kv_block_age families,
+                # all off-path on the drainer thread
+                t0 = pc()
+                from seldon_core_tpu.utils.genperf import GENPERF
+
+                GENPERF.observe_tick(rec.kind, detail)
+                dev_phases = detail.get("device_phases") or {}
+                for phase, secs in (detail.get("phases") or {}).items():
+                    dev = float(dev_phases.get(phase, 0.0))
+                    host = max(float(secs) - dev, 0.0)
+                    if host > 0:
+                        RECORDER.record_gen_step_seconds(
+                            rec.kind, phase, host)
+                    if dev > 0:
+                        RECORDER.record_gen_step_seconds(
+                            rec.kind, f"{phase}_device", dev)
+                bubble = float(detail.get("bubble_s", 0.0) or 0.0)
+                cause = str(detail.get("bubble_cause", "") or "")
+                if bubble > 0 and cause:
+                    RECORDER.record_gen_bubble(cause, bubble)
+                for _n_blocks, age_s in (detail.get("kv_ages") or ()):
+                    RECORDER.record_gen_kv_block_age(float(age_s))
+                self.fold_cost["recorder"].observe(pc() - t0)
             if rec.flags & WANT_TRACE:
                 t0 = pc()
                 admitted, retired, used, total, tokens = rec.gen
+                attrs = {
+                    "active": rec.rows, "waiting": rec.requests,
+                    "admitted": admitted, "retired": retired,
+                    "kv_blocks_used": used, "kv_blocks_total": total,
+                    "tokens": tokens,
+                }
+                if detail is not None:
+                    # the tick's device/bubble face on the trace too, so
+                    # a slow gen_step span decomposes without /genperf
+                    attrs["device_ms"] = round(
+                        float(detail.get("device_s", 0.0)) * 1e3, 3)
+                    if detail.get("bubble_s"):
+                        attrs["bubble_ms"] = round(
+                            float(detail["bubble_s"]) * 1e3, 3)
+                        attrs["bubble_cause"] = detail.get(
+                            "bubble_cause", "")
                 TRACER._fold(Span(
                     puid="", name="gen_step", kind="gen_step",
                     method=rec.kind, start_s=rec.start_s,
                     duration_ms=rec.duration_s * 1e3,
-                    attrs={
-                        "active": rec.rows, "waiting": rec.requests,
-                        "admitted": admitted, "retired": retired,
-                        "kv_blocks_used": used, "kv_blocks_total": total,
-                        "tokens": tokens,
-                    },
+                    attrs=attrs,
                     span_id=new_span_id(),
                 ))
                 self.fold_cost["tracer"].observe(pc() - t0)
@@ -811,6 +864,14 @@ class TelemetrySpine:
             from seldon_core_tpu.runtime.autopilot import AUTOPILOT
 
             AUTOPILOT.publish_gauges()
+        except Exception:  # noqa: BLE001 - gauges must not wedge a drain
+            pass
+        # derived generation-lane gauges (served decode MFU) ride the
+        # same throttle — computed from GENPERF's fold-side totals
+        try:
+            from seldon_core_tpu.utils.genperf import GENPERF
+
+            GENPERF.publish_gauges()
         except Exception:  # noqa: BLE001 - gauges must not wedge a drain
             pass
 

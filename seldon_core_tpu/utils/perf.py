@@ -435,6 +435,19 @@ class PerfObservatory:
             return adjusted * medians[len(medians) // 2]
         return adjusted
 
+    def cost_features(self, key: str) -> Optional[Dict[str, float]]:
+        """One executable's registered static cost features (or None) —
+        the read side of ``record_compile`` for derived-figure consumers
+        (the generation flight recorder prices served decode MFU off the
+        ``gen_decode_step`` features the scheduler registers)."""
+        if not self.enabled:
+            return None
+        ent = self._execs.get(key)
+        if ent is None or not ent.cost:
+            return None
+        with self._lock:
+            return dict(ent.cost)
+
     def note_padding(self, real_rows: int, padded_rows: int) -> None:
         """Micro-batcher padding accounting: pad rows burn FLOPs without
         serving traffic (runtime/batching.py reports each padded chunk)."""

@@ -125,6 +125,19 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_gen_admitted_total": ("counter", ()),
     "seldon_tpu_gen_retired_total": ("counter", ("reason",)),
     "seldon_tpu_gen_steps_total": ("counter", ("kind",)),
+    # generation-lane flight recorder (utils/genperf.py): per-tick
+    # host/device time by kind and phase (admit / prefill / decode /
+    # retire / host_other, with a "_device" suffix for the fenced device
+    # wall inside a phase), the bubble ledger by cause (host /
+    # admission_stall / pool_exhaustion / idle — the
+    # SeldonTPUDecodeBubbles alert's axis), served decode MFU over REAL
+    # tokens, KV-block residency at release, and scheduler tick-loop
+    # errors (a silently-erroring scheduler must be visible)
+    "seldon_tpu_gen_step_seconds": ("histogram", ("kind", "phase")),
+    "seldon_tpu_gen_bubble_seconds_total": ("counter", ("cause",)),
+    "seldon_tpu_gen_served_mfu": ("gauge", ()),
+    "seldon_tpu_gen_kv_block_age_seconds": ("histogram", ()),
+    "seldon_tpu_gen_tick_errors_total": ("counter", ()),
     # serving-mesh data plane (gateway/balancer.py): per-replica gateway-
     # side inflight and pick counts (the power-of-two-choices signal and
     # its outcome — max/mean of the inflight gauge is the imbalance the
@@ -227,6 +240,13 @@ _REWARD_BUCKETS = (0.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0,
                    2.5, 10.0)
 _OUTLIER_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                     1000.0)
+# scheduler tick phases span tens of µs (CPU host bookkeeping) to whole
+# seconds (a cold-compile prefill chunk); KV-block residency spans one
+# short generation (~100 ms) to pinned-prefix lifetimes (minutes+)
+_GEN_STEP_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+_KV_AGE_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                   10.0, 30.0, 60.0, 300.0, 1800.0)
 
 
 class Reservoir:
@@ -345,6 +365,15 @@ class FlightRecorder:
         self.gen_admitted = 0
         self.gen_retired: Dict[str, int] = {}
         self.gen_steps: Dict[str, int] = {}
+        # generation flight-recorder mirrors (utils/genperf.py feeds
+        # these off-path from the spine fold): per-kind/phase tick time,
+        # the bubble ledger by cause, KV-block residency at release,
+        # served decode MFU (throttled gauge) and tick-loop errors
+        self.gen_step_seconds: Dict[str, Reservoir] = {}   # "kind/phase"
+        self.gen_bubble_s: Dict[str, float] = {}           # cause -> s
+        self.gen_kv_block_age = Reservoir()
+        self.gen_served_mfu: Optional[float] = None
+        self.gen_tick_errors = 0
         # disaggregated serving-mesh mirrors (runtime/servingmesh.py
         # coordinator + runtime/genserver.py import path): handoff
         # outcomes, latency reservoir, streamed bytes, in-flight gauge
@@ -614,8 +643,40 @@ class FlightRecorder:
             self._p_gen_steps = Counter(
                 "seldon_tpu_gen_steps_total",
                 "Scheduler steps executed, by kind (prefill / decode / "
-                "spec / mixed)",
+                "spec / mixed / idle)",
                 ["kind"], registry=self.registry)
+            self._p_gen_step_seconds = Histogram(
+                "seldon_tpu_gen_step_seconds",
+                "Generation-tick time by kind and phase (flight "
+                "recorder): host phases admit / prefill / decode / "
+                "retire / host_other, plus fenced device wall under "
+                "the *_device phases",
+                ["kind", "phase"], registry=self.registry,
+                buckets=_GEN_STEP_BUCKETS)
+            self._p_gen_bubble = Counter(
+                "seldon_tpu_gen_bubble_seconds_total",
+                "Device-idle seconds between consecutive scheduler "
+                "ticks, by cause (host / admission_stall / "
+                "pool_exhaustion / idle) — the SeldonTPUDecodeBubbles "
+                "alert's axis",
+                ["cause"], registry=self.registry)
+            self._p_gen_served_mfu = Gauge(
+                "seldon_tpu_gen_served_mfu",
+                "Served decode MFU as a 0..1 fraction: real (unpadded) "
+                "token FLOPs over fenced decode device time against "
+                "the chip's peak — the figure the decode megastep is "
+                "judged by",
+                registry=self.registry)
+            self._p_gen_kv_block_age = Histogram(
+                "seldon_tpu_gen_kv_block_age_seconds",
+                "Residency of paged KV blocks at release (seconds from "
+                "sequence admission to block free)",
+                registry=self.registry, buckets=_KV_AGE_BUCKETS)
+            self._p_gen_tick_errors = Counter(
+                "seldon_tpu_gen_tick_errors_total",
+                "Generation scheduler tick-loop exceptions (each one "
+                "fails the whole in-flight batch — should be zero)",
+                registry=self.registry)
             self._p_kv_handoff = Counter(
                 "seldon_tpu_kv_handoff_total",
                 "Disaggregated KV-block handoffs by outcome (prefill "
@@ -868,6 +929,51 @@ class FlightRecorder:
             self.gen_steps[kind] = self.gen_steps.get(kind, 0) + n
         if self.registry is not None:
             self._p_gen_steps.labels(kind=kind).inc(n)
+
+    # -- generation flight recorder (utils/genperf.py, fed off-path) -----
+
+    def record_gen_step_seconds(self, kind: str, phase: str,
+                                seconds: float) -> None:
+        """One tick's time in one phase; host phases carry the plain
+        phase name, fenced device wall arrives as ``<phase>_device``."""
+        self._gen += 1
+        key = f"{kind}/{phase}"
+        with self._lock:
+            res = self.gen_step_seconds.get(key)
+            if res is None:
+                res = self.gen_step_seconds[key] = Reservoir()
+        res.observe(seconds)
+        if self.registry is not None:
+            self._p_gen_step_seconds.labels(
+                kind=kind, phase=phase).observe(seconds)
+
+    def record_gen_bubble(self, cause: str, seconds: float) -> None:
+        self._gen += 1
+        with self._lock:
+            self.gen_bubble_s[cause] = \
+                self.gen_bubble_s.get(cause, 0.0) + float(seconds)
+        if self.registry is not None:
+            self._p_gen_bubble.labels(cause=cause).inc(seconds)
+
+    def record_gen_kv_block_age(self, seconds: float) -> None:
+        self._gen += 1
+        self.gen_kv_block_age.observe(seconds)
+        if self.registry is not None:
+            self._p_gen_kv_block_age.observe(seconds)
+
+    def set_gen_served_mfu(self, frac: float) -> None:
+        self._gen += 1
+        with self._lock:
+            self.gen_served_mfu = float(frac)
+        if self.registry is not None:
+            self._p_gen_served_mfu.set(frac)
+
+    def record_gen_tick_error(self, n: int = 1) -> None:
+        self._gen += 1
+        with self._lock:
+            self.gen_tick_errors += int(n)
+        if self.registry is not None:
+            self._p_gen_tick_errors.inc(n)
 
     # -- disaggregated serving mesh (runtime/servingmesh.py) -------------
 
@@ -1391,6 +1497,9 @@ class FlightRecorder:
                 "admitted": self.gen_admitted,
                 "retired": dict(self.gen_retired),
                 "steps": dict(self.gen_steps),
+                "bubble_seconds": dict(self.gen_bubble_s),
+                "tick_errors": self.gen_tick_errors,
+                "served_mfu": self.gen_served_mfu,
             }
             cc = dict(self.compile_cache_events)
             latency_keys = list(self._latency)
@@ -1587,6 +1696,11 @@ class FlightRecorder:
             self.gen_admitted = 0
             self.gen_retired = {}
             self.gen_steps = {}
+            self.gen_step_seconds = {}
+            self.gen_bubble_s = {}
+            self.gen_kv_block_age = Reservoir()
+            self.gen_served_mfu = None
+            self.gen_tick_errors = 0
             self.kv_handoffs = {}
             self.kv_handoff_latency = Reservoir()
             self.kv_handoff_bytes = 0

@@ -568,18 +568,31 @@ def assemble_tree(spans: List[Span]) -> List[dict]:
     return [node(r) for r in sorted(roots, key=lambda s: s.start_s)]
 
 
+#: span kinds that ANNOTATE a window rather than represent exclusive
+#: execution: a gen_seq lifecycle timeline overlaps the very dispatch /
+#: kv_handoff legs it narrates, so letting it gate the critical path
+#: would swallow those legs (it ends last and has no children)
+_ANNOTATION_KINDS = frozenset({"gen_seq"})
+
+
 def critical_path(spans: List[Span]) -> Tuple[Optional[Span], List[Tuple[Span, float]]]:
     """(root, segments): the chain of spans that gated the root's wall
     clock, as ``(span, self_ms)`` contributions.  Walks backward from the
     root's end, descending into the latest-ending child each time — the
     standard span-tree critical path.  Segment self-times sum to the root
     duration exactly (children are clipped to their parent's window), so
-    the decomposition accounts for 100% of observed latency."""
+    the decomposition accounts for 100% of observed latency.  Annotation
+    spans (``_ANNOTATION_KINDS``) stay in the tree but never gate the
+    path."""
     roots, kids = _links(spans)
     if not roots:
         return None, []
     # prefer the request-edge span; fall back to the longest root
-    root = max(roots, key=lambda s: (s.kind == "request", s.duration_ms))
+    # (annotation spans last — an orphaned timeline must not become
+    # the root while a real execution root is present)
+    root = max(roots, key=lambda s: (
+        s.kind == "request", s.kind not in _ANNOTATION_KINDS,
+        s.duration_ms))
     segments: List[Tuple[Span, float]] = []
 
     def visit(sp: Span, cutoff: float, floor: float) -> None:
@@ -590,7 +603,10 @@ def critical_path(spans: List[Span]) -> Tuple[Optional[Span], List[Tuple[Span, f
         # and break the sums-exactly invariant
         start = max(sp.start_s, floor)
         cursor = min(sp.end_s, cutoff)
-        children = sorted(kids.get(sp.span_id, []), key=lambda c: c.end_s)
+        children = sorted(
+            (c for c in kids.get(sp.span_id, [])
+             if c.kind not in _ANNOTATION_KINDS),
+            key=lambda c: c.end_s)
         while children and cursor > start:
             c = children.pop()  # latest-ending child gates the parent
             c_end = min(c.end_s, cursor)

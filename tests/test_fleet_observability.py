@@ -39,6 +39,7 @@ from seldon_core_tpu.messages import SeldonMessage
 from seldon_core_tpu.runtime.engine import EngineService
 from seldon_core_tpu.runtime.udsrelay import OP_TRACE, serve_uds
 from seldon_core_tpu.testing.faults import FaultSpec, FaultyEngine
+from seldon_core_tpu.utils.quality import QUALITY
 from seldon_core_tpu.utils.tracing import TRACER, Span, trace_document
 
 
@@ -375,6 +376,13 @@ def test_partial_tree_marker_on_local_and_federated_paths():
 def test_fleet_surfaces_slow_replica_as_outlier():
     """The ISSUE's outlier test: a +30 ms FaultyEngine replica must
     surface as THE outlier of its set on /fleet."""
+    # earlier test files train the process-global quality observatory's
+    # drift reference for the shared iris node name; against that
+    # inherited reference the starved replica's tiny live window can
+    # score a PSI big enough to outrank the injected +30ms on the
+    # outlier ladder — this test is about the LATENCY outlier, so it
+    # starts from fresh drift state
+    QUALITY.reset()
     spec = _iris_spec()
     fast = EngineService(spec)
     slow = FaultyEngine(EngineService(spec), FaultSpec(delay_s=0.03))

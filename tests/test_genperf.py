@@ -1,0 +1,391 @@
+"""Generation-lane flight recorder (utils/genperf.py): bubble-ledger
+arithmetic on a hand-timed fake clock, the host+device+bubble ≈ wall
+accounting identity, phase-split residuals, the served-decode null
+guards, ``GET /genperf`` on both REST lanes, the per-sequence lifecycle
+timeline joining the causal trace, tick-error visibility, and the
+kill-switch contract (all observatories off => ZERO ring writes from a
+full scheduler run)."""
+
+import asyncio
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.models.transformer import LMConfig, lm_init
+from seldon_core_tpu.runtime.engine import EngineService
+from seldon_core_tpu.runtime.genserver import GenServer
+from seldon_core_tpu.utils.genperf import BUBBLE_CAUSES, GENPERF
+from seldon_core_tpu.utils.hotrecord import SPINE
+from seldon_core_tpu.utils.perf import OBSERVATORY
+from seldon_core_tpu.utils.quality import QUALITY
+from seldon_core_tpu.utils.telemetry import RECORDER, TPU_METRIC_FAMILIES
+from seldon_core_tpu.utils.tracing import (
+    TRACER,
+    TraceContext,
+    new_span_id,
+    new_trace_id,
+    trace_scope,
+)
+
+CFG = LMConfig(vocab=48, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+               dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return lm_init(jax.random.key(3), CFG)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    SPINE.drain()
+    SPINE.reset()
+    GENPERF.reset()
+    TRACER.clear()
+    yield
+    SPINE.drain()
+    SPINE.reset()
+    GENPERF.reset()
+    TRACER.clear()
+
+
+def _server(params, **kw):
+    kw.setdefault("max_new_tokens", 10)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("slots", 8)
+    kw.setdefault("span", 3)
+    kw.setdefault("prefill_chunk", 4)
+    return GenServer(params, kw.pop("cfg", CFG), **kw)
+
+
+def _settle(srv, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = srv.snapshot()
+        if not s["inflight_sequences"] and not s["waiting_sequences"]:
+            return s
+        time.sleep(0.01)
+    raise AssertionError("scheduler did not settle")
+
+
+def deployment():
+    return SeldonDeploymentSpec.from_json_dict(
+        {"spec": {"name": "genperf-dep", "predictors": [{
+            "name": "p",
+            "graph": {"name": "m", "implementation": "SIMPLE_MODEL",
+                      "type": "MODEL"},
+        }]}}
+    )
+
+
+# -- bubble-ledger arithmetic (hand-timed fake clock) ------------------------
+
+
+def test_bubble_ledger_arithmetic_fake_clock():
+    """Four hand-timed ticks, one bubble per cause: the ledger must
+    reproduce the exact per-cause sums and the exact bubble fraction —
+    no measurement noise, pure arithmetic."""
+    GENPERF.observe_tick("decode", {
+        "wall_s": 0.010, "device_s": 0.006,
+        "bubble_s": 0.002, "bubble_cause": "host"})
+    GENPERF.observe_tick("decode", {
+        "wall_s": 0.010, "device_s": 0.004,
+        "bubble_s": 0.003, "bubble_cause": "admission_stall"})
+    GENPERF.observe_tick("prefill", {
+        "wall_s": 0.020, "device_s": 0.015,
+        "bubble_s": 0.001, "bubble_cause": "pool_exhaustion"})
+    GENPERF.observe_tick("idle", {
+        "wall_s": 0.005, "bubble_s": 0.004, "bubble_cause": "idle"})
+    doc = GENPERF.document()
+    by_cause = doc["bubbles"]["by_cause_s"]
+    assert by_cause == {"host": 0.002, "admission_stall": 0.003,
+                        "pool_exhaustion": 0.001, "idle": 0.004}
+    assert doc["bubbles"]["by_cause_ticks"] == {
+        "host": 1, "admission_stall": 1, "pool_exhaustion": 1, "idle": 1}
+    assert set(by_cause) <= set(BUBBLE_CAUSES)
+    # wall 0.045, bubble 0.010 -> fraction 0.010 / 0.055
+    assert doc["bubbles"]["fraction"] == round(0.010 / 0.055, 4)
+    assert doc["ticks"] == {"decode": 2, "prefill": 1, "idle": 1}
+    # idle duty cycle: 0.005 of the 0.055 total scheduler wall
+    assert doc["idle"]["ticks"] == 1
+    assert doc["idle"]["duty_cycle"] == round(0.005 / 0.055, 4)
+
+
+def test_accounting_identity_host_device_bubble_covers_wall():
+    """host := wall - device and bubble := inter-tick gap, so the
+    ledger accounts for scheduler wall BY CONSTRUCTION — the demo
+    artifact's >= 95 % criterion checks the wiring, not luck."""
+    GENPERF.observe_tick("decode", {
+        "wall_s": 0.012, "device_s": 0.009,
+        "bubble_s": 0.001, "bubble_cause": "host"})
+    GENPERF.observe_tick("mixed", {"wall_s": 0.030, "device_s": 0.022})
+    acct = GENPERF.document()["accounting"]
+    assert acct["scheduler_wall_s"] == round(0.012 + 0.030 + 0.001, 4)
+    assert acct["host_s"] == round(0.003 + 0.008, 4)
+    assert acct["device_s"] == round(0.009 + 0.022, 4)
+    assert acct["bubble_s"] == 0.001
+    assert acct["accounted_fraction"] == 1.0
+
+
+def test_phase_split_residual_lands_in_host_other():
+    """Named phases get their fenced device time subtracted; tick wall
+    not covered by any named phase shows up as host_other, never
+    disappears."""
+    GENPERF.observe_tick("decode", {
+        "wall_s": 0.010, "device_s": 0.005,
+        "phases": {"admit": 0.002, "decode": 0.006},
+        "device_phases": {"decode": 0.005}})
+    ph = GENPERF.document()["phases"]
+    assert ph["host_s"]["decode/admit"] == 0.002
+    assert ph["host_s"]["decode/decode"] == round(0.006 - 0.005, 4)
+    assert ph["device_s"]["decode/decode"] == 0.005
+    assert ph["host_s"]["decode/host_other"] == round(0.010 - 0.008, 4)
+
+
+def test_served_decode_null_guard_without_cost_features(monkeypatch):
+    """No registered decode-step cost features (perf observatory off or
+    scheduler never initialized a device): the MFU/BW figures are None,
+    never a KeyError — but the raw token/throughput accounting stays."""
+    monkeypatch.setattr(OBSERVATORY, "enabled", False)
+    GENPERF.observe_tick("decode", {
+        "wall_s": 0.010, "device_s": 0.004, "tokens": 8, "steps": 3,
+        "real_rows": 2, "rows": 4,
+        "device_phases": {"decode": 0.004}, "phases": {"decode": 0.004}})
+    served = GENPERF.document()["served_decode"]
+    assert served["served_decode_mfu_pct"] is None
+    assert served["served_decode_hbm_bw_util_pct"] is None
+    assert served["real_tokens"] == 8
+    assert served["served_decode_tok_s_device"] == round(8 / 0.004, 1)
+
+
+def test_tick_error_counter_and_family():
+    assert "seldon_tpu_gen_tick_errors_total" in TPU_METRIC_FAMILIES
+    before = RECORDER.gen_tick_errors
+    GENPERF.observe_tick_error()
+    RECORDER.record_gen_tick_error()
+    assert GENPERF.document()["tick_errors_total"] == 1
+    assert RECORDER.gen_tick_errors == before + 1
+
+
+# -- the real scheduler feeding the recorder ---------------------------------
+
+
+def test_scheduler_run_accounts_for_wall(params):
+    """A real (CPU) scheduler run: every tick lands in the recorder via
+    the spine's off-path drainer, the accounting identity holds, and
+    KV block ages appear at retirement."""
+    srv = _server(params)
+    try:
+        reqs = [srv.submit(np.full((1, 5), i + 1.0)) for i in range(4)]
+        for r in reqs:
+            r.future.result(timeout=30)
+        _settle(srv)
+    finally:
+        srv.stop()
+    SPINE.drain()
+    doc = GENPERF.document()
+    assert sum(doc["ticks"].values()) > 0
+    assert doc["accounting"]["accounted_fraction"] >= 0.95
+    assert doc["rows"]["real_total"] > 0
+    assert doc["rows"]["real_fraction"] <= 1.0
+    assert doc["kv"]["blocks_released_total"] > 0
+    assert doc["kv"]["block_age_s"]["count"] > 0
+    # the scheduler registered analytic decode-step costs at device init
+    assert OBSERVATORY.cost_features("gen_decode_step") is not None
+    assert doc["served_decode"]["real_tokens"] > 0
+
+
+def test_idle_ticks_accounted(params):
+    """Satellite: idle spins are explicit — a tick that wakes but runs
+    no prefill/decode work (here: the cancel-drop path) lands in
+    steps_total['idle'] and /genperf carries an idle duty-cycle
+    figure instead of silence."""
+    srv = _server(params)
+    try:
+        req = srv.submit(np.full((1, 5), 3.0))
+        req.cancel()        # dropped at the next tick's _drop_cancelled
+        try:
+            req.future.result(timeout=30)
+        except Exception:
+            pass            # cancellation may resolve or fail the future
+        deadline = time.monotonic() + 10
+        while srv.snapshot()["steps_total"].get("idle", 0) == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        snap = srv.snapshot()
+    finally:
+        srv.stop()
+    SPINE.drain()
+    assert snap["steps_total"].get("idle", 0) > 0
+    doc = GENPERF.document()
+    assert doc["idle"]["ticks"] > 0
+    assert doc["idle"]["duty_cycle"] is not None
+
+
+def test_tick_error_path_visible(params, monkeypatch):
+    """Satellite: a raising tick is COUNTED (snapshot + recorder +
+    /genperf), not silently retried forever."""
+    srv = _server(params)
+    before = RECORDER.gen_tick_errors
+
+    def boom():
+        raise RuntimeError("injected tick failure")
+
+    try:
+        monkeypatch.setattr(srv, "_admit", boom)
+        req = srv.submit(np.full((1, 5), 2.0))
+        with pytest.raises(Exception):
+            req.future.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while srv.snapshot()["tick_errors_total"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        snap = srv.snapshot()
+    finally:
+        srv.stop()
+    assert snap["tick_errors_total"] >= 1
+    assert RECORDER.gen_tick_errors > before
+    assert GENPERF.document()["tick_errors_total"] >= 1
+
+
+def test_sequence_timeline_joins_causal_trace(params, monkeypatch):
+    """Per-sequence lifecycle (enqueue -> admit -> prefill chunks ->
+    decode rounds -> retire) is emitted as ONE gen_sequence span into
+    the SAME trace tree as the submitting request."""
+    monkeypatch.setattr(TRACER, "enabled", True)
+    ctx = TraceContext(trace_id=new_trace_id(), span_id=new_span_id(),
+                       sampled=True, puid="p-genperf")
+    srv = _server(params)
+    try:
+        with trace_scope(ctx):
+            req = srv.submit(np.full((1, 6), 4.0))
+        req.future.result(timeout=30)
+        _settle(srv)
+    finally:
+        srv.stop()
+    SPINE.drain()
+    spans = TRACER.by_trace(ctx.trace_id)
+    seq_spans = [s for s in spans if s.name == "gen_sequence"]
+    assert len(seq_spans) == 1, [s.name for s in spans]
+    span = seq_spans[0]
+    assert span.kind == "gen_seq"
+    assert span.parent_span_id == ctx.span_id
+    assert span.puid == "p-genperf"
+    names = [e["name"] for e in span.events]
+    assert names[0] == "enqueue"
+    assert "admit" in names
+    assert "prefill_chunk" in names
+    assert "decode_round" in names
+    assert names[-1] == "retire"
+    # events are monotonically timestamped — a timeline, not a bag
+    stamps = [e["ts"] for e in span.events]
+    assert stamps == sorted(stamps)
+
+
+def test_kill_switches_leave_zero_ring_writes(params, monkeypatch):
+    """SELDON_TPU_TELEMETRY=0 + trace/perf/quality off: a FULL scheduler
+    run performs ZERO ring writes and the recorder sees ZERO ticks —
+    the flight recorder costs nothing when turned off."""
+    monkeypatch.setattr(SPINE, "telemetry_enabled", False)
+    monkeypatch.setattr(TRACER, "enabled", False)
+    monkeypatch.setattr(OBSERVATORY, "enabled", False)
+    monkeypatch.setattr(QUALITY, "enabled", False)
+    writes = {"n": 0}
+    real_append = SPINE._append
+
+    def counting_append(rec):
+        writes["n"] += 1
+        return real_append(rec)
+
+    monkeypatch.setattr(SPINE, "_append", counting_append)
+    srv = _server(params)
+    try:
+        srv.submit(np.full((1, 5), 5.0)).future.result(timeout=30)
+        _settle(srv)
+    finally:
+        srv.stop()
+    SPINE.drain()
+    assert writes["n"] == 0
+    assert GENPERF.document()["ticks"] == {}
+
+
+def test_gen_continuous_kill_switch_keeps_genperf_empty(monkeypatch):
+    """SELDON_TPU_GEN_CONTINUOUS=0: no scheduler exists, so /genperf
+    reports scheduler: null and an empty recorder — and serving still
+    works (the static-path contract lives in test_genserver)."""
+    monkeypatch.setenv("SELDON_TPU_GEN_CONTINUOUS", "0")
+    engine = EngineService(deployment())
+    doc = engine.genperf_document()
+    assert doc["scheduler"] is None
+    assert doc["adaptive_chunk"] is None
+    assert doc["ticks"] == {}
+    assert doc["served_decode"]["served_decode_mfu_pct"] is None
+
+
+# -- the REST surfaces -------------------------------------------------------
+
+
+def test_genperf_endpoint_on_both_lanes():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from seldon_core_tpu.runtime.rest import make_engine_app
+
+    engine = EngineService(deployment())
+
+    async def run():
+        async with TestClient(TestServer(make_engine_app(engine))) as client:
+            r = await client.get("/genperf")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["engine"]["deployment"] == "genperf-dep"
+            assert "accounting" in doc and "bubbles" in doc
+            assert "served_decode" in doc
+
+    asyncio.run(run())
+
+    from seldon_core_tpu.runtime.httpfast import serve_fast
+
+    async def run_fast():
+        import aiohttp
+
+        server = await serve_fast(engine, "127.0.0.1", 0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{server.port}/genperf"
+                ) as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert "accounting" in doc and "bubbles" in doc
+        finally:
+            await server.stop()
+
+    asyncio.run(run_fast())
+
+
+def test_new_metric_families_registered():
+    for fam in (
+        "seldon_tpu_gen_step_seconds",
+        "seldon_tpu_gen_bubble_seconds_total",
+        "seldon_tpu_gen_served_mfu",
+        "seldon_tpu_gen_kv_block_age_seconds",
+        "seldon_tpu_gen_tick_errors_total",
+    ):
+        assert fam in TPU_METRIC_FAMILIES
+    RECORDER.record_gen_step_seconds("decode", "decode", 0.004)
+    RECORDER.record_gen_bubble("host", 0.002)
+    RECORDER.record_gen_kv_block_age(1.5)
+    RECORDER.set_gen_served_mfu(0.12)
+    snap = RECORDER.snapshot()["generation"]["continuous"]
+    assert snap["bubble_seconds"].get("host", 0) >= 0.002
+    assert snap["served_mfu"] == 0.12
+    if RECORDER.registry is not None:
+        text = RECORDER.exposition().decode()
+        assert "seldon_tpu_gen_bubble_seconds_total" in text
+        assert "seldon_tpu_gen_served_mfu" in text

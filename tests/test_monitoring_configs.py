@@ -128,6 +128,12 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_gen_admitted_total",
         "seldon_tpu_gen_retired_total",
         "seldon_tpu_gen_steps_total",
+        # generation flight recorder (utils/genperf.py)
+        "seldon_tpu_gen_step_seconds",
+        "seldon_tpu_gen_bubble_seconds_total",
+        "seldon_tpu_gen_served_mfu",
+        "seldon_tpu_gen_kv_block_age_seconds",
+        "seldon_tpu_gen_tick_errors_total",
         # traffic lifecycle (gateway/shadow.py + operator/rollouts.py)
         "seldon_tpu_shadow_requests_total",
         "seldon_tpu_shadow_disagreement",
