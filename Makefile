@@ -14,6 +14,9 @@ test:
 
 # deterministic fault-injection suite: combiner quorum, router fallback,
 # breaker transitions, end-to-end deadlines, pause/drain (tests/test_chaos.py)
+# + the mesh-kill lane (tests/test_mesh_kill.py): SIGKILL the coordinator
+# gateway and one engine under live unary+SSE load — zero failed unary,
+# >=99% streams complete, coordinator failover within one lease TTL
 chaos:
 	python -m pytest tests/ -q -m chaos
 

@@ -76,6 +76,8 @@ from seldon_core_tpu.utils.telemetry import RECORDER, Reservoir
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.runtime.resilience import (
     DEADLINE_HEADER,
+    IDEMPOTENT_METHODS,
+    RetryBudget,
     deadline_header_value,
     deadline_ms_header,
     maybe_deadline_scope,
@@ -331,6 +333,16 @@ class DeploymentStore:
         ]
         self._revision += 1
 
+    def weights(self, deployment_id: str) -> Dict[str, int]:
+        """The live traffic split by predictor name — the read side of
+        ``set_weights`` (a freshly elected coordinator's rollout
+        controller resumes a predecessor's rollout from this instead of
+        restarting at stage 0)."""
+        for r in self._by_key.values():
+            if r.deployment_id == deployment_id:
+                return {name: w for name, w, _ in r.engines}
+        raise KeyError(f"deployment not registered: {deployment_id!r}")
+
     def unregister(self, oauth_key: str) -> None:
         self._by_key.pop(oauth_key, None)
         self._tokens = {
@@ -432,6 +444,20 @@ class ApiGateway:
         #: optional RolloutController (operator/rollouts.py) — attach to
         #: serve its status on GET /rollouts
         self.rollouts = None
+        #: optional GatewayFederation (gateway/federation.py) — attached
+        #: by gateway_main when N replicas share a sqlite store.  Feeds
+        #: engine-lease liveness into the balancer, gates singleton
+        #: duties, and federates /fleet across peers.  None = this
+        #: replica is its own coordinator (single-gateway behavior)
+        self.federation = None
+        # hedged-recovery budget (runtime/resilience.py RetryBudget,
+        # Finagle semantics): every successful predict deposits a
+        # fraction of a token, every hedged re-dispatch withdraws one —
+        # a fleet-wide outage can't stampede 2x traffic onto survivors
+        self._hedge_budget = RetryBudget()
+        #: inflight work re-homed after a replica death, by kind —
+        #: the gateway-local mirror of seldon_tpu_failover_total
+        self.failovers: Dict[str, int] = {}
         # multi-tenant fair admission (runtime/qos.py): per-tenant token
         # buckets + weighted fair queueing over dispatch slots, LRU-
         # bounded accounting.  Inert with default knobs (no rate limit,
@@ -735,6 +761,18 @@ class ApiGateway:
                                         rows=rows)
                         else:
                             endpoint.release(batcher=True)
+                if not raised:
+                    if ok and not shed:
+                        # fund the hedge budget off real successes so a
+                        # fleet-wide outage can't stampede retries
+                        self._hedge_budget.deposit()
+                    elif not ok:
+                        # the replica failed transport-style (dead
+                        # process, lapsed lease, timeout): re-dispatch
+                        # the idempotent predict ONCE to a peer replica
+                        resp = await self._maybe_hedge(
+                            rs, endpoint, msg, rows, resp)
+                        shed = self._is_autopilot_shed(resp)
             # record which predictor served (canary observability; feedback
             # routes back to the same predictor)
             resp.meta.requestPath.setdefault("predictor", predictor_name)
@@ -768,6 +806,68 @@ class ApiGateway:
             self.firehose.publish(reg.deployment_id, msg, resp,
                                   tenant=tenant, tier=tier)
         return resp
+
+    async def _maybe_hedge(self, rs: ReplicaSet, failed: ReplicaEndpoint,
+                           msg: SeldonMessage, rows: Optional[int],
+                           resp: SeldonMessage) -> SeldonMessage:
+        """Hedged recovery after a transport-shaped replica failure: one
+        re-dispatch of the (idempotent) predict to a peer replica.
+
+        Guard rails, in order: the federation kill switch (the hedge is
+        part of the mesh-recovery layer — ``SELDON_TPU_FEDERATION=0``
+        restores fail-to-caller bit-for-bit), idempotency (predict is in
+        the resilience layer's IDEMPOTENT_METHODS — the dead engine may
+        have half-executed it), a live peer to hedge to, remaining
+        deadline, and the Finagle-style retry budget (funded by
+        successes, so a fleet-wide outage degrades to the original
+        failure instead of doubling traffic on survivors).  Returns the
+        peer's response when it is not itself a replica fault, else the
+        original failure."""
+        from seldon_core_tpu.gateway.federation import federation_enabled
+
+        if (
+            not federation_enabled()
+            or not replicas_enabled()
+            or "predict" not in IDEMPOTENT_METHODS
+            or len(rs) < 2
+        ):
+            return resp
+        rem = remaining_s()
+        if rem is not None and rem <= 0.05:
+            return resp
+        if not self._hedge_budget.withdraw():
+            RECORDER.record_retry_budget_exhausted()
+            return resp
+        endpoint, decision = rs.pick(
+            lambda ep, _f=failed: _not_decode(ep) and ep is not _f,
+            rows=rows,
+        )
+        if endpoint is failed:
+            # pick() falls back to the full pool when the filter empties
+            # it — no live peer, nothing to hedge to
+            return resp
+        endpoint.begin()
+        t0 = time.perf_counter()
+        ok = False
+        raised = True
+        shed = False
+        try:
+            resp2 = await self._dispatch_predict(endpoint, msg)
+            shed = self._is_autopilot_shed(resp2)
+            ok = not self._replica_fault(resp2)
+            raised = False
+        finally:
+            if raised or shed:
+                endpoint.release(batcher=True)
+            else:
+                rs.complete(endpoint, decision,
+                            time.perf_counter() - t0, ok=ok, rows=rows)
+        if not ok:
+            return resp  # peer no better: surface the ORIGINAL failure
+        if not shed:
+            self.failovers["unary"] = self.failovers.get("unary", 0) + 1
+            RECORDER.record_failover("unary")
+        return resp2
 
     @staticmethod
     def _note_tenant_slo(tenant: str, latency_s: float,
@@ -1221,7 +1321,16 @@ class ApiGateway:
             try:
                 for client in self._prune_stale_sets():
                     await client.close()
+                # engine-lease liveness rides the same tick: a lapsed
+                # lease marks a replica dead within one TTL instead of
+                # waiting out three failed scrapes (gateway/federation.py)
+                leases = (
+                    self.federation.engine_leases()
+                    if self.federation is not None else None
+                )
                 for _fp, rs in list(self._replica_sets.values()):
+                    if leases is not None:
+                        rs.apply_leases(leases)
                     if len(rs) > 1:
                         await rs.scrape_once(self._get_session())
                 # fleet outlier gauges refresh off the docs the pass
@@ -1342,6 +1451,16 @@ class ApiGateway:
             "rollouts": (
                 None if self.rollouts is None else self.rollouts.snapshot()
             ),
+            # coordinator election + re-homed-work accounting: which
+            # replica owns singleton duties, the fencing token, live
+            # peers, and how much inflight work this replica recovered
+            "federation": {
+                **(
+                    {} if self.federation is None
+                    else self.federation.snapshot()
+                ),
+                "failovers": dict(self.failovers),
+            },
             "feedback": {
                 "count": self.feedback_count,
                 "mean_reward": round(
@@ -1374,6 +1493,10 @@ class ApiGateway:
     async def close(self) -> None:
         BROWNOUT.unregister_depth(self._brownout_key)
         _release_brownout_sink(self._brownout_sink)
+        if self.federation is not None:
+            # hand the coordinator lease over NOW — the surviving
+            # replicas must not wait out the TTL on a graceful exit
+            self.federation.resign()
         self.shadow.cancel_all()
         if self._scrape_task is not None:
             self._scrape_task.cancel()
@@ -1691,38 +1814,196 @@ def make_gateway_app(gateway: ApiGateway):
                     await agen.aclose()
                 await resp.write_eof()
                 return resp
-            # remote engine: stream the upstream SSE bytes unchanged
+            # remote engine: proxy the upstream SSE stream.  With
+            # federation on, the gateway parses the events it forwards —
+            # accumulating each row's emitted tokens — so a mid-stream
+            # engine death RE-HOMES the stream to a peer replica: the
+            # peer re-prefills prompt + emitted-so-far (the genserver's
+            # preempt/recompute contract) and decoding resumes where it
+            # broke instead of 502ing the client
             import aiohttp
 
-            try:
-                async with gateway._get_session().post(
-                    str(engine) + "/api/v0.1/generate/stream",
-                    data=payload,
-                    # tenant/tier ride upstream so the remote engine's
-                    # genserver schedules the stream on the right lane
-                    headers={TENANT_HEADER: tenant, TIER_HEADER: tier},
-                    timeout=aiohttp.ClientTimeout(
-                        total=None, sock_connect=20
-                    ),
-                ) as upstream:
-                    if upstream.status != 200:
+            from seldon_core_tpu.gateway.federation import (
+                federation_enabled as _fed_on,
+            )
+
+            prompt = None
+            doc0 = None
+            max_new0 = None
+            if _fed_on():
+                try:
+                    doc0 = _json.loads(payload)
+                    arr = SeldonMessage.from_json(payload).data.array
+                    prompt = np.asarray(arr, dtype=np.float64)
+                    if prompt.ndim < 2:
+                        prompt = prompt.reshape(1, -1)
+                    if isinstance(doc0, dict) and \
+                            doc0.get("max_new") is not None:
+                        max_new0 = int(doc0["max_new"])
+                except Exception:
+                    # unparseable payload: no resume — plain proxy below
+                    prompt = None
+            if prompt is None:
+                # resume unavailable (kill switch / non-tensor payload):
+                # the pre-federation raw byte proxy, bit-for-bit
+                try:
+                    async with gateway._get_session().post(
+                        str(engine) + "/api/v0.1/generate/stream",
+                        data=payload,
+                        # tenant/tier ride upstream so the remote
+                        # engine's genserver schedules the stream on the
+                        # right lane
+                        headers={TENANT_HEADER: tenant, TIER_HEADER: tier},
+                        timeout=aiohttp.ClientTimeout(
+                            total=None, sock_connect=20
+                        ),
+                    ) as upstream:
+                        if upstream.status != 200:
+                            return _error_response(
+                                await upstream.text(), code=upstream.status
+                            )
+                        await resp.prepare(request)
+                        async for chunk_bytes in upstream.content.iter_any():
+                            await resp.write(chunk_bytes)
+                except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                    if not resp.prepared:
                         return _error_response(
-                            await upstream.text(), code=upstream.status
+                            f"engine unreachable: {e}", code=503
                         )
-                    await resp.prepare(request)
-                    async for chunk_bytes in upstream.content.iter_any():
-                        await resp.write(chunk_bytes)
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-                if not resp.prepared:
-                    return _error_response(
-                        f"engine unreachable: {e}", code=503
+                    # upstream broke mid-stream: emit a terminal error
+                    # event — the SSE contract's in-band failure channel
+                    await resp.write(
+                        b'data: {"done": true, "error": %s}\n\n'
+                        % _json.dumps(str(e)).encode()
                     )
-                # upstream broke mid-stream: emit a terminal error event —
-                # the SSE contract's in-band failure channel
-                await resp.write(
-                    b'data: {"done": true, "error": %s}\n\n'
-                    % _json.dumps(str(e)).encode()
+                await resp.write_eof()
+                return resp
+
+            emitted: list = []  # [B, <=chunk] arrays, in emit order
+            done_forwarded = False
+
+            def _note_event(event: bytes) -> None:
+                """Account one SSE event about to be forwarded: stash
+                its token columns for a possible re-prefill, notice the
+                terminal frame.  Unparseable events forward untouched."""
+                nonlocal done_forwarded
+                _, _, body = event.partition(b"data:")
+                try:
+                    obj = _json.loads(body)
+                except ValueError:
+                    return
+                if not isinstance(obj, dict):
+                    return
+                if obj.get("done"):
+                    done_forwarded = True
+                    return
+                toks = obj.get("tokens")
+                if toks:
+                    emitted.append(np.asarray(toks, dtype=np.float64))
+
+            def _resume_payload() -> str:
+                """The re-prefill request: prompt + every token already
+                forwarded becomes the new prompt, and the token budget
+                shrinks by what was served — the peer continues the
+                SAME generation, it doesn't start a fresh one."""
+                doc = dict(doc0) if isinstance(doc0, dict) else {}
+                new_prompt = (
+                    np.concatenate([prompt] + emitted, axis=1)
+                    if emitted else prompt
                 )
+                doc["data"] = {"ndarray": new_prompt.tolist()}
+                if max_new0 is not None:
+                    served = sum(a.shape[1] for a in emitted)
+                    doc["max_new"] = max(max_new0 - served, 1)
+                return _json.dumps(doc)
+
+            def _stream_peer(exclude):
+                """Lowest-score remote streamable peer outside
+                ``exclude`` — the re-home target (None = give up)."""
+                capable = [
+                    ep for ep in rs.endpoints
+                    if ep not in exclude and ep.base_url is not None
+                    and _streamable(ep) and _not_decode(ep)
+                ]
+                if not capable:
+                    return None
+                now = time.monotonic()
+                return min(
+                    capable,
+                    key=lambda ep: ep.score(now, rs.stale_after_s),
+                )
+
+            attempts = 0
+            failed_eps: list = []
+            body = payload
+            upstream_url = str(engine)
+            while True:
+                buf = b""
+                try:
+                    async with gateway._get_session().post(
+                        upstream_url + "/api/v0.1/generate/stream",
+                        data=body,
+                        headers={TENANT_HEADER: tenant, TIER_HEADER: tier},
+                        timeout=aiohttp.ClientTimeout(
+                            total=None, sock_connect=20
+                        ),
+                    ) as upstream:
+                        if upstream.status != 200:
+                            if not resp.prepared and attempts == 0:
+                                return _error_response(
+                                    await upstream.text(),
+                                    code=upstream.status,
+                                )
+                            raise RuntimeError(
+                                f"upstream answered {upstream.status}"
+                            )
+                        if not resp.prepared:
+                            await resp.prepare(request)
+                        async for chunk_bytes in \
+                                upstream.content.iter_any():
+                            buf += chunk_bytes
+                            # forward COMPLETE events only: a half-event
+                            # from a dying engine must not reach the
+                            # client (the resumed peer re-emits those
+                            # tokens and they would double)
+                            while b"\n\n" in buf:
+                                event, _, buf = buf.partition(b"\n\n")
+                                _note_event(event)
+                                await resp.write(event + b"\n\n")
+                    if not done_forwarded:
+                        raise RuntimeError(
+                            "upstream ended without a terminal event"
+                        )
+                    break
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        RuntimeError) as e:
+                    if done_forwarded:
+                        break  # the stream had already finished cleanly
+                    attempts += 1
+                    peer = None
+                    if attempts <= 2:
+                        failed_eps.append(endpoint)
+                        peer = _stream_peer(failed_eps)
+                    if peer is None:
+                        if not resp.prepared:
+                            return _error_response(
+                                f"engine unreachable: {e}", code=503
+                            )
+                        await resp.write(
+                            b'data: {"done": true, "error": %s}\n\n'
+                            % _json.dumps(str(e)).encode()
+                        )
+                        break
+                    # re-home: the load accounting moves with the stream
+                    if track:
+                        endpoint.release()
+                        peer.begin(batcher=False)
+                    endpoint = peer
+                    upstream_url = str(peer.base_url)
+                    body = _resume_payload()
+                    gateway.failovers["stream"] = (
+                        gateway.failovers.get("stream", 0) + 1)
+                    RECORDER.record_failover("stream")
             await resp.write_eof()
             return resp
         finally:
@@ -1818,12 +2099,36 @@ def make_gateway_app(gateway: ApiGateway):
         )
         return web.json_response(doc)
 
-    async def fleet(_):
+    async def fleet(request):
         # per-deployment rollups of every replica's /stats + /perf +
-        # /quality, with per-replica outlier deltas vs the set median
+        # /quality, with per-replica outlier deltas vs the set median.
+        # With federation live the view fans out to every sibling
+        # gateway replica too (?local=1 stops the recursion): one GET
+        # answers for the whole gateway tier, whichever replica the
+        # load balancer happened to route it to
+        import aiohttp
+
         from seldon_core_tpu.gateway.fleet import fleet_document
 
-        return web.json_response(await fleet_document(gateway))
+        doc = await fleet_document(gateway)
+        fed = gateway.federation
+        if (fed is not None and fed.enabled
+                and request.query.get("local") != "1"):
+            doc["replica_id"] = fed.replica_id
+            peer_docs = {}
+            for rid, url in fed.peers():
+                try:
+                    async with gateway._get_session().get(
+                        url.rstrip("/") + "/fleet?local=1",
+                        timeout=aiohttp.ClientTimeout(total=2.0),
+                    ) as r:
+                        peer_docs[rid] = await r.json(content_type=None)
+                except Exception as e:  # noqa: BLE001 — a dead peer is
+                    # data, not a reason to fail the whole view
+                    peer_docs[rid] = {"error": f"{type(e).__name__}: {e}"}
+            if peer_docs:
+                doc["gateway_peers"] = peer_docs
+        return web.json_response(doc)
 
     async def profile_start(request):
         from seldon_core_tpu.gateway.fleet import profile_start as start

@@ -131,6 +131,7 @@ class ReplicaEndpoint:
         "picks", "failures", "consec_failures", "fail_degraded_until",
         "scraped_inflight", "scraped_free_kv", "scrape_ts",
         "scrape_failed", "breaker_open", "fleet_docs",
+        "boot_id", "epoch_resets", "lease_state",
     )
 
     #: minimum samples before a shape bucket's own EWMA is trusted
@@ -200,10 +201,39 @@ class ReplicaEndpoint:
         #: seldon_tpu_fleet_* outlier gauges read from here so the
         #: aggregation adds zero polling of its own
         self.fleet_docs: Optional[dict] = None
+        #: engine boot epoch, scraped off /stats (or carried by the
+        #: engine's liveness lease).  A CHANGE at the same URL means the
+        #: process restarted: every score input learned about the dead
+        #: process (EWMA, shape models, failure streaks, scraped load)
+        #: describes nobody and is reset instead of poisoning picks
+        self.boot_id: Optional[str] = None
+        self.epoch_resets = 0
+        #: store-lease liveness (gateway/federation.py feed): None until
+        #: the engine ever heartbeats a lease, then "live"/"dead".  A
+        #: lapsed or dropped lease marks the replica dead within one
+        #: lease TTL — faster than 3 failed scrapes
+        self.lease_state: Optional[str] = None
+
+    def observe_boot_id(self, boot_id: Optional[str]) -> None:
+        """Record the engine's boot epoch; on a change at the same URL,
+        reset every score input the previous process earned."""
+        if not boot_id:
+            return
+        if self.boot_id is not None and boot_id != self.boot_id:
+            self.ewma_ms = 0.0
+            self.shape_ms = {}
+            self.consec_failures = 0
+            self.fail_degraded_until = 0.0
+            self.scraped_inflight = 0
+            self.breaker_open = False
+            self.epoch_resets += 1
+        self.boot_id = boot_id
 
     # -- health ----------------------------------------------------------
 
     def degraded(self, now: float, stale_after_s: float) -> bool:
+        if self.lease_state == "dead":
+            return True
         # fast-failure degradation applies to EVERY target kind — it is
         # the only health signal a uds-only or in-process endpoint has,
         # and the cooldown expiring is the passive half-open probe
@@ -328,6 +358,9 @@ class ReplicaEndpoint:
             "fail_degraded": time.monotonic() < self.fail_degraded_until,
             "breaker_open": self.breaker_open,
             "scrape_failed": self.scrape_failed,
+            "boot_id": self.boot_id,
+            "epoch_resets": self.epoch_resets,
+            "lease_state": self.lease_state,
         }
 
 
@@ -449,6 +482,38 @@ class ReplicaSet:
             self.mispicks += 1
             RECORDER.record_replica_mispick()
 
+    # -- store-lease liveness (gateway/federation.py feed) ---------------
+
+    def apply_leases(self, leases) -> None:
+        """Fold the shared store's engine-lease table (url -> (boot_id,
+        expires)) into endpoint health.  Only engines that EVER
+        heartbeated participate — an endpoint with no lease row keeps
+        scrape-based health untouched (mixed fleets, tests, engines
+        started without a store).  A lapsed or dropped lease marks the
+        replica dead within one lease TTL, long before three scrapes
+        fail; the lease's boot_id doubles as an early epoch signal."""
+        if not leases and not any(
+            ep.lease_state is not None for ep in self.endpoints
+        ):
+            return
+        now = time.time()
+        for ep in self.endpoints:
+            if ep.base_url is None:
+                continue
+            row = leases.get(ep.base_url) or leases.get(ep.base_url + "/")
+            if row is None:
+                # an engine that once held a lease and now has NO row
+                # deregistered (graceful drain) — dead until it returns
+                if ep.lease_state is not None:
+                    ep.lease_state = "dead"
+                continue
+            boot_id, expires = row
+            if float(expires) > now:
+                ep.lease_state = "live"
+                ep.observe_boot_id(boot_id)
+            else:
+                ep.lease_state = "dead"
+
     # -- passive health (the /stats scrape) ------------------------------
 
     async def scrape_once(self, session) -> int:
@@ -473,6 +538,10 @@ class ReplicaSet:
                     doc = await r.json(content_type=None)
                 if not isinstance(doc, dict):
                     raise ValueError("stats body is not an object")
+                # boot epoch FIRST: a restarted engine at the same URL
+                # resets the dead process's learned state before this
+                # scrape's fresh readings land on top
+                ep.observe_boot_id(doc.get("boot_id"))
                 batch = (doc.get("telemetry") or {}).get("batch") or {}
                 # subtract OWN batcher-bound inflight: the engine's
                 # figure includes unary work THIS gateway queued, which

@@ -1,0 +1,238 @@
+"""Gateway federation — N apife replicas over one shared sqlite store.
+
+The reference architecture runs the api-frontend as a Deployment behind a
+Service: every replica serves ingress statelessly, and anything stateful
+(OAuth tokens) lives in Redis.  Our gateway grew singleton duties the
+reference never had — rollout controllers, scale-ahead, shadow budget
+accounting — which must run EXACTLY ONCE across the fleet or two replicas
+fight over the same traffic split.
+
+This module is the election that picks the one replica allowed to run
+them.  It is deliberately boring: a single row in the shared sqlite file
+(``leases`` table, gateway/state.py) holds ``(holder, token, expires)``;
+every replica ticks ``acquire_lease`` at ttl/3, the holder renews, the
+rest observe.  When the coordinator dies or stalls past the TTL, the next
+ticker takes over and the **fencing token** bumps — any write the
+ex-coordinator issues afterwards carries the old token and is rejected
+inside the store's own write transaction (``fenced_set_weights``), the
+classic lock-service fence (cf. Chubby; HashiCorp's leader election over
+a session-bound KV key).
+
+Failure semantics by design:
+
+* ingress never depends on the lease — every replica serves requests the
+  whole time, only singleton DUTIES move;
+* QoS token buckets and SLO burn rings are per-replica (a shed decision
+  is latency-critical; sharing them through sqlite would put a disk write
+  on the admission path) — documented in docs/operations.md;
+* a store outage demotes the replica (it cannot prove tenure, so it must
+  not act as coordinator) but keeps serving ingress.
+
+Kill switch: ``SELDON_TPU_FEDERATION=0`` (or an in-memory store, which
+has no lease API) makes every replica its own coordinator — bit-for-bit
+the pre-federation single-gateway behavior.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import time
+from typing import Callable, List, Optional, Tuple
+
+from seldon_core_tpu.utils.telemetry import RECORDER
+
+__all__ = [
+    "GatewayFederation",
+    "federation_enabled",
+    "lease_ttl_s",
+    "COORDINATOR_LEASE",
+]
+
+#: the singleton-duty lease's row name in the shared ``leases`` table
+COORDINATOR_LEASE = "coordinator"
+
+
+def federation_enabled() -> bool:
+    """``SELDON_TPU_FEDERATION=0`` restores single-gateway behavior."""
+    return os.environ.get("SELDON_TPU_FEDERATION", "1") != "0"
+
+
+def lease_ttl_s() -> float:
+    """Coordinator + engine lease TTL (``SELDON_TPU_LEASE_TTL_S``,
+    default 3s) — the upper bound on coordinator-failover time and on
+    how long a dead engine keeps attracting picks before the balancer
+    declares it via the lease (scrape fail-degrade needs 3 consecutive
+    failures; the lease usually loses the race only when scrapes are
+    faster than heartbeats)."""
+    try:
+        return max(float(os.environ.get("SELDON_TPU_LEASE_TTL_S", "3")), 0.2)
+    except ValueError:
+        return 3.0
+
+
+class GatewayFederation:
+    """One gateway replica's view of the federation.
+
+    ``tick()`` is the whole protocol: claim-or-renew the coordinator
+    lease, heartbeat this replica into the peer directory, notice
+    transitions.  Everything else is read-side sugar (``is_coordinator``
+    gates singleton duties; ``set_weights`` routes a coordinator's
+    traffic-split writes through the fenced path; ``peers`` feeds the
+    /fleet federation).
+
+    Degrades to a no-op "always coordinator" when federation is off or
+    the store has no lease API (the in-memory store) — callers never
+    branch on the mode themselves."""
+
+    def __init__(self, store, replica_id: Optional[str] = None, *,
+                 ttl_s: Optional[float] = None,
+                 base_url: Optional[str] = None,
+                 clock: Callable[[], float] = time.time):
+        self.store = store
+        self.replica_id = (
+            replica_id
+            or os.environ.get("SELDON_TPU_GW_REPLICA_ID")
+            or f"gw-{secrets.token_hex(4)}"
+        )
+        self.ttl_s = float(ttl_s if ttl_s is not None else lease_ttl_s())
+        self.base_url = base_url
+        self.clock = clock
+        self.enabled = (
+            federation_enabled() and hasattr(store, "acquire_lease")
+        )
+        self._token: Optional[int] = None
+        self._store_error: Optional[str] = None
+        self._last_tick = 0.0
+        self._transitions = 0
+
+    # -- the protocol ------------------------------------------------------
+
+    def tick(self) -> bool:
+        """Claim or renew the coordinator lease + heartbeat the peer row;
+        returns whether this replica is the coordinator NOW."""
+        if not self.enabled:
+            return True
+        was = self._token is not None
+        try:
+            token = self.store.acquire_lease(
+                COORDINATOR_LEASE, self.replica_id, self.ttl_s)
+            if self.base_url:
+                self.store.heartbeat_peer(
+                    self.replica_id, self.base_url, self.ttl_s)
+            self._store_error = None
+        except Exception as e:  # noqa: BLE001 — a partitioned store must
+            # demote (tenure can't be proven) without crashing the loop
+            token = None
+            self._store_error = f"{type(e).__name__}: {e}"
+            RECORDER.record_lease_transition("store_error")
+        self._last_tick = self.clock()
+        if token is not None and not was:
+            RECORDER.record_lease_transition("acquired")
+            self._transitions += 1
+        elif token is None and was:
+            RECORDER.record_lease_transition("lost")
+            self._transitions += 1
+        self._token = token
+        return token is not None
+
+    def resign(self) -> None:
+        """Graceful shutdown: hand the lease over NOW instead of making
+        the fleet wait out the TTL, and leave the peer directory."""
+        if not self.enabled:
+            return
+        try:
+            if self._token is not None:
+                self.store.release_lease(
+                    COORDINATOR_LEASE, self.replica_id, self._token)
+                RECORDER.record_lease_transition("released")
+                self._transitions += 1
+            self.store.drop_peer(self.replica_id)
+        except Exception:  # noqa: BLE001 — best effort on the way out
+            pass
+        self._token = None
+
+    async def run(self, stop: Optional[asyncio.Event] = None) -> None:
+        """Tick at ttl/3 (two missable heartbeats before the lease
+        lapses) until ``stop`` is set."""
+        interval = max(self.ttl_s / 3.0, 0.05)
+        while stop is None or not stop.is_set():
+            self.tick()
+            if stop is None:
+                await asyncio.sleep(interval)
+            else:
+                try:
+                    await asyncio.wait_for(stop.wait(), interval)
+                except asyncio.TimeoutError:
+                    pass
+
+    # -- read side ---------------------------------------------------------
+
+    @property
+    def is_coordinator(self) -> bool:
+        return True if not self.enabled else self._token is not None
+
+    @property
+    def fencing_token(self) -> Optional[int]:
+        return self._token
+
+    def set_weights(self, deployment_id: str, weights) -> None:
+        """The rollout controller's traffic-split lever, fenced: when
+        federation is live the write proves tenure inside the store's
+        own transaction; otherwise it is the plain store write."""
+        if self.enabled and self._token is not None:
+            self.store.fenced_set_weights(
+                deployment_id, weights,
+                lease=COORDINATOR_LEASE,
+                holder=self.replica_id, token=self._token)
+        else:
+            self.store.set_weights(deployment_id, weights)
+
+    def peers(self) -> List[Tuple[str, str]]:
+        """Live sibling replicas as (replica_id, base_url) — the /fleet
+        federation's fan-out list (this replica excluded)."""
+        if not self.enabled:
+            return []
+        try:
+            return list(self.store.peers(exclude=self.replica_id))
+        except Exception:  # noqa: BLE001
+            return []
+
+    def engine_leases(self):
+        """All engine leases (url -> (boot_id, expires)), {} when the
+        store has none or is unreachable — the balancer's liveness feed."""
+        if not self.enabled or not hasattr(self.store, "engine_leases"):
+            return {}
+        try:
+            return dict(self.store.engine_leases())
+        except Exception:  # noqa: BLE001
+            return {}
+
+    def snapshot(self) -> dict:
+        """The /stats ``federation`` block."""
+        doc = {
+            "enabled": self.enabled,
+            "replica_id": self.replica_id,
+            "coordinator": self.is_coordinator,
+            "lease_ttl_s": self.ttl_s,
+            "transitions": self._transitions,
+        }
+        if self.enabled:
+            doc["fencing_token"] = self._token
+            doc["peers"] = [
+                {"replica_id": rid, "url": url} for rid, url in self.peers()
+            ]
+            if self._store_error:
+                doc["store_error"] = self._store_error
+            try:
+                lease = self.store.lease(COORDINATOR_LEASE)
+            except Exception:  # noqa: BLE001
+                lease = None
+            if lease is not None:
+                doc["lease"] = {
+                    "holder": lease["holder"],
+                    "token": lease["token"],
+                    "expires_in_s": round(lease["expires"] - time.time(), 3),
+                }
+        return doc

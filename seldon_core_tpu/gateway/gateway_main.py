@@ -204,6 +204,24 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
     )
     if gateway.firehose is not None:
         gateway.firehose.start()  # drain task needs the running loop
+    # gateway federation (gateway/federation.py): with a shared sqlite
+    # state file and SELDON_TPU_FEDERATION unset/1, this replica joins
+    # the coordinator election + peer directory.  In-memory store or
+    # SELDON_TPU_FEDERATION=0: no-op, single-gateway behavior bit-for-bit
+    from seldon_core_tpu.gateway.federation import GatewayFederation
+
+    advertise = os.environ.get("GATEWAY_ADVERTISE_URL", "").strip() or \
+        f"http://127.0.0.1:{rest_port}"
+    federation = GatewayFederation(store, base_url=advertise)
+    gateway.federation = federation
+    fed_stop = asyncio.Event()
+    fed_task = None
+    if federation.enabled:
+        fed_task = asyncio.get_running_loop().create_task(
+            federation.run(fed_stop))
+        print(f"federation: replica={federation.replica_id} "
+              f"ttl={federation.ttl_s:.1f}s advertise={advertise}",
+              flush=True)
     seen: dict = {}
     url_map = _engine_url_map()
     template = _engine_url_template()  # fatal at boot if malformed
@@ -235,6 +253,10 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
             if spec_dir:  # poll for new/changed deployment specs
                 _register_specs(store, spec_dir, seen, url_map, template,
                                 replicas)
+    if fed_task is not None:
+        fed_stop.set()
+        await fed_task
+        federation.resign()  # hand the lease over NOW, not at TTL expiry
     await grpc_server.stop(grace=5.0)
     await runner.cleanup()
     if gateway.firehose is not None:

@@ -19,12 +19,13 @@ the in-memory store.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import secrets
 import sqlite3
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 
 from seldon_core_tpu.gateway.apife import (
     TOKEN_TTL_S,
@@ -37,7 +38,7 @@ from seldon_core_tpu.gateway.shadow import (
 )
 from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
 
-__all__ = ["SqliteDeploymentStore"]
+__all__ = ["SqliteDeploymentStore", "StaleFenceError"]
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS registrations (
@@ -56,7 +57,36 @@ CREATE TABLE IF NOT EXISTS meta (
     k TEXT PRIMARY KEY,
     v INTEGER NOT NULL
 );
+CREATE TABLE IF NOT EXISTS leases (
+    name TEXT PRIMARY KEY,
+    holder TEXT NOT NULL,
+    token INTEGER NOT NULL,
+    expires REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS engine_leases (
+    url TEXT PRIMARY KEY,
+    boot_id TEXT NOT NULL,
+    expires REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS gateway_peers (
+    replica_id TEXT PRIMARY KEY,
+    base_url TEXT NOT NULL,
+    expires REAL NOT NULL
+);
 """
+
+#: how many times a write transaction retries when another gateway
+#: replica holds the sqlite write lock, and the base of the backoff
+#: (full jitter on top; total worst-case wait ~= 2s, far beyond any
+#: real contention window for a WAL-mode file on a shared volume)
+_BUSY_RETRIES = 6
+_BUSY_BACKOFF_S = 0.03
+
+
+class StaleFenceError(RuntimeError):
+    """A fenced write carried a fencing token that is no longer the
+    lease's current token — the caller lost the lease (paused past its
+    TTL, another replica took over) and MUST NOT mutate shared state."""
 
 # bumped inside the same transaction as the registration write, so every
 # gateway replica sharing the file observes other replicas' changes too
@@ -72,12 +102,51 @@ class SqliteDeploymentStore:
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        # isolation_level=None -> autocommit: transactions are explicit
+        # (BEGIN IMMEDIATE in _write) so a multi-statement writer holds
+        # the write lock for exactly its own span and nothing implicit
+        # lingers between calls
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None)
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute("PRAGMA synchronous=NORMAL")
+            # first line of defense against a sibling replica's write
+            # lock; the _write retry loop is the second
+            self._conn.execute("PRAGMA busy_timeout=200")
             self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+
+    @contextlib.contextmanager
+    def _write(self):
+        """One IMMEDIATE write transaction with SQLITE_BUSY retry.
+
+        BEGIN IMMEDIATE takes the write lock up front, so two gateway
+        replicas racing ``set_weights``/``register`` serialize at BEGIN
+        instead of failing mid-transaction on the first write.  A busy
+        BEGIN (the other replica holds the lock past busy_timeout) is
+        retried with linear backoff + full jitter rather than surfacing
+        a raw OperationalError to the caller."""
+        with self._lock:
+            last: Optional[Exception] = None
+            for attempt in range(_BUSY_RETRIES):
+                try:
+                    self._conn.execute("BEGIN IMMEDIATE")
+                except sqlite3.OperationalError as e:
+                    msg = str(e).lower()
+                    if "locked" not in msg and "busy" not in msg:
+                        raise
+                    last = e
+                    time.sleep(_BUSY_BACKOFF_S * (attempt + 1)
+                               * (0.5 + secrets.randbelow(512) / 1024))
+                    continue
+                try:
+                    yield self._conn
+                except BaseException:
+                    self._conn.execute("ROLLBACK")
+                    raise
+                self._conn.execute("COMMIT")
+                return
+            raise last  # type: ignore[misc]
 
     # -- registrations -----------------------------------------------------
 
@@ -130,13 +199,50 @@ class SqliteDeploymentStore:
             "engines": weighted,
             "shadow": None if shadow is None else shadow.to_json_dict(),
         }
-        with self._lock:
-            self._conn.execute(
+        with self._write() as conn:
+            conn.execute(
                 "INSERT OR REPLACE INTO registrations VALUES (?, ?, ?, ?)",
                 (key, spec.name, spec.oauth_secret, json.dumps(doc)),
             )
-            self._conn.execute(_BUMP_REVISION)
-            self._conn.commit()
+            conn.execute(_BUMP_REVISION)
+
+    @staticmethod
+    def _set_weights_in(conn, deployment_id: str, weights) -> None:
+        """The set_weights body, run inside an already-open write
+        transaction (shared by the plain and fenced entry points)."""
+        row = conn.execute(
+            "SELECT oauth_key, engines_json FROM registrations "
+            "WHERE deployment_id = ?",
+            (deployment_id,),
+        ).fetchone()
+        if row is None:
+            raise KeyError(
+                f"deployment not registered: {deployment_id!r}"
+            )
+        key, engines_json = row
+        doc = json.loads(engines_json)
+        engines = doc["engines"] if isinstance(doc, dict) else doc
+        known = {e[0] for e in engines}
+        unknown = set(weights) - known
+        if unknown:
+            raise KeyError(
+                f"unknown predictors for {deployment_id!r}: "
+                f"{sorted(unknown)}"
+            )
+        engines = [
+            [name, max(int(weights.get(name, w)), 0), engine]
+            for name, w, engine in engines
+        ]
+        if isinstance(doc, dict):
+            doc["engines"] = engines
+        else:
+            doc = engines
+        conn.execute(
+            "UPDATE registrations SET engines_json = ? "
+            "WHERE oauth_key = ?",
+            (json.dumps(doc), key),
+        )
+        conn.execute(_BUMP_REVISION)
 
     def set_weights(self, deployment_id: str, weights) -> None:
         """Reassign one deployment's live traffic split in place — the
@@ -144,52 +250,55 @@ class SqliteDeploymentStore:
         store's ``set_weights`` (unknown predictors are a typed error);
         the revision bump propagates the change to every gateway replica
         sharing the file."""
+        with self._write() as conn:
+            self._set_weights_in(conn, deployment_id, weights)
+
+    def fenced_set_weights(self, deployment_id: str, weights, *,
+                           lease: str, holder: str, token: int) -> None:
+        """``set_weights`` guarded by a fencing check INSIDE the same
+        write transaction: the caller must still be the named lease's
+        current holder at its current token.  An ex-coordinator that was
+        paused past its TTL (GC stall, SIGSTOP) and resumed with a stale
+        token gets :class:`StaleFenceError` instead of clobbering the
+        new coordinator's traffic split."""
+        with self._write() as conn:
+            row = conn.execute(
+                "SELECT holder, token, expires FROM leases WHERE name = ?",
+                (lease,),
+            ).fetchone()
+            if (row is None or row[0] != holder
+                    or int(row[1]) != int(token)
+                    or float(row[2]) <= time.time()):
+                raise StaleFenceError(
+                    f"lease {lease!r}: fencing token {token} for "
+                    f"{holder!r} is stale (current: {row!r})"
+                )
+            self._set_weights_in(conn, deployment_id, weights)
+
+    def weights(self, deployment_id: str) -> Dict[str, int]:
+        """The live traffic split by predictor name (read side of
+        ``set_weights`` — same contract as the in-memory store's)."""
         with self._lock:
             row = self._conn.execute(
-                "SELECT oauth_key, engines_json FROM registrations "
+                "SELECT engines_json FROM registrations "
                 "WHERE deployment_id = ?",
                 (deployment_id,),
             ).fetchone()
-            if row is None:
-                raise KeyError(
-                    f"deployment not registered: {deployment_id!r}"
-                )
-            key, engines_json = row
-            doc = json.loads(engines_json)
-            engines = doc["engines"] if isinstance(doc, dict) else doc
-            known = {e[0] for e in engines}
-            unknown = set(weights) - known
-            if unknown:
-                raise KeyError(
-                    f"unknown predictors for {deployment_id!r}: "
-                    f"{sorted(unknown)}"
-                )
-            engines = [
-                [name, max(int(weights.get(name, w)), 0), engine]
-                for name, w, engine in engines
-            ]
-            if isinstance(doc, dict):
-                doc["engines"] = engines
-            else:
-                doc = engines
-            self._conn.execute(
-                "UPDATE registrations SET engines_json = ? "
-                "WHERE oauth_key = ?",
-                (json.dumps(doc), key),
-            )
-            self._conn.execute(_BUMP_REVISION)
-            self._conn.commit()
+        if row is None:
+            raise KeyError(f"deployment not registered: {deployment_id!r}")
+        doc = json.loads(row[0])
+        engines = doc["engines"] if isinstance(doc, dict) else doc
+        return {e[0]: int(e[1]) for e in engines}
 
     def unregister(self, oauth_key: str) -> None:
-        with self._lock:
-            self._conn.execute(
+        with self._write() as conn:
+            conn.execute(
                 "DELETE FROM registrations WHERE oauth_key = ?", (oauth_key,)
             )
-            self._conn.execute(
+            conn.execute(
                 "DELETE FROM tokens WHERE oauth_key = ?", (oauth_key,)
             )
-            self._conn.execute(_BUMP_REVISION)
-            self._conn.commit()
+            conn.execute(_BUMP_REVISION)
 
     def revision(self) -> int:
         """Monotone registration-change counter shared through the sqlite
@@ -236,15 +345,14 @@ class SqliteDeploymentStore:
             raise AuthError("invalid client credentials")
         token = secrets.token_urlsafe(24)
         now = time.time()
-        with self._lock:
+        with self._write() as conn:
             # expired rows are evicted on the write path (the same lazy
             # policy the in-memory store uses)
-            self._conn.execute("DELETE FROM tokens WHERE expiry <= ?", (now,))
-            self._conn.execute(
+            conn.execute("DELETE FROM tokens WHERE expiry <= ?", (now,))
+            conn.execute(
                 "INSERT INTO tokens VALUES (?, ?, ?)",
                 (token, oauth_key, now + TOKEN_TTL_S),
             )
-            self._conn.commit()
         return token
 
     def principal_for_token(self, token: str) -> _Registration:
@@ -257,11 +365,10 @@ class SqliteDeploymentStore:
             raise AuthError("invalid token")
         key, expiry = row
         if time.time() > expiry:
-            with self._lock:
-                self._conn.execute(
+            with self._write() as conn:
+                conn.execute(
                     "DELETE FROM tokens WHERE token = ?", (token,)
                 )
-                self._conn.commit()
             raise AuthError("token expired")
         reg = self._registration(key)
         if reg is None:
@@ -275,6 +382,18 @@ class SqliteDeploymentStore:
             ).fetchall()
         return [r[0] for r in rows]
 
+    def active_token_count(self) -> int:
+        """Unexpired issued tokens — the /stats ``active_tokens`` gauge
+        (ApiGateway.stats reads this off whichever store it was built
+        with; the sqlite store counts live rows, mirroring the in-memory
+        store's lazy-eviction semantics)."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COUNT(*) FROM tokens WHERE expiry > ?",
+                (time.time(),),
+            ).fetchone()
+        return int(row[0])
+
     # ApiGateway._resolve peeks at _by_key when auth is disabled; present
     # the same mapping view lazily
     @property
@@ -284,6 +403,145 @@ class SqliteDeploymentStore:
                 "SELECT oauth_key FROM registrations"
             ).fetchall()]
         return {k: self._registration(k) for k in keys}
+
+    # -- coordinator leases (gateway/federation.py) ------------------------
+
+    def acquire_lease(self, name: str, holder: str,
+                      ttl_s: float) -> Optional[int]:
+        """Claim or renew the named lease; returns the fencing token if
+        ``holder`` now holds it, None if another live holder does.
+
+        The token is a monotone integer that bumps on every CHANGE of
+        tenure (fresh claim, takeover of an expired lease) and stays
+        fixed across renewals by the same holder — so any write fenced
+        on an old token is rejectable forever, while a healthy
+        coordinator's heartbeat doesn't invalidate its own writes."""
+        now = time.time()
+        with self._write() as conn:
+            row = conn.execute(
+                "SELECT holder, token, expires FROM leases WHERE name = ?",
+                (name,),
+            ).fetchone()
+            if row is None:
+                conn.execute(
+                    "INSERT INTO leases VALUES (?, ?, 1, ?)",
+                    (name, holder, now + ttl_s),
+                )
+                return 1
+            cur_holder, cur_token, expires = row
+            if cur_holder == holder and float(expires) > now:
+                conn.execute(
+                    "UPDATE leases SET expires = ? WHERE name = ?",
+                    (now + ttl_s, name),
+                )
+                return int(cur_token)
+            if float(expires) <= now:
+                # expired — ANY caller may take over; tenure changes, so
+                # the token bumps even if the holder name is the same
+                # (a restarted process must not inherit its dead
+                # predecessor's fence)
+                conn.execute(
+                    "UPDATE leases SET holder = ?, token = token + 1, "
+                    "expires = ? WHERE name = ?",
+                    (holder, now + ttl_s, name),
+                )
+                return int(cur_token) + 1
+            return None
+
+    def release_lease(self, name: str, holder: str, token: int) -> None:
+        """Voluntary release (graceful shutdown) — a no-op unless the
+        caller still holds the lease at its current token."""
+        with self._write() as conn:
+            conn.execute(
+                "DELETE FROM leases WHERE name = ? AND holder = ? "
+                "AND token = ?",
+                (name, holder, int(token)),
+            )
+
+    def lease(self, name: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT holder, token, expires FROM leases WHERE name = ?",
+                (name,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {"holder": row[0], "token": int(row[1]),
+                "expires": float(row[2])}
+
+    # -- engine liveness leases (runtime/engine_main.py heartbeats,
+    #    gateway/balancer.py reads) ----------------------------------------
+
+    def heartbeat_engine(self, url: str, boot_id: str,
+                         ttl_s: float) -> None:
+        with self._write() as conn:
+            conn.execute(
+                "INSERT INTO engine_leases VALUES (?, ?, ?) "
+                "ON CONFLICT(url) DO UPDATE SET boot_id = excluded.boot_id, "
+                "expires = excluded.expires",
+                (url, boot_id, time.time() + ttl_s),
+            )
+
+    def drop_engine(self, url: str) -> None:
+        """Graceful deregistration: the engine's lease disappears
+        immediately instead of lapsing a TTL later."""
+        with self._write() as conn:
+            conn.execute(
+                "DELETE FROM engine_leases WHERE url = ?", (url,)
+            )
+
+    def live_engines(self) -> Dict[str, Tuple[str, float]]:
+        """url -> (boot_id, expires) for every UNEXPIRED engine lease.
+        An engine that ever heartbeated and is absent here is dead (or
+        drained) as far as the balancer is concerned."""
+        now = time.time()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT url, boot_id, expires FROM engine_leases "
+                "WHERE expires > ?",
+                (now,),
+            ).fetchall()
+        return {r[0]: (r[1], float(r[2])) for r in rows}
+
+    def engine_leases(self) -> Dict[str, Tuple[str, float]]:
+        """ALL engine leases, lapsed included — url -> (boot_id,
+        expires); the balancer distinguishes "lease lapsed" (dead) from
+        "never leased" (liveness unknown, fall back to scrape health)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT url, boot_id, expires FROM engine_leases"
+            ).fetchall()
+        return {r[0]: (r[1], float(r[2])) for r in rows}
+
+    # -- gateway peer directory (the /fleet federation surface) ------------
+
+    def heartbeat_peer(self, replica_id: str, base_url: str,
+                       ttl_s: float) -> None:
+        with self._write() as conn:
+            conn.execute(
+                "INSERT INTO gateway_peers VALUES (?, ?, ?) "
+                "ON CONFLICT(replica_id) DO UPDATE SET "
+                "base_url = excluded.base_url, expires = excluded.expires",
+                (replica_id, base_url, time.time() + ttl_s),
+            )
+
+    def drop_peer(self, replica_id: str) -> None:
+        with self._write() as conn:
+            conn.execute(
+                "DELETE FROM gateway_peers WHERE replica_id = ?",
+                (replica_id,),
+            )
+
+    def peers(self, exclude: Optional[str] = None) -> List[Tuple[str, str]]:
+        """Unexpired gateway replicas as (replica_id, base_url)."""
+        now = time.time()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT replica_id, base_url FROM gateway_peers "
+                "WHERE expires > ? ORDER BY replica_id",
+                (now,),
+            ).fetchall()
+        return [(r[0], r[1]) for r in rows if r[0] != exclude]
 
     def close(self) -> None:
         with self._lock:

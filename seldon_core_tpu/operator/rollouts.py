@@ -187,11 +187,19 @@ class RolloutController:
     (gateway/firehose.py ``publish_event``)."""
 
     def __init__(self, store, signals: Callable[[RolloutPlan], dict],
-                 firehose=None, clock: Callable[[], float] = time.monotonic):
+                 firehose=None, clock: Callable[[], float] = time.monotonic,
+                 federation=None):
         self.store = store
         self.signals = signals
         self.firehose = firehose
         self.clock = clock
+        #: optional GatewayFederation (gateway/federation.py).  A rollout
+        #: controller is a SINGLETON duty — with N gateway replicas over
+        #: one store, only the coordinator's controller may tick, and its
+        #: traffic-split writes go through the fenced path so a paused
+        #: ex-coordinator that wakes up mid-write is rejected by the
+        #: store itself (fencing token), not by luck
+        self.federation = federation
         self._rollouts: Dict[str, _Rollout] = {}
         #: deployment -> EVERY config_hash that rolled back (bounded to
         #: the most recent 64) — the quarantine survives the _Rollout
@@ -236,6 +244,8 @@ class RolloutController:
         taken (promote / hold / rollback), one dict per deployment."""
         if not rollouts_enabled():
             return []
+        if self.federation is not None and not self.federation.is_coordinator:
+            return []  # singleton duty: only the coordinator replica ticks
         decisions = []
         for ro in list(self._rollouts.values()):
             if ro.state in ("promoted", "rolled_back"):
@@ -246,6 +256,8 @@ class RolloutController:
     def tick_deployment(self, deployment: str) -> Optional[dict]:
         """Tick just one deployment (the reconciler's per-CR path)."""
         if not rollouts_enabled():
+            return None
+        if self.federation is not None and not self.federation.is_coordinator:
             return None
         ro = self._rollouts.get(deployment)
         if ro is None or ro.state in ("promoted", "rolled_back"):
@@ -262,7 +274,19 @@ class RolloutController:
             deployment=plan.deployment, candidate=plan.candidate,
             stage_percent=str(ro.current_percent), state=ro.state,
         ) as span:
-            decision = self._decide(ro, now)
+            try:
+                decision = self._decide(ro, now)
+            except Exception as e:  # noqa: BLE001 — narrow re-raise below
+                from seldon_core_tpu.gateway.state import StaleFenceError
+
+                if not isinstance(e, StaleFenceError):
+                    raise
+                # this replica lost the coordinator lease mid-decision and
+                # the store rejected the split write (stale fencing token).
+                # Abandon the transition — the successor's controller owns
+                # the rollout now, re-derived from the shared store
+                RECORDER.record_lease_transition("fenced_write_rejected")
+                decision = ro.note("fenced", time.time(), error=str(e))
             if span is not None:
                 span["decision"] = decision["decision"]
                 if decision.get("reason"):
@@ -272,6 +296,9 @@ class RolloutController:
     def _decide(self, ro: _Rollout, now: float) -> dict:
         plan = ro.plan
         if ro.state == "pending":
+            resumed = self._maybe_resume(ro, now)
+            if resumed is not None:
+                return resumed
             # first shift: candidate enters at stage 0's percent
             return self._advance(ro, now)
         sig = self._signals_safe(plan)
@@ -298,6 +325,49 @@ class RolloutController:
         if ro.stage_idx >= len(plan.stages) - 1:
             return self._promote(ro, now, sig)
         return self._advance(ro, now)
+
+    def _maybe_resume(self, ro: _Rollout, now: float) -> Optional[dict]:
+        """Continue a predecessor's rollout instead of restarting it.
+
+        With N federated gateway replicas, the rollout's only durable
+        state is the traffic split in the shared store — the _Rollout
+        object dies with the coordinator that held it.  A fresh
+        controller whose pending plan finds the candidate ALREADY at one
+        of its stage percents (the dead coordinator got that far)
+        fast-forwards to that stage and holds it, rather than snapping
+        live traffic back to stage 0.
+
+        Only armed under federation: a lone controller owns its rollout
+        for the rollout's whole life, and a fresh canary whose candidate
+        REGISTRATION weight happens to equal a stage percent must not
+        read as a predecessor's progress."""
+        if self.federation is None:
+            return None
+        plan = ro.plan
+        try:
+            current = self.store.weights(plan.deployment)
+        except Exception:  # noqa: BLE001 — a store that can't answer (no
+            # weights API, partitioned) degrades to the stage-0 start
+            return None
+        pct = current.get(plan.candidate)
+        if pct is None or pct not in plan.stages:
+            return None
+        ro.state = "running"
+        ro.stage_idx = plan.stages.index(pct)
+        ro.stage_entered_at = now
+        sig = self._signals_safe(plan)
+        if "_scrape_error" in sig:
+            ro.stage_requests_at_entry = None
+            ro.stage_errors_at_entry = None
+        else:
+            ro.stage_requests_at_entry = int(sig.get("requests", 0) or 0)
+            ro.stage_errors_at_entry = int(sig.get("errors", 0) or 0)
+        RECORDER.set_rollout_stage(plan.deployment, pct)
+        event = ro.note("resume", time.time(),
+                        stage=ro.stage_idx, percent=pct)
+        self._publish("rollout_resumed", plan, stage=ro.stage_idx,
+                      percent=pct)
+        return event
 
     # -- signal plumbing --------------------------------------------------
 
@@ -344,8 +414,19 @@ class RolloutController:
 
     # -- transitions -------------------------------------------------------
 
+    def _write_split(self, deployment: str, weights: Dict[str, int]) -> None:
+        """The controller's only store write, fenced when federated: a
+        stale fencing token (this replica lost the coordinator lease to
+        a successor while deciding) surfaces as StaleFenceError — the
+        caller's transition is abandoned, the NEW coordinator's
+        controller re-derives it from the shared store."""
+        if self.federation is not None:
+            self.federation.set_weights(deployment, weights)
+        else:
+            self.store.set_weights(deployment, weights)
+
     def _set_split(self, plan: RolloutPlan, candidate_percent: int) -> None:
-        self.store.set_weights(plan.deployment, {
+        self._write_split(plan.deployment, {
             plan.candidate: candidate_percent,
             plan.baseline: 100 - candidate_percent,
         })
@@ -396,7 +477,7 @@ class RolloutController:
         reason, observed = breach
         ro.state = "rolled_back"
         ro.rollback_reason = reason
-        self.store.set_weights(plan.deployment, {
+        self._write_split(plan.deployment, {
             plan.candidate: 0,
             plan.baseline: 100,
         })

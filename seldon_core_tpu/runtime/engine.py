@@ -20,6 +20,7 @@ graceful drain (engine RestClientController.java:57-99), feedback counters
 from __future__ import annotations
 
 import asyncio
+import json as _json
 import logging
 import os
 import time
@@ -134,6 +135,14 @@ class EngineService:
         self._graph_path = "/".join(
             n.name for n in self.predictor.graph.walk()
         )
+        # boot epoch: a fresh random id per EngineService construction.
+        # The gateway's scrape compares it across passes — a CHANGE at
+        # the same URL means the process restarted, so every per-replica
+        # signal learned about the dead process (EWMA, failure streaks,
+        # scraped load) resets instead of poisoning picks
+        import secrets as _secrets
+
+        self.boot_id = _secrets.token_hex(8)
         # /stats assembly cache (see stats()): the four observatory walks
         # are rebuilt only when the folded state actually moved
         self._stats_cache = None
@@ -459,6 +468,7 @@ class EngineService:
             self._stats_cache = (key, now, walks)
             staleness = 0.0
         return {
+            "boot_id": self.boot_id,
             "engine": {
                 "deployment": self.deployment.name,
                 "predictor": self.predictor.name,
@@ -665,6 +675,21 @@ class EngineService:
                 "graph does not support streaming generation "
                 "(need a single generator node)"
             )
+        # optional per-request token budget: a top-level "max_new" key
+        # in the payload (the gateway's stream-failover resume sets it
+        # to the REMAINING budget when it re-prefills on a peer).
+        # Popped before message parsing, like the rest lane's "chunk"
+        max_new = None
+        try:
+            doc = _json.loads(raw)
+        except (TypeError, ValueError):
+            doc = None  # from_json owns the error behaviour below
+        if isinstance(doc, dict) and doc.get("max_new") is not None:
+            try:
+                max_new = max(1, int(doc.pop("max_new")))
+            except (TypeError, ValueError):
+                raise SeldonMessageError("max_new must be an integer")
+            raw = _json.dumps(doc)
         msg = SeldonMessage.from_json(raw)
         if msg.data is None or msg.data.array is None:
             raise SeldonMessageError("streaming needs a numeric prompt")
@@ -677,7 +702,7 @@ class EngineService:
             # continuous lane: the stream joins the in-flight decode
             # batch at the next scheduler step (chunked prefill first),
             # instead of holding the device for a private generate()
-            gen = self.genserver.stream(rows, chunk=chunk)
+            gen = self.genserver.stream(rows, chunk=chunk, max_new=max_new)
         else:
             name, unit = next(iter(self.compiled.units.items()))
             state = self.compiled.states[name]
@@ -1654,6 +1679,22 @@ class EngineService:
 
     def unpause(self) -> None:
         self.paused = False
+
+    def drained(self) -> bool:
+        """No work left anywhere in the process — the shutdown drain's
+        early-exit probe (engine_main polls this instead of always
+        sleeping out the full ``ENGINE_SHUTDOWN_DRAIN_S`` window)."""
+        if self.batcher is not None:
+            b = self.batcher.snapshot()
+            if b.get("inflight_dispatches", 0):
+                return False
+            if any(v.get("requests", 0) for v in b.get("buckets", {}).values()):
+                return False
+        if self.genserver is not None:
+            g = self.genserver.snapshot()
+            if g.get("inflight_sequences", 0) or g.get("waiting_sequences", 0):
+                return False
+        return True
 
     # -- state persistence handoff --------------------------------------
 

@@ -239,6 +239,37 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
         flush=True,
     )
 
+    # engine liveness lease: when a shared gateway state file and an
+    # advertise URL are configured, heartbeat this replica's row (with
+    # its boot_id epoch) so gateway balancers learn about a dead or
+    # restarted engine within one lease TTL instead of waiting out
+    # 3 failed scrapes (gateway/balancer.py ReplicaSet.apply_leases)
+    lease_store = None
+    advertise_url = os.environ.get("ENGINE_ADVERTISE_URL", "").strip()
+    state_path = os.environ.get("GATEWAY_STATE_PATH", "").strip()
+    heartbeat_task = None
+    if advertise_url and state_path:
+        from seldon_core_tpu.gateway.federation import lease_ttl_s
+        from seldon_core_tpu.gateway.state import SqliteDeploymentStore
+
+        lease_store = SqliteDeploymentStore(state_path)
+        lease_ttl = lease_ttl_s()
+
+        async def _heartbeat_loop():
+            while True:
+                try:
+                    lease_store.heartbeat_engine(
+                        advertise_url, engine.boot_id, lease_ttl)
+                except Exception as e:  # noqa: BLE001 — a wedged store
+                    # must not kill the engine; the lease just lapses
+                    print(f"engine lease heartbeat failed: {e}", flush=True)
+                await asyncio.sleep(max(lease_ttl / 3.0, 0.05))
+
+        heartbeat_task = asyncio.get_running_loop().create_task(
+            _heartbeat_loop())
+        print(f"engine lease: heartbeating {advertise_url} "
+              f"(ttl {lease_ttl:.1f}s) into {state_path}", flush=True)
+
     # graceful shutdown: SIGTERM/SIGINT flips readiness and drains before
     # exit — the reference's Tomcat drain (App.java:85-95, 20 s) + pre-stop
     # pause contract, built into the process itself
@@ -262,16 +293,38 @@ async def serve(deployment: SeldonDeploymentSpec, predictor_name=None,
     await stop.wait()
     drain_s = float(os.environ.get("ENGINE_SHUTDOWN_DRAIN_S", "20"))
     print(
-        f"engine draining: {drain_s:.0f}s (readiness now 503; "
+        f"engine draining: up to {drain_s:.0f}s (readiness now 503; "
         f"signal again to skip)",
         flush=True,
     )
     engine.pause()  # /ready -> 503; the LB stops routing here
-    try:
-        await asyncio.wait_for(hurry.wait(), drain_s)
+    if lease_store is not None:
+        # deregister FIRST: balancers mark this replica dead (lease row
+        # gone while it previously had one) before the drain even starts,
+        # so no new work is routed at a draining engine
+        if heartbeat_task is not None:
+            heartbeat_task.cancel()
+        try:
+            lease_store.drop_engine(advertise_url)
+        except Exception:  # noqa: BLE001 — best effort on the way out
+            pass
+    # poll-drain: exit the moment the last inflight request/sequence
+    # finishes instead of always sleeping out the full window (a 20 s
+    # fixed sleep was the old behavior — rolling restarts paid it even
+    # on an idle engine)
+    deadline = loop.time() + drain_s
+    while loop.time() < deadline and not hurry.is_set():
+        if engine.drained():
+            print("engine drained early "
+                  f"({drain_s - (deadline - loop.time()):.1f}s)", flush=True)
+            break
+        try:
+            await asyncio.wait_for(
+                hurry.wait(), min(0.1, max(deadline - loop.time(), 0.01)))
+        except asyncio.TimeoutError:
+            pass
+    if hurry.is_set():
         print("drain skipped by second signal", flush=True)
-    except asyncio.TimeoutError:
-        pass  # full drain window elapsed
     await grpc_stop()
     if runner is not None:
         await runner.cleanup()

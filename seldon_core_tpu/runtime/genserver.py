@@ -608,17 +608,42 @@ class GenServer:
                                width, e)
         return count
 
+    _LEDGER_STATES = {_Sequence.WAITING: "waiting",
+                      _Sequence.PREFILL: "prefill",
+                      _Sequence.RUNNING: "running",
+                      _Sequence.DONE: "done"}
+
     def snapshot(self) -> Dict[str, Any]:
         alloc = self._allocator
+        now = time.time()
         with self._lock:
             waiting = len(self._waiting) + len(self._arrivals)
             inflight = len(self._active) + len(self._prefilling)
             tiers: Dict[str, int] = {}
+            ledger: List[Dict[str, Any]] = []
             for coll in (self._waiting, self._arrivals,
                          self._prefilling, self._active):
                 for s in coll:
                     t = s.request.tier
                     tiers[t] = tiers.get(t, 0) + 1
+                    # the sequence ledger: enough per-sequence progress
+                    # (prompt length, tokens emitted so far, remaining
+                    # budget) for an operator — or a failover peer doing
+                    # re-prefill resume — to reconstruct where a killed
+                    # replica's streams stood.  The gateway's own resume
+                    # path keeps the emitted tokens client-side; this is
+                    # the server-side journal of the same truth.
+                    ledger.append({
+                        "sid": s.sid,
+                        "tier": t,
+                        "state": self._LEDGER_STATES.get(s.state, "?"),
+                        "prompt_len": int(s.prompt0.shape[-1]),
+                        "emitted": len(s.emitted),
+                        "max_new": s.max_new,
+                        "streaming": s.request.chunk is not None,
+                        "age_s": round(now - s.t_start, 3)
+                        if s.t_start else None,
+                    })
         doc = {
             "mode": "speculative" if self.spec else "decode",
             # disaggregated serving mesh: this replica's generation role
@@ -649,6 +674,7 @@ class GenServer:
             "steps_total": dict(self.steps_total),
             "tokens_emitted_total": self.tokens_emitted_total,
             "tick_errors_total": self.tick_errors_total,
+            "sequence_ledger": ledger,
         }
         if self.spec:
             dalloc = self._draft_allocator

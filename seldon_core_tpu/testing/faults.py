@@ -40,7 +40,9 @@ __all__ = [
     "FaultyNodeRuntime",
     "FaultyEngine",
     "InjectedFault",
+    "PartitionedStore",
     "drive_tenant",
+    "kill_engine",
 ]
 
 
@@ -300,3 +302,104 @@ async def drive_tenant(
 
     await asyncio.gather(*(one() for _ in range(int(n))))
     return latencies, outcomes
+
+
+def kill_engine(proc, sig: Optional[int] = None) -> None:
+    """Kill an engine subprocess mid-request / mid-stream.
+
+    ``proc`` is anything with ``.pid`` (``subprocess.Popen``,
+    ``asyncio.subprocess.Process``); ``sig`` defaults to SIGKILL — the
+    interesting case, since SIGTERM triggers the engine's own graceful
+    drain and the mesh never sees an abrupt death.  The call returns
+    immediately (no wait); chaos harnesses assert on the FLEET's
+    recovery, not the corpse's exit code."""
+    import os as _os
+    import signal as _signal
+
+    if sig is None:
+        sig = getattr(_signal, "SIGKILL", _signal.SIGTERM)
+    _os.kill(proc.pid, sig)
+
+
+class PartitionedStore:
+    """A deployment-store wrapper that partitions / lags sqlite traffic,
+    deterministically scriptable — the chaos harness for the federation
+    layer (a coordinator whose store vanishes must demote itself and keep
+    serving ingress; see gateway/federation.py).
+
+    Modes, settable at any time mid-test:
+
+    * ``store.partition()``       — every call raises ``InjectedFault``
+    * ``store.heal()``            — calls pass through again
+    * ``store.lag(seconds)``      — every call sleeps first (sync sleep:
+      the store API is sync; callers on an event loop feel it as a stall,
+      which is exactly what a slow disk does to them)
+    * ``store.fail_next(n)``      — the next ``n`` calls raise, then heal
+      (deterministic flap, no RNG involved)
+
+    Reads and writes can be partitioned independently via
+    ``partition(reads=..., writes=...)`` — a read-only partition models a
+    replica that can renew its lease (write) but not list peers, and vice
+    versa.  Method classification: anything starting with a mutating verb
+    is a write, the rest are reads (``_WRITE_PREFIXES``)."""
+
+    _WRITE_PREFIXES = ("set_", "register", "unregister", "issue_",
+                       "acquire_", "release_", "heartbeat_", "drop_",
+                       "fenced_", "delete", "revoke")
+
+    def __init__(self, inner):
+        self.inner = inner
+        self._read_down = False
+        self._write_down = False
+        self._lag_s = 0.0
+        self._fail_next = 0
+        self.calls: Dict[str, int] = {}
+        self.faults_injected = 0
+
+    # -- the control surface (test-side) ---------------------------------
+
+    def partition(self, *, reads: bool = True, writes: bool = True) -> None:
+        self._read_down = bool(reads)
+        self._write_down = bool(writes)
+
+    def heal(self) -> None:
+        self._read_down = self._write_down = False
+        self._lag_s = 0.0
+        self._fail_next = 0
+
+    def lag(self, seconds: float) -> None:
+        self._lag_s = max(float(seconds), 0.0)
+
+    def fail_next(self, n: int = 1) -> None:
+        self._fail_next = max(int(n), 0)
+
+    # -- the data path (system-under-test side) --------------------------
+
+    def _gate(self, name: str) -> None:
+        self.calls[name] = self.calls.get(name, 0) + 1
+        if self._lag_s:
+            import time as _time
+
+            _time.sleep(self._lag_s)
+        if self._fail_next > 0:
+            self._fail_next -= 1
+            self.faults_injected += 1
+            raise InjectedFault(f"store fault injected on {name}")
+        is_write = name.startswith(self._WRITE_PREFIXES)
+        if (is_write and self._write_down) or \
+                (not is_write and self._read_down):
+            self.faults_injected += 1
+            raise InjectedFault(
+                f"store partitioned ({'write' if is_write else 'read'} "
+                f"path down) on {name}")
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if not callable(attr) or name.startswith("_"):
+            return attr
+
+        def gated(*args, **kwargs):
+            self._gate(name)
+            return attr(*args, **kwargs)
+
+        return gated

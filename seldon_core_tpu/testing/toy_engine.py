@@ -1,0 +1,124 @@
+"""A minimal, killable engine process for mesh-kill chaos drills.
+
+Real engines (runtime/engine_main.py) need a compiled graph and a JAX
+platform; chaos drills need the opposite — a process cheap enough to
+spawn per test that speaks just enough of the engine wire surface to
+carry live traffic, and that can be SIGKILLed mid-stream without
+ceremony (testing/faults.py ``kill_engine``).  It serves:
+
+  POST /api/v0.1/predictions       unary SUCCESS echo
+  POST /api/v0.1/generate/stream   SSE token stream continuing the
+                                   arithmetic run of the prompt: token
+                                   k is ``prompt[-1] + k``.  A resumed
+                                   stream (prompt = original + emitted
+                                   so far, reduced ``max_new`` — the
+                                   gateway's re-prefill contract)
+                                   therefore continues the SAME run, so
+                                   a client can verify exactly-once
+                                   cumulative output across a failover
+                                   by checking for one consecutive
+                                   sequence.
+  GET  /stats                      {"boot_id": ...} for restart-epoch
+                                   detection in the balancer scrape
+
+With ``ENGINE_ADVERTISE_URL`` + ``GATEWAY_STATE_PATH`` set it
+heartbeats an engine liveness lease through the shared sqlite store
+exactly like engine_main does — a SIGKILL lapses the lease and the
+balancer marks the corpse dead within one TTL.
+
+    python -m seldon_core_tpu.testing.toy_engine --port 8000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import secrets
+
+__all__ = ["main", "serve"]
+
+
+async def serve(port: int, token_sleep_s: float = 0.05,
+                host: str = "127.0.0.1") -> None:
+    from aiohttp import web
+
+    boot_id = secrets.token_hex(8)
+
+    async def predictions(request):
+        if request.content_type == "application/x-seldon-tensor":
+            # decline the binary wire lane like an engine build without
+            # it: the gateway negotiates down to JSON permanently
+            return web.Response(status=415, text="json only")
+        await request.read()  # drain
+        return web.json_response({"meta": {}, "data": {"ndarray": [[0.5]]}})
+
+    async def generate_stream(request):
+        doc = json.loads(await request.text())
+        prompt = doc["data"]["ndarray"][0]
+        max_new = max(1, int(doc.get("max_new", 4)))
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        last = float(prompt[-1])
+        for k in range(1, max_new + 1):
+            await asyncio.sleep(token_sleep_s)
+            await resp.write(
+                b'data: {"tokens": [[%s]]}\n\n'
+                % repr(last + k).encode())
+        await resp.write(b'data: {"done": true}\n\n')
+        return resp
+
+    async def stats(request):
+        return web.json_response({"boot_id": boot_id, "toy": True})
+
+    app = web.Application()
+    app.router.add_post("/api/v0.1/predictions", predictions)
+    app.router.add_post("/api/v0.1/generate/stream", generate_stream)
+    app.router.add_get("/stats", stats)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, host, port).start()
+    print(f"toy-engine listening :{port} boot={boot_id}", flush=True)
+
+    heartbeat_task = None
+    advertise = os.environ.get("ENGINE_ADVERTISE_URL", "").strip()
+    state_path = os.environ.get("GATEWAY_STATE_PATH", "").strip()
+    if advertise and state_path:
+        from seldon_core_tpu.gateway.federation import lease_ttl_s
+        from seldon_core_tpu.gateway.state import SqliteDeploymentStore
+
+        store = SqliteDeploymentStore(state_path)
+        ttl = lease_ttl_s()
+
+        async def _heartbeat() -> None:
+            while True:
+                try:
+                    store.heartbeat_engine(advertise, boot_id, ttl)
+                except Exception as e:  # noqa: BLE001 — liveness is best-effort
+                    print(f"toy-engine heartbeat failed: {e}", flush=True)
+                await asyncio.sleep(ttl / 3.0)
+
+        heartbeat_task = asyncio.get_running_loop().create_task(_heartbeat())
+
+    try:
+        await asyncio.Event().wait()  # serve until killed — that's the point
+    finally:
+        if heartbeat_task is not None:
+            heartbeat_task.cancel()
+        await runner.cleanup()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="toy engine for mesh-kill chaos drills")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--token-sleep-s", type=float, default=0.05)
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args(argv)
+    asyncio.run(serve(args.port, args.token_sleep_s, args.host))
+
+
+if __name__ == "__main__":
+    main()

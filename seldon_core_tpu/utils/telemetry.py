@@ -216,6 +216,16 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_fleet_outlier_ratio": ("gauge", ("set", "replica")),
     "seldon_tpu_fleet_replicas": ("gauge", ("set",)),
     "seldon_tpu_fleet_staleness_seconds": ("gauge", ("set", "replica")),
+    # mesh fault recovery (gateway/federation.py + apife.py failover
+    # paths): work re-homed after a process death — kind=unary (hedged
+    # re-dispatch of an idempotent predict to a peer replica) or
+    # kind=stream (an SSE decode stream resumed on a peer by re-prefill)
+    # — and coordinator/engine lease tenure changes by kind (acquired /
+    # lost / released / store_error).  A lease_transitions spike reads
+    # "the fleet is re-electing"; failover_total says the recovery
+    # machinery actually fired
+    "seldon_tpu_failover_total": ("counter", ("kind",)),
+    "seldon_tpu_lease_transitions_total": ("counter", ("kind",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -398,6 +408,10 @@ class FlightRecorder:
         # worst worse-than-median ratio + replica counts per set
         self.fleet_outliers: Dict[str, Dict[str, float]] = {}
         self.fleet_replicas: Dict[str, int] = {}
+        # mesh fault recovery (gateway/federation.py coordinator
+        # election + apife.py hedged-unary / stream-resume paths)
+        self.failovers: Dict[str, int] = {}            # kind -> n
+        self.lease_transitions: Dict[str, int] = {}    # kind -> n
         # traffic-lifecycle mirrors (gateway/shadow.py mirror outcomes +
         # divergence, operator/rollouts.py rollbacks and stage weights)
         self.shadow_requests: Dict[str, int] = {}      # outcome -> n
@@ -732,6 +746,19 @@ class FlightRecorder:
                 "Age of one replica's scraped fleet documents at the "
                 "last rollup (how far behind the /fleet view may be)",
                 ["set", "replica"], registry=self.registry)
+            self._p_failovers = Counter(
+                "seldon_tpu_failover_total",
+                "Inflight work re-homed after a process death: "
+                "kind=unary (idempotent predict hedge-re-dispatched to "
+                "a peer replica) or kind=stream (SSE decode stream "
+                "resumed on a peer by re-prefill — gateway/apife.py)",
+                ["kind"], registry=self.registry)
+            self._p_lease_transitions = Counter(
+                "seldon_tpu_lease_transitions_total",
+                "Coordinator-lease tenure changes observed by this "
+                "gateway replica (acquired / lost / released / "
+                "store_error — gateway/federation.py)",
+                ["kind"], registry=self.registry)
             self._p_lane_requests = Counter(
                 "seldon_tpu_relay_lane_requests_total",
                 "Gateway->engine dispatches by relay lane "
@@ -1114,6 +1141,26 @@ class FlightRecorder:
             self.shadow_disagreement.observe(float(disagreement))
             if self.registry is not None:
                 self._p_shadow_disagreement.observe(float(disagreement))
+
+    def record_failover(self, kind: str) -> None:
+        """One piece of inflight work re-homed after a process death
+        (kind=unary|stream) — bumped by the gateway's recovery paths,
+        never on the happy path."""
+        self._gen += 1
+        with self._lock:
+            self.failovers[kind] = self.failovers.get(kind, 0) + 1
+        if self.registry is not None:
+            self._p_failovers.labels(kind=kind).inc()
+
+    def record_lease_transition(self, kind: str) -> None:
+        """One coordinator/engine lease tenure change as seen by this
+        process (acquired / lost / released / store_error)."""
+        self._gen += 1
+        with self._lock:
+            self.lease_transitions[kind] = (
+                self.lease_transitions.get(kind, 0) + 1)
+        if self.registry is not None:
+            self._p_lease_transitions.labels(kind=kind).inc()
 
     def record_rollback(self, reason: str) -> None:
         self._gen += 1
@@ -1539,6 +1586,8 @@ class FlightRecorder:
                 "fleet_outliers": {
                     s: dict(d) for s, d in self.fleet_outliers.items()
                 },
+                "failovers": dict(self.failovers),
+                "lease_transitions": dict(self.lease_transitions),
             }
             wire = {
                 "requests": dict(self.wire_requests),
@@ -1715,6 +1764,8 @@ class FlightRecorder:
             self.wire_coalesced = 0
             self.fleet_outliers = {}
             self.fleet_replicas = {}
+            self.failovers = {}
+            self.lease_transitions = {}
             self.shadow_requests = {}
             self.shadow_disagreement = Reservoir()
             self.shadow_latency = Reservoir()

@@ -1,0 +1,656 @@
+"""Federated gateway tier + inflight-work recovery (gateway/federation.py,
+gateway/state.py leases, gateway/balancer.py lease liveness, apife's
+hedged unary re-dispatch and SSE stream failover).
+
+The properties pinned here are the mesh's crash contract: any process is
+killable under load without user-visible failure —
+
+  * the shared sqlite store serializes concurrent replicas (IMMEDIATE
+    transactions + SQLITE_BUSY retry): no lost updates, monotone revision;
+  * the coordinator lease fails over within one TTL, and a paused-then-
+    resumed ex-coordinator's writes are REJECTED by the fencing token
+    inside the store's own transaction (the Chubby-style fence);
+  * a rollout controller survives the handoff: the successor continues,
+    the zombie's split write dies as a typed "fenced" decision;
+  * a dead engine's inflight unary re-dispatches to a peer (zero failed
+    unary), and a live SSE stream re-homes mid-generation via re-prefill
+    (prompt + emitted-so-far) instead of 502ing;
+  * ``SELDON_TPU_FEDERATION=0`` restores fail-to-caller bit-for-bit.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.gateway.apife import ApiGateway, DeploymentStore
+from seldon_core_tpu.gateway.balancer import ReplicaSet
+from seldon_core_tpu.gateway.federation import (
+    COORDINATOR_LEASE,
+    GatewayFederation,
+)
+from seldon_core_tpu.gateway.state import SqliteDeploymentStore, StaleFenceError
+from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+from seldon_core_tpu.messages import SeldonMessage
+from seldon_core_tpu.operator.rollouts import (
+    RolloutController,
+    RolloutGates,
+    RolloutPlan,
+)
+from seldon_core_tpu.testing.faults import InjectedFault, PartitionedStore
+
+
+def canary_spec(name="dep", key="key"):
+    def predictor(pname, reps):
+        return {"name": pname, "replicas": reps,
+                "graph": {"name": "m", "type": "MODEL",
+                          "implementation": "SIMPLE_MODEL"}}
+    return SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": name, "oauth_key": key, "oauth_secret": "s",
+            "predictors": [predictor("baseline", 9),
+                           predictor("candidate", 1)],
+        }
+    })
+
+
+@pytest.fixture()
+def db_path(tmp_path):
+    return str(tmp_path / "gateway.db")
+
+
+async def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _weights(store, key="key"):
+    reg = store._registration(key)
+    return {name: w for name, w, _ in reg.engines}
+
+
+# ---------------------------------------------------------------------------
+# shared-store concurrency: no lost updates, monotone revision
+# ---------------------------------------------------------------------------
+
+
+def test_two_store_instances_concurrent_writes_no_lost_updates(db_path):
+    """Two store instances (two gateway replicas) hammering the same file
+    with interleaved registrations + weight shifts: every write lands
+    (revision advances exactly once per bump-carrying write) and no
+    writer ever sees a raw SQLITE_BUSY."""
+    a = SqliteDeploymentStore(db_path)
+    b = SqliteDeploymentStore(db_path)
+    a.register(canary_spec(), {"baseline": "http://b:8000",
+                               "candidate": "http://c:8000"})
+    base = a.revision()
+    n, errors = 40, []
+
+    def worker(store, flip):
+        try:
+            for i in range(n):
+                pct = (i * 7) % 101 if flip else (100 - (i * 3) % 101)
+                store.set_weights("dep", {"candidate": pct,
+                                          "baseline": 100 - pct})
+        except Exception as e:  # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(a, True)),
+               threading.Thread(target=worker, args=(b, False))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, f"writers surfaced: {errors[:3]}"
+    # every set_weights bumps revision inside ITS OWN transaction: two
+    # replicas x n writes = exactly 2n bumps — a lost update would skip
+    assert a.revision() == base + 2 * n
+    w = _weights(a)
+    assert w["candidate"] + w["baseline"] == 100
+
+
+def test_busy_writer_retries_instead_of_raising(db_path):
+    """A sibling replica holding the write lock past busy_timeout makes
+    BEGIN IMMEDIATE fail SQLITE_BUSY — the _write retry loop must absorb
+    it, not surface an OperationalError."""
+    import sqlite3
+
+    a = SqliteDeploymentStore(db_path)
+    a.register(canary_spec(), {"baseline": "http://b:8000",
+                               "candidate": "http://c:8000"})
+    held = threading.Event()
+
+    def hold_lock():
+        # a rogue connection holding the write lock, released only after
+        # busy_timeout has certainly elapsed — inside the retry window
+        rogue = sqlite3.connect(db_path, isolation_level=None)
+        rogue.execute("BEGIN IMMEDIATE")
+        held.set()
+        time.sleep(0.35)
+        rogue.execute("COMMIT")
+        rogue.close()
+
+    t = threading.Thread(target=hold_lock)
+    t.start()
+    held.wait()
+    a.set_weights("dep", {"candidate": 50, "baseline": 50})  # must not raise
+    t.join()
+    assert _weights(a)["candidate"] == 50
+
+
+# ---------------------------------------------------------------------------
+# coordinator lease + fencing
+# ---------------------------------------------------------------------------
+
+
+def test_lease_acquire_renew_takeover_token_semantics(db_path):
+    s = SqliteDeploymentStore(db_path)
+    assert s.acquire_lease("coord", "A", ttl_s=0.3) == 1
+    # renewal by the live holder keeps the token
+    assert s.acquire_lease("coord", "A", ttl_s=0.3) == 1
+    # a live lease blocks other claimants
+    assert s.acquire_lease("coord", "B", ttl_s=0.3) is None
+    time.sleep(0.35)
+    # expired: takeover bumps the token
+    assert s.acquire_lease("coord", "B", ttl_s=0.3) == 2
+    time.sleep(0.35)
+    # even the SAME holder name re-claiming an expired lease bumps — a
+    # restarted process must not inherit its dead predecessor's fence
+    assert s.acquire_lease("coord", "B", ttl_s=0.3) == 3
+
+
+def test_release_lease_is_conditional(db_path):
+    s = SqliteDeploymentStore(db_path)
+    s.acquire_lease("coord", "A", ttl_s=5.0)
+    s.release_lease("coord", "A", token=99)  # wrong token: no-op
+    assert s.lease("coord")["holder"] == "A"
+    s.release_lease("coord", "A", token=1)
+    assert s.lease("coord") is None
+
+
+def test_paused_ex_coordinator_write_rejected_by_fence(db_path):
+    """The tentpole fencing property: a coordinator paused past its TTL
+    (GC stall, SIGSTOP) resumes and writes with its old token — the
+    store rejects it inside the same transaction; the new coordinator's
+    write with the current token lands."""
+    s = SqliteDeploymentStore(db_path)
+    s.register(canary_spec(), {"baseline": "http://b:8000",
+                               "candidate": "http://c:8000"})
+    old = s.acquire_lease("coord", "A", ttl_s=0.25)
+    time.sleep(0.3)  # A is "paused" past its TTL
+    new = s.acquire_lease("coord", "B", ttl_s=5.0)
+    assert new == old + 1
+    with pytest.raises(StaleFenceError):
+        s.fenced_set_weights("dep", {"candidate": 90, "baseline": 10},
+                             lease="coord", holder="A", token=old)
+    assert _weights(s)["candidate"] == 1  # the zombie write never landed
+    s.fenced_set_weights("dep", {"candidate": 25, "baseline": 75},
+                         lease="coord", holder="B", token=new)
+    assert _weights(s)["candidate"] == 25
+
+
+def test_federation_election_and_failover_within_one_ttl(db_path):
+    store_a = SqliteDeploymentStore(db_path)
+    store_b = SqliteDeploymentStore(db_path)
+    fed_a = GatewayFederation(store_a, "gw-a", ttl_s=0.3,
+                              base_url="http://a:8080")
+    fed_b = GatewayFederation(store_b, "gw-b", ttl_s=0.3,
+                              base_url="http://b:8080")
+    assert fed_a.tick() is True
+    assert fed_b.tick() is False
+    assert fed_a.is_coordinator and not fed_b.is_coordinator
+    # the peer directory sees both replicas either way
+    assert fed_a.peers() == [("gw-b", "http://b:8080")]
+    # gw-a dies (stops ticking); gw-b takes over within one TTL
+    deadline = time.time() + 0.3 + 0.2
+    while not fed_b.tick() and time.time() < deadline:
+        time.sleep(0.05)
+    assert fed_b.is_coordinator, "failover exceeded one lease TTL"
+    assert fed_b.fencing_token == fed_a.fencing_token + 1
+
+
+def test_federation_demotes_on_store_error_and_recovers(db_path):
+    inner = SqliteDeploymentStore(db_path)
+    store = PartitionedStore(inner)
+    fed = GatewayFederation(store, "gw-a", ttl_s=5.0)
+    assert fed.tick() is True
+    store.partition()
+    # tenure can't be proven against a dead store: demote, keep serving
+    assert fed.tick() is False
+    assert not fed.is_coordinator
+    assert "InjectedFault" in fed.snapshot().get("store_error", "")
+    store.heal()
+    assert fed.tick() is True
+
+
+def test_kill_switch_makes_every_replica_coordinator(db_path, monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_FEDERATION", "0")
+    fed = GatewayFederation(SqliteDeploymentStore(db_path), "gw-a")
+    assert not fed.enabled
+    assert fed.tick() is True and fed.is_coordinator
+    # in-memory stores have no lease API: same degradation
+    monkeypatch.delenv("SELDON_TPU_FEDERATION")
+    fed2 = GatewayFederation(DeploymentStore(), "gw-b")
+    assert not fed2.enabled and fed2.is_coordinator
+
+
+# ---------------------------------------------------------------------------
+# rollout controller: singleton duty surviving the handoff
+# ---------------------------------------------------------------------------
+
+
+def _fast_plan():
+    return RolloutPlan(
+        deployment="dep", candidate="candidate", baseline="baseline",
+        stages=(10, 50, 100), hold_s=0.0,
+        gates=RolloutGates(min_requests=0, max_drift=None,
+                           max_burn_rate=None, max_error_rate=None,
+                           max_shadow_disagreement=None),
+        config_hash="h1",
+    )
+
+
+def test_rollout_controller_survives_coordinator_handoff(db_path):
+    """Replica A's controller starts the rollout; A stalls past its TTL;
+    replica B's controller continues the SAME rollout off the shared
+    store, and A's zombie tick dies as a typed 'fenced' decision instead
+    of clobbering B's split."""
+    store_a = SqliteDeploymentStore(db_path)
+    store_b = SqliteDeploymentStore(db_path)
+    store_a.register(canary_spec(), {"baseline": "http://b:8000",
+                                     "candidate": "http://c:8000"})
+    fed_a = GatewayFederation(store_a, "gw-a", ttl_s=0.25)
+    fed_b = GatewayFederation(store_b, "gw-b", ttl_s=0.25)
+    signals = lambda plan: {"requests": 1000, "errors": 0}  # noqa: E731
+    ctl_a = RolloutController(store_a, signals, federation=fed_a)
+    ctl_b = RolloutController(store_b, signals, federation=fed_b)
+    ctl_a.apply(_fast_plan())
+    ctl_b.apply(_fast_plan())
+
+    fed_a.tick()
+    assert fed_b.tick() is False
+    [d] = ctl_a.tick()
+    assert d["decision"] == "advance" and d["percent"] == 10
+    assert _weights(store_b)["candidate"] == 10
+    # B is NOT coordinator: its controller must not tick at all
+    assert ctl_b.tick() == []
+
+    time.sleep(0.3)  # A stalls past its TTL (never ticks its federation)
+    assert fed_b.tick() is True
+    # the successor RESUMES at the predecessor's stage off the shared
+    # store's live split, then continues — never snaps back to stage 0
+    [d] = ctl_b.tick()
+    assert d["decision"] == "resume" and d["percent"] == 10
+    [d] = ctl_b.tick()
+    assert d["decision"] == "advance" and d["percent"] == 50
+    assert _weights(store_a)["candidate"] == 50
+
+    # the zombie: A still believes in its stale token -> fenced decision,
+    # split untouched
+    assert fed_a.is_coordinator  # stale local view, by construction
+    [d] = ctl_a.tick()
+    assert d["decision"] == "fenced"
+    assert _weights(store_a)["candidate"] == 50
+
+
+# ---------------------------------------------------------------------------
+# engine liveness leases -> balancer
+# ---------------------------------------------------------------------------
+
+
+def test_apply_leases_marks_lapsed_dead_and_resets_on_boot_id(db_path):
+    s = SqliteDeploymentStore(db_path)
+    rs = ReplicaSet(["http://a:1", "http://b:1"])
+    a, b = rs.endpoints
+    # never-leased endpoints keep scrape-based health untouched
+    rs.apply_leases(s.engine_leases())
+    assert a.lease_state is None and not a.degraded(time.monotonic(), 10.0)
+
+    s.heartbeat_engine("http://a:1", "boot-1", ttl_s=0.25)
+    rs.apply_leases(s.engine_leases())
+    assert a.lease_state == "live" and a.boot_id == "boot-1"
+    assert b.lease_state is None
+
+    # a poisoned EWMA from the dead epoch must not outlive the process
+    a.ewma_ms = 500.0
+    a.consec_failures = 2
+    time.sleep(0.3)  # lease lapses: the engine is dead
+    rs.apply_leases(s.engine_leases())
+    assert a.lease_state == "dead"
+    assert a.degraded(time.monotonic(), 10.0)
+
+    # same URL, new boot_id: restarted process — state resets, liveness
+    # returns, the stale EWMA/failure streak dies with the old epoch
+    s.heartbeat_engine("http://a:1", "boot-2", ttl_s=5.0)
+    rs.apply_leases(s.engine_leases())
+    assert a.lease_state == "live" and a.boot_id == "boot-2"
+    assert a.ewma_ms == 0.0 and a.consec_failures == 0
+    assert a.epoch_resets == 1
+
+    # graceful deregistration: row deleted -> dead immediately
+    s.drop_engine("http://a:1")
+    rs.apply_leases(s.engine_leases())
+    assert a.lease_state == "dead"
+
+
+# ---------------------------------------------------------------------------
+# hedged unary re-dispatch: zero failed unary across an engine death
+# ---------------------------------------------------------------------------
+
+
+def _remote_spec(urls):
+    spec = SeldonDeploymentSpec.from_json_dict({
+        "spec": {
+            "name": "dep", "oauth_key": "key", "oauth_secret": "s",
+            "predictors": [
+                {"name": "p", "replicas": 1,
+                 "graph": {"name": "m", "type": "MODEL",
+                           "implementation": "SIMPLE_MODEL"}}
+            ],
+        }
+    })
+    store = DeploymentStore()
+    store.register(spec, {"p": urls})
+    return store
+
+
+def test_dead_engine_unary_rehomed_to_peer():
+    """A predict routed at a dead engine re-dispatches to the live peer:
+    the caller sees SUCCESS, the failover counter ticks."""
+    from aiohttp import web
+
+    async def run():
+        async def ok(request):
+            return web.json_response(
+                {"meta": {}, "data": {"ndarray": [[0.5]]}})
+
+        app = web.Application()
+        app.router.add_post("/api/v0.1/predictions", ok)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        live = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", live).start()
+        dead = await _free_port()  # nothing listens here
+
+        store = _remote_spec([f"http://127.0.0.1:{dead}",
+                              f"http://127.0.0.1:{live}"])
+        gw = ApiGateway(store=store, require_auth=False)
+        try:
+            msg = SeldonMessage.from_array(np.zeros((1, 4), np.float64))
+            await gw.predict(msg)  # builds the replica set
+            [(_fp, rs)] = list(gw._replica_sets.values())
+            d = next(ep for ep in rs.endpoints if str(dead) in ep.base_url)
+            h = next(ep for ep in rs.endpoints if str(live) in ep.base_url)
+            # steer the pick at the corpse: the dead replica looks FAST
+            # (it never answered, so nothing poisoned its EWMA) — exactly
+            # the window before fail-degradation kicks in
+            d.ewma_ms, d.consec_failures, d.fail_degraded_until = 0.1, 0, 0.0
+            h.ewma_ms = 1000.0
+            before = gw.failovers.get("unary", 0)
+            resp = await gw.predict(msg)
+            st = resp.status
+            assert st is None or st.status == "SUCCESS", st
+            assert gw.failovers["unary"] == before + 1
+        finally:
+            await gw.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+def test_kill_switch_restores_fail_to_caller(monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_FEDERATION", "0")
+    from aiohttp import web
+
+    async def run():
+        async def ok(request):
+            return web.json_response(
+                {"meta": {}, "data": {"ndarray": [[0.5]]}})
+
+        app = web.Application()
+        app.router.add_post("/api/v0.1/predictions", ok)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        live = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", live).start()
+        dead = await _free_port()
+
+        store = _remote_spec([f"http://127.0.0.1:{dead}",
+                              f"http://127.0.0.1:{live}"])
+        gw = ApiGateway(store=store, require_auth=False)
+        try:
+            msg = SeldonMessage.from_array(np.zeros((1, 4), np.float64))
+            await gw.predict(msg)
+            [(_fp, rs)] = list(gw._replica_sets.values())
+            d = next(ep for ep in rs.endpoints if str(dead) in ep.base_url)
+            h = next(ep for ep in rs.endpoints if str(live) in ep.base_url)
+            d.ewma_ms, d.consec_failures, d.fail_degraded_until = 0.1, 0, 0.0
+            h.ewma_ms = 1000.0
+            resp = await gw.predict(msg)
+            # pre-federation behavior bit-for-bit: the failure surfaces
+            assert resp.status is not None
+            assert resp.status.status == "FAILURE"
+            assert gw.failovers.get("unary", 0) == 0
+        finally:
+            await gw.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# SSE stream failover: resume mid-generation via re-prefill
+# ---------------------------------------------------------------------------
+
+
+def test_stream_rehomed_mid_generation_resumes_via_reprefill():
+    """An engine dies two tokens into a five-token stream.  The gateway
+    re-homes the live SSE stream to the peer with prompt+emitted as the
+    new prompt and the budget reduced by what was served; the client
+    sees tokens 10..14 exactly once plus the terminal event — never a
+    502, never a duplicate."""
+    import aiohttp
+    from aiohttp import web
+
+    from seldon_core_tpu.gateway.apife import make_gateway_app
+    from seldon_core_tpu.runtime.rest import serve_app
+
+    resume_bodies = []
+
+    async def stream_handler(request):
+        doc = json.loads(await request.text())
+        prompt = doc["data"]["ndarray"][0]
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        if len(prompt) == 3:  # the fresh stream: serve 2 tokens, then die
+            await resp.write(b'data: {"tokens": [[10.0]]}\n\n')
+            await resp.write(b'data: {"tokens": [[11.0]]}\n\n')
+            return resp  # abrupt end, no terminal event
+        # the resume: prompt must be original + emitted, budget reduced
+        resume_bodies.append(doc)
+        for tok in prompt[3:]:  # re-emit nothing: continue AFTER emitted
+            pass
+        for t in (12.0, 13.0, 14.0):
+            await resp.write(
+                b'data: {"tokens": [[%.1f]]}\n\n' % t)
+        await resp.write(b'data: {"done": true}\n\n')
+        return resp
+
+    async def make_engine():
+        app = web.Application()
+        app.router.add_post("/api/v0.1/generate/stream", stream_handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        port = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        return runner, port
+
+    async def run():
+        r1, p1 = await make_engine()
+        r2, p2 = await make_engine()
+        store = _remote_spec([f"http://127.0.0.1:{p1}",
+                              f"http://127.0.0.1:{p2}"])
+        gw = ApiGateway(store=store, require_auth=False)
+        gport = await _free_port()
+        grunner = await serve_app(make_gateway_app(gw), "127.0.0.1", gport)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{gport}/api/v0.1/generate/stream",
+                    json={"data": {"ndarray": [[1.0, 2.0, 3.0]]},
+                          "max_new": 5},
+                ) as r:
+                    assert r.status == 200
+                    raw = await r.read()
+            events = [json.loads(e.partition(b"data:")[2])
+                      for e in raw.split(b"\n\n") if e.strip()]
+            toks = [e["tokens"][0][0] for e in events if "tokens" in e]
+            assert toks == [10.0, 11.0, 12.0, 13.0, 14.0]
+            assert any(e.get("done") for e in events)
+            assert not any("error" in e for e in events)
+            assert gw.failovers.get("stream", 0) == 1
+            # the re-prefill contract: prompt + emitted, budget shrunk
+            [body] = resume_bodies
+            assert body["data"]["ndarray"] == [[1.0, 2.0, 3.0, 10.0, 11.0]]
+            assert body["max_new"] == 3
+        finally:
+            await grunner.cleanup()
+            await gw.close()
+            await r1.cleanup()
+            await r2.cleanup()
+
+    asyncio.run(run())
+
+
+def test_stream_kill_switch_keeps_raw_proxy(monkeypatch):
+    """SELDON_TPU_FEDERATION=0: a mid-stream death surfaces as the
+    in-band terminal error event (the pre-federation contract), with no
+    resume attempt reaching the peer."""
+    monkeypatch.setenv("SELDON_TPU_FEDERATION", "0")
+    import aiohttp
+    from aiohttp import web
+
+    from seldon_core_tpu.gateway.apife import make_gateway_app
+    from seldon_core_tpu.runtime.rest import serve_app
+
+    calls = []
+
+    async def stream_handler(request):
+        calls.append(request.remote)
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        await resp.write(b'data: {"tokens": [[10.0]]}\n\n')
+        return resp  # dies without a terminal event
+
+    async def run():
+        app = web.Application()
+        app.router.add_post("/api/v0.1/generate/stream", stream_handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        p1 = await _free_port()
+        await web.TCPSite(runner, "127.0.0.1", p1).start()
+        store = _remote_spec([f"http://127.0.0.1:{p1}"])
+        gw = ApiGateway(store=store, require_auth=False)
+        gport = await _free_port()
+        grunner = await serve_app(make_gateway_app(gw), "127.0.0.1", gport)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://127.0.0.1:{gport}/api/v0.1/generate/stream",
+                    json={"data": {"ndarray": [[1.0, 2.0, 3.0]]},
+                          "max_new": 5},
+                ) as r:
+                    assert r.status == 200
+                    raw = await r.read()
+            assert len(calls) == 1  # no resume attempt
+            assert gw.failovers.get("stream", 0) == 0
+        finally:
+            await grunner.cleanup()
+            await gw.close()
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# fault harness: PartitionedStore semantics
+# ---------------------------------------------------------------------------
+
+
+def test_partitioned_store_read_write_asymmetry(db_path):
+    inner = SqliteDeploymentStore(db_path)
+    store = PartitionedStore(inner)
+    store.heartbeat_engine("http://a:1", "b1", 5.0)  # healthy passthrough
+    assert "http://a:1" in store.engine_leases()
+
+    store.partition(reads=True, writes=False)
+    store.heartbeat_engine("http://a:1", "b1", 5.0)  # writes still up
+    with pytest.raises(InjectedFault):
+        store.engine_leases()
+
+    store.partition(reads=False, writes=True)
+    assert "http://a:1" in store.engine_leases()
+    with pytest.raises(InjectedFault):
+        store.acquire_lease("coord", "A", 1.0)
+
+    store.heal()
+    store.fail_next(2)  # deterministic flap: exactly two calls fail
+    with pytest.raises(InjectedFault):
+        store.engine_leases()
+    with pytest.raises(InjectedFault):
+        store.engine_leases()
+    assert "http://a:1" in store.engine_leases()
+    assert store.faults_injected == 4
+
+
+def test_kill_engine_sends_sigkill():
+    import signal
+    import subprocess
+    import sys
+
+    from seldon_core_tpu.testing.faults import kill_engine
+
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(30)"])
+    kill_engine(proc)
+    assert proc.wait(timeout=5) == -signal.SIGKILL
+
+
+# ---------------------------------------------------------------------------
+# /stats surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_gateway_stats_federation_block(db_path):
+    async def run():
+        store = SqliteDeploymentStore(db_path)
+        store.register(canary_spec(), {"baseline": "http://b:8000",
+                                       "candidate": "http://c:8000"})
+        gw = ApiGateway(store=store, require_auth=False)
+        fed = GatewayFederation(store, "gw-a", ttl_s=5.0,
+                                base_url="http://a:8080")
+        gw.federation = fed
+        fed.tick()
+        try:
+            doc = gw.stats()["federation"]
+            assert doc["replica_id"] == "gw-a"
+            assert doc["coordinator"] is True
+            assert doc["fencing_token"] == 1
+            assert doc["lease"]["holder"] == "gw-a"
+            assert doc["failovers"] == {}
+        finally:
+            fed.resign()
+            await gw.close()
+        assert store.lease(COORDINATOR_LEASE) is None  # resigned cleanly
+
+    asyncio.run(run())

@@ -167,5 +167,9 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_fleet_outlier_ratio",
         "seldon_tpu_fleet_replicas",
         "seldon_tpu_fleet_staleness_seconds",
+        # federated gateway tier + inflight-work recovery
+        # (gateway/federation.py + gateway/apife.py)
+        "seldon_tpu_failover_total",
+        "seldon_tpu_lease_transitions_total",
     ):
         assert family in text, f"{family} missing from every dashboard"
