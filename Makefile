@@ -96,6 +96,15 @@ disagg-demo:
 fleet-demo:
 	JAX_PLATFORMS=cpu python scripts/fleet_demo.py --out fleet_demo
 
+# perf-corpus demo: restart warm-start off the durable dispatch ledger
+# (utils/perfcorpus.py) — a freshly-booted engine must price
+# previously-seen shapes BEFORE its first dispatch (autopilot keys > 0
+# at boot), and the SELDON_TPU_CORPUS=0 arm must boot cold.  Artifact
+# corpus_demo/corpus.json + the GET /corpus page (scripts/corpus_demo.py;
+# docs/operations.md "Fleet-truth burn and the perf corpus")
+corpus-demo:
+	JAX_PLATFORMS=cpu python scripts/corpus_demo.py --out corpus_demo
+
 bench:
 	python bench.py
 
@@ -226,4 +235,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo corpus-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun

@@ -1,0 +1,211 @@
+"""Perf-corpus demo: restart warm-start — proof the durable dispatch
+ledger (utils/perfcorpus.py) lets a freshly-booted engine price shapes
+BEFORE its first dispatch.
+
+Three lives of the "same" engine, all in-process (CPU, no TPU):
+
+  1. first life: a corpus-enabled engine serves mixed-shape traffic,
+     the drainer fold appends one compact row per dispatch, and the
+     segment rotation compacts per-key sketches to disk;
+  2. restart: process state is torn down (autopilot table reset, corpus
+     handle dropped) and a NEW engine boots against the same corpus
+     dir — its constructor warm-starts the autopilot, so the model
+     table must be non-empty and the served key priced BEFORE any
+     request arrives;
+  3. kill-switch restart: same teardown with ``SELDON_TPU_CORPUS=0``
+     — the table must boot cold, pinning that the warmth really came
+     from the corpus.
+
+ASSERTS (exit 1 on failure — the CI lane is non-blocking but the
+artifact says pass/fail loudly):
+
+  * first life appended rows and persisted sketches for >= 2 keys;
+  * the restarted engine has autopilot keys > 0 and warm_keys > 0
+    BEFORE its first dispatch, and predicts the served key within 3x
+    of the first life's measured p50 (history prices the shape);
+  * the kill-switch restart boots with 0 keys.
+
+Artifact:
+
+    <out>/corpus.json       the three lives' counters + pass/fail
+    <out>/corpus_page.json  the GET /corpus document after life 1
+
+Run via ``make corpus-demo``; CI uploads the artifact from a
+non-blocking lane, mirroring ``autopilot-demo``."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+
+# script lives in scripts/ — put the repo root on the path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_FEATURES = 8
+
+
+def deployment() -> dict:
+    return {
+        "spec": {
+            "name": "corpus-demo",
+            "predictors": [{
+                "name": "p",
+                "graph": {"name": "m", "implementation": "SIMPLE_MODEL",
+                          "type": "MODEL"},
+            }],
+        }
+    }
+
+
+def _payloads() -> dict:
+    rng = np.random.default_rng(0)
+    return {
+        r: json.dumps({"data": {
+            "ndarray": rng.normal(size=(r, N_FEATURES)).tolist()
+        }}, separators=(",", ":"))
+        for r in (4, 32)
+    }
+
+
+async def _serve(engine, payloads, n: int) -> None:
+    for i in range(n):
+        _text, status = await engine.predict_json(
+            payloads[32 if i % 2 else 4])
+        assert status == 200, f"predict failed: {status}"
+
+
+async def run_demo(out_dir: str, requests: int) -> dict:
+    from seldon_core_tpu.graph.spec import SeldonDeploymentSpec
+    from seldon_core_tpu.runtime.autopilot import AUTOPILOT
+    from seldon_core_tpu.runtime.engine import EngineService
+    from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.perfcorpus import CORPUS
+
+    os.makedirs(out_dir, exist_ok=True)
+    corpus_dir = os.path.join(os.path.abspath(out_dir), "corpus")
+    os.environ["SELDON_TPU_CORPUS_DIR"] = corpus_dir
+    os.environ.pop("SELDON_TPU_CORPUS", None)
+    CORPUS.reconfigure()
+    AUTOPILOT.reset()
+    payloads = _payloads()
+    spec = SeldonDeploymentSpec.from_json_dict(deployment())
+
+    # -- life 1: corpus-enabled engine serves traffic ---------------------
+    engine = EngineService(spec)
+    await _serve(engine, payloads, requests)
+    SPINE.drain()
+    CORPUS.flush()  # rotation: sketches persisted for the next life
+    page = engine.corpus_document()
+    first_life = {
+        "requests": requests,
+        "corpus_rows": page["rows_total"],
+        "corpus_keys": len(page["keys"]),
+        "disk_bytes": page["disk_bytes"],
+    }
+    # the hottest key and its measured p50: the restart must price it
+    top = page["keys"][0] if page["keys"] else None
+    await engine.close()
+
+    # -- life 2: restart against the same corpus dir ----------------------
+    # process death, simulated: the learned table and the corpus handle
+    # are process state and die with it; the corpus DIR survives
+    AUTOPILOT.reset()
+    CORPUS.reconfigure()
+    engine2 = EngineService(spec)  # constructor warm-starts the autopilot
+    snap = AUTOPILOT.snapshot()    # captured BEFORE any dispatch
+    pred_s = AUTOPILOT.predict_s(top["key"]) if top else None
+    restart = {
+        "keys_before_first_dispatch": snap["keys"],
+        "warm_keys": snap["warm_keys"],
+        "top_key": top["key"] if top else None,
+        "measured_p50_ms": top["p50_ms"] if top else None,
+        "predicted_ms": (round(pred_s * 1e3, 3)
+                         if pred_s is not None else None),
+    }
+    await _serve(engine2, payloads, 2)  # still serves after warm-start
+    await engine2.close()
+
+    # -- life 3: kill-switch restart must boot cold -----------------------
+    SPINE.drain()  # life 2's pending records fold into the OLD table
+    AUTOPILOT.reset()
+    os.environ["SELDON_TPU_CORPUS"] = "0"
+    try:
+        CORPUS.reconfigure()
+        engine3 = EngineService(spec)
+        cold = {"keys_before_first_dispatch": AUTOPILOT.snapshot()["keys"]}
+        await engine3.close()
+    finally:
+        del os.environ["SELDON_TPU_CORPUS"]
+        del os.environ["SELDON_TPU_CORPUS_DIR"]
+        CORPUS.reconfigure()
+        AUTOPILOT.reset()
+
+    warm_ok = (
+        restart["keys_before_first_dispatch"] > 0
+        and restart["warm_keys"] > 0
+        and restart["predicted_ms"] is not None
+        and restart["measured_p50_ms"] is not None
+        and restart["predicted_ms"] <= 3.0 * restart["measured_p50_ms"]
+        and restart["predicted_ms"] >= restart["measured_p50_ms"] / 3.0
+    )
+    doc = {
+        "first_life": first_life,
+        "restart": restart,
+        "kill_switch_restart": cold,
+        "restart_warm_started": warm_ok,
+        "kill_switch_boots_cold": cold["keys_before_first_dispatch"] == 0,
+        "passed": bool(
+            first_life["corpus_rows"] >= requests
+            and first_life["corpus_keys"] >= 2
+            and warm_ok
+            and cold["keys_before_first_dispatch"] == 0
+        ),
+    }
+    with open(os.path.join(out_dir, "corpus.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+    with open(os.path.join(out_dir, "corpus_page.json"), "w") as f:
+        json.dump(page, f, indent=1)
+    return doc
+
+
+def print_table(doc: dict) -> None:
+    fl, rs = doc["first_life"], doc["restart"]
+    print(f"first life: {fl['requests']} requests -> "
+          f"{fl['corpus_rows']} corpus rows, {fl['corpus_keys']} keys, "
+          f"{fl['disk_bytes']} bytes on disk")
+    print(f"restart:    {rs['keys_before_first_dispatch']} autopilot keys "
+          f"({rs['warm_keys']} warm) BEFORE first dispatch")
+    print(f"            top key {rs['top_key']}: measured p50 "
+          f"{rs['measured_p50_ms']} ms, warm prediction "
+          f"{rs['predicted_ms']} ms")
+    cold_keys = doc["kill_switch_restart"]["keys_before_first_dispatch"]
+    print(f"kill switch: {cold_keys} keys (must be 0)")
+    print(f"restart warm-started: {doc['restart_warm_started']}")
+    print(f"kill switch boots cold: {doc['kill_switch_boots_cold']}")
+    print("PASSED" if doc["passed"] else "FAILED")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="corpus_demo")
+    parser.add_argument("--requests", type=int, default=40)
+    args = parser.parse_args(argv)
+    doc = asyncio.run(run_demo(args.out, args.requests))
+    print_table(doc)
+    print(f"\nartifact: {args.out}/corpus.json (docs/operations.md "
+          f"'Fleet-truth burn and the perf corpus')")
+    # skip interpreter finalization: multi-engine boots leave the CPU
+    # backend with joinable native threads whose static destructors
+    # abort the process AFTER all work (and the artifact) completed —
+    # the exit code must report the assertions above, not XLA teardown
+    sys.stdout.flush()
+    os._exit(0 if doc["passed"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -2130,6 +2130,13 @@ def make_gateway_app(gateway: ApiGateway):
                 doc["gateway_peers"] = peer_docs
         return web.json_response(doc)
 
+    async def corpus(_):
+        # fleet-wide perf corpus: every replica's durable per-key
+        # sketches merged into one training substrate (gateway/fleet.py)
+        from seldon_core_tpu.gateway.fleet import corpus_document
+
+        return web.json_response(await corpus_document(gateway))
+
     async def profile_start(request):
         from seldon_core_tpu.gateway.fleet import profile_start as start
 
@@ -2172,6 +2179,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)
     app.router.add_get("/fleet", fleet)
+    app.router.add_get("/corpus", corpus)
     app.router.add_get("/profile", profile_get)
     app.router.add_post("/profile/start", profile_start)
     app.router.add_post("/profile/stop", profile_stop)

@@ -22,11 +22,19 @@ Failure semantics by design:
 
 * ingress never depends on the lease — every replica serves requests the
   whole time, only singleton DUTIES move;
-* QoS token buckets and SLO burn rings are per-replica (a shed decision
-  is latency-critical; sharing them through sqlite would put a disk write
-  on the admission path) — documented in docs/operations.md;
+* QoS token buckets stay per-replica (a shed decision is
+  latency-critical; sharing them through sqlite would put a disk write
+  on the admission path), but SLO burn and throttle/shed ACCOUNTING
+  federates off-path: every tick publishes this replica's window counts
+  into the shared ``burn_deltas`` table and folds every peer's last
+  counts into the process-global fleet-truth view
+  (utils/quality.py ``FLEET_BURN``) that the brownout ladder and
+  rollout burn gates judge — so a 3-replica mesh reacts to the fleet's
+  burn, not a 1/3 slice.  ``SELDON_TPU_FLEET_BURN=0`` kills just this
+  layer (per-replica burn bit-for-bit);
 * a store outage demotes the replica (it cannot prove tenure, so it must
-  not act as coordinator) but keeps serving ingress.
+  not act as coordinator) but keeps serving ingress; the fleet-burn view
+  goes stale and consumers fall back to their local rings.
 
 Kill switch: ``SELDON_TPU_FEDERATION=0`` (or an in-memory store, which
 has no lease API) makes every replica its own coordinator — bit-for-bit
@@ -106,6 +114,12 @@ class GatewayFederation:
         self._store_error: Optional[str] = None
         self._last_tick = 0.0
         self._transitions = 0
+        #: the gateway's TenantGovernor (set by gateway_main / tests) —
+        #: source of the throttle/shed half of the burn delta
+        self.governor = None
+        self._burn_publishes = 0
+        self._burn_folds = 0
+        self._burn_errors = 0
 
     # -- the protocol ------------------------------------------------------
 
@@ -135,7 +149,111 @@ class GatewayFederation:
             RECORDER.record_lease_transition("lost")
             self._transitions += 1
         self._token = token
+        # fleet-truth burn rides the same cadence (ttl/3): publish this
+        # replica's deltas, fold every peer's — EVERY replica folds (the
+        # view feeds local brownout/rollout decisions, not a singleton
+        # duty), so it does not gate on the coordinator lease
+        self._burn_tick()
         return token is not None
+
+    # -- fleet-truth burn (federated SLO/QoS accounting) -------------------
+
+    #: how far back one window's published counts stay credible: a dead
+    #: replica's last delta keeps counting until the window it measured
+    #: has fully aged out — failover cannot amnesia away burned budget
+    _WINDOW_SPANS = {"5m": 300.0, "1h": 3600.0}
+
+    def _burn_tick(self) -> None:
+        """Publish this replica's SLO window counts + QoS throttle/shed
+        totals into the shared ``burn_deltas`` table, then fold EVERY
+        replica's last published counts into the process-global
+        :data:`~seldon_core_tpu.utils.quality.FLEET_BURN` view.  Rides
+        ``tick()`` — off every request path.  No SLO configured means no
+        burn layer (exactly the local tracker's contract); store errors
+        are counted and the stale view degrades consumers to their
+        per-replica rings (fail-closed toward pre-fleet behaviour)."""
+        from seldon_core_tpu.utils.quality import (
+            QUALITY,
+            fleet_burn_enabled,
+        )
+
+        if (not fleet_burn_enabled()
+                or not hasattr(self.store, "publish_burn")
+                or not QUALITY.slo.configured):
+            return
+        try:
+            gov = self.governor
+            tenants_qos = gov.burn_totals() if gov is not None else {}
+            throttled = sum(
+                v["throttled"] for v in tenants_qos.values())
+            shed = sum(v["shed"] for v in tenants_qos.values())
+            rows = []
+            for window, c in QUALITY.slo.window_counts().items():
+                rows.append(("_global", window, c["total"], c["slow"],
+                             c["errors"], throttled, shed))
+            for tenant, wins in QUALITY.tenant_window_counts().items():
+                qos = tenants_qos.get(tenant, {})
+                for window, c in wins.items():
+                    rows.append((tenant, window, c["total"], c["slow"],
+                                 c["errors"], qos.get("throttled", 0),
+                                 qos.get("shed", 0)))
+            self.store.publish_burn(self.replica_id, rows)
+            self._burn_publishes += 1
+            self._burn_fold()
+        except Exception:  # noqa: BLE001 — a sick store already demoted
+            # us above; burn degrades to the per-replica view via
+            # staleness, never by crashing the tick loop
+            self._burn_errors += 1
+
+    def _burn_fold(self) -> None:
+        """Sum every replica's fresh-enough counts per (scope, window)
+        and publish the aggregate — the SAME burn math as the local ring
+        (``SloTracker.burn_entry``) over summed counts, so fleet and
+        local views cannot diverge in formula, only in scope."""
+        from seldon_core_tpu.utils.quality import (
+            FLEET_BURN,
+            QUALITY,
+            SloTracker,
+        )
+
+        now = time.time()
+        agg: dict = {}
+        replicas = set()
+        for r in self.store.burn_rows():
+            span = self._WINDOW_SPANS.get(r["window"], 300.0)
+            if now - r["updated"] > span:
+                continue
+            replicas.add(r["replica_id"])
+            a = agg.setdefault(
+                (r["scope"], r["window"]), [0, 0, 0, 0, 0])
+            a[0] += r["total"]
+            a[1] += r["slow"]
+            a[2] += r["errors"]
+            a[3] += r["throttled"]
+            a[4] += r["shed"]
+        p99_ms = QUALITY.slo.p99_ms
+        error_rate = QUALITY.slo.error_rate
+        windows: dict = {}
+        tenants: dict = {}
+        for (scope, window), a in sorted(agg.items()):
+            entry = SloTracker.burn_entry(
+                a[0], a[1], a[2], p99_ms, error_rate)
+            entry["throttled"] = a[3]
+            entry["shed"] = a[4]
+            if scope == "_global":
+                windows[window] = entry
+            else:
+                tenants.setdefault(scope, {})[window] = entry
+        FLEET_BURN.publish({
+            "replicas": sorted(replicas),
+            "windows": windows,
+            "tenants": tenants,
+            "folded_at": round(now, 3),
+            "folded_by": self.replica_id,
+        })
+        self._burn_folds += 1
+        for window, entry in windows.items():
+            RECORDER.set_fleet_burn(window, entry["burn_rate"])
 
     def resign(self) -> None:
         """Graceful shutdown: hand the lease over NOW instead of making
@@ -220,6 +338,11 @@ class GatewayFederation:
         }
         if self.enabled:
             doc["fencing_token"] = self._token
+            doc["fleet_burn"] = {
+                "publishes": self._burn_publishes,
+                "folds": self._burn_folds,
+                "errors": self._burn_errors,
+            }
             doc["peers"] = [
                 {"replica_id": rid, "url": url} for rid, url in self.peers()
             ]

@@ -56,6 +56,7 @@ __all__ = [
     "federated_trace_document",
     "federated_export_document",
     "fleet_document",
+    "corpus_document",
     "refresh_outlier_gauges",
     "extract_replica_row",
     "compute_outliers",
@@ -615,6 +616,17 @@ async def _source_docs(gateway, src: FleetSource, max_age_s: float
         return {}, float("inf"), (
             "no document surface on the relay lane (uds-only endpoint "
             "— register an http://..+uds:/ spec for fleet rollups)")
+    # a lapsed store lease (gateway/federation.py heartbeats) means the
+    # stashed fleet_docs describe a DEAD process — serving their figures
+    # as a live row would hide the death behind week-old numbers.  The
+    # row says so explicitly and its staleness is pinned to at least the
+    # lease TTL so the staleness gauge reads stale, not fresh
+    if getattr(src.endpoint, "lease_state", None) == "dead":
+        from seldon_core_tpu.gateway.federation import lease_ttl_s
+
+        _s, _p, _q, age = _source_docs_cached(src)
+        return ({"lease": "dead"}, max(age or 0.0, lease_ttl_s()),
+                "engine lease lapsed")
     stats, perf, quality, age = _source_docs_cached(src)
     error = None
     if stats is None or age is None or age > max_age_s:
@@ -635,6 +647,8 @@ async def _source_docs(gateway, src: FleetSource, max_age_s: float
         row.setdefault("ewma_ms", _num(ep.ewma_ms))
         row["picks"] = ep.picks
         row["failures"] = ep.failures
+        if ep.lease_state is not None:
+            row["lease"] = ep.lease_state
     return row, age or 0.0, error
 
 
@@ -668,7 +682,10 @@ async def fleet_document(gateway) -> dict:
     for set_name, dep in deployments.items():
         rows = {
             name: r for name, r in dep["replicas"].items()
-            if "error" not in r or r.get("staleness_s") is not None
+            # a dead-lease row carries no live metrics — feeding its
+            # stale figures to the outlier math would skew the median
+            if r.get("lease") != "dead"
+            and ("error" not in r or r.get("staleness_s") is not None)
         }
         out = compute_outliers(rows, threshold)
         dep.update(out)
@@ -684,10 +701,15 @@ async def fleet_document(gateway) -> dict:
         dep["totals"] = totals
         # publish the gauges from the same rollup the document shows
         _publish_set_gauges(RECORDER, set_name, dep)
+    from seldon_core_tpu.utils.quality import FLEET_BURN
+
     return {
         "enabled": enabled,
         "outlier_threshold": threshold,
         "scrape_interval_s": scrape_interval_s(),
+        # fleet-truth SLO/QoS burn: the aggregate every replica folds
+        # from the shared store's burn_deltas (gateway/federation.py)
+        "burn": FLEET_BURN.snapshot(),
         "deployments": deployments,
     }
 
@@ -703,6 +725,96 @@ def _publish_set_gauges(recorder, set_name: str, dep: dict) -> None:
             recorder.set_fleet_staleness(set_name, replica, st)
 
 
+def _merge_corpus_keys(merged: Dict[str, Dict[str, Any]],
+                       doc: dict) -> int:
+    """Fold one replica's ``/corpus`` key table into the fleet merge:
+    quantiles combine as n-weighted means (each replica's sketch already
+    summarizes its own sample ring — exact fleet quantiles would need
+    the raw walls, which the compact rows deliberately do not carry),
+    tier counts sum, recency takes the max."""
+    folded = 0
+    for row in doc.get("keys") or []:
+        if not isinstance(row, dict):
+            continue
+        key, n = row.get("key"), row.get("n") or 0
+        if not key or n <= 0:
+            continue
+        folded += 1
+        ent = merged.get(key)
+        if ent is None:
+            merged[key] = {**row, "sources": 1}
+            continue
+        total = ent["n"] + n
+        for f in ("p50_ms", "p90_ms", "p99_ms", "spread_ms", "last_ms"):
+            a, b = _num(ent.get(f)), _num(row.get(f))
+            if a is not None and b is not None:
+                ent[f] = round((a * ent["n"] + b * n) / total, 4)
+            elif b is not None:
+                ent[f] = b
+        tiers = dict(ent.get("tiers") or {})
+        for t, c in (row.get("tiers") or {}).items():
+            tiers[t] = tiers.get(t, 0) + (c or 0)
+        ent["tiers"] = tiers
+        ent["n"] = total
+        ent["last_ts"] = max(_num(ent.get("last_ts")) or 0.0,
+                             _num(row.get("last_ts")) or 0.0)
+        ent["sources"] += 1
+    return folded
+
+
+async def corpus_document(gateway) -> dict:
+    """The gateway's ``GET /corpus`` body: every replica's durable perf
+    corpus merged into ONE fleet-wide key table — the training substrate
+    for learned cost models (ROADMAP item 4) assembled across the whole
+    fleet instead of read one process at a time.  In-process engines
+    share the gateway's process-global corpus, so the local document
+    covers them; URL replicas are fetched at query time (read path, never
+    hot); with ``SELDON_TPU_FLEET=0`` the local document stands alone."""
+    from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.perfcorpus import CORPUS
+
+    SPINE.drain()  # in-process engines' pending dispatches land first
+    local = CORPUS.document()
+    merged: Dict[str, Dict[str, Any]] = {}
+    rows_total = int(local.get("rows_total") or 0)
+    reports: List[dict] = [{
+        "source": "gateway", "lane": "local",
+        "keys": _merge_corpus_keys(merged, local), "error": None,
+    }]
+    if fleet_enabled():
+        sources = [s for s in gather_sources(gateway)
+                   if s.lane == "http"]
+
+        async def one(src: FleetSource):
+            try:
+                doc = await _fetch_json(
+                    gateway, src.base_url + "/corpus")
+                return src, doc, None
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - reported per source
+                return src, None, f"{type(e).__name__}: {e}"
+
+        for src, doc, error in await asyncio.gather(
+                *(one(s) for s in sources)):
+            folded = 0
+            if doc is not None:
+                folded = _merge_corpus_keys(merged, doc)
+                rows_total += int(doc.get("rows_total") or 0)
+            reports.append({
+                "source": src.name, "lane": src.lane, "role": src.role,
+                "set": src.set_name, "keys": folded, "error": error,
+            })
+    keys = sorted(merged.values(), key=lambda r: r["n"], reverse=True)
+    return {
+        "federated": fleet_enabled(),
+        "sources": reports,
+        "rows_total": rows_total,
+        "key_count": len(keys),
+        "keys": keys,
+    }
+
+
 def refresh_outlier_gauges(gateway) -> None:
     """Scrape-tick gauge refresh: recompute each URL replica set's
     outlier ratios from the docs the scrape pass just stashed — zero
@@ -713,12 +825,22 @@ def refresh_outlier_gauges(gateway) -> None:
         return
     from seldon_core_tpu.utils.telemetry import RECORDER
 
+    from seldon_core_tpu.gateway.federation import lease_ttl_s
+
     now = time.monotonic()
     for (dep, pred), (_fp, rs) in list(gateway._replica_sets.items()):
         rows: Dict[str, Dict[str, Any]] = {}
         stale: Dict[str, float] = {}
         for ep in rs.endpoints:
             docs = getattr(ep, "fleet_docs", None)
+            if getattr(ep, "lease_state", None) == "dead":
+                # lapsed lease: the stashed docs describe a dead process
+                # — keep it out of the outlier median, but publish a
+                # staleness of at least the lease TTL so the gauge (and
+                # any alert on it) reads stale instead of silently fresh
+                age = (now - docs.get("ts", now)) if docs else 0.0
+                stale[ep.name] = round(max(age, lease_ttl_s()), 3)
+                continue
             if not docs:
                 continue
             row = extract_replica_row(
@@ -726,13 +848,13 @@ def refresh_outlier_gauges(gateway) -> None:
             row.setdefault("ewma_ms", _num(ep.ewma_ms))
             rows[ep.name] = row
             stale[ep.name] = round(now - docs.get("ts", now), 3)
-        if len(rows) < 2:
+        if len(rows) < 2 and not (stale.keys() - rows.keys()):
             continue
         out = compute_outliers(rows)
         _publish_set_gauges(
             RECORDER, f"{dep}/{pred}",
             {"replicas": {n: {"staleness_s": stale.get(n)}
-                          for n in rows},
+                          for n in stale},
              "ratios": out["ratios"]},
         )
 

@@ -213,6 +213,9 @@ async def serve(spec_dir: str = "", host: str = "0.0.0.0") -> None:
     advertise = os.environ.get("GATEWAY_ADVERTISE_URL", "").strip() or \
         f"http://127.0.0.1:{rest_port}"
     federation = GatewayFederation(store, base_url=advertise)
+    # the burn publisher reads this replica's QoS throttle/shed totals
+    # off the gateway's tenant governor (fleet-truth burn accounting)
+    federation.governor = gateway.tenants
     gateway.federation = federation
     fed_stop = asyncio.Event()
     fed_task = None

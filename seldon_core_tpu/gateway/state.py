@@ -73,6 +73,18 @@ CREATE TABLE IF NOT EXISTS gateway_peers (
     base_url TEXT NOT NULL,
     expires REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS burn_deltas (
+    replica_id TEXT NOT NULL,
+    scope TEXT NOT NULL,
+    window TEXT NOT NULL,
+    total INTEGER NOT NULL,
+    slow INTEGER NOT NULL,
+    errors INTEGER NOT NULL,
+    throttled INTEGER NOT NULL,
+    shed INTEGER NOT NULL,
+    updated REAL NOT NULL,
+    PRIMARY KEY (replica_id, scope, window)
+);
 """
 
 #: how many times a write transaction retries when another gateway
@@ -542,6 +554,55 @@ class SqliteDeploymentStore:
                 (now,),
             ).fetchall()
         return [(r[0], r[1]) for r in rows if r[0] != exclude]
+
+    # -- federated SLO/QoS burn deltas (fleet-truth accounting) ------------
+
+    def publish_burn(self, replica_id: str, rows) -> None:
+        """Upsert one replica's burn deltas in ONE write transaction
+        (same BEGIN IMMEDIATE + busy-retry discipline as every other
+        shared-state write).  Each row is ``(scope, window, total, slow,
+        errors, throttled, shed)`` — absolute current-window counts, so
+        a replica's LAST publish stays meaningful after it dies (the
+        fold keeps reading it until the window ages it out: no burn
+        amnesia on failover)."""
+        now = time.time()
+        with self._write() as conn:
+            for scope, window, total, slow, errors, throttled, shed in rows:
+                conn.execute(
+                    "INSERT INTO burn_deltas VALUES "
+                    "(?, ?, ?, ?, ?, ?, ?, ?, ?) "
+                    "ON CONFLICT(replica_id, scope, window) DO UPDATE SET "
+                    "total = excluded.total, slow = excluded.slow, "
+                    "errors = excluded.errors, "
+                    "throttled = excluded.throttled, "
+                    "shed = excluded.shed, updated = excluded.updated",
+                    (replica_id, str(scope), str(window), int(total),
+                     int(slow), int(errors), int(throttled), int(shed),
+                     now),
+                )
+
+    def burn_rows(self, max_age_s: Optional[float] = None) -> List[Dict]:
+        """Every replica's last published deltas (optionally bounded by
+        age) — the fold side of fleet-truth burn.  Dead replicas' rows
+        are INCLUDED by design; the per-window age mask in the fold is
+        what retires them."""
+        now = time.time()
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT replica_id, scope, window, total, slow, errors, "
+                "throttled, shed, updated FROM burn_deltas "
+                "ORDER BY replica_id, scope, window",
+            ).fetchall()
+        out: List[Dict] = []
+        for r in rows:
+            if max_age_s is not None and now - r[8] > max_age_s:
+                continue
+            out.append({
+                "replica_id": r[0], "scope": r[1], "window": r[2],
+                "total": r[3], "slow": r[4], "errors": r[5],
+                "throttled": r[6], "shed": r[7], "updated": r[8],
+            })
+        return out
 
     def close(self) -> None:
         with self._lock:

@@ -576,9 +576,15 @@ class GatewaySignals:
                         pass
         if drifts:
             out["drift"] = max(drifts)
-        slo = QUALITY.slo.burn_rates()
-        if QUALITY.slo.configured and "5m" in slo:
-            out["burn_rate"] = slo["5m"].get("burn_rate")
+        # burn gates judge the fleet-truth aggregate when federation
+        # publishes one, the local ring otherwise — the SAME
+        # effective_burn_rate the brownout ladder reads, so a canary
+        # cannot pass on a 1/N slice of the fleet's burn
+        from seldon_core_tpu.utils.quality import effective_burn_rate
+
+        burn = effective_burn_rate("5m")
+        if burn is not None:
+            out["burn_rate"] = burn
         dis = self.gateway.shadow.disagreement_rate(plan.deployment)
         if dis is not None:
             out["shadow_disagreement"] = dis

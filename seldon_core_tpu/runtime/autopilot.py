@@ -206,6 +206,8 @@ class Autopilot:
             else _env_float("SELDON_TPU_AUTOPILOT_MIN_SAMPLES", 5)
         )
         self._models: Dict[str, _KeyModel] = {}
+        #: keys seeded from the durable perf corpus at boot (warm_start)
+        self.warm_keys = 0
         #: |measured - predicted| / predicted per observed dispatch, the
         #: honesty figure behind seldon_tpu_autopilot_mispredict_pct
         self.mispredict_pct = Reservoir(1024)
@@ -246,6 +248,40 @@ class Autopilot:
                 abs(float(seconds) - pred) / pred * 100.0
             )
         return pred
+
+    def warm_start(self, entries) -> int:
+        """Seed the model table from a prior process's compacted perf
+        corpus (utils/perfcorpus.py) so a restarted engine prices
+        previously-seen keys BEFORE its first dispatch.  Each entry is
+        ``{key, n, est_s, scale_s, last_s}``; only keys with no live
+        observations are seeded (a measurement always beats history),
+        sample counts are capped so the learning rate keeps full
+        authority over a warm key, and MAX_KEYS holds.  Returns the
+        number of keys seeded."""
+        seeded = 0
+        for ent in entries:
+            try:
+                key = str(ent.get("key") or "")
+                est = float(ent.get("est_s") or 0.0)
+            except (TypeError, ValueError):
+                continue
+            if not key or est <= 0 or key in self._models:
+                continue
+            if len(self._models) >= self.MAX_KEYS:
+                break
+            m = _KeyModel(key)
+            # cap the inherited weight: enough to be trusted outright
+            # (n >= min_samples -> predict returns est_s), small enough
+            # that the count stays honest about being historical
+            m.n = min(max(int(ent.get("n") or 1), 1), 10 * self.min_samples)
+            m.est_s = est
+            scale = float(ent.get("scale_s") or 0.0)
+            m.scale_s = scale if scale > 0 else est * 0.5
+            m.last_s = float(ent.get("last_s") or est)
+            self._models[key] = m
+            seeded += 1
+        self.warm_keys += seeded
+        return seeded
 
     # -- prediction (decision sites) --------------------------------------
 
@@ -331,6 +367,7 @@ class Autopilot:
         return {
             "enabled": autopilot_enabled(),
             "keys": len(self._models),
+            "warm_keys": self.warm_keys,
             "observations": snap["count"],
             "mispredict_p50_pct": round(snap["p50"], 2),
         }
@@ -338,6 +375,7 @@ class Autopilot:
     def reset(self) -> None:
         """Fresh state — tests and A/B bench arms only."""
         self._models = {}
+        self.warm_keys = 0
         self.mispredict_pct = Reservoir(1024)
 
 

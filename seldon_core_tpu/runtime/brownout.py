@@ -132,13 +132,17 @@ class BrownoutTransition:
 
 
 def _default_burn() -> Optional[float]:
-    """The 5m fast-burn rate from the process-global SLO tracker; None
-    when no SLO is configured (burn then simply isn't a signal)."""
-    from seldon_core_tpu.utils.quality import QUALITY
+    """The 5m fast-burn rate the ladder judges: the federated
+    fleet-truth aggregate when the gateway federation publishes a fresh
+    one, the process-local SLO ring otherwise (and the max of both when
+    both exist) — ``effective_burn_rate`` in utils/quality.py is the
+    single shared rule, so the rollout burn gates judge the SAME number.
+    None when no SLO is configured anywhere (burn then simply isn't a
+    signal)."""
+    from seldon_core_tpu.utils.quality import effective_burn_rate
 
-    if not QUALITY.slo.configured:
-        return None
-    return float(QUALITY.slo.burn_rates()["5m"]["burn_rate"])
+    burn = effective_burn_rate("5m")
+    return None if burn is None else float(burn)
 
 
 class BrownoutController:

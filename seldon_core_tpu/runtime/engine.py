@@ -391,6 +391,15 @@ class EngineService:
             from seldon_core_tpu.native.fastcodec import native_available
 
             native_available()
+        # warm-start the autopilot from the persisted perf corpus so a
+        # restarted engine prices previously-seen shapes before its first
+        # dispatch (no-op when SELDON_TPU_CORPUS_DIR is unset)
+        try:
+            from seldon_core_tpu.utils.perfcorpus import CORPUS
+
+            CORPUS.warm_start_autopilot()
+        except Exception:  # noqa: BLE001 - corpus must never block serving
+            logger.exception("perf-corpus warm start failed (serving anyway)")
 
 
     # -- flight recorder -----------------------------------------------
@@ -584,6 +593,22 @@ class EngineService:
                 "mode": self.mode,
             },
             **AUTOPILOT.document(),
+        }
+
+    def corpus_document(self) -> dict:
+        """The ``GET /corpus`` body: the durable per-process perf corpus
+        (per-key quantile sketches, segment/rotation state, warm-start
+        counters — utils/perfcorpus.py) under this engine's identity."""
+        from seldon_core_tpu.utils.perfcorpus import CORPUS
+
+        SPINE.drain()  # pending dispatch records land in the corpus first
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            **CORPUS.document(),
         }
 
     def quality_document(self) -> dict:

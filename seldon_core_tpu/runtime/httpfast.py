@@ -28,6 +28,8 @@ Semantics match ``rest.py:make_engine_app`` route for route:
                                (utils/hotrecord.py)
   GET  /autopilot              learned cost-model table
                                (runtime/autopilot.py)
+  GET  /corpus                 durable perf corpus
+                               (utils/perfcorpus.py)
   GET  /trace /trace/export
 
 ``GET /prometheus?format=openmetrics`` serves the OpenMetrics exposition
@@ -144,6 +146,7 @@ class _EngineRoutes:
             b"/quality": self._quality,
             b"/overhead": self._overhead,
             b"/autopilot": self._autopilot,
+            b"/corpus": self._corpus,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
@@ -312,6 +315,15 @@ class _EngineRoutes:
         return (
             200,
             _json.dumps(self.engine.autopilot_document()).encode(),
+            _JSON,
+        )
+
+    async def _corpus(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return (
+            200,
+            _json.dumps(self.engine.corpus_document()).encode(),
             _JSON,
         )
 

@@ -353,6 +353,16 @@ class TenantGovernor:
     def note_shed(self, tenant: str) -> None:
         self._tenant(tenant).shed += 1
 
+    def burn_totals(self) -> Dict[str, Dict[str, int]]:
+        """``{tenant: {throttled, shed}}`` cumulative counters — the QoS
+        half of the federated burn delta (gateway/federation.py
+        publishes these through the shared store; cumulative totals sum
+        meaningfully across replicas where rates would not)."""
+        return {
+            name: {"throttled": t.throttled, "shed": t.shed}
+            for name, t in self._tenants.items()
+        }
+
     # -- weighted fair queue ---------------------------------------------
 
     def queue_depth(self) -> int:

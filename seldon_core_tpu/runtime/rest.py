@@ -270,6 +270,11 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # (runtime/autopilot.py; docs/operations.md runbook)
         return web.json_response(engine.autopilot_document())
 
+    async def corpus(_):
+        # durable perf corpus: per-key quantile sketches + segment state
+        # (utils/perfcorpus.py; docs/operations.md runbook)
+        return web.json_response(engine.corpus_document())
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
@@ -414,6 +419,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/quality", quality)
     app.router.add_get("/overhead", overhead)
     app.router.add_get("/autopilot", autopilot)
+    app.router.add_get("/corpus", corpus)
     app.router.add_post("/quality/reference", _quality_reference)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)

@@ -103,6 +103,28 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+_tier_fn = None
+
+
+def _dispatch_tier() -> str:
+    """QoS tier bound to the calling context — lazily bound to
+    runtime.qos.current_tier (utils must stay importable without the
+    runtime package); '' when unavailable."""
+    global _tier_fn
+    fn = _tier_fn
+    if fn is None:
+        try:
+            from seldon_core_tpu.runtime.qos import current_tier as fn
+        except Exception:  # noqa: BLE001 - tier is best-effort metadata
+            def fn() -> str:
+                return ""
+        _tier_fn = fn
+    try:
+        return fn() or ""
+    except Exception:  # noqa: BLE001
+        return ""
+
+
 class HotRecord:
     """The fixed-layout per-hop record.  Every hop uses a subset of the
     slots; unused slots stay None.  Deliberately a dumb container — all
@@ -118,6 +140,7 @@ class HotRecord:
         "name", "kind", "method",
         "executable",     # compiled-executable key (dispatch hops)
         "rows", "real_rows",
+        "tier",           # QoS tier bound to the dispatch (perf corpus)
         "deadline_remaining_s",
         "compile_cache",  # "hit" | "miss" | None
         "queue_wait_s",
@@ -152,6 +175,7 @@ class HotRecord:
         self.executable = ""
         self.rows = 0
         self.real_rows = 0
+        self.tier = ""
         self.deadline_remaining_s = None
         self.compile_cache = None
         self.queue_wait_s = 0.0
@@ -338,6 +362,12 @@ class TelemetrySpine:
     def _drain_loop(self) -> None:  # pragma: no cover - timing-dependent
         while not self._stopped:
             time.sleep(self.drain_interval_s)
+            # re-check AFTER the sleep: quiesce() flips the flag while
+            # this thread is asleep, and a fold entered past that point
+            # races interpreter finalization (its C-extension frames
+            # keep running while C++ statics destruct -> std::terminate)
+            if self._stopped:
+                break
             try:
                 self.drain()
             except Exception:  # noqa: BLE001 - the drainer must survive
@@ -345,12 +375,14 @@ class TelemetrySpine:
 
     def quiesce(self) -> None:
         """Interpreter-exit hook: stop the drainer and wait for any
-        in-flight fold.  Daemon threads are killed abruptly at
-        finalization — one caught mid-fold inside an XLA call would
-        abort the process instead of exiting it."""
+        in-flight fold.  Daemon threads are not interrupted inside
+        C-extension calls at finalization — one still folding when the
+        runtime's C++ statics destruct aborts the process instead of
+        exiting it.  The fold lock is taken and deliberately KEPT: a
+        drainer that passed the _stopped check before it flipped parks
+        on the lock (safe to finalize over) instead of entering a fold."""
         self._stopped = True
-        if self._drain_lock.acquire(timeout=2.0):
-            self._drain_lock.release()
+        self._drain_lock.acquire(timeout=2.0)
 
     # -- unified sampling --------------------------------------------------
 
@@ -464,6 +496,11 @@ class TelemetrySpine:
         rec.rows = int(rows)
         rec.real_rows = int(real_rows)
         rec.method = method
+        if wants.perf:
+            # the QoS tier is a contextvar on the CALLING thread — the
+            # drainer can't read it later, so it rides the record (one
+            # contextvar get; the corpus rows bucket by tier)
+            rec.tier = _dispatch_tier()
         rec.deadline_remaining_s = deadline_remaining_s
         rec.compile_cache = compile_cache
         rec.error = error
@@ -798,6 +835,26 @@ class TelemetrySpine:
                 pred = AUTOPILOT.observe(rec.executable, rec.duration_s)
                 if pred is not None:
                     attrs["autopilot_predicted_ms"] = round(pred * 1e3, 3)
+                # the durable perf corpus appends the SAME fused record
+                # (utils/perfcorpus.py) — a disk write on the drainer
+                # thread, never the dispatch path; disabled corpus is a
+                # dict-miss-cheap no-op
+                from seldon_core_tpu.utils.perfcorpus import CORPUS
+
+                if CORPUS.enabled and not rec.error:
+                    from seldon_core_tpu.runtime.autopilot import (
+                        pad_bucket,
+                    )
+
+                    CORPUS.record(
+                        rec.executable,
+                        pad_bucket=pad_bucket(rec.rows),
+                        tier=rec.tier,
+                        wall_s=rec.duration_s,
+                        rows=rec.real_rows or rec.rows,
+                        features=OBSERVATORY.cost_features(
+                            rec.executable),
+                    )
                 self.fold_cost["perf"].observe(pc() - t0)
             if rec.flags & WANT_QUALITY:
                 t0 = pc()
@@ -872,6 +929,13 @@ class TelemetrySpine:
             from seldon_core_tpu.utils.genperf import GENPERF
 
             GENPERF.publish_gauges()
+        except Exception:  # noqa: BLE001 - gauges must not wedge a drain
+            pass
+        # durable perf-corpus accounting (rows / disk bytes / warm keys)
+        try:
+            from seldon_core_tpu.utils.perfcorpus import CORPUS
+
+            CORPUS.publish_gauges()
         except Exception:  # noqa: BLE001 - gauges must not wedge a drain
             pass
 

@@ -70,6 +70,10 @@ __all__ = [
     "QualityObservatory",
     "QUALITY",
     "SloTracker",
+    "FleetBurnView",
+    "FLEET_BURN",
+    "fleet_burn_enabled",
+    "effective_burn_rate",
     "router_quality",
     "psi",
     "ks_statistic",
@@ -467,38 +471,60 @@ class SloTracker:
             if error:
                 self._counts[i, 2] += 1
 
-    def burn_rates(self, now: Optional[float] = None) -> Dict[str, Any]:
+    def window_counts(
+            self, now: Optional[float] = None
+    ) -> Dict[str, Dict[str, int]]:
+        """Raw ``{window: {total, slow, errors}}`` sums — the compact
+        delta a gateway replica publishes into the shared store for
+        fleet-truth burn (counts sum across replicas; rates do not)."""
         ts = int(now if now is not None else time.time())
         with self._lock:
             sec = self._sec.copy()
             counts = self._counts.copy()
-        out: Dict[str, Any] = {}
+        out: Dict[str, Dict[str, int]] = {}
         for name, w in self.windows:
             mask = (sec > ts - w) & (sec <= ts)
             total, slow, errors = (int(v) for v in counts[mask].sum(axis=0))
-            entry: Dict[str, Any] = {"requests": total}
-            burns = []
-            if self.p99_ms is not None:
-                lb = (slow / total) / self.LATENCY_BUDGET if total else 0.0
-                entry["latency_burn"] = round(lb, 4)
-                burns.append(lb)
-            if self.error_rate is not None:
-                # an explicit zero budget means zero tolerance: any error
-                # at all burns at the cap, not "error tracking disabled"
-                if not total:
-                    eb = 0.0
-                elif self.error_rate > 0:
-                    eb = min((errors / total) / self.error_rate,
-                             self.BURN_CAP)
-                else:
-                    eb = 0.0 if errors == 0 else self.BURN_CAP
-                entry["error_burn"] = round(eb, 4)
-                burns.append(eb)
-            rate = max(burns) if burns else 0.0
-            entry["burn_rate"] = round(rate, 4)
-            entry["budget_remaining"] = round(max(0.0, 1.0 - rate), 4)
-            out[name] = entry
+            out[name] = {"total": total, "slow": slow, "errors": errors}
         return out
+
+    @classmethod
+    def burn_entry(cls, total: int, slow: int, errors: int,
+                   p99_ms: Optional[float],
+                   error_rate: Optional[float]) -> Dict[str, Any]:
+        """Burn math over one window's counts — THE shared rule behind
+        both the local ``burn_rates`` read and the gateway's fleet-truth
+        fold of summed peer counts, so the two views cannot diverge."""
+        entry: Dict[str, Any] = {"requests": total}
+        burns = []
+        if p99_ms is not None:
+            lb = (slow / total) / cls.LATENCY_BUDGET if total else 0.0
+            entry["latency_burn"] = round(lb, 4)
+            burns.append(lb)
+        if error_rate is not None:
+            # an explicit zero budget means zero tolerance: any error
+            # at all burns at the cap, not "error tracking disabled"
+            if not total:
+                eb = 0.0
+            elif error_rate > 0:
+                eb = min((errors / total) / error_rate, cls.BURN_CAP)
+            else:
+                eb = 0.0 if errors == 0 else cls.BURN_CAP
+            entry["error_burn"] = round(eb, 4)
+            burns.append(eb)
+        rate = max(burns) if burns else 0.0
+        entry["burn_rate"] = round(rate, 4)
+        entry["budget_remaining"] = round(max(0.0, 1.0 - rate), 4)
+        return entry
+
+    def burn_rates(self, now: Optional[float] = None) -> Dict[str, Any]:
+        return {
+            name: self.burn_entry(
+                c["total"], c["slow"], c["errors"],
+                self.p99_ms, self.error_rate,
+            )
+            for name, c in self.window_counts(now).items()
+        }
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -512,6 +538,111 @@ class SloTracker:
         with self._lock:
             self._sec[:] = 0
             self._counts[:] = 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet-truth burn (federated gateway replicas)
+# ---------------------------------------------------------------------------
+
+
+def fleet_burn_enabled() -> bool:
+    """``SELDON_TPU_FLEET_BURN=0`` is the kill switch: no burn deltas
+    publish, no peer folds land, and every consumer reads its own
+    per-replica burn — PR-17-and-earlier behaviour bit-for-bit."""
+    return os.environ.get("SELDON_TPU_FLEET_BURN", "1") != "0"
+
+
+def _fleet_burn_stale_s() -> float:
+    return _env_float("SELDON_TPU_FLEET_BURN_STALE_S") or 15.0
+
+
+class FleetBurnView:
+    """Process-global holder for the fleet-truth burn aggregate.
+
+    The gateway federation tick (gateway/federation.py) folds every
+    replica's published window counts into one document and parks it
+    here; consumers (brownout ladder, rollout burn gates, ``/quality``,
+    ``/fleet``) read through :func:`effective_burn_rate`.  The view is
+    deliberately dumb — publish/read under a lock with a freshness
+    bound — so a wedged federation loop degrades to the per-replica
+    fallback instead of freezing a stale fleet number into the ladder
+    (fail-closed toward existing behaviour)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._doc: Optional[Dict[str, Any]] = None
+        self._set_at = 0.0
+
+    def publish(self, doc: Dict[str, Any]) -> None:
+        with self._lock:
+            self._doc = doc
+            self._set_at = time.monotonic()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._doc = None
+            self._set_at = 0.0
+
+    def age_s(self) -> Optional[float]:
+        with self._lock:
+            if self._doc is None:
+                return None
+            return time.monotonic() - self._set_at
+
+    def fresh(self) -> bool:
+        age = self.age_s()
+        return age is not None and age <= _fleet_burn_stale_s()
+
+    def burn_rate(self, window: str = "5m") -> Optional[float]:
+        """The fleet aggregate burn for one window — None when the kill
+        switch is thrown, nothing was ever folded, or the last fold is
+        stale (consumers then fall back to their local ring)."""
+        if not fleet_burn_enabled() or not self.fresh():
+            return None
+        with self._lock:
+            doc = self._doc
+        try:
+            entry = (doc or {}).get("windows", {}).get(window)
+            if entry is None:
+                return None
+            return float(entry["burn_rate"])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            doc = dict(self._doc) if self._doc else None
+        age = self.age_s()
+        return {
+            "enabled": fleet_burn_enabled(),
+            "fresh": self.fresh(),
+            "age_s": None if age is None else round(age, 3),
+            "stale_after_s": _fleet_burn_stale_s(),
+            "view": doc,
+        }
+
+
+FLEET_BURN = FleetBurnView()
+
+
+def effective_burn_rate(window: str = "5m") -> Optional[float]:
+    """THE burn number decision sites act on: the fleet-truth aggregate
+    when federation publishes a fresh one, the local per-replica ring
+    otherwise, and the max of both when both exist (a replica burning
+    alone must not be talked down by a calm fleet).  None when neither
+    view has a signal — burn then simply isn't a signal, exactly the
+    pre-fleet contract of brownout's ``_default_burn``."""
+    local: Optional[float] = None
+    if QUALITY.slo.configured:
+        entry = QUALITY.slo.burn_rates().get(window)
+        if entry is not None:
+            local = float(entry["burn_rate"])
+    fleet = FLEET_BURN.burn_rate(window)
+    if fleet is None:
+        return local
+    if local is None:
+        return fleet
+    return max(local, fleet)
 
 
 # ---------------------------------------------------------------------------
@@ -1066,6 +1197,15 @@ class QualityObservatory:
             tenant: tracker.burn_rates() for tenant, tracker in trackers
         }
 
+    def tenant_window_counts(self) -> Dict[str, Dict[str, Dict[str, int]]]:
+        """{tenant: {window: counts}} raw sums — what a federated
+        gateway replica publishes as its per-tenant burn delta."""
+        with self._lock:
+            trackers = list(self._tenant_slo.items())
+        return {
+            tenant: tracker.window_counts() for tenant, tracker in trackers
+        }
+
     def refresh_gauges(self) -> None:
         """Recompute the seldon_tpu_slo_burn_rate and drift gauges —
         called from the Prometheus exposition path so a scrape-only
@@ -1135,6 +1275,10 @@ class QualityObservatory:
             # per-tenant burn (5m ring per tenant, LRU-bounded): which
             # tenant is burning the budget, not just that it burns
             "tenant_slo": self.tenant_slo_block(),
+            # the federated fleet-truth aggregate the brownout ladder
+            # and rollout gates actually judge (gateway/federation.py
+            # folds peer deltas here; stale/off -> per-replica fallback)
+            "fleet_burn": FLEET_BURN.snapshot(),
         }
 
     def snapshot(self) -> Dict[str, Any]:

@@ -226,6 +226,18 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     # machinery actually fired
     "seldon_tpu_failover_total": ("counter", ("kind",)),
     "seldon_tpu_lease_transitions_total": ("counter", ("kind",)),
+    # durable perf corpus (utils/perfcorpus.py): dispatch rows appended
+    # this process, total on-disk footprint (segments + compacted
+    # sketches — rotation bounds it), and autopilot keys warm-started
+    # from a prior process's corpus at boot
+    "seldon_tpu_corpus_rows": ("gauge", ()),
+    "seldon_tpu_corpus_bytes": ("gauge", ()),
+    "seldon_tpu_corpus_warm_keys": ("gauge", ()),
+    # fleet-truth SLO burn (gateway/federation.py folding peer deltas
+    # from the shared store): the aggregate burn rate per window that
+    # the brownout ladder and rollout gates actually judge — the
+    # SeldonTPUFleetBurn alert's axis (local slice: slo_burn_rate)
+    "seldon_tpu_fleet_burn_rate": ("gauge", ("window",)),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -412,6 +424,12 @@ class FlightRecorder:
         # election + apife.py hedged-unary / stream-resume paths)
         self.failovers: Dict[str, int] = {}            # kind -> n
         self.lease_transitions: Dict[str, int] = {}    # kind -> n
+        # durable perf corpus (utils/perfcorpus.py publish_gauges) +
+        # fleet-truth burn (gateway/federation.py burn folds)
+        self.corpus_rows = 0
+        self.corpus_bytes = 0
+        self.corpus_warm_keys = 0
+        self.fleet_burn: Dict[str, float] = {}         # window -> rate
         # traffic-lifecycle mirrors (gateway/shadow.py mirror outcomes +
         # divergence, operator/rollouts.py rollbacks and stage weights)
         self.shadow_requests: Dict[str, int] = {}      # outcome -> n
@@ -759,6 +777,31 @@ class FlightRecorder:
                 "gateway replica (acquired / lost / released / "
                 "store_error — gateway/federation.py)",
                 ["kind"], registry=self.registry)
+            self._p_corpus_rows = Gauge(
+                "seldon_tpu_corpus_rows",
+                "Dispatch rows appended to the durable perf corpus by "
+                "this process (utils/perfcorpus.py — the autopilot "
+                "warm-start / learned-cost-model training substrate)",
+                registry=self.registry)
+            self._p_corpus_bytes = Gauge(
+                "seldon_tpu_corpus_bytes",
+                "On-disk footprint of the perf corpus (raw segments + "
+                "compacted sketches; segment rotation bounds it at "
+                "~max_segments x segment_bytes)",
+                registry=self.registry)
+            self._p_corpus_warm_keys = Gauge(
+                "seldon_tpu_corpus_warm_keys",
+                "Autopilot keys warm-started from a prior process's "
+                "corpus at boot — priced before their first dispatch",
+                registry=self.registry)
+            self._p_fleet_burn = Gauge(
+                "seldon_tpu_fleet_burn_rate",
+                "Fleet-truth SLO burn rate per window: every gateway "
+                "replica's published counts folded through the shared "
+                "store (gateway/federation.py) — what the brownout "
+                "ladder and rollout gates judge; compare against the "
+                "per-replica seldon_tpu_slo_burn_rate slice",
+                ["window"], registry=self.registry)
             self._p_lane_requests = Counter(
                 "seldon_tpu_relay_lane_requests_total",
                 "Gateway->engine dispatches by relay lane "
@@ -1161,6 +1204,29 @@ class FlightRecorder:
                 self.lease_transitions.get(kind, 0) + 1)
         if self.registry is not None:
             self._p_lease_transitions.labels(kind=kind).inc()
+
+    def set_corpus(self, rows: int, disk_bytes: int,
+                   warm_keys: int) -> None:
+        """Perf-corpus accounting, refreshed from the spine's throttled
+        gauge pass (utils/hotrecord.py), never per-row."""
+        self._gen += 1
+        with self._lock:
+            self.corpus_rows = int(rows)
+            self.corpus_bytes = int(disk_bytes)
+            self.corpus_warm_keys = int(warm_keys)
+        if self.registry is not None:
+            self._p_corpus_rows.set(rows)
+            self._p_corpus_bytes.set(disk_bytes)
+            self._p_corpus_warm_keys.set(warm_keys)
+
+    def set_fleet_burn(self, window: str, rate: float) -> None:
+        """One window of the federated fleet-truth burn aggregate —
+        set by the gateway federation's burn fold, never per-request."""
+        self._gen += 1
+        with self._lock:
+            self.fleet_burn[window] = float(rate)
+        if self.registry is not None:
+            self._p_fleet_burn.labels(window=window).set(rate)
 
     def record_rollback(self, reason: str) -> None:
         self._gen += 1
@@ -1588,6 +1654,12 @@ class FlightRecorder:
                 },
                 "failovers": dict(self.failovers),
                 "lease_transitions": dict(self.lease_transitions),
+                "fleet_burn": dict(self.fleet_burn),
+            }
+            corpus = {
+                "rows": self.corpus_rows,
+                "bytes": self.corpus_bytes,
+                "warm_keys": self.corpus_warm_keys,
             }
             wire = {
                 "requests": dict(self.wire_requests),
@@ -1638,6 +1710,7 @@ class FlightRecorder:
             "traffic_lifecycle": lifecycle,
             "autopilot": autopilot,
             "qos": qos,
+            "corpus": corpus,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -1766,6 +1839,10 @@ class FlightRecorder:
             self.fleet_replicas = {}
             self.failovers = {}
             self.lease_transitions = {}
+            self.corpus_rows = 0
+            self.corpus_bytes = 0
+            self.corpus_warm_keys = 0
+            self.fleet_burn = {}
             self.shadow_requests = {}
             self.shadow_disagreement = Reservoir()
             self.shadow_latency = Reservoir()

@@ -90,8 +90,13 @@ def _reset_learned_singletons():
     from seldon_core_tpu.runtime.autopilot import AUTOPILOT
     from seldon_core_tpu.runtime.brownout import BROWNOUT
     from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.quality import FLEET_BURN
 
     SPINE.drain()
     AUTOPILOT.reset()
     BROWNOUT.reset()
+    # the fleet-truth burn view steers the brownout ladder and rollout
+    # gates (utils/quality.py effective_burn_rate) — same decides-not-
+    # observes rule as the two above
+    FLEET_BURN.clear()
     yield

@@ -40,6 +40,30 @@ from seldon_core_tpu.testing.faults import FaultSpec, FaultyNodeRuntime
 pytestmark = pytest.mark.chaos
 
 
+@pytest.fixture(autouse=True)
+def _chaos_teardown_reset():
+    """Teardown-side isolation for the learned process globals.
+
+    The conftest autouse reset runs BEFORE each test, which already
+    protects same-process siblings; this teardown additionally scrubs
+    the chaos suite's trained state the moment each test exits, so the
+    hog-tenant scenario (throttled-engine latencies trained into the
+    AUTOPILOT, brownout ladder possibly engaged) never leaks out of
+    this module — the documented near-0.5 argmax flip in
+    test_traffic_lifecycle's shadow-diff test cannot recur through ANY
+    entry point, pytest-ordered or not."""
+    yield
+    from seldon_core_tpu.runtime.autopilot import AUTOPILOT
+    from seldon_core_tpu.runtime.brownout import BROWNOUT
+    from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.quality import FLEET_BURN
+
+    SPINE.drain()  # pending dispatch records fold into the OLD table
+    AUTOPILOT.reset()
+    BROWNOUT.reset()
+    FLEET_BURN.clear()
+
+
 @register_unit("chaos.Router0")
 class AlwaysBranch0(Unit):
     """Deterministic router: always branch 0 (the branch we break)."""

@@ -1,0 +1,303 @@
+"""Fleet-truth SLO/QoS burn accounting (utils/quality.py FLEET_BURN +
+gateway/federation.py burn deltas over the shared sqlite store).
+
+The acceptance properties pinned here (ISSUE PR-18):
+
+  * **fleet-truth engages the ladder** — two gateway replicas each burn
+    below the brownout enter threshold, but their SUMMED counts exceed
+    it: with federation on, every replica's brownout ladder engages off
+    the fleet aggregate; with ``SELDON_TPU_FLEET_BURN=0`` nothing
+    publishes, nothing folds, and each replica judges only its own ring
+    (PR-17-and-earlier behaviour bit-for-bit);
+  * **rollout burn gates judge the same aggregate** — GatewaySignals
+    reads ``effective_burn_rate``, so a canary cannot pass on a 1/N
+    slice of the fleet's burn;
+  * **no burn amnesia on failover** (satellite) — the coordinator dies
+    mid-burn; the successor's fold still sums the dead replica's last
+    published deltas until the window they measured ages out;
+  * **fail-closed staleness** — a stale fold (wedged federation loop)
+    makes consumers fall back to their per-replica rings, never freeze
+    a stale fleet number into decisions.
+"""
+
+import time
+
+import pytest
+
+from seldon_core_tpu.gateway.federation import GatewayFederation
+from seldon_core_tpu.gateway.state import SqliteDeploymentStore
+from seldon_core_tpu.runtime.brownout import BrownoutController
+from seldon_core_tpu.utils.quality import (
+    FLEET_BURN,
+    QUALITY,
+    SloTracker,
+    effective_burn_rate,
+    fleet_burn_enabled,
+)
+
+
+@pytest.fixture()
+def db_path(tmp_path):
+    return str(tmp_path / "gateway.db")
+
+
+@pytest.fixture(autouse=True)
+def _slo(monkeypatch):
+    """A configured latency SLO on the process-global tracker, restored
+    (with clean rings) afterwards."""
+    saved_p99, saved_err = QUALITY.slo.p99_ms, QUALITY.slo.error_rate
+    QUALITY.slo.p99_ms = 10.0
+    QUALITY.slo.error_rate = None
+    yield
+    QUALITY.slo.p99_ms, QUALITY.slo.error_rate = saved_p99, saved_err
+    QUALITY.slo.reset_events()
+    FLEET_BURN.clear()
+
+
+def _burn_locally(slow_fraction, total=100, now=None):
+    """Feed the process-global SLO ring a window with the given slow
+    fraction (p99 objective 10ms => slow = latency > 10ms)."""
+    now = now if now is not None else time.time()
+    slow = int(total * slow_fraction)
+    for i in range(total):
+        QUALITY.slo.record(
+            0.050 if i < slow else 0.001, now=now)
+
+
+class _Gov:
+    """Stand-in TenantGovernor: cumulative throttle/shed counters."""
+
+    def __init__(self, throttled=0, shed=0):
+        self._t, self._s = throttled, shed
+
+    def burn_totals(self):
+        return {"acme": {"throttled": self._t, "shed": self._s}}
+
+
+# ---------------------------------------------------------------------------
+# the delta table (gateway/state.py)
+# ---------------------------------------------------------------------------
+
+
+def test_publish_burn_upserts_and_burn_rows_reads_all_replicas(db_path):
+    s = SqliteDeploymentStore(db_path)
+    s.publish_burn("gw-a", [("_global", "5m", 100, 5, 0, 2, 1)])
+    s.publish_burn("gw-b", [("_global", "5m", 50, 0, 0, 0, 0)])
+    s.publish_burn("gw-a", [("_global", "5m", 120, 6, 0, 2, 1)])  # upsert
+    rows = s.burn_rows()
+    assert len(rows) == 2
+    by_replica = {r["replica_id"]: r for r in rows}
+    assert by_replica["gw-a"]["total"] == 120  # absolute, not summed
+    assert by_replica["gw-a"]["slow"] == 6
+    assert by_replica["gw-b"]["total"] == 50
+
+
+def test_burn_rows_age_filter(db_path):
+    s = SqliteDeploymentStore(db_path)
+    s.publish_burn("gw-a", [("_global", "5m", 10, 1, 0, 0, 0)])
+    assert len(s.burn_rows(max_age_s=60.0)) == 1
+    assert len(s.burn_rows(max_age_s=0.0)) == 0
+
+
+# ---------------------------------------------------------------------------
+# the 2-replica acceptance: fleet aggregate engages, per-replica does not
+# ---------------------------------------------------------------------------
+
+
+def _two_replicas(db_path):
+    store_a = SqliteDeploymentStore(db_path)
+    store_b = SqliteDeploymentStore(db_path)
+    fed_a = GatewayFederation(store_a, "gw-a", ttl_s=5.0)
+    fed_b = GatewayFederation(store_b, "gw-b", ttl_s=5.0)
+    fed_a.governor = _Gov(throttled=3, shed=1)
+    fed_b.governor = _Gov()
+    return fed_a, fed_b
+
+
+def test_fleet_aggregate_exceeds_what_each_replica_sees(db_path):
+    """Each replica's local 5m burn is ~1.2x (12% slow over a 1% budget
+    ... scaled: 1.2% slow / 0.01 budget = 1.2) — below the ladder's
+    enter threshold of 2.0.  The sum (2.4% slow over the combined
+    total... same fraction) — the REAL fleet case is replicas burning
+    on DIFFERENT requests: here replica B publishes counts from its own
+    (simulated) ring, so the fold sums 1.2% + strictly more slow
+    traffic and the aggregate crosses 2.0 while each local view reads
+    1.2."""
+    fed_a, fed_b = _two_replicas(db_path)
+    # replica A's local ring: 1.2% slow of 1000 => burn 1.2 (< 2.0)
+    _burn_locally(0.012, total=1000)
+    assert QUALITY.slo.burn_rates()["5m"]["burn_rate"] == pytest.approx(
+        1.2, abs=0.05)
+    # replica B published heavier counts (its own process's ring — we
+    # inject the delta directly, as its tick would)
+    fed_b.store.publish_burn(
+        "gw-b", [("_global", "5m", 1000, 40, 0, 0, 0)])
+    fed_a.tick()   # publishes A's delta, folds both
+    assert fed_a._burn_publishes == 1 and fed_a._burn_folds == 1
+    snap = FLEET_BURN.snapshot()
+    assert snap["fresh"]
+    view = snap["view"]
+    assert set(view["replicas"]) == {"gw-a", "gw-b"}
+    # fleet: (12 + 40) slow / 2000 total = 2.6% over 1% budget = 2.6
+    assert view["windows"]["5m"]["burn_rate"] == pytest.approx(
+        2.6, abs=0.1)
+    assert view["windows"]["5m"]["throttled"] == 3  # A's QoS totals
+    assert view["windows"]["5m"]["shed"] == 1
+    # effective = max(local 1.2, fleet 2.6)
+    assert effective_burn_rate("5m") == pytest.approx(2.6, abs=0.1)
+
+
+def test_brownout_ladder_engages_on_fleet_not_on_local(db_path):
+    fed_a, fed_b = _two_replicas(db_path)
+    _burn_locally(0.012, total=1000)
+    fed_b.store.publish_burn(
+        "gw-b", [("_global", "5m", 1000, 40, 0, 0, 0)])
+
+    ladder = BrownoutController(enter_burn=2.0, enter_depth=0.0,
+                                dwell_s=0.0)
+    # before any fold: local burn 1.2 / enter 2.0 => pressure < 1, calm
+    ladder.tick()
+    assert ladder.stage() == 0
+    fed_a.tick()
+    ladder.tick()
+    assert ladder.stage() == 1   # fleet 2.6 / 2.0 => severity 1
+    assert ladder.snapshot()["signals"]["burn_5m"] == pytest.approx(
+        2.6, abs=0.1)
+
+
+def test_kill_switch_restores_per_replica_behaviour(db_path, monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_FLEET_BURN", "0")
+    assert not fleet_burn_enabled()
+    fed_a, fed_b = _two_replicas(db_path)
+    _burn_locally(0.012, total=1000)
+    fed_b.store.publish_burn(
+        "gw-b", [("_global", "5m", 1000, 40, 0, 0, 0)])
+    fed_a.tick()
+    assert fed_a._burn_publishes == 0   # kill switch: no publish, no fold
+    assert fed_a.store.burn_rows() == [
+        r for r in fed_a.store.burn_rows() if r["replica_id"] == "gw-b"
+    ]
+    # consumers read the local ring only
+    assert effective_burn_rate("5m") == pytest.approx(1.2, abs=0.05)
+    ladder = BrownoutController(enter_burn=2.0, enter_depth=0.0,
+                                dwell_s=0.0)
+    ladder.tick()
+    assert ladder.stage() == 0
+
+
+def test_rollout_burn_gate_reads_the_same_aggregate(db_path):
+    """GatewaySignals' burn figure IS effective_burn_rate — with a
+    fresh fleet fold the canary gate judges 2.6, not its local 1.2."""
+    from seldon_core_tpu.operator.rollouts import GatewaySignals
+
+    fed_a, fed_b = _two_replicas(db_path)
+    _burn_locally(0.012, total=1000)
+    fed_b.store.publish_burn(
+        "gw-b", [("_global", "5m", 1000, 40, 0, 0, 0)])
+    fed_a.tick()
+
+    class _Shadow:
+        def disagreement_rate(self, _):
+            return None
+
+    class _Gateway:
+        shadow = _Shadow()
+
+        def predictor_traffic(self, _dep, _pred):
+            return 100, 0
+
+    class _Plan:
+        deployment, candidate = "dep", "candidate"
+
+    out = GatewaySignals(_Gateway())(_Plan())
+    assert out["burn_rate"] == pytest.approx(2.6, abs=0.1)
+
+
+# ---------------------------------------------------------------------------
+# failover continuity (satellite): no burn amnesia
+# ---------------------------------------------------------------------------
+
+
+def test_successor_fold_keeps_dead_replicas_last_deltas(db_path):
+    """Kill the coordinator mid-burn: its last published counts keep
+    feeding every successor's fold until the 5m window they measured
+    has fully aged out — burned budget cannot be amnesia'd away by a
+    crash."""
+    fed_a, fed_b = _two_replicas(db_path)
+    _burn_locally(0.012, total=1000)
+    assert fed_a.tick()   # A is coordinator and published its delta
+    # A dies. Nothing removes its burn_deltas row. B folds regardless of
+    # who holds the coordinator lease — burn is not a singleton duty.
+    fed_b.tick()
+    view = FLEET_BURN.snapshot()["view"]
+    assert "gw-a" in view["replicas"]     # the dead replica still counts
+    assert view["folded_by"] == "gw-b"
+    # B's fold sums A's last counts: 12 slow / 1000 = 1.2% => burn 1.2
+    # PLUS B's own (empty governor, shared process ring also 1.2% — the
+    # rows are per-replica in the STORE, so A's and B's both sum)
+    assert view["windows"]["5m"]["requests"] >= 1000
+    assert view["windows"]["5m"]["burn_rate"] >= 1.0
+
+
+def test_aged_out_deltas_stop_counting(db_path):
+    """A replica dead longer than the window span no longer feeds the
+    fold — stale history must not pin the fleet at a burn it has
+    outlived."""
+    s = SqliteDeploymentStore(db_path)
+    fed = GatewayFederation(s, "gw-live", ttl_s=5.0)
+    _burn_locally(0.001, total=1000)   # live replica: calm
+    # a dead replica's row, stamped 10 minutes ago (past the 5m span)
+    s.publish_burn("gw-dead", [("_global", "5m", 1000, 500, 0, 0, 0)])
+    import sqlite3
+
+    with sqlite3.connect(db_path) as conn:
+        conn.execute("UPDATE burn_deltas SET updated = updated - 600 "
+                     "WHERE replica_id = 'gw-dead'")
+    fed.tick()
+    view = FLEET_BURN.snapshot()["view"]
+    assert "gw-dead" not in view["replicas"]
+    assert view["windows"]["5m"]["burn_rate"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# staleness fail-closed
+# ---------------------------------------------------------------------------
+
+
+def test_stale_fold_degrades_to_local_ring(db_path, monkeypatch):
+    fed_a, fed_b = _two_replicas(db_path)
+    _burn_locally(0.012, total=1000)
+    fed_b.store.publish_burn(
+        "gw-b", [("_global", "5m", 1000, 40, 0, 0, 0)])
+    fed_a.tick()
+    assert effective_burn_rate("5m") == pytest.approx(2.6, abs=0.1)
+    # the federation loop wedges: the last fold ages past the bound
+    monkeypatch.setenv("SELDON_TPU_FLEET_BURN_STALE_S", "0.05")
+    time.sleep(0.06)
+    assert not FLEET_BURN.fresh()
+    assert effective_burn_rate("5m") == pytest.approx(1.2, abs=0.05)
+
+
+def test_per_tenant_deltas_publish_and_fold(db_path):
+    fed_a, _fed_b = _two_replicas(db_path)
+    _burn_locally(0.012, total=200)
+    QUALITY.record_tenant_request("acme", 0.050, now=time.time())
+    try:
+        fed_a.tick()
+        view = FLEET_BURN.snapshot()["view"]
+        assert "acme" in view["tenants"]
+        entry = view["tenants"]["acme"]["5m"]
+        assert entry["requests"] == 1
+        assert entry["throttled"] == 3 and entry["shed"] == 1
+    finally:
+        QUALITY._tenant_slo.clear()
+
+
+def test_no_slo_configured_means_no_burn_layer(db_path):
+    QUALITY.slo.p99_ms = None
+    QUALITY.slo.error_rate = None
+    fed_a, _ = _two_replicas(db_path)
+    fed_a.tick()
+    assert fed_a._burn_publishes == 0
+    assert fed_a.store.burn_rows() == []
+    assert effective_burn_rate("5m") is None

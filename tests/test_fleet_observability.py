@@ -512,6 +512,95 @@ def test_fleet_kill_switch_local_only(monkeypatch):
         asyncio.run(e1.close())
 
 
+def test_dead_lease_row_reads_dead_not_stale_docs(monkeypatch):
+    """PR-17 liveness coherence: an engine whose store lease lapsed must
+    read ``lease: dead`` on /fleet instead of silently serving its
+    scrape-stashed fleet_docs, with staleness pinned to at least the
+    lease TTL and the dead row kept out of the outlier median."""
+    import time as _t
+
+    from seldon_core_tpu.gateway.federation import lease_ttl_s
+    from seldon_core_tpu.utils.telemetry import RECORDER
+
+    spec = _iris_spec()
+    live = EngineService(spec)
+    store = DeploymentStore()
+    store.register(spec, {"p": [live, "http://127.0.0.1:1/gone"]})
+    gw = ApiGateway(store, require_auth=False)
+    published = {}
+    monkeypatch.setattr(
+        RECORDER, "set_fleet_staleness",
+        lambda set_name, replica, s: published.__setitem__(replica, s))
+    try:
+        (src,) = [s for s in gather_sources(gw) if s.lane == "http"]
+        ep = src.endpoint
+        # a scrape pass once stashed healthy-looking docs ...
+        ep.fleet_docs = {
+            "ts": _t.monotonic(),
+            "stats": {"telemetry": {"request_latency_s": {
+                "engine": {"count": 500, "p99": 0.002}}}},
+            "perf": None, "quality": None,
+        }
+        # ... then the lease lapsed (federation.apply_leases verdict)
+        ep.lease_state = "dead"
+        doc = asyncio.run(fleet_document(gw))
+        dep = doc["deployments"]["d/p"]
+        row = dep["replicas"][ep.name]
+        assert row["lease"] == "dead"
+        assert row["error"] == "engine lease lapsed"
+        # the stale figures are NOT served as a live row
+        assert "requests" not in row
+        assert row["staleness_s"] >= lease_ttl_s()
+        # dead row stays out of the outlier median
+        assert ep.name not in dep["ratios"]
+        assert all(o["replica"] != ep.name for o in dep["outliers"])
+        # the staleness gauge reflects the lease state, not doc age
+        assert published[ep.name] >= lease_ttl_s()
+    finally:
+        asyncio.run(gw.close())
+        asyncio.run(live.close())
+
+
+def test_scrape_tick_gauges_publish_dead_lease_staleness(monkeypatch):
+    """refresh_outlier_gauges (the scrape-tick lane, no /fleet query):
+    a dead-lease replica must still publish a staleness gauge — pinned
+    to the lease TTL — even when too few live rows remain for outlier
+    math."""
+    import time as _t
+
+    from seldon_core_tpu.gateway.fleet import refresh_outlier_gauges
+    from seldon_core_tpu.gateway.federation import lease_ttl_s
+    from seldon_core_tpu.utils.telemetry import RECORDER
+
+    spec = _iris_spec()
+    store = DeploymentStore()
+    store.register(spec, {"p": ["http://127.0.0.1:1/a",
+                                "http://127.0.0.1:2/b"]})
+    gw = ApiGateway(store, require_auth=False)
+    published = {}
+    monkeypatch.setattr(
+        RECORDER, "set_fleet_staleness",
+        lambda set_name, replica, s: published.__setitem__(replica, s))
+    try:
+        srcs = [s for s in gather_sources(gw) if s.lane == "http"]
+        assert len(srcs) == 2
+        dead, alive = srcs[0].endpoint, srcs[1].endpoint
+        now = _t.monotonic()
+        dead.fleet_docs = {"ts": now, "stats": {}, "perf": None,
+                           "quality": None}
+        dead.lease_state = "dead"
+        alive.fleet_docs = {"ts": now, "stats": {}, "perf": None,
+                            "quality": None}
+        alive.lease_state = "live"
+        refresh_outlier_gauges(gw)
+        # one live row is below the outlier quorum, but the dead
+        # replica's staleness still lands (that's the alertable signal)
+        assert published[dead.name] >= lease_ttl_s()
+        assert published[alive.name] < lease_ttl_s()
+    finally:
+        asyncio.run(gw.close())
+
+
 # ---------------------------------------------------------------------------
 # Coordinated profiling windows
 # ---------------------------------------------------------------------------

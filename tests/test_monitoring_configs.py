@@ -171,5 +171,11 @@ def test_new_tpu_families_are_dashboarded():
         # (gateway/federation.py + gateway/apife.py)
         "seldon_tpu_failover_total",
         "seldon_tpu_lease_transitions_total",
+        # durable perf corpus + fleet-truth burn (utils/perfcorpus.py +
+        # gateway/federation.py burn fold)
+        "seldon_tpu_corpus_rows",
+        "seldon_tpu_corpus_bytes",
+        "seldon_tpu_corpus_warm_keys",
+        "seldon_tpu_fleet_burn_rate",
     ):
         assert family in text, f"{family} missing from every dashboard"
