@@ -96,6 +96,17 @@ disagg-demo:
 fleet-demo:
 	JAX_PLATFORMS=cpu python scripts/fleet_demo.py --out fleet_demo
 
+# cost-attribution demo: two tenants with skewed load through the
+# micro-batcher AND the continuous-batching scheduler — the cost ledger
+# (utils/costledger.py) must split each fenced device wall 3:2 with the
+# pad tax following real shares, keep the accounting identity
+# (accounted_fraction == 1.0), integrate KV-block-seconds, and the
+# usage-weighted WFQ arm (SELDON_TPU_QOS_USAGE_WEIGHTED=1) must drain
+# the cheap tenant ahead of the hog.  Artifact cost_demo/costs.json
+# (scripts/cost_demo.py; docs/operations.md "Reading the /costs page")
+cost-demo:
+	JAX_PLATFORMS=cpu python scripts/cost_demo.py --out cost_demo
+
 # perf-corpus demo: restart warm-start off the durable dispatch ledger
 # (utils/perfcorpus.py) — a freshly-booted engine must price
 # previously-seen shapes BEFORE its first dispatch (autopilot keys > 0
@@ -235,4 +246,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo corpus-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo corpus-demo cost-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun

@@ -3439,10 +3439,26 @@ def served_gen_phase(smoke: bool) -> dict:
             "SELDON_TPU_TRACE": "1",
         },
     )
+    def scrape_device_wall():
+        # the cost ledger's fenced device wall (utils/costledger.py,
+        # accounting.device_wall_s) — deltas around the timed requests
+        # bound how much of the served wall the device was actually busy
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{Engine.REST_PORT}/costs",
+                timeout=10,
+            ) as r:
+                acct = json.loads(r.read()).get("accounting", {})
+            return float(acct.get("device_wall_s") or 0.0)
+        except Exception:
+            return None
+
     spans = []
     try:
         request(timeout=900)  # compile + warm
+        wall0 = scrape_device_wall()
         lats = [request(timeout=120) for _ in range(2 if smoke else 4)]
+        wall1 = scrape_device_wall()
         try:
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{Engine.REST_PORT}/trace?limit=200",
@@ -3472,16 +3488,37 @@ def served_gen_phase(smoke: bool) -> dict:
         return float(np.median(ds)) if ds else None
 
     plane_ms = p50("plane", len(lats))
+    # Efficiency from the SAME fenced device wall the cost ledger uses:
+    # device-busy seconds during the timed requests over the summed
+    # served walls.  Requests are sequential, so the ratio is <= 1 by
+    # construction — unlike the old raw-jit/served ratio, which compared
+    # two arms with different relay floors and could (and did, 113.8% in
+    # BENCH_r05_full) exceed 100%.  No fenced wall recorded (ledger off,
+    # or an arm whose dispatch lane doesn't fence) => null + reason, not
+    # an impossible ratio.
+    eff_pct = None
+    eff_reason = None
+    served_wall = sum(lats)
+    if wall0 is None or wall1 is None:
+        eff_reason = "costs endpoint unavailable (no fenced device wall)"
+    elif wall1 - wall0 <= 0 or served_wall <= 0:
+        eff_reason = ("no fenced device wall recorded during timed "
+                      "requests (cost ledger off or lane unfenced)")
+    else:
+        eff_pct = round(min(100.0, 100 * (wall1 - wall0) / served_wall), 1)
     doc = {
         "served_gen_tok_s": round(B * new / med, 1),
         "served_gen_latency_ms": round(med * 1e3, 1),
         "served_gen_batch": B,
         "served_gen_prompt_len": S,
         # the raw jit path for the SAME request content (prefill + decode
-        # + one relay round trip); served/raw is the serving efficiency
+        # + one relay round trip) — kept for reference; the efficiency
+        # figure below no longer derives from it
         "served_gen_raw_ms": round(raw_ms, 1),
-        "served_gen_efficiency_pct": round(100 * raw_ms / (med * 1e3), 1),
+        "served_gen_efficiency_pct": eff_pct,
     }
+    if eff_reason is not None:
+        doc["served_gen_efficiency_reason"] = eff_reason
     if plane_ms is not None:
         doc.update({
             # the engine-side span: pad + device dispatch + relay +
@@ -3492,6 +3529,38 @@ def served_gen_phase(smoke: bool) -> dict:
             "served_gen_overhead_ms": round(med * 1e3 - plane_ms, 1),
         })
     return doc
+
+
+def probe_cost_attribution(smoke: bool) -> dict:
+    """Attribution-health keys for the perf trajectory: run the cost
+    demo (scripts/cost_demo.py — micro-batcher + scheduler arms, two
+    tenants, skewed load) in a clean subprocess and lift its accounting
+    identity and the interactive-vs-offline cost-per-token ratio into
+    the compact doc.  CPU-only; errors degrade to absent keys, never a
+    failed bench."""
+    out = tempfile.mkdtemp(prefix="bench_cost_demo_")
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "cost_demo.py"), "--out", out],
+            capture_output=True, text=True, timeout=600,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+        )
+        with open(os.path.join(out, "costs.json")) as f:
+            demo = json.load(f)
+    except Exception as e:  # noqa: BLE001 - a broken demo is a null key
+        return {"cost_attribution_error": str(e)[:200]}
+    return {
+        # 1.0 == every fenced device second landed on a tenant, the pad
+        # tax, or idle — the ledger's honesty number
+        "cost_attributed_fraction": demo.get("cost_attributed_fraction"),
+        # what an interactive token costs relative to an offline token
+        # (tier table of /costs): the batching-efficiency price of
+        # latency preference
+        "cost_per_1k_tok_interactive_vs_offline_x": demo.get(
+            "cost_per_1k_tok_interactive_vs_offline_x"),
+        "cost_demo_ok": bool(demo.get("ok")) and proc.returncode == 0,
+    }
 
 
 def main() -> None:
@@ -3750,6 +3819,14 @@ def main() -> None:
             "served_gen_efficiency_pct"),
     )
 
+    # ---- cost-attribution health (CPU; who-consumed-the-chip axis) -------
+    costattr = probe_cost_attribution(args.smoke)
+    emit_partial(
+        cost_attributed_fraction=costattr.get("cost_attributed_fraction"),
+        cost_per_1k_tok_interactive_vs_offline_x=costattr.get(
+            "cost_per_1k_tok_interactive_vs_offline_x"),
+    )
+
     # ---- served-decode flight recorder (CPU; bubble-ledger axis) ---------
     sdec = probe_served_decode(args.smoke)
     emit_partial(
@@ -3927,6 +4004,7 @@ def main() -> None:
         **disagg,
         **autopilot,
         **fusion,
+        **costattr,
         "duration_s": duration,
     }
     # full artifact to disk; compact machine line LAST on stdout
@@ -3966,6 +4044,10 @@ def main() -> None:
         "disagg_tok_s_1p1d", "disagg_tok_s_1p2d",
         "kv_handoff_p50_ms", "kv_handoff_bytes_per_tok",
         "disagg_host_cores",
+        # attribution health (cost ledger): 1.0 == every fenced device
+        # second attributed; the ratio prices latency preference
+        "cost_attributed_fraction",
+        "cost_per_1k_tok_interactive_vs_offline_x",
     ]
     compact = {k: result[k] for k in compact_keys if k in result}
     compact["full_artifact"] = "BENCH_FULL.json"

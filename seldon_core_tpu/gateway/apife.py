@@ -1576,6 +1576,17 @@ def make_gateway_app(gateway: ApiGateway):
         )
 
         RECORDER.record_wire_request("ingress", "json")
+        from seldon_core_tpu.utils.costledger import (
+            LEDGER,
+            costledger_enabled,
+        )
+        if costledger_enabled():
+            # tenant-attributed ingress bytes (utils/costledger.py);
+            # deployment is unresolved this early, so gateway rows key
+            # on the lane alone
+            LEDGER.note_bytes(
+                request.headers.get(TENANT_HEADER) or "", "",
+                "gateway_json", int(request.content_length or 0))
         try:
             # deadline set at the gateway governs the whole request tree;
             # an incoming traceparent makes the gateway span the caller's
@@ -1632,6 +1643,17 @@ def make_gateway_app(gateway: ApiGateway):
         except wirelib.WireError as e:
             return _error_response(str(e), code=e.http_code)
         smeta = frame.meta
+        from seldon_core_tpu.utils.costledger import (
+            LEDGER,
+            costledger_enabled,
+        )
+        if costledger_enabled():
+            # tenant-attributed binary-ingress bytes: the frame sidecar
+            # names the tenant; HTTP header is the fallback
+            LEDGER.note_bytes(
+                smeta.get("tenant")
+                or request.headers.get(TENANT_HEADER) or "",
+                "", "gateway_wire", len(body))
         dl_ms = smeta.get("deadline_ms")
         budget_s = (
             dl_ms / 1e3 if dl_ms else
@@ -2137,6 +2159,13 @@ def make_gateway_app(gateway: ApiGateway):
 
         return web.json_response(await corpus_document(gateway))
 
+    async def costs(_):
+        # fleet-wide resource attribution: every replica's cost ledger
+        # merged into one who-consumes-what table (gateway/fleet.py)
+        from seldon_core_tpu.gateway.fleet import costs_document
+
+        return web.json_response(await costs_document(gateway))
+
     async def profile_start(request):
         from seldon_core_tpu.gateway.fleet import profile_start as start
 
@@ -2180,6 +2209,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/trace/export", trace_export)
     app.router.add_get("/fleet", fleet)
     app.router.add_get("/corpus", corpus)
+    app.router.add_get("/costs", costs)
     app.router.add_get("/profile", profile_get)
     app.router.add_post("/profile/start", profile_start)
     app.router.add_post("/profile/stop", profile_stop)

@@ -64,6 +64,27 @@ _EWMA_FLOOR_MS = 0.1
 #: failed / scrape stale / fast-failing): it still serves when EVERY
 #: candidate is degraded, but never beats a healthy one
 _UNHEALTHY_PENALTY = 1e9
+#: an idle, healthy endpoint whose last completed sample is older than
+#: this prices at the floor so p2c sends it ONE probe, and a probe that
+#: wildly disagrees with the stale EWMA RESEEDS it instead of blending.
+#: Without this an endpoint whose first sample ate a one-off cost (jit
+#: compile, cold page cache) can be starved FOREVER: p2c never re-picks
+#: it, so its poisoned EWMA never gets a correcting sample.  Cost of the
+#: escape hatch: at most one redirected request per window per idle
+#: endpoint.  SELDON_TPU_REPROBE_S overrides; 0 disables
+_REPROBE_AFTER_S = 0.1
+#: blend-vs-reseed trust region: a fresh sample within this factor of
+#: the stale EWMA still blends (low-traffic endpoints keep smoothing);
+#: beyond it the history is judged wrong and replaced
+_REPROBE_RESEED_X = 4.0
+
+
+def reprobe_after_s() -> float:
+    try:
+        return float(os.environ.get(
+            "SELDON_TPU_REPROBE_S", str(_REPROBE_AFTER_S)))
+    except ValueError:
+        return _REPROBE_AFTER_S
 #: consecutive dispatch failures before a replica is degraded — without
 #: this a replica that FAILS in microseconds drains its inflight
 #: instantly, scores at the EWMA floor, and becomes a traffic black hole
@@ -132,6 +153,7 @@ class ReplicaEndpoint:
         "scraped_inflight", "scraped_free_kv", "scrape_ts",
         "scrape_failed", "breaker_open", "fleet_docs",
         "boot_id", "epoch_resets", "lease_state",
+        "last_sample_ts", "ewma_reseeds",
     )
 
     #: minimum samples before a shape bucket's own EWMA is trusted
@@ -187,6 +209,13 @@ class ReplicaEndpoint:
         # both.  SELDON_TPU_AUTOPILOT=0 restores the blind EWMA
         self.shape_ms: dict = {}
         self.picks = 0
+        #: monotonic time of the last SUCCESSFUL completed sample; 0 =
+        #: never sampled.  Drives the stale-EWMA re-probe (see
+        #: _REPROBE_AFTER_S)
+        self.last_sample_ts = 0.0
+        #: times a re-probe sample replaced (not blended into) a stale
+        #: EWMA that disagreed beyond the trust region
+        self.ewma_reseeds = 0
         self.failures = 0
         self.consec_failures = 0
         self.fail_degraded_until = 0.0
@@ -221,6 +250,7 @@ class ReplicaEndpoint:
             return
         if self.boot_id is not None and boot_id != self.boot_id:
             self.ewma_ms = 0.0
+            self.last_sample_ts = 0.0
             self.shape_ms = {}
             self.consec_failures = 0
             self.fail_degraded_until = 0.0
@@ -277,11 +307,23 @@ class ReplicaEndpoint:
         engine-side inflight adds load other gateways put there.  The
         per-request cost is shape-aware when the caller passes the request
         row count (autopilot cost-aware routing)."""
-        s = (
-            (self.inflight + self.scraped_inflight + 1)
-            * max(self.predicted_ms(rows), _EWMA_FLOOR_MS)
-        )
-        if self.degraded(now, stale_after_s):
+        ms = max(self.predicted_ms(rows), _EWMA_FLOOR_MS)
+        degraded = self.degraded(now, stale_after_s)
+        reprobe = reprobe_after_s()
+        if (
+            not degraded
+            and reprobe > 0.0
+            and self.inflight == 0
+            and self.last_sample_ts > 0.0
+            and now - self.last_sample_ts > reprobe
+        ):
+            # idle + healthy + no fresh sample: the EWMA is hearsay.
+            # Price at the floor so p2c sends ONE probe (the inflight
+            # gate stops a pile-on while the probe is out) — the
+            # completion either confirms the history or reseeds it
+            ms = _EWMA_FLOOR_MS
+        s = (self.inflight + self.scraped_inflight + 1) * ms
+        if degraded:
             s += _UNHEALTHY_PENALTY
         return s
 
@@ -303,10 +345,32 @@ class ReplicaEndpoint:
         RECORDER.set_replica_inflight(self.set_name, self.name, self.inflight)
         if ok:
             ms = latency_s * 1e3
-            self.ewma_ms = (
-                ms if self.ewma_ms == 0.0
-                else (1 - _EWMA_ALPHA) * self.ewma_ms + _EWMA_ALPHA * ms
+            now = time.monotonic()
+            reprobe = reprobe_after_s()
+            stale = (
+                reprobe > 0.0
+                and self.last_sample_ts > 0.0
+                and now - self.last_sample_ts > reprobe
             )
+            if self.ewma_ms == 0.0:
+                self.ewma_ms = ms
+            elif stale and not (
+                self.ewma_ms / _REPROBE_RESEED_X
+                <= ms
+                <= self.ewma_ms * _REPROBE_RESEED_X
+            ):
+                # stale history that a fresh probe contradicts beyond
+                # the trust region is judged WRONG, not smoothed: a
+                # compile-poisoned 400ms first sample blended at
+                # alpha=0.2 needs ~10 probes to converge, and p2c only
+                # grants one probe per re-probe window — reseed instead
+                self.ewma_ms = ms
+                self.ewma_reseeds += 1
+            else:
+                self.ewma_ms = (
+                    (1 - _EWMA_ALPHA) * self.ewma_ms + _EWMA_ALPHA * ms
+                )
+            self.last_sample_ts = now
             if rows is not None:
                 bucket = pad_bucket(rows)
                 model = self.shape_ms.get(bucket)
@@ -352,6 +416,7 @@ class ReplicaEndpoint:
             "inflight": self.inflight,
             "scraped_inflight": self.scraped_inflight,
             "ewma_ms": round(self.ewma_ms, 3),
+            "ewma_reseeds": self.ewma_reseeds,
             "picks": self.picks,
             "failures": self.failures,
             "consec_failures": self.consec_failures,

@@ -161,7 +161,11 @@ class GatewayFederation:
     #: how far back one window's published counts stay credible: a dead
     #: replica's last delta keeps counting until the window it measured
     #: has fully aged out — failover cannot amnesia away burned budget
-    _WINDOW_SPANS = {"5m": 300.0, "1h": 3600.0}
+    #: "admission" is a synthetic window: per-tenant token-bucket
+    #: admission totals (requests/throttled/shed) riding the same
+    #: burn_deltas lane so /fleet can show fleet-wide per-tenant
+    #: admission rates without a second store table
+    _WINDOW_SPANS = {"5m": 300.0, "1h": 3600.0, "admission": 300.0}
 
     def _burn_tick(self) -> None:
         """Publish this replica's SLO window counts + QoS throttle/shed
@@ -169,34 +173,48 @@ class GatewayFederation:
         replica's last published counts into the process-global
         :data:`~seldon_core_tpu.utils.quality.FLEET_BURN` view.  Rides
         ``tick()`` — off every request path.  No SLO configured means no
-        burn layer (exactly the local tracker's contract); store errors
-        are counted and the stale view degrades consumers to their
-        per-replica rings (fail-closed toward pre-fleet behaviour)."""
+        SLO burn rows (exactly the local tracker's contract), but
+        per-tenant ADMISSION rows (synthetic window ``"admission"``:
+        total=requests, throttled/shed from the token buckets) still
+        publish whenever a governor is live — admission truth does not
+        require an SLO.  Store errors are counted and the stale view
+        degrades consumers to their per-replica rings (fail-closed
+        toward pre-fleet behaviour)."""
         from seldon_core_tpu.utils.quality import (
             QUALITY,
             fleet_burn_enabled,
         )
 
         if (not fleet_burn_enabled()
-                or not hasattr(self.store, "publish_burn")
-                or not QUALITY.slo.configured):
+                or not hasattr(self.store, "publish_burn")):
+            return
+        gov = self.governor
+        tenants_qos = gov.burn_totals() if gov is not None else {}
+        if not QUALITY.slo.configured and not tenants_qos:
             return
         try:
-            gov = self.governor
-            tenants_qos = gov.burn_totals() if gov is not None else {}
             throttled = sum(
                 v["throttled"] for v in tenants_qos.values())
             shed = sum(v["shed"] for v in tenants_qos.values())
             rows = []
-            for window, c in QUALITY.slo.window_counts().items():
-                rows.append(("_global", window, c["total"], c["slow"],
-                             c["errors"], throttled, shed))
-            for tenant, wins in QUALITY.tenant_window_counts().items():
-                qos = tenants_qos.get(tenant, {})
-                for window, c in wins.items():
-                    rows.append((tenant, window, c["total"], c["slow"],
-                                 c["errors"], qos.get("throttled", 0),
-                                 qos.get("shed", 0)))
+            if QUALITY.slo.configured:
+                for window, c in QUALITY.slo.window_counts().items():
+                    rows.append(
+                        ("_global", window, c["total"], c["slow"],
+                         c["errors"], throttled, shed))
+                for tenant, wins in (
+                        QUALITY.tenant_window_counts().items()):
+                    qos = tenants_qos.get(tenant, {})
+                    for window, c in wins.items():
+                        rows.append(
+                            (tenant, window, c["total"], c["slow"],
+                             c["errors"], qos.get("throttled", 0),
+                             qos.get("shed", 0)))
+            for tenant, qos in tenants_qos.items():
+                rows.append((tenant, "admission",
+                             qos.get("requests", 0), 0, 0,
+                             qos.get("throttled", 0),
+                             qos.get("shed", 0)))
             self.store.publish_burn(self.replica_id, rows)
             self._burn_publishes += 1
             self._burn_fold()
@@ -218,12 +236,23 @@ class GatewayFederation:
 
         now = time.time()
         agg: dict = {}
+        admission: dict = {}
         replicas = set()
         for r in self.store.burn_rows():
             span = self._WINDOW_SPANS.get(r["window"], 300.0)
             if now - r["updated"] > span:
                 continue
             replicas.add(r["replica_id"])
+            if r["window"] == "admission":
+                # synthetic window: cumulative admission counts, no
+                # burn-rate math — total carries the request counter
+                adm = admission.setdefault(
+                    r["scope"], {"requests": 0, "throttled": 0,
+                                 "shed": 0})
+                adm["requests"] += r["total"]
+                adm["throttled"] += r["throttled"]
+                adm["shed"] += r["shed"]
+                continue
             a = agg.setdefault(
                 (r["scope"], r["window"]), [0, 0, 0, 0, 0])
             a[0] += r["total"]
@@ -244,6 +273,8 @@ class GatewayFederation:
                 windows[window] = entry
             else:
                 tenants.setdefault(scope, {})[window] = entry
+        for scope, adm in sorted(admission.items()):
+            tenants.setdefault(scope, {})["admission"] = adm
         FLEET_BURN.publish({
             "replicas": sorted(replicas),
             "windows": windows,

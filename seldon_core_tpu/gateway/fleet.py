@@ -57,6 +57,7 @@ __all__ = [
     "federated_export_document",
     "fleet_document",
     "corpus_document",
+    "costs_document",
     "refresh_outlier_gauges",
     "extract_replica_row",
     "compute_outliers",
@@ -813,6 +814,59 @@ async def corpus_document(gateway) -> dict:
         "key_count": len(keys),
         "keys": keys,
     }
+
+
+async def costs_document(gateway) -> dict:
+    """The gateway's ``GET /costs`` body: every replica's resource
+    ledger merged into ONE fleet-wide attribution table (who is
+    consuming the fleet — device-seconds, pad tax, KV-block-seconds,
+    bytes per tenant x deployment, plus the summed accounting identity
+    and capacity block).  In-process engines share the gateway's
+    process-global ledger, so the local document covers them; URL
+    replicas are fetched at query time (read path, never hot); with
+    ``SELDON_TPU_FLEET=0`` the local document stands alone."""
+    from seldon_core_tpu.utils.costledger import (
+        LEDGER,
+        merge_cost_documents,
+    )
+    from seldon_core_tpu.utils.hotrecord import SPINE
+
+    SPINE.drain()  # in-process engines' pending flush/tick records first
+    local = LEDGER.document()
+    docs: List[dict] = [local]
+    reports: List[dict] = [{
+        "source": "gateway", "lane": "local",
+        "tenants": len(local.get("tenants") or ()), "error": None,
+    }]
+    if fleet_enabled():
+        sources = [s for s in gather_sources(gateway)
+                   if s.lane == "http"]
+
+        async def one(src: FleetSource):
+            try:
+                doc = await _fetch_json(
+                    gateway, src.base_url + "/costs")
+                return src, doc, None
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - reported per source
+                return src, None, f"{type(e).__name__}: {e}"
+
+        for src, doc, error in await asyncio.gather(
+                *(one(s) for s in sources)):
+            if doc is not None:
+                docs.append(doc)
+            reports.append({
+                "source": src.name, "lane": src.lane, "role": src.role,
+                "set": src.set_name,
+                "tenants": len((doc or {}).get("tenants") or ()),
+                "error": error,
+            })
+    merged = merge_cost_documents(docs)
+    merged["federated"] = fleet_enabled()
+    merged["sources"] = reports
+    merged["enabled"] = bool(local.get("enabled"))
+    return merged
 
 
 def refresh_outlier_gauges(gateway) -> None:

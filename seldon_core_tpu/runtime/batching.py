@@ -40,8 +40,14 @@ import numpy as np
 from seldon_core_tpu.graph.interpreter import methods_for
 from seldon_core_tpu.graph.spec import PredictiveUnit, UnitMethod
 from seldon_core_tpu.runtime.autopilot import autopilot_enabled, pad_bucket
-from seldon_core_tpu.runtime.qos import TIER_INTERACTIVE, current_tier, tier_rank
+from seldon_core_tpu.runtime.qos import (
+    TIER_INTERACTIVE,
+    current_tenant,
+    current_tier,
+    tier_rank,
+)
 from seldon_core_tpu.runtime.resilience import current_deadline
+from seldon_core_tpu.utils.costledger import costledger_enabled
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
@@ -124,6 +130,10 @@ class MicroBatcher:
         self._flush_ewma_s = 0.0
         self._inflight: set = set()  # strong refs: bare create_task is GC-able
         self.recorder = RECORDER  # flight-recorder hub (occupancy/wait/slots)
+        # deployment identity for cost attribution (utils/costledger.py);
+        # the engine stamps it after construction — empty means the flush
+        # records fold under the anonymous deployment
+        self.cost_deployment = ""
 
     async def submit(self, x: np.ndarray):
         """x: [b, ...feature] rows of one request.  Returns (y_rows, aux)."""
@@ -144,9 +154,12 @@ class MicroBatcher:
         # records each caller's queue wait as a span parented under ITS
         # request span, and the autopilot's flush planner reads the
         # waiting requests' tightest remaining deadline
+        # tenant rides each entry (alongside trace context / deadline) so
+        # the flush record can split its fenced wall across the tenants
+        # whose rows shared the dispatch (utils/costledger.py)
         self._buckets.setdefault(key, deque()).append(
             (x, fut, time.perf_counter(), current_trace_context(),
-             current_deadline())
+             current_deadline(), current_tenant() or "")
         )
         if key not in self._pumps:
             self._pumps[key] = asyncio.create_task(self._pump(key))
@@ -266,7 +279,7 @@ class MicroBatcher:
                     self._sem.release()
                     continue
                 t = asyncio.get_running_loop().create_task(
-                    self._run_batch(take, predicted_s)
+                    self._run_batch(take, predicted_s, tier=key[2])
                 )
                 self._inflight.add(t)
                 self.recorder.set_inflight(len(self._inflight))
@@ -347,12 +360,13 @@ class MicroBatcher:
         k, _r, t, _dl = max(fits or scored, key=lambda s: (s[1] / s[2], s[0]))
         return k, t
 
-    async def _run_batch(self, bucket, predicted_s=None) -> None:
+    async def _run_batch(self, bucket, predicted_s=None,
+                         tier: str = "") -> None:
         xs = [e[0] for e in bucket]
         futs = [e[1] for e in bucket]
         now = time.perf_counter()
         now_epoch = time.time()
-        for x, _, t_enq, ctx, _dl in bucket:
+        for x, _, t_enq, ctx, _dl, _tenant in bucket:
             # ONE fused ring record per caller: the queue-wait reservoir
             # observation AND the per-caller queue span (parented under
             # the caller's request span — the "queue" phase of the
@@ -361,6 +375,33 @@ class MicroBatcher:
                 now - t_enq, ctx=ctx, rows=len(x),
                 start_s=now_epoch - (now - t_enq),
             )
+        cost = None
+        if costledger_enabled():
+            # attribution payload for the flush record: per-tenant real
+            # rows + the padded capacity the dispatch will actually run
+            # (replicates _dispatch_chunked's pow-2 chunk arithmetic) —
+            # built once per flush, folded off-path by the cost ledger
+            agg: Dict[str, list] = {}
+            for e in bucket:
+                row = agg.setdefault(e[5], [0.0, 0.0])
+                row[0] += len(e[0])
+                row[1] += 1.0
+            n_rows = sum(len(x) for x in xs)
+            padded = 0
+            for start in range(0, n_rows, self.max_batch):
+                n = min(self.max_batch, n_rows - start)
+                if self.pad_to_buckets and n > 1:
+                    padded += min(1 << (n - 1).bit_length(), self.max_batch)
+                else:
+                    padded += n
+            cost = {
+                "dep": self.cost_deployment,
+                "padded": padded,
+                "tenants": [
+                    (tenant, tier, units, requests, 0)
+                    for tenant, (units, requests) in agg.items()
+                ],
+            }
         try:
             stacked = np.concatenate(xs, axis=0)
             total = len(stacked)
@@ -383,6 +424,7 @@ class MicroBatcher:
                     rows=total, requests=len(bucket), start_s=now_epoch,
                     duration_s=flush_s,
                     predicted_s=predicted_s,
+                    cost=cost,
                 )
             ys = np.asarray(ys)[:total]
             # one walk decides whether aux carries per-row arrays at all;

@@ -300,6 +300,10 @@ class EngineService:
                             **cs, role=self.gen_role,
                             coordinator=coordinator,
                         )
+                        # deployment identity for the cost ledger's
+                        # per-tick attribution (utils/costledger.py)
+                        self.genserver.cost_deployment = (
+                            self.deployment.name)
                 except Exception:  # noqa: BLE001 - fall back to static path
                     logger.exception(
                         "continuous generation lane disabled "
@@ -361,6 +365,8 @@ class EngineService:
                 # SELDON_TPU_AUTOPILOT=0 keeps flush-all bit-for-bit)
                 predict_s_fn=self._predict_dispatch_s,
             )
+            # deployment identity for flush-record cost attribution
+            self.batcher.cost_deployment = self.deployment.name
         if self.batcher is not None:
             # batchable graphs have no routers, so the executed path — and
             # therefore the output names — never varies per request
@@ -609,6 +615,30 @@ class EngineService:
                 "mode": self.mode,
             },
             **CORPUS.document(),
+        }
+
+    def costs_document(self) -> dict:
+        """The ``GET /costs`` body: the process-global resource ledger
+        (per-tenant x deployment x phase device-seconds, pad tax,
+        KV-block-seconds, attributed bytes, the accounting identity and
+        the capacity block — utils/costledger.py) under this engine's
+        identity."""
+        from seldon_core_tpu.utils.costledger import LEDGER
+
+        try:  # capacity block: available chip-seconds = devices x wall
+            import jax
+
+            LEDGER.devices = max(1, jax.local_device_count())
+        except Exception:  # noqa: BLE001 - capacity keeps devices=1
+            pass
+        SPINE.drain()  # pending flush/tick records land in the ledger first
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            **LEDGER.document(),
         }
 
     def quality_document(self) -> dict:
@@ -1381,6 +1411,19 @@ class EngineService:
             except wire.WireError as e:
                 code["code"] = "400"
                 return self._wire_error_frame(puid, e, 400)
+            from seldon_core_tpu.utils.costledger import (
+                LEDGER,
+                costledger_enabled,
+            )
+            if costledger_enabled():
+                # tenant-attributed wire-lane ingress bytes: the sidecar
+                # identity is bound by qos_scope above, so the ledger
+                # rows land on the tenant that shipped the tensor
+                from seldon_core_tpu.runtime.qos import current_tenant
+
+                LEDGER.note_bytes(
+                    current_tenant() or "", self.deployment.name,
+                    "wire", int(getattr(rows, "nbytes", 0)))
             try:
                 y_rows, (routing, tags) = await self._submit(rows)
             except (SeldonMessageError, GraphSpecError) as e:

@@ -63,6 +63,7 @@ from seldon_core_tpu.messages import LoadShedError
 from seldon_core_tpu.runtime.autopilot import SHED_INFO_PREFIX
 from seldon_core_tpu.runtime.brownout import BROWNOUT, BROWNOUT_INFO_PREFIX
 from seldon_core_tpu.runtime.qos import current_tier, tier_rank
+from seldon_core_tpu.utils.costledger import costledger_enabled
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
 from seldon_core_tpu.utils.telemetry import RECORDER
@@ -453,6 +454,15 @@ class GenServer:
         self._tick_kv_pos = 0                # cache positions streamed
         self._tick_kv_blocks = 0             # blocks the tables covered
         self._tick_kv_ages: List[tuple] = []  # (n_blocks, age_s) freed
+        # cost-ledger scratch (utils/costledger.py): per-phase tenant
+        # splits of the tick's padded capacity + KV-block-seconds freed
+        # this tick.  None when the ledger kill switch is off — the
+        # accumulators then cost nothing, and the tick record carries no
+        # "attr" payload (so the spine never sets WANT_COST)
+        self._tick_attr: Optional[Dict[str, Any]] = None
+        self._tick_kv_attr: List[tuple] = []   # (tenant, block_s) freed
+        #: deployment identity on /costs rows; the engine stamps it
+        self.cost_deployment = ""
         # this scheduler's waiting queue is an overload signal: the
         # brownout ladder reads it as queue depth.  Registered through a
         # weakref (and finalized) so the registry never pins a scheduler
@@ -915,6 +925,8 @@ class GenServer:
         self._dev_s = {}
         self._tick_rows = self._tick_real_rows = 0
         self._tick_dev_steps = self._tick_kv_pos = self._tick_kv_blocks = 0
+        self._tick_attr = {} if costledger_enabled() else None
+        self._tick_kv_attr = []
         self._ensure_device()
         self._drop_cancelled()
         ta = time.perf_counter()
@@ -971,6 +983,27 @@ class GenServer:
         if bubble_s > 0.0:
             detail["bubble_s"] = bubble_s
             detail["bubble_cause"] = bubble_cause
+        if self._tick_attr is not None:
+            # cost-ledger payload: per-phase tenant splits of the padded
+            # capacity, KV-block-seconds freed this tick, deployment
+            # identity.  Attached even on idle ticks so bubbles fold to
+            # the ledger's idle bucket (its accounting identity needs
+            # every second of wall, busy or not)
+            detail["attr"] = {
+                "dep": self.cost_deployment,
+                "phases": {
+                    phase: {
+                        "padded": d["padded"],
+                        "tenants": [
+                            (t, tr, u, r, tok)
+                            for (t, tr), (u, r, tok)
+                            in d["tenants"].items()
+                        ],
+                    }
+                    for phase, d in self._tick_attr.items()
+                },
+                "kv": tuple(self._tick_kv_attr),
+            }
         self._publish(admitted, retired, kind or "idle", tokens, wall,
                       detail=detail)
         progress = (kind is not None or admitted > 0 or retired > 0
@@ -1065,6 +1098,22 @@ class GenServer:
             self.retired_total.get("preempted", 0) + 1)
         RECORDER.record_gen_retired("preempted")
 
+    def _attr_note(self, phase: str, padded_units: float,
+                   rows) -> None:
+        """Cost-ledger accumulation: ``rows`` increments of
+        ``(tenant, tier, real_units, requests, tokens)`` against the
+        tick's ``phase`` bucket.  No-op when the ledger is off."""
+        if self._tick_attr is None:
+            return
+        d = self._tick_attr.setdefault(
+            phase, {"padded": 0.0, "tenants": {}})
+        d["padded"] += padded_units
+        for tenant, tier, units, requests, toks in rows:
+            row = d["tenants"].setdefault((tenant, tier), [0.0, 0.0, 0])
+            row[0] += units
+            row[1] += requests
+            row[2] += toks
+
     def _release_blocks(self, seq: _Sequence) -> None:
         if self._allocator is not None and seq.blocks:
             if seq.t_start > 0.0:
@@ -1072,6 +1121,14 @@ class GenServer:
                 # (seldon_tpu_gen_kv_block_age_seconds via the spine fold)
                 self._tick_kv_ages.append(
                     (len(seq.blocks), time.time() - seq.t_start))
+                if self._tick_attr is not None:
+                    # KV-block-seconds (blocks x held-time) land on the
+                    # owning tenant at retire/preempt — the ledger's
+                    # memory-residency axis
+                    self._tick_kv_attr.append((
+                        seq.request.tenant or "",
+                        len(seq.blocks) * (time.time() - seq.t_start),
+                    ))
             self._allocator.free(seq.blocks)
         seq.blocks = []
         if self._draft_allocator is not None and seq.draft_blocks:
@@ -1251,6 +1308,13 @@ class GenServer:
         OBSERVATORY.note_padding(len(batch), B)
         self._tick_rows += B
         self._tick_real_rows += len(batch)
+        # cost attribution: real units are this chunk's REAL prompt
+        # tokens per sequence; the dispatched capacity is B x C (pad
+        # rows and pad columns both burn the same device program)
+        self._attr_note("prefill", B * C, [
+            (s.request.tenant, s.request.tier, int(widths[i]), 0, 0)
+            for i, s in enumerate(batch)
+        ])
         self._tick_kv_blocks += sum(
             self._blocks_needed(int(start[i]) + widths[i])
             for i in range(len(batch)))
@@ -1314,6 +1378,10 @@ class GenServer:
                 seq.pending = first
                 self._emit_tokens(seq, [first])
                 emitted += 1
+                # one completed prefill = one request for the ledger's
+                # per-request usage normalization; the first served token
+                self._attr_note("prefill", 0, [
+                    (seq.request.tenant, seq.request.tier, 0, 1, 1)])
             if self.role == "prefill":
                 if seq.done:
                     # the first token already finished the sequence
@@ -1407,6 +1475,10 @@ class GenServer:
         OBSERVATORY.note_padding(len(batch), B)
         self._tick_rows += B
         self._tick_real_rows += len(batch)
+        # cost attribution: one real unit per LIVE sequence, capacity B
+        # (the pow-2 row padding is the decode round's whole pad tax)
+        self._attr_note("decode", B, [
+            (s.request.tenant, s.request.tier, 1, 0, 0) for s in batch])
         self._tick_kv_blocks += sum(
             self._blocks_needed(s.n_valid + self.span) for s in batch)
         # cache positions the round streams (served HBM-BW accounting):
@@ -1445,6 +1517,9 @@ class GenServer:
             self._seq_event(s, "decode_round", n_valid=s.n_valid,
                             take=take)
             emitted += take
+            if take > 0:
+                self._attr_note("decode", 0, [
+                    (s.request.tenant, s.request.tier, 0, 0, take)])
         return emitted
 
     def _spec_round(self) -> int:
@@ -1492,6 +1567,8 @@ class GenServer:
         OBSERVATORY.note_padding(len(batch), B)
         self._tick_rows += B
         self._tick_real_rows += len(batch)
+        self._attr_note("decode", B, [
+            (s.request.tenant, s.request.tier, 1, 0, 0) for s in batch])
         self._tick_kv_blocks += sum(
             self._blocks_needed(s.n_valid + W) for s in batch)
         self._tick_kv_pos += sum(
@@ -1526,6 +1603,9 @@ class GenServer:
             self._seq_event(s, "decode_round", n_valid=s.n_valid,
                             take=take, gained=g)
             emitted += take
+            if take > 0:
+                self._attr_note("decode", 0, [
+                    (s.request.tenant, s.request.tier, 0, 0, take)])
             accept_sum += (g - 1) / max(self.spec_k, 1)
             accept_rounds += 1
         if accept_rounds:

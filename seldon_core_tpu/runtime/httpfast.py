@@ -147,6 +147,7 @@ class _EngineRoutes:
             b"/overhead": self._overhead,
             b"/autopilot": self._autopilot,
             b"/corpus": self._corpus,
+            b"/costs": self._costs,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
@@ -324,6 +325,15 @@ class _EngineRoutes:
         return (
             200,
             _json.dumps(self.engine.corpus_document()).encode(),
+            _JSON,
+        )
+
+    async def _costs(self, body, ctype, query) -> Result:
+        import json as _json
+
+        return (
+            200,
+            _json.dumps(self.engine.costs_document()).encode(),
             _JSON,
         )
 

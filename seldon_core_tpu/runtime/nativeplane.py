@@ -38,6 +38,8 @@ from typing import Optional
 
 import numpy as np
 
+from seldon_core_tpu.runtime.qos import TIER_INTERACTIVE
+from seldon_core_tpu.utils.costledger import costledger_enabled
 from seldon_core_tpu.utils.hotrecord import SPINE
 from seldon_core_tpu.utils.perf import OBSERVATORY
 
@@ -354,6 +356,28 @@ class NativeDataPlane:
                     # device+relay time is only paid at the readback);
                     # it is also the only array touch observability needs
                     y = np.asarray(y)
+                    dispatch_s = time.perf_counter() - t_dispatch
+                    # flush-record parity with MicroBatcher._flush: a
+                    # native batch IS a stacked flush, so it books batch
+                    # occupancy AND carries the cost-ledger attribution
+                    # payload (utils/costledger.py) — without this the
+                    # ledger is blind on the lane that serves most real
+                    # traffic.  The C++ coalescer doesn't surface request
+                    # boundaries or Seldon-Tenant to Python, so the wall
+                    # and pad tax book to the anonymous tenant at the
+                    # default tier; requests=0 marks the count unknown
+                    cost = None
+                    if costledger_enabled():
+                        cost = {
+                            "dep": engine.deployment.name,
+                            "padded": len(padded),
+                            "tenants": [("", TIER_INTERACTIVE,
+                                         float(rows), 0, 0)],
+                        }
+                    SPINE.record_flush(
+                        rows=rows, requests=0, start_s=start_s,
+                        duration_s=dispatch_s, cost=cost,
+                    )
                     if wants.any:
                         # `padded is x` means it is a VIEW into the C++
                         # plane's request buffer, which is recycled the
@@ -367,7 +391,7 @@ class NativeDataPlane:
                             executable=engine.compiled.executable_key(
                                 padded
                             ),
-                            seconds=time.perf_counter() - t_dispatch,
+                            seconds=dispatch_s,
                             start_s=start_s,
                             rows=rows, real_rows=rows, method="native",
                             quality_node=engine._quality_node,

@@ -359,7 +359,8 @@ class TenantGovernor:
         publishes these through the shared store; cumulative totals sum
         meaningfully across replicas where rates would not)."""
         return {
-            name: {"throttled": t.throttled, "shed": t.shed}
+            name: {"requests": t.requests, "throttled": t.throttled,
+                   "shed": t.shed}
             for name, t in self._tenants.items()
         }
 
@@ -381,10 +382,24 @@ class TenantGovernor:
         finish)``; the tenant's finish clock then advances ``1/weight``
         — the SFQ rule.  A tenant pushing 10x its share advances its own
         clock 10x faster, so its backlog always sorts behind a
-        well-behaved tenant's next request."""
+        well-behaved tenant's next request.
+
+        With ``SELDON_TPU_QOS_USAGE_WEIGHTED=1`` the advance is scaled
+        by the cost ledger's per-request device-seconds ratio for this
+        tenant, so a tenant whose requests burn 3x the fleet-average
+        device time drains its queue 3x slower — fair share measured in
+        chip-seconds, not request counts."""
         t = self._tenant(tenant)
         start = max(self._vtime, t.vfinish)
-        t.vfinish = start + 1.0 / t.weight
+        advance = 1.0
+        from seldon_core_tpu.utils.costledger import (
+            usage_weighted_enabled,
+        )
+        if usage_weighted_enabled():
+            from seldon_core_tpu.utils.costledger import LEDGER
+
+            advance = LEDGER.usage_advance(tenant)
+        t.vfinish = start + advance / t.weight
         return start
 
     def _acquire_nowait(self, tenant: str) -> bool:

@@ -275,6 +275,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # (utils/perfcorpus.py; docs/operations.md runbook)
         return web.json_response(engine.corpus_document())
 
+    async def costs(_):
+        # resource-attribution ledger: per-tenant/deployment/phase
+        # device-seconds, pad tax, KV-block-seconds, capacity
+        # (utils/costledger.py; docs/operations.md runbook)
+        return web.json_response(engine.costs_document())
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
@@ -420,6 +426,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/overhead", overhead)
     app.router.add_get("/autopilot", autopilot)
     app.router.add_get("/corpus", corpus)
+    app.router.add_get("/costs", costs)
     app.router.add_post("/quality/reference", _quality_reference)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)

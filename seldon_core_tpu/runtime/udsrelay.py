@@ -535,6 +535,9 @@ class UdsRelayClient:
         self._open = 0
         self._lock = asyncio.Lock()
         self.closed = False
+        # deployment identity on cost-ledger relay-byte rows; owners
+        # that know the target deployment stamp it after construction
+        self.cost_deployment = ""
 
     async def _connect(self):
         return await asyncio.open_unix_connection(self.path)
@@ -602,6 +605,20 @@ class UdsRelayClient:
             op |= META_FLAG
             prefix = _uvarint(len(meta)) + meta
             payload = prefix + payload
+        from seldon_core_tpu.utils.costledger import costledger_enabled
+
+        if costledger_enabled():
+            # tenant-attributed relay bytes (utils/costledger.py).  The
+            # tenant contextvar is bound on request-path calls (the same
+            # context current_relay_meta reads); dispatch-thread calls
+            # book under the anonymous tenant — lane totals stay honest
+            # either way
+            from seldon_core_tpu.runtime.qos import current_tenant
+            from seldon_core_tpu.utils.costledger import LEDGER
+
+            LEDGER.note_bytes(current_tenant() or "",
+                              self.cost_deployment, "relay",
+                              len(payload))
         try:
             writer.write(_REQ_HEAD.pack(len(payload), op))
             if payload:

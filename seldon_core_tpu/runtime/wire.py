@@ -216,11 +216,25 @@ def account_copy(nbytes: int) -> None:
     """One host-side byte copy of ``nbytes`` — the codec's honesty
     counter.  Lanes that must materialize request bytes out of a receive
     buffer account that copy here too, so ``bytes_copied_per_request`` in
-    the bench is end-to-end, not codec-flattering."""
+    the bench is end-to-end, not codec-flattering.
+
+    When the cost ledger is on, the same copy lands tenant-attributed
+    (utils/costledger.py lane ``wire_copy``) — calls on a bound request
+    context bill the copying tenant, dispatch-thread calls book under
+    the anonymous tenant so lane totals stay complete either way."""
     if nbytes > 0:
         from seldon_core_tpu.utils.telemetry import RECORDER
 
         RECORDER.record_wire_copy(int(nbytes))
+        from seldon_core_tpu.utils.costledger import (
+            LEDGER,
+            costledger_enabled,
+        )
+        if costledger_enabled():
+            from seldon_core_tpu.runtime.qos import current_tenant
+
+            LEDGER.note_bytes(current_tenant() or "", "", "wire_copy",
+                              int(nbytes))
 
 
 # ---------------------------------------------------------------------------

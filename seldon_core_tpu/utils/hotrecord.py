@@ -86,6 +86,7 @@ WANT_RECORDER = 1
 WANT_TRACE = 2
 WANT_PERF = 4
 WANT_QUALITY = 8
+WANT_COST = 16    # record carries a cost-ledger attribution payload
 
 #: hop kinds (HotRecord.hop)
 HOP_SPAN = "span"          # a finished tracer span (request/client/...)
@@ -157,6 +158,10 @@ class HotRecord:
         "gen_detail",     # flight-recorder per-tick decomposition dict
                           # (host/device/phase splits, bubble ledger,
                           # real rows, KV accounting — utils/genperf.py)
+        "cost",           # cost-ledger attribution payload of a flush
+                          # (per-tenant real rows + padded capacity —
+                          # utils/costledger.py); gen ticks ride
+                          # gen_detail["attr"] instead
     )
 
     def __init__(self, hop: str, flags: int):
@@ -189,6 +194,7 @@ class HotRecord:
         self.span = None
         self.gen = None
         self.gen_detail = None
+        self.cost = None
 
 
 class ThreadRing:
@@ -316,6 +322,7 @@ class TelemetrySpine:
             "perf": Reservoir(1024),
             "quality": Reservoir(1024),
             "recorder": Reservoir(1024),
+            "ledger": Reservoir(1024),
         }
         #: on-path ring-write cost, sampled every 32nd write
         self.ring_write_s = Reservoir(1024)
@@ -442,17 +449,22 @@ class TelemetrySpine:
 
     def record_flush(self, rows: int, requests: int, start_s: float,
                      duration_s: float,
-                     predicted_s: Optional[float] = None) -> bool:
+                     predicted_s: Optional[float] = None,
+                     cost: Optional[Dict[str, Any]] = None) -> bool:
         """One record per stacked flush: batch occupancy + the
         standalone flush span (multi-request, so it has no parent).
         ``predicted_s`` carries the autopilot's planned-flush prediction
-        so the decision rides the existing write — never a new one."""
+        so the decision rides the existing write — never a new one.
+        ``cost`` is the batcher's attribution payload (per-tenant real
+        rows + padded capacity, utils/costledger.py); it keeps the
+        record ring-worthy even with telemetry/tracing off, so the
+        ledger's own kill switch is the only gate on attribution."""
         want_trace = TRACER.enabled and (
             TRACER.sample >= 1.0 or self._rng.random() < TRACER.sample
         )
         flags = (WANT_RECORDER if self.telemetry_enabled else 0) | (
             WANT_TRACE if want_trace else 0
-        )
+        ) | (WANT_COST if cost is not None else 0)
         if not flags:
             return False
         rec = HotRecord(HOP_FLUSH, flags)
@@ -461,6 +473,7 @@ class TelemetrySpine:
         rec.start_s = start_s
         rec.duration_s = float(duration_s)
         rec.predicted_s = predicted_s
+        rec.cost = cost
         return self._append(rec)
 
     def record_dispatch(
@@ -579,7 +592,7 @@ class TelemetrySpine:
         )
         flags = (WANT_RECORDER if self.telemetry_enabled else 0) | (
             WANT_TRACE if want_trace else 0
-        )
+        ) | (WANT_COST if detail is not None and "attr" in detail else 0)
         if not flags:
             return False
         rec = HotRecord(HOP_GEN_STEP, flags)
@@ -731,6 +744,14 @@ class TelemetrySpine:
                     span_id=new_span_id(),
                 ))
                 self.fold_cost["tracer"].observe(pc() - t0)
+            if rec.flags & WANT_COST and rec.cost is not None:
+                # tenant/deployment attribution of the flush's fenced
+                # wall — the resource ledger's batch lane, off-path
+                t0 = pc()
+                from seldon_core_tpu.utils.costledger import LEDGER
+
+                LEDGER.fold_flush(rec.cost, rec.duration_s)
+                self.fold_cost["ledger"].observe(pc() - t0)
             return
         if rec.hop == HOP_GEN_STEP:
             # gauges/counters were set by the scheduler itself (one call
@@ -774,6 +795,14 @@ class TelemetrySpine:
                 for _n_blocks, age_s in (detail.get("kv_ages") or ()):
                     RECORDER.record_gen_kv_block_age(float(age_s))
                 self.fold_cost["recorder"].observe(pc() - t0)
+            if detail is not None and rec.flags & WANT_COST:
+                # per-tenant split of the tick's fenced device wall +
+                # KV-block-seconds — the resource ledger's gen lane
+                t0 = pc()
+                from seldon_core_tpu.utils.costledger import LEDGER
+
+                LEDGER.fold_gen_tick(detail)
+                self.fold_cost["ledger"].observe(pc() - t0)
             if rec.flags & WANT_TRACE:
                 t0 = pc()
                 admitted, retired, used, total, tokens = rec.gen
@@ -936,6 +965,15 @@ class TelemetrySpine:
             from seldon_core_tpu.utils.perfcorpus import CORPUS
 
             CORPUS.publish_gauges()
+        except Exception:  # noqa: BLE001 - gauges must not wedge a drain
+            pass
+        # resource-attribution counters (cost_device_seconds /
+        # kv_block_seconds / pad_tax / attributed_fraction) — deltas
+        # computed fold-side, pushed on the same 1/s throttle
+        try:
+            from seldon_core_tpu.utils.costledger import LEDGER
+
+            LEDGER.publish_gauges()
         except Exception:  # noqa: BLE001 - gauges must not wedge a drain
             pass
 

@@ -35,7 +35,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 try:
     from prometheus_client import (
@@ -238,6 +238,21 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     # the brownout ladder and rollout gates actually judge — the
     # SeldonTPUFleetBurn alert's axis (local slice: slo_burn_rate)
     "seldon_tpu_fleet_burn_rate": ("gauge", ("window",)),
+    # resource-attribution ledger (utils/costledger.py): per-tenant x
+    # deployment x phase fenced device-seconds, KV-block residency
+    # integrated at release, the pad tax (padded-remainder seconds a
+    # tenant's batch shape caused), and the accounting identity's
+    # honesty gauge — the SeldonTPUUnattributedDeviceTime alert pages
+    # when attributed_fraction sits below 0.97 (a lane is burning chip
+    # time the ledger cannot put a name on).  Tenant cardinality is
+    # bounded by the same overflow fold as the QoS families
+    "seldon_tpu_cost_device_seconds_total":
+        ("counter", ("tenant", "deployment", "phase")),
+    "seldon_tpu_cost_kv_block_seconds_total":
+        ("counter", ("tenant", "deployment")),
+    "seldon_tpu_cost_pad_tax_seconds_total":
+        ("counter", ("tenant", "deployment")),
+    "seldon_tpu_cost_attributed_fraction": ("gauge", ()),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -456,6 +471,13 @@ class FlightRecorder:
         self.brownout_stage = 0
         self.brownout_transitions: Dict[str, int] = {}  # stage -> n
         self.brownout_sheds: Dict[str, int] = {}       # tier -> n
+        # resource-attribution mirrors (utils/costledger.py pushes
+        # deltas from the spine's throttled gauge refresh — the
+        # hot-path writers never touch these)
+        self.cost_device_s: Dict[Tuple[str, str, str], float] = {}
+        self.cost_kv_block_s: Dict[Tuple[str, str], float] = {}
+        self.cost_pad_tax_s: Dict[Tuple[str, str], float] = {}
+        self.cost_attributed_fraction: Optional[float] = None
         # Prometheus high-water mark per hop: the counter is advanced by
         # deltas against THIS, not the snapshot mirror above — reset()
         # clears the mirror but must not rewind the monotone counter's
@@ -907,6 +929,28 @@ class FlightRecorder:
                 "Requests shed by the brownout ladder, by latency tier "
                 "— typed retryable 503s, never silent drops",
                 ["tier"], registry=self.registry)
+            self._p_cost_device_seconds = Counter(
+                "seldon_tpu_cost_device_seconds_total",
+                "Fenced device wall attributed to a tenant x deployment "
+                "x phase, proportional to real units in each shared "
+                "dispatch (utils/costledger.py; GET /costs)",
+                ["tenant", "deployment", "phase"], registry=self.registry)
+            self._p_cost_kv_block_seconds = Counter(
+                "seldon_tpu_cost_kv_block_seconds_total",
+                "Per-sequence KV-block residency (blocks x held-time), "
+                "integrated at retire/preempt, by tenant x deployment",
+                ["tenant", "deployment"], registry=self.registry)
+            self._p_cost_pad_tax_seconds = Counter(
+                "seldon_tpu_cost_pad_tax_seconds_total",
+                "Device wall spent on pow-2 padding, billed to the "
+                "tenants whose real units shared the dispatch",
+                ["tenant", "deployment"], registry=self.registry)
+            self._p_cost_attributed_fraction = Gauge(
+                "seldon_tpu_cost_attributed_fraction",
+                "(attributed + pad_tax + idle) / fenced device wall — "
+                "1.0 when every fold carried attribution; "
+                "SeldonTPUUnattributedDeviceTime alerts below 0.97",
+                registry=self.registry)
 
     # -- batcher ---------------------------------------------------------
 
@@ -1321,6 +1365,56 @@ class FlightRecorder:
         if self.registry is not None:
             self._p_brownout_shed.labels(tier=tier).inc()
 
+    # -- resource-attribution ledger (utils/costledger.py) --------------
+    # All four are delta-fed from the spine's throttled gauge refresh
+    # (~1/s) — never per request.  The tenant label cap reuses the QoS
+    # overflow rule so the label set stays bounded.
+
+    def record_cost_device_seconds(self, tenant: str, deployment: str,
+                                   phase: str, seconds: float) -> None:
+        with self._lock:
+            label = self._tenant_label(
+                {t: 1 for (t, _d, _p) in self.cost_device_s}, tenant)
+            key = (label, deployment, phase)
+            self.cost_device_s[key] = (
+                self.cost_device_s.get(key, 0.0) + seconds)
+        if self.registry is not None:
+            self._p_cost_device_seconds.labels(
+                tenant=label, deployment=deployment, phase=phase,
+            ).inc(seconds)
+
+    def record_cost_kv_block_seconds(self, tenant: str, deployment: str,
+                                     block_seconds: float) -> None:
+        with self._lock:
+            label = self._tenant_label(
+                {t: 1 for (t, _d) in self.cost_kv_block_s}, tenant)
+            key = (label, deployment)
+            self.cost_kv_block_s[key] = (
+                self.cost_kv_block_s.get(key, 0.0) + block_seconds)
+        if self.registry is not None:
+            self._p_cost_kv_block_seconds.labels(
+                tenant=label, deployment=deployment,
+            ).inc(block_seconds)
+
+    def record_cost_pad_tax_seconds(self, tenant: str, deployment: str,
+                                    seconds: float) -> None:
+        with self._lock:
+            label = self._tenant_label(
+                {t: 1 for (t, _d) in self.cost_pad_tax_s}, tenant)
+            key = (label, deployment)
+            self.cost_pad_tax_s[key] = (
+                self.cost_pad_tax_s.get(key, 0.0) + seconds)
+        if self.registry is not None:
+            self._p_cost_pad_tax_seconds.labels(
+                tenant=label, deployment=deployment,
+            ).inc(seconds)
+
+    def record_cost_attributed_fraction(self, fraction: float) -> None:
+        with self._lock:
+            self.cost_attributed_fraction = float(fraction)
+        if self.registry is not None:
+            self._p_cost_attributed_fraction.set(fraction)
+
     def set_autopilot_model(self, mispredict_p50_pct: Optional[float],
                             keys: int) -> None:
         """Model-health gauges, refreshed from the spine's throttled
@@ -1685,6 +1779,21 @@ class FlightRecorder:
                 "brownout_transitions": dict(self.brownout_transitions),
                 "brownout_sheds": dict(self.brownout_sheds),
             }
+            cost = {
+                "device_s": {
+                    "/".join(k): round(v, 6)
+                    for k, v in self.cost_device_s.items()
+                },
+                "kv_block_s": {
+                    "/".join(k): round(v, 3)
+                    for k, v in self.cost_kv_block_s.items()
+                },
+                "pad_tax_s": {
+                    "/".join(k): round(v, 6)
+                    for k, v in self.cost_pad_tax_s.items()
+                },
+                "attributed_fraction": self.cost_attributed_fraction,
+            }
             quality = {
                 "drift": dict(self.drift_scores),
                 "slo_burn": dict(self.slo_burn),
@@ -1710,6 +1819,7 @@ class FlightRecorder:
             "traffic_lifecycle": lifecycle,
             "autopilot": autopilot,
             "qos": qos,
+            "cost": cost,
             "corpus": corpus,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
@@ -1807,6 +1917,10 @@ class FlightRecorder:
             self.feedback_truth = 0
             self.feedback_agree = 0
             self.feedback_disagree = 0
+            self.cost_device_s = {}
+            self.cost_kv_block_s = {}
+            self.cost_pad_tax_s = {}
+            self.cost_attributed_fraction = None
             self.outlier_scores = Reservoir()
             self.outlier_exceeded = 0
             self.slo_burn = {}

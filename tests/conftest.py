@@ -90,6 +90,7 @@ def _reset_learned_singletons():
     from seldon_core_tpu.runtime.autopilot import AUTOPILOT
     from seldon_core_tpu.runtime.brownout import BROWNOUT
     from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.costledger import LEDGER
     from seldon_core_tpu.utils.quality import FLEET_BURN
 
     SPINE.drain()
@@ -99,4 +100,9 @@ def _reset_learned_singletons():
     # gates (utils/quality.py effective_burn_rate) — same decides-not-
     # observes rule as the two above
     FLEET_BURN.clear()
+    # the cost ledger steers WFQ grant order when
+    # SELDON_TPU_QOS_USAGE_WEIGHTED=1 (usage_advance scales virtual
+    # finish tags) — one test's attributed spend must not reorder a
+    # later test's admissions
+    LEDGER.reset()
     yield

@@ -293,11 +293,45 @@ def test_per_tenant_deltas_publish_and_fold(db_path):
         QUALITY._tenant_slo.clear()
 
 
-def test_no_slo_configured_means_no_burn_layer(db_path):
+def test_no_slo_configured_means_no_slo_burn_rows(db_path):
+    """No SLO objective => no SLO burn rows and no burn-rate signal
+    (the local tracker's contract) — but per-tenant ADMISSION rows
+    (synthetic "admission" window, PR-19) still publish while a
+    governor is live: admission truth does not require an SLO."""
     QUALITY.slo.p99_ms = None
     QUALITY.slo.error_rate = None
     fed_a, _ = _two_replicas(db_path)
     fed_a.tick()
-    assert fed_a._burn_publishes == 0
-    assert fed_a.store.burn_rows() == []
+    rows = fed_a.store.burn_rows()
+    assert rows and all(r["window"] == "admission" for r in rows)
     assert effective_burn_rate("5m") is None
+    # admission folds into the fleet view without any SLO math
+    view = FLEET_BURN.snapshot()["view"]
+    assert view["windows"] == {}
+    assert view["tenants"]["acme"]["admission"]["throttled"] == 3
+
+
+def test_no_governor_and_no_slo_publishes_nothing(db_path):
+    QUALITY.slo.p99_ms = None
+    QUALITY.slo.error_rate = None
+    store = SqliteDeploymentStore(db_path)
+    fed = GatewayFederation(store, "gw-solo", ttl_s=5.0)
+    fed.tick()
+    assert fed._burn_publishes == 0
+    assert fed.store.burn_rows() == []
+
+
+def test_admission_rows_fold_fleet_wide(db_path):
+    """Two replicas admitting the same tenant: /fleet's admission view
+    sums requests/throttled/shed across replicas — the fleet-wide
+    per-tenant admission rate ROADMAP's QoS tail asked for."""
+    fed_a, fed_b = _two_replicas(db_path)
+    fed_b.governor = _Gov(throttled=2, shed=0)
+    _burn_locally(0.012, total=200)
+    fed_a.tick()
+    fed_b.tick()
+    adm = FLEET_BURN.snapshot()["view"]["tenants"]["acme"]["admission"]
+    assert adm["throttled"] == 3 + 2
+    assert adm["shed"] == 1 + 0
+    # _Gov publishes no request counter; real governors do
+    assert adm["requests"] == 0

@@ -296,6 +296,9 @@ def test_kill_switches_leave_zero_ring_writes(params, monkeypatch):
     monkeypatch.setattr(TRACER, "enabled", False)
     monkeypatch.setattr(OBSERVATORY, "enabled", False)
     monkeypatch.setattr(QUALITY, "enabled", False)
+    # the cost ledger (on by default) rides the same tick records —
+    # cut it too or its WANT_COST payloads keep the ring warm
+    monkeypatch.setenv("SELDON_TPU_COSTLEDGER", "0")
     writes = {"n": 0}
     real_append = SPINE._append
 

@@ -338,6 +338,14 @@ def test_gateway_steers_around_slow_inprocess_replica():
         store.register(spec, {"p": [fast, slow]})
         gw = ApiGateway(store, require_auth=False)
 
+        # warm BOTH engines' jit caches outside the measured window:
+        # this test pins STEADY-STATE p2c steering, and the one-off
+        # compile otherwise poisons a coin-flip endpoint's first EWMA
+        # sample (the stale-EWMA re-probe recovers it, but recovery
+        # costs wall this short run can't always spare)
+        await fast.predict(msg4())
+        await slow.inner.predict(msg4())
+
         async def worker(n):
             for _ in range(n):
                 resp = await gw.predict(msg4())
@@ -597,3 +605,66 @@ def test_cancelled_predict_is_neutral_for_replica_health():
         await gw.close()
 
     asyncio.run(run())
+
+
+# -- stale-EWMA re-probe: a poisoned endpoint cannot be starved forever ----
+
+
+def test_stale_ewma_reprobe_floors_score_and_reseeds(monkeypatch):
+    """An idle, healthy endpoint whose only sample ate a one-off cost
+    (jit compile) used to keep losing p2c forever — its EWMA never got
+    a correcting sample.  Pin the escape hatch: past the re-probe
+    window the score floors to attract ONE probe (not while one is
+    already out), and a probe that contradicts the stale history beyond
+    the trust region RESEEDS the EWMA instead of blending."""
+    import time as _time
+
+    ep = ReplicaEndpoint("http://a:1")
+    ep.begin()
+    ep.complete(0.400)  # compile-poisoned first sample
+    assert ep.ewma_ms == pytest.approx(400.0)
+    now = _time.monotonic()
+    # fresh sample: full price
+    assert ep.score(now, 10.0) == pytest.approx(400.0)
+    # idle past the window: floor-priced so p2c sends a probe
+    ep.last_sample_ts = now - 1.0
+    assert ep.score(now, 10.0) == pytest.approx(_EWMA_FLOOR_MS)
+    # ...but not while the probe is in flight (no pile-on)
+    ep.begin()
+    assert ep.score(now, 10.0) == pytest.approx(2 * 400.0)
+    # the probe lands 200x below the stale EWMA: reseed, not blend
+    ep.complete(0.002)
+    assert ep.ewma_ms == pytest.approx(2.0)
+    assert ep.ewma_reseeds == 1
+    # a stale probe WITHIN the trust region keeps the smoothing blend
+    ep.last_sample_ts = _time.monotonic() - 1.0
+    ep.begin()
+    ep.complete(0.003)
+    assert ep.ewma_ms == pytest.approx(
+        (1 - _EWMA_ALPHA) * 2.0 + _EWMA_ALPHA * 3.0)
+    assert ep.ewma_reseeds == 1
+    # rapid traffic (fresh samples) blends no matter how far off
+    before = ep.ewma_ms
+    ep.begin()
+    ep.complete(1.0)
+    assert ep.ewma_ms == pytest.approx(
+        (1 - _EWMA_ALPHA) * before + _EWMA_ALPHA * 1000.0)
+    assert ep.ewma_reseeds == 1
+    # SELDON_TPU_REPROBE_S=0 disables the hatch entirely
+    monkeypatch.setenv("SELDON_TPU_REPROBE_S", "0")
+    ep.last_sample_ts = _time.monotonic() - 99.0
+    assert ep.score(_time.monotonic(), 10.0) == pytest.approx(ep.ewma_ms)
+
+
+def test_reprobe_never_rescues_a_degraded_endpoint():
+    """The floor price is an exploration grant for HEALTHY endpoints —
+    a degraded one keeps its penalty no matter how stale its EWMA."""
+    import time as _time
+
+    ep = ReplicaEndpoint("http://a:1")
+    ep.begin()
+    ep.complete(0.400)
+    now = _time.monotonic()
+    ep.last_sample_ts = now - 99.0
+    ep.fail_degraded_until = now + 60.0
+    assert ep.score(now, 10.0) > _UNHEALTHY_PENALTY

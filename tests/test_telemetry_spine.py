@@ -109,11 +109,15 @@ def _switch(monkeypatch, telemetry, trace, perf, quality):
 
 def test_all_kill_switches_mean_zero_ring_writes(monkeypatch):
     """SELDON_TPU_TELEMETRY=0 SELDON_TPU_TRACE=0 SELDON_TPU_PERF=0
-    SELDON_TPU_QUALITY=0 semantics: the dispatch path performs ZERO ring
-    writes and ZERO observatory calls — serving pays nothing for the
-    telemetry layer it turned off."""
+    SELDON_TPU_QUALITY=0 SELDON_TPU_COSTLEDGER=0 semantics: the
+    dispatch path performs ZERO ring writes and ZERO observatory calls
+    — serving pays nothing for the telemetry layer it turned off.  (The
+    cost ledger is the fifth consumer: on by default, its WANT_COST
+    records keep flowing with the other four off, so it must be cut
+    here too.)"""
     engine = EngineService(deployment())
     _switch(monkeypatch, False, False, False, False)
+    monkeypatch.setenv("SELDON_TPU_COSTLEDGER", "0")
     counts = _counted(monkeypatch)
     drive(engine)
     SPINE.drain()
@@ -394,7 +398,7 @@ def test_overhead_document_decomposes_subsystems():
         TRACER.disable()
     assert doc["budget_ms"] == SPINE.budget_ms
     assert set(doc["off_path_fold"]) == {
-        "tracer", "perf", "quality", "recorder"}
+        "tracer", "perf", "quality", "recorder", "ledger"}
     assert doc["ring"]["writes"] > 0
     assert doc["ring"]["dropped_total"] == 0
     assert doc["records_folded"].get("dispatch", 0) >= 5
