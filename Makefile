@@ -107,6 +107,16 @@ fleet-demo:
 cost-demo:
 	JAX_PLATFORMS=cpu python scripts/cost_demo.py --out cost_demo
 
+# postmortem demo: at SELDON_TPU_TRACE_SAMPLE=0.01 an injected +30 ms
+# dispatch outlier must be KEPT by the tail-sampled recorder
+# (utils/postmortem.py) with the explainer naming the guilty phase,
+# while SELDON_TPU_POSTMORTEM=0 keeps nothing and restores the plain
+# traceparent flags byte.  Artifact postmortem_demo/postmortem.json
+# (scripts/postmortem_demo.py; docs/operations.md "Reading a
+# postmortem")
+postmortem-demo:
+	JAX_PLATFORMS=cpu python scripts/postmortem_demo.py --out postmortem_demo
+
 # perf-corpus demo: restart warm-start off the durable dispatch ledger
 # (utils/perfcorpus.py) — a freshly-booted engine must price
 # previously-seen shapes BEFORE its first dispatch (autopilot keys > 0
@@ -246,4 +256,4 @@ release-dryrun:
 	  { echo "usage: make release-dryrun VERSION=X.Y.Z"; exit 2; }
 	python release/release.py --version $(VERSION)
 
-.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo corpus-demo cost-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun
+.PHONY: proto native test chaos trace-demo perf-demo quality-demo scale-demo autopilot-demo canary-demo overload-demo disagg-demo fleet-demo corpus-demo cost-demo postmortem-demo bench overhead-gate ttft-gate fairness-gate wire-gate wire-demo decode-gate decode-demo fusion-gate fusion-demo demos train-demo stack bundle images publish release-dryrun

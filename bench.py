@@ -3827,6 +3827,26 @@ def main() -> None:
             "cost_per_1k_tok_interactive_vs_offline_x"),
     )
 
+    # ---- postmortem recorder health (tail-capture axis) ------------------
+    # reads whatever the in-process drives above fed the recorder;
+    # kill-switch guard (the relay_floor_ms lesson): both keys emit null
+    # — never KeyError — when capture is off or nothing completed
+    try:
+        from seldon_core_tpu.utils.postmortem import POSTMORTEM as _PM
+        pm_snap = _PM.snapshot()
+    except Exception:  # noqa: BLE001
+        pm_snap = {}
+    _pm_done = pm_snap.get("completed_total") or 0
+    postmortem = {
+        "postmortem_kept_per_1k": (
+            round(1e3 * pm_snap.get("kept_total", 0) / _pm_done, 2)
+            if pm_snap.get("enabled") and _pm_done else None),
+        "postmortem_capture_overhead_ms": (
+            pm_snap.get("offer_p50_ms")
+            if pm_snap.get("enabled") else None),
+    }
+    emit_partial(**postmortem)
+
     # ---- served-decode flight recorder (CPU; bubble-ledger axis) ---------
     sdec = probe_served_decode(args.smoke)
     emit_partial(
@@ -4005,6 +4025,7 @@ def main() -> None:
         **autopilot,
         **fusion,
         **costattr,
+        **postmortem,
         "duration_s": duration,
     }
     # full artifact to disk; compact machine line LAST on stdout
@@ -4048,6 +4069,9 @@ def main() -> None:
         # second attributed; the ratio prices latency preference
         "cost_attributed_fraction",
         "cost_per_1k_tok_interactive_vs_offline_x",
+        # tail-capture health: keep rate per 1k completions + the p50
+        # cost of one offer() on the hot fold path (null when off)
+        "postmortem_kept_per_1k", "postmortem_capture_overhead_ms",
     ]
     compact = {k: result[k] for k in compact_keys if k in result}
     compact["full_artifact"] = "BENCH_FULL.json"

@@ -651,7 +651,10 @@ class ApiGateway:
     async def predict(
         self, msg: SeldonMessage, token: Optional[str] = None
     ) -> SeldonMessage:
-        from seldon_core_tpu.utils.tracing import TRACER
+        from seldon_core_tpu.utils.tracing import (
+            TRACER,
+            current_trace_context,
+        )
 
         reg = self._resolve(token)
         # tenant identity (runtime/qos.py): the Seldon-Tenant header
@@ -719,6 +722,7 @@ class ApiGateway:
                 ok = False
                 raised = True
                 shed = False
+                pm_trace_id = ""
                 try:
                     with TRACER.span(
                         msg.meta.puid, "gateway", kind="request",
@@ -733,8 +737,21 @@ class ApiGateway:
                         # — the raw header is absent exactly for
                         # authenticated callers
                         resp = await self._dispatch_predict(endpoint, msg)
-                    shed = self._is_autopilot_shed(resp)
-                    ok = not self._replica_fault(resp)
+                        # verdict stamped while the ingress span is still
+                        # OPEN: the postmortem retention policy judges the
+                        # root span at fold time, and a replica fault or
+                        # policy shed travels as a healthy-looking 200
+                        # envelope the span would otherwise never show
+                        shed = self._is_autopilot_shed(resp)
+                        ok = not self._replica_fault(resp)
+                        _ctx = current_trace_context()
+                        if _ctx is not None:
+                            pm_trace_id = _ctx.trace_id
+                        if shed:
+                            TRACER.annotate(shed=True, status=503)
+                        elif not ok:
+                            TRACER.annotate(
+                                status=503, error="replica_fault")
                     raised = False
                 finally:
                     if track:
@@ -770,9 +787,28 @@ class ApiGateway:
                         # the replica failed transport-style (dead
                         # process, lapsed lease, timeout): re-dispatch
                         # the idempotent predict ONCE to a peer replica
+                        failed_resp = resp
                         resp = await self._maybe_hedge(
                             rs, endpoint, msg, rows, resp)
                         shed = self._is_autopilot_shed(resp)
+                        if pm_trace_id:
+                            # out-of-band: the ingress span already
+                            # closed, so the hedge verdict joins the
+                            # pending trace as a note and re-triggers
+                            # the postmortem keep/drop decision
+                            try:
+                                from seldon_core_tpu.utils.postmortem \
+                                    import POSTMORTEM
+                                POSTMORTEM.note(
+                                    pm_trace_id, "failover",
+                                    lane="unary",
+                                    recovered=(
+                                        resp is not failed_resp
+                                        and not
+                                        self._replica_fault(resp)),
+                                )
+                            except Exception:  # noqa: BLE001
+                                pass
             # record which predictor served (canary observability; feedback
             # routes back to the same predictor)
             resp.meta.requestPath.setdefault("predictor", predictor_name)
@@ -2026,6 +2062,19 @@ def make_gateway_app(gateway: ApiGateway):
                     gateway.failovers["stream"] = (
                         gateway.failovers.get("stream", 0) + 1)
                     RECORDER.record_failover("stream")
+                    # the stream lane opens no request span, so the
+                    # re-home lands as a traceless (synthetic)
+                    # postmortem exemplar rather than a trace join
+                    try:
+                        from seldon_core_tpu.utils.postmortem import (
+                            POSTMORTEM)
+                        POSTMORTEM.note(
+                            "", "rehome", lane="stream",
+                            deployment=reg.deployment_id,
+                            attempts=attempts, error=str(e)[:200],
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
             await resp.write_eof()
             return resp
         finally:
@@ -2166,6 +2215,15 @@ def make_gateway_app(gateway: ApiGateway):
 
         return web.json_response(await costs_document(gateway))
 
+    async def postmortems(request):
+        # worst-of-fleet exemplars: the gateway's own kept traces plus
+        # every replica's, merged worst-first (gateway/fleet.py); ?puid=
+        # chases a single exemplar across the fleet
+        from seldon_core_tpu.gateway.fleet import postmortems_document
+
+        return web.json_response(await postmortems_document(
+            gateway, puid=request.query.get("puid", "")))
+
     async def profile_start(request):
         from seldon_core_tpu.gateway.fleet import profile_start as start
 
@@ -2210,6 +2268,7 @@ def make_gateway_app(gateway: ApiGateway):
     app.router.add_get("/fleet", fleet)
     app.router.add_get("/corpus", corpus)
     app.router.add_get("/costs", costs)
+    app.router.add_get("/postmortems", postmortems)
     app.router.add_get("/profile", profile_get)
     app.router.add_post("/profile/start", profile_start)
     app.router.add_post("/profile/stop", profile_stop)

@@ -108,6 +108,20 @@ def uds_enabled() -> bool:
     return os.environ.get("SELDON_TPU_UDS", "1") != "0"
 
 
+def _pm_note(reason: str, **attrs) -> None:
+    """Out-of-band postmortem breadcrumb for fleet-health transitions
+    (lease flips, breaker opens).  These events have no open request
+    span, so they land as traceless synthetic exemplars — bounded, and
+    inert when the recorder is disabled.  Never raises: replica health
+    bookkeeping must not depend on the observability layer."""
+    try:
+        from seldon_core_tpu.utils.postmortem import POSTMORTEM
+
+        POSTMORTEM.note("", reason, **attrs)
+    except Exception:  # noqa: BLE001 - breadcrumbs are best-effort
+        pass
+
+
 def fleet_scrape_enabled() -> bool:
     """Should the scrape pass retain fleet documents (gateway/fleet.py)?
     Off with the federation kill switch (``SELDON_TPU_FLEET=0``) or
@@ -566,18 +580,28 @@ class ReplicaSet:
             if ep.base_url is None:
                 continue
             row = leases.get(ep.base_url) or leases.get(ep.base_url + "/")
+            prev = ep.lease_state
             if row is None:
                 # an engine that once held a lease and now has NO row
                 # deregistered (graceful drain) — dead until it returns
                 if ep.lease_state is not None:
                     ep.lease_state = "dead"
+                    if prev == "live":
+                        _pm_note("lease", endpoint=ep.base_url,
+                                 transition="live->dead", cause="dropped")
                 continue
             boot_id, expires = row
             if float(expires) > now:
                 ep.lease_state = "live"
                 ep.observe_boot_id(boot_id)
+                if prev == "dead":
+                    _pm_note("lease", endpoint=ep.base_url,
+                             transition="dead->live")
             else:
                 ep.lease_state = "dead"
+                if prev == "live":
+                    _pm_note("lease", endpoint=ep.base_url,
+                             transition="live->dead", cause="lapsed")
 
     # -- passive health (the /stats scrape) ------------------------------
 
@@ -625,10 +649,14 @@ class ReplicaSet:
                 breakers = (
                     (doc.get("resilience") or {}).get("breakers") or {}
                 )
+                was_open = ep.breaker_open
                 ep.breaker_open = any(
                     (br or {}).get("state") not in (None, "closed")
                     for br in breakers.values()
                 )
+                if ep.breaker_open and not was_open:
+                    _pm_note("breaker", endpoint=ep.base_url,
+                             transition="closed->open")
                 # free-KV-block headroom + role off the genserver block
                 # (disaggregated mesh: the decode-capacity signal and
                 # the role the endpoint actually serves)
@@ -659,7 +687,7 @@ class ReplicaSet:
                 # must not mark the replica degraded.
                 if fleet_scrape_enabled():
                     docs = {"stats": doc, "perf": None, "quality": None,
-                            "ts": ep.scrape_ts}
+                            "postmortems": None, "ts": ep.scrape_ts}
 
                     async def _doc(path):
                         async with session.get(
@@ -670,17 +698,21 @@ class ReplicaSet:
                     # return_exceptions: one surface erroring (quality
                     # observatory disabled, transient 500) must not
                     # throw away the OTHER doc that fetched fine
-                    perf, quality = await asyncio.gather(
+                    perf, quality, postmortems = await asyncio.gather(
                         _doc("/perf"), _doc("/quality"),
+                        _doc("/postmortems"),
                         return_exceptions=True,
                     )
                     if isinstance(perf, asyncio.CancelledError) or \
-                            isinstance(quality, asyncio.CancelledError):
+                            isinstance(quality, asyncio.CancelledError) or \
+                            isinstance(postmortems, asyncio.CancelledError):
                         raise asyncio.CancelledError
                     if not isinstance(perf, BaseException):
                         docs["perf"] = perf
                     if not isinstance(quality, BaseException):
                         docs["quality"] = quality
+                    if not isinstance(postmortems, BaseException):
+                        docs["postmortems"] = postmortems
                     ep.fleet_docs = docs
                 return 1
             except asyncio.CancelledError:

@@ -58,6 +58,7 @@ __all__ = [
     "fleet_document",
     "corpus_document",
     "costs_document",
+    "postmortems_document",
     "refresh_outlier_gauges",
     "extract_replica_row",
     "compute_outliers",
@@ -867,6 +868,108 @@ async def costs_document(gateway) -> dict:
     merged["sources"] = reports
     merged["enabled"] = bool(local.get("enabled"))
     return merged
+
+
+async def postmortems_document(gateway, puid: str = "") -> dict:
+    """The gateway's ``GET /postmortems`` body: worst-of-fleet kept
+    exemplars.  The summary view merges the gateway's own recorder
+    (which also covers in-process engines — they share the
+    process-global singleton) with the replica summaries the health
+    scrape already stashed next to ``/perf`` and ``/quality``
+    (``ep.fleet_docs`` — zero new polling loops).  ``?puid=`` chases ONE
+    exemplar: the local recorder first, then each HTTP replica at query
+    time (read path, never hot).  With ``SELDON_TPU_FLEET=0`` the local
+    document stands alone."""
+    from seldon_core_tpu.utils.hotrecord import SPINE
+    from seldon_core_tpu.utils.postmortem import POSTMORTEM
+
+    SPINE.drain()  # pending request spans complete their verdicts first
+    if puid:
+        local = POSTMORTEM.document(puid=puid)
+        if local.get("found") or not fleet_enabled():
+            local["source"] = "gateway" if local.get("found") else None
+            return local
+        from urllib.parse import quote
+
+        sources = [s for s in gather_sources(gateway) if s.lane == "http"]
+
+        async def chase(src: FleetSource):
+            try:
+                doc = await _fetch_json(
+                    gateway, src.base_url + "/postmortems?puid="
+                    + quote(puid, safe=""))
+                return src, doc
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - absent source = not found
+                return src, None
+
+        for src, doc in await asyncio.gather(
+                *(chase(s) for s in sources)):
+            if isinstance(doc, dict) and doc.get("found"):
+                doc["source"] = src.name
+                return doc
+        return {"found": False, "puid": puid, "postmortem": None,
+                "source": None}
+    local = POSTMORTEM.document()
+    kept = [dict(s, source="gateway") for s in local.get("kept") or ()]
+    synthetic = [dict(s, source="gateway")
+                 for s in local.get("synthetic") or ()]
+    counters = dict(local.get("counters") or {})
+    reports: List[dict] = [{
+        "source": "gateway", "lane": "local",
+        "kept": len(kept), "stale_s": None, "error": None,
+    }]
+    if fleet_enabled():
+        now = time.monotonic()
+        for src in gather_sources(gateway):
+            if src.lane != "http":
+                continue
+            docs = getattr(src.endpoint, "fleet_docs", None) \
+                if src.endpoint is not None else None
+            pm = (docs or {}).get("postmortems")
+            stale_s = (round(now - docs["ts"], 3)
+                       if docs and docs.get("ts") else None)
+            if not isinstance(pm, dict):
+                reports.append({
+                    "source": src.name, "lane": src.lane, "role": src.role,
+                    "set": src.set_name, "kept": 0, "stale_s": stale_s,
+                    "error": "no scraped postmortem document",
+                })
+                continue
+            folded = 0
+            for key in ("kept", "synthetic"):
+                dest = kept if key == "kept" else synthetic
+                for s in pm.get(key) or ():
+                    if isinstance(s, dict):
+                        dest.append(dict(s, source=src.name))
+                        folded += 1
+            for name, val in (pm.get("counters") or {}).items():
+                if isinstance(val, dict):
+                    slot = counters.setdefault(name, {})
+                    if isinstance(slot, dict):
+                        for reason, n in val.items():
+                            slot[reason] = slot.get(reason, 0) + int(n or 0)
+                elif isinstance(val, (int, float)):
+                    counters[name] = (counters.get(name) or 0) + val
+            reports.append({
+                "source": src.name, "lane": src.lane, "role": src.role,
+                "set": src.set_name, "kept": folded, "stale_s": stale_s,
+                "error": None,
+            })
+    # worst-of-fleet ordering: biggest explained excess first, then most
+    # recent — same sort the per-process document uses
+    kept.sort(key=lambda s: (-(s.get("excess_ms") or 0.0),
+                             -(s.get("kept_at_s") or 0.0)))
+    return {
+        "federated": fleet_enabled(),
+        "enabled": bool(local.get("enabled")),
+        "sources": reports,
+        "counters": counters,
+        "kept_count": len(kept),
+        "kept": kept,
+        "synthetic": synthetic,
+    }
 
 
 def refresh_outlier_gauges(gateway) -> None:

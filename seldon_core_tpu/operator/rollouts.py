@@ -487,13 +487,24 @@ class RolloutController:
         if plan.config_hash not in hashes:
             hashes.append(plan.config_hash)
             del hashes[:-64]
+        # cite the postmortem exemplars that witnessed the breach: the
+        # operator lands on GET /postmortems?puid=<one of these> instead
+        # of re-deriving which requests the gate actually saw
+        evidence: list = []
+        try:
+            from seldon_core_tpu.utils.postmortem import POSTMORTEM
+            evidence = POSTMORTEM.exemplar_puids(
+                deployment=plan.deployment, limit=4)
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            evidence = []
         event = ro.note(
             "rollback", time.time(), reason=reason, observed=observed,
             signals={k: v for k, v in sig.items() if not k.startswith("_")},
+            evidence_puids=evidence,
         )
         self._publish(
             "rollback", plan, reason=reason, observed=observed,
-            config_hash=plan.config_hash,
+            config_hash=plan.config_hash, evidence_puids=evidence,
         )
         return event
 

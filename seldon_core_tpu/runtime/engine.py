@@ -641,6 +641,24 @@ class EngineService:
             **LEDGER.document(),
         }
 
+    def postmortems_document(self, puid: str = "") -> dict:
+        """The ``GET /postmortems`` body: the tail-sampled postmortem
+        recorder (utils/postmortem.py — kept worst-request exemplars
+        with their automatic explanations, retention counters, pending
+        buffer state) under this engine's identity.  ``puid`` (or a
+        trace_id) answers the full immutable exemplar document."""
+        from seldon_core_tpu.utils.postmortem import POSTMORTEM
+
+        SPINE.drain()  # pending request spans complete their verdicts first
+        return {
+            "engine": {
+                "deployment": self.deployment.name,
+                "predictor": self.predictor.name,
+                "mode": self.mode,
+            },
+            **POSTMORTEM.document(puid=puid),
+        }
+
     def quality_document(self) -> dict:
         """The ``GET /quality`` body: the process-global quality
         observatory (per-node drift table, feedback reward/accuracy,
@@ -778,25 +796,36 @@ class EngineService:
                 ctx = current_trace_context()
                 if ctx is not None and ctx.sampled:
                     audit_extra["trace_id"] = ctx.trace_id
-                while True:
-                    toks = await loop.run_in_executor(
-                        None, next, gen, None
-                    )
-                    if toks is None:
-                        break
-                    arr = np.asarray(toks)  # materialized for serialization
-                    if ttft_s is None:
-                        # engine-truth TTFT for the audit entry (prefill +
-                        # first decode scan + readback); the Prometheus
-                        # ttft/decode-rate families are recorded ONCE, by
-                        # stream_chunks itself — recording here too would
-                        # double-count every stream
-                        ttft_s = time.perf_counter() - t0
-                    tokens += int(arr.shape[0] * arr.shape[1])
-                    yield _json.dumps({
-                        "tokens": arr.astype(float).tolist(),
-                        "done": False,
-                    })
+                try:
+                    while True:
+                        toks = await loop.run_in_executor(
+                            None, next, gen, None
+                        )
+                        if toks is None:
+                            break
+                        arr = np.asarray(toks)  # materialized for serialization
+                        if ttft_s is None:
+                            # engine-truth TTFT for the audit entry (prefill +
+                            # first decode scan + readback); the Prometheus
+                            # ttft/decode-rate families are recorded ONCE, by
+                            # stream_chunks itself — recording here too would
+                            # double-count every stream
+                            ttft_s = time.perf_counter() - t0
+                        tokens += int(arr.shape[0] * arr.shape[1])
+                        yield _json.dumps({
+                            "tokens": arr.astype(float).tolist(),
+                            "done": False,
+                        })
+                except GeneratorExit:
+                    # stamped INSIDE the span (the outer handlers run
+                    # after it closed) so the postmortem retention policy
+                    # sees the abandoned/failed stream on its root span
+                    self.tracer.annotate(status=499)
+                    raise
+                except Exception as e:
+                    self.tracer.annotate(status=500,
+                                         error=type(e).__name__)
+                    raise
         except GeneratorExit:
             status = 499  # client abandoned the stream mid-flight
             raise
@@ -1231,6 +1260,9 @@ class EngineService:
                         # a shed is flow control, not an SLO error
                         # (utils/metrics.py time_server)
                         code["shed"] = isinstance(e, LoadShedError)
+                        self.tracer.annotate(
+                            status=e.http_code, error=type(e).__name__,
+                            shed=isinstance(e, LoadShedError))
                         self._audit_request(
                             puid, "predict", e.http_code, t0,
                             rows=len(rows), lane="rest",
@@ -1430,6 +1462,9 @@ class EngineService:
                 http_code = getattr(e, "http_code", 400)
                 code["code"] = str(http_code)
                 code["shed"] = isinstance(e, LoadShedError)
+                self.tracer.annotate(
+                    status=http_code, error=type(e).__name__,
+                    shed=isinstance(e, LoadShedError))
                 self._audit_request(
                     puid, "predict", http_code, t0,
                     rows=len(rows), lane="wire",
@@ -1488,6 +1523,9 @@ class EngineService:
                         # a shed is flow control, not an SLO error
                         # (utils/metrics.py time_server)
                         code["shed"] = isinstance(e, LoadShedError)
+                        self.tracer.annotate(
+                            status=e.http_code, error=type(e).__name__,
+                            shed=isinstance(e, LoadShedError))
                         self._audit_request(
                             puid, "predict", e.http_code, t0,
                             rows=len(rows), lane="grpc",
@@ -1557,6 +1595,9 @@ class EngineService:
                         # a shed is flow control, not an SLO error
                         # (utils/metrics.py time_server)
                         code["shed"] = isinstance(e, LoadShedError)
+                        self.tracer.annotate(
+                            status=e.http_code, error=type(e).__name__,
+                            shed=isinstance(e, LoadShedError))
                         self._audit_request(
                             puid, "predict", e.http_code, t0,
                             rows=len(rows), lane="grpc",
@@ -1660,6 +1701,9 @@ class EngineService:
                 # a shed is flow control, not an SLO error
                 # (utils/metrics.py time_server)
                 code["shed"] = isinstance(e, LoadShedError)
+                self.tracer.annotate(
+                    status=http_code, error=type(e).__name__,
+                    shed=isinstance(e, LoadShedError))
                 self._audit_request(
                     msg.meta.puid, "predict", http_code, t0, rows=n_rows,
                     lane="object",

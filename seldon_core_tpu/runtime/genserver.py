@@ -1998,10 +1998,16 @@ class GenServer:
         Strictly a no-op for untraced requests — the per-tick hot path
         pays one attribute read and one boolean test."""
         ctx = getattr(seq.request, "trace_ctx", None)
-        if ctx is None or not ctx.sampled:
+        if ctx is None:
             return
         from seldon_core_tpu.utils.tracing import TRACER
 
+        if not ctx.sampled and not (
+            getattr(ctx, "pm", False) and TRACER.pm_hook is not None
+        ):
+            # not sampled AND not under postmortem tail capture: the
+            # preempt/admit timeline would reach no surface — skip it
+            return
         if not TRACER.enabled or len(seq.events) >= 512:
             return
         ev: Dict[str, Any] = {"name": name, "ts": round(time.time(), 6)}
@@ -2017,10 +2023,15 @@ class GenServer:
         if not seq.events:
             return
         ctx = getattr(seq.request, "trace_ctx", None)
-        if ctx is None or not ctx.sampled:
+        if ctx is None:
             return
         from seldon_core_tpu.utils.tracing import TRACER, Span, new_span_id
 
+        pm_only = not ctx.sampled
+        if pm_only and not (
+            getattr(ctx, "pm", False) and TRACER.pm_hook is not None
+        ):
+            return
         if not TRACER.enabled:
             return
         start_s = seq.events[0]["ts"]
@@ -2033,6 +2044,7 @@ class GenServer:
                    "role": self.role},
             trace_id=ctx.trace_id, span_id=new_span_id(),
             parent_span_id=ctx.span_id, events=list(seq.events),
+            pm_only=pm_only,
         ))
         seq.events = []
 

@@ -148,6 +148,7 @@ class _EngineRoutes:
             b"/autopilot": self._autopilot,
             b"/corpus": self._corpus,
             b"/costs": self._costs,
+            b"/postmortems": self._postmortems,
             b"/trace": self._trace,
             b"/trace/export": self._trace_export,
             # NB: no GET /trace/enable|disable — the PR-3 deprecation
@@ -336,6 +337,14 @@ class _EngineRoutes:
             _json.dumps(self.engine.costs_document()).encode(),
             _JSON,
         )
+
+    async def _postmortems(self, body, ctype, query) -> Result:
+        import json as _json
+
+        q = parse_qs(query)
+        doc = self.engine.postmortems_document(
+            puid=q.get("puid", [""])[0])
+        return 200, _json.dumps(doc).encode(), _JSON
 
     async def _quality_reference(self, body, ctype, query) -> Result:
         import json as _json

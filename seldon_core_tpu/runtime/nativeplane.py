@@ -341,7 +341,12 @@ class NativeDataPlane:
                     except BaseException as e:
                         # failed dispatches keep their span too (engine
                         # lane parity): the incident trace must show the
-                        # device hop that died
+                        # device hop that died — and the typed error on
+                        # the open plane span is what the postmortem
+                        # retention policy keys on for this lane
+                        engine.tracer.annotate(
+                            status=500, error=type(e).__name__
+                        )
                         if wants.trace:
                             SPINE.record_failed_dispatch(
                                 executable=engine.compiled.executable_key(

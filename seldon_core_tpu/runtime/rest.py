@@ -281,6 +281,12 @@ def make_engine_app(engine: EngineService) -> web.Application:
         # (utils/costledger.py; docs/operations.md runbook)
         return web.json_response(engine.costs_document())
 
+    async def postmortems(request: web.Request) -> web.Response:
+        # tail-sampled worst-request exemplars with automatic explainers
+        # (utils/postmortem.py); ?puid= returns one full document
+        return web.json_response(engine.postmortems_document(
+            puid=request.query.get("puid", "")))
+
     async def trace(request: web.Request) -> web.Response:
         from seldon_core_tpu.utils.tracing import TRACER, trace_document
 
@@ -427,6 +433,7 @@ def make_engine_app(engine: EngineService) -> web.Application:
     app.router.add_get("/autopilot", autopilot)
     app.router.add_get("/corpus", corpus)
     app.router.add_get("/costs", costs)
+    app.router.add_get("/postmortems", postmortems)
     app.router.add_post("/quality/reference", _quality_reference)
     app.router.add_get("/trace", trace)
     app.router.add_get("/trace/export", trace_export)

@@ -87,6 +87,9 @@ WANT_TRACE = 2
 WANT_PERF = 4
 WANT_QUALITY = 8
 WANT_COST = 16    # record carries a cost-ledger attribution payload
+WANT_PM = 32      # head-sampled OUT, but under postmortem tail capture:
+                  # the reconstructed span is pm_only — pending buffer
+                  # only, never the tracer ring (utils/postmortem.py)
 
 #: hop kinds (HotRecord.hop)
 HOP_SPAN = "span"          # a finished tracer span (request/client/...)
@@ -246,19 +249,21 @@ class Wants:
     subsystem's interest in this hop (nested sampling — see module
     docstring)."""
 
-    __slots__ = ("trace", "quality", "perf", "recorder", "flags")
+    __slots__ = ("trace", "quality", "perf", "recorder", "pm", "flags")
 
     def __init__(self, trace: bool, quality: bool, perf: bool,
-                 recorder: bool):
+                 recorder: bool, pm: bool = False):
         self.trace = trace
         self.quality = quality
         self.perf = perf
         self.recorder = recorder
+        self.pm = pm
         self.flags = (
             (WANT_TRACE if trace else 0)
             | (WANT_QUALITY if quality else 0)
             | (WANT_PERF if perf else 0)
             | (WANT_RECORDER if recorder else 0)
+            | (WANT_PM if pm else 0)
         )
 
     @property
@@ -401,8 +406,13 @@ class TelemetrySpine:
         would."""
         u = self._rng.random()
         ctx = current_trace_context()
+        pm = False
         if ctx is not None:
             trace = TRACER.enabled and ctx.sampled
+            # a sampled-out context under postmortem tail capture still
+            # wants the dispatch span — pm_only, pending buffer only
+            pm = (TRACER.enabled and not ctx.sampled and ctx.pm
+                  and TRACER.pm_hook is not None)
         else:
             trace = TRACER.enabled and (
                 TRACER.sample >= 1.0 or u < TRACER.sample
@@ -410,7 +420,7 @@ class TelemetrySpine:
         quality = QUALITY.enabled and QUALITY.sample > 0.0 and (
             QUALITY.sample >= 1.0 or u < QUALITY.sample
         )
-        return Wants(trace, quality, OBSERVATORY.enabled, False)
+        return Wants(trace, quality, OBSERVATORY.enabled, False, pm=pm)
 
     # -- hot-path record sites ---------------------------------------------
 
@@ -430,9 +440,13 @@ class TelemetrySpine:
         want_trace = (
             TRACER.enabled and ctx is not None and ctx.sampled
         )
+        want_pm = (
+            TRACER.enabled and ctx is not None and not ctx.sampled
+            and getattr(ctx, "pm", False) and TRACER.pm_hook is not None
+        )
         flags = (WANT_RECORDER if self.telemetry_enabled else 0) | (
             WANT_TRACE if want_trace else 0
-        )
+        ) | (WANT_PM if want_pm else 0)
         if not flags:
             return False
         rec = HotRecord(HOP_QUEUE, flags)
@@ -440,7 +454,7 @@ class TelemetrySpine:
         rec.start_s = start_s
         rec.duration_s = float(wait_s)
         rec.rows = int(rows)
-        if want_trace:
+        if want_trace or want_pm:
             rec.puid = ctx.puid
             rec.trace_id = ctx.trace_id
             rec.parent_span_id = ctx.span_id
@@ -518,7 +532,7 @@ class TelemetrySpine:
         rec.compile_cache = compile_cache
         rec.error = error
         rec.phases = phases
-        if wants.trace:
+        if wants.trace or wants.pm:
             ctx = current_trace_context()
             if ctx is not None:
                 rec.trace_id = ctx.trace_id
@@ -701,7 +715,10 @@ class TelemetrySpine:
             t0 = pc()
             TRACER._fold(rec.span)
             self.fold_cost["tracer"].observe(pc() - t0)
-            if rec.span.kind == "request":
+            if rec.span.kind == "request" and not rec.span.pm_only:
+                # pm_only request spans exist only for the postmortem
+                # pending buffer — the overhead estimator's sample set
+                # must stay exactly what head sampling admitted
                 self.hop_ms["request"].observe(rec.span.duration_ms)
             return
         if rec.hop == HOP_QUEUE:
@@ -709,7 +726,7 @@ class TelemetrySpine:
                 t0 = pc()
                 RECORDER.observe_queue_wait(rec.queue_wait_s)
                 self.fold_cost["recorder"].observe(pc() - t0)
-            if rec.flags & WANT_TRACE:
+            if rec.flags & (WANT_TRACE | WANT_PM):
                 t0 = pc()
                 TRACER._fold(Span(
                     puid=rec.puid, name="batch_queue", kind="queue",
@@ -718,6 +735,7 @@ class TelemetrySpine:
                     attrs={"rows": rec.rows},
                     trace_id=rec.trace_id, span_id=rec.span_id,
                     parent_span_id=rec.parent_span_id,
+                    pm_only=not (rec.flags & WANT_TRACE),
                 ))
                 self.fold_cost["tracer"].observe(pc() - t0)
             return
@@ -894,7 +912,7 @@ class TelemetrySpine:
                 if drift is not None:
                     attrs["drift"] = round(drift, 4)
                 self.fold_cost["quality"].observe(pc() - t0)
-            if rec.flags & WANT_TRACE:
+            if rec.flags & (WANT_TRACE | WANT_PM):
                 t0 = pc()
                 if rec.error:
                     attrs["error"] = rec.error
@@ -914,6 +932,7 @@ class TelemetrySpine:
                     duration_ms=rec.duration_s * 1e3, attrs=attrs,
                     trace_id=rec.trace_id, span_id=rec.span_id,
                     parent_span_id=rec.parent_span_id,
+                    pm_only=not (rec.flags & WANT_TRACE),
                 ))
                 self.fold_cost["tracer"].observe(pc() - t0)
 
@@ -974,6 +993,14 @@ class TelemetrySpine:
             from seldon_core_tpu.utils.costledger import LEDGER
 
             LEDGER.publish_gauges()
+        except Exception:  # noqa: BLE001 - gauges must not wedge a drain
+            pass
+        # postmortem pinned-span accounting rides the same throttle —
+        # never per keep/drop
+        try:
+            from seldon_core_tpu.utils.postmortem import POSTMORTEM
+
+            POSTMORTEM.publish_gauges()
         except Exception:  # noqa: BLE001 - gauges must not wedge a drain
             pass
 
@@ -1081,3 +1108,13 @@ TRACER.drain_hook = SPINE.drain
 RECORDER.drain_hook = SPINE.drain
 OBSERVATORY.drain_hook = SPINE.drain
 QUALITY.drain_hook = SPINE.drain
+
+# tail-sampled postmortem capture (utils/postmortem.py): every folded
+# span — sampled or pm_only — is offered to the pending buffer so the
+# keep/drop verdict can wait for request completion.  The kill switch
+# (SELDON_TPU_POSTMORTEM=0) leaves pm_hook None, which restores head
+# sampling bit-for-bit: no pm_only spans are ever recorded.
+from seldon_core_tpu.utils.postmortem import POSTMORTEM  # noqa: E402
+
+if POSTMORTEM.enabled:
+    TRACER.pm_hook = POSTMORTEM.offer

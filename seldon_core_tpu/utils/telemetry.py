@@ -253,6 +253,16 @@ TPU_METRIC_FAMILIES: Dict[str, tuple] = {
     "seldon_tpu_cost_pad_tax_seconds_total":
         ("counter", ("tenant", "deployment")),
     "seldon_tpu_cost_attributed_fraction": ("gauge", ()),
+    # tail-sampled postmortem recorder (utils/postmortem.py): exemplars
+    # kept by retention reason (error / shed / slo / autopilot_excess /
+    # preemption / breaker / failover / lease / baseline), pending
+    # traces evicted without a keep verdict (buffer overflow or TTL),
+    # and spans currently pinned inside kept exemplar documents.  The
+    # SeldonTPUPostmortemFlood alert pages on a sustained kept rate —
+    # the anomaly detector itself saying most traffic is anomalous
+    "seldon_tpu_postmortem_kept_total": ("counter", ("reason",)),
+    "seldon_tpu_postmortem_dropped_total": ("counter", ()),
+    "seldon_tpu_postmortem_pinned_spans": ("gauge", ()),
 }
 
 _OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
@@ -445,6 +455,11 @@ class FlightRecorder:
         self.corpus_bytes = 0
         self.corpus_warm_keys = 0
         self.fleet_burn: Dict[str, float] = {}         # window -> rate
+        # tail-sampled postmortem mirrors (utils/postmortem.py: keeps by
+        # retention reason, pending-buffer drops, pinned exemplar spans)
+        self.postmortem_kept: Dict[str, int] = {}      # reason -> n
+        self.postmortem_dropped = 0
+        self.postmortem_pinned = 0
         # traffic-lifecycle mirrors (gateway/shadow.py mirror outcomes +
         # divergence, operator/rollouts.py rollbacks and stage weights)
         self.shadow_requests: Dict[str, int] = {}      # outcome -> n
@@ -815,6 +830,25 @@ class FlightRecorder:
                 "seldon_tpu_corpus_warm_keys",
                 "Autopilot keys warm-started from a prior process's "
                 "corpus at boot — priced before their first dispatch",
+                registry=self.registry)
+            self._p_postmortem_kept = Counter(
+                "seldon_tpu_postmortem_kept_total",
+                "Postmortem exemplars kept by retention reason (error / "
+                "shed / slo / autopilot_excess / preemption / breaker / "
+                "failover / lease / baseline — utils/postmortem.py); the "
+                "SeldonTPUPostmortemFlood alert pages on a sustained "
+                "kept rate",
+                ["reason"], registry=self.registry)
+            self._p_postmortem_dropped = Counter(
+                "seldon_tpu_postmortem_dropped_total",
+                "Pending postmortem traces evicted without a keep "
+                "verdict (buffer overflow or TTL — requests that never "
+                "completed, or capture outrunning the bounded buffer)",
+                registry=self.registry)
+            self._p_postmortem_pinned = Gauge(
+                "seldon_tpu_postmortem_pinned_spans",
+                "Spans currently pinned inside kept postmortem exemplar "
+                "documents (copied out of the trace ring at keep time)",
                 registry=self.registry)
             self._p_fleet_burn = Gauge(
                 "seldon_tpu_fleet_burn_rate",
@@ -1248,6 +1282,37 @@ class FlightRecorder:
                 self.lease_transitions.get(kind, 0) + 1)
         if self.registry is not None:
             self._p_lease_transitions.labels(kind=kind).inc()
+
+    def record_postmortem_kept(self, reason: str) -> None:
+        """One postmortem exemplar kept (utils/postmortem.py retention
+        verdict at request completion) — labelled by the FIRST reason,
+        so the rate per reason reads as 'what kind of anomaly is the
+        fleet producing right now'."""
+        self._gen += 1
+        with self._lock:
+            self.postmortem_kept[reason] = (
+                self.postmortem_kept.get(reason, 0) + 1)
+        if self.registry is not None:
+            self._p_postmortem_kept.labels(reason=reason).inc()
+
+    def record_postmortem_dropped(self, n: int = 1) -> None:
+        """Pending postmortem traces evicted without a keep verdict
+        (buffer overflow / TTL sweep) — bumped fold-side, never on the
+        request path."""
+        self._gen += 1
+        with self._lock:
+            self.postmortem_dropped += n
+        if self.registry is not None:
+            self._p_postmortem_dropped.inc(n)
+
+    def set_postmortem_pinned(self, n: int) -> None:
+        """Spans pinned inside kept exemplar documents — refreshed from
+        the spine's throttled gauge pass, never per keep."""
+        self._gen += 1
+        with self._lock:
+            self.postmortem_pinned = int(n)
+        if self.registry is not None:
+            self._p_postmortem_pinned.set(n)
 
     def set_corpus(self, rows: int, disk_bytes: int,
                    warm_keys: int) -> None:
@@ -1766,6 +1831,11 @@ class FlightRecorder:
                 "rollbacks": dict(self.rollbacks),
                 "rollout_stage": dict(self.rollout_stage),
             }
+            postmortem = {
+                "kept": dict(self.postmortem_kept),
+                "dropped": self.postmortem_dropped,
+                "pinned_spans": self.postmortem_pinned,
+            }
             autopilot = {
                 "decisions": dict(self.autopilot_decisions),
                 "sheds": dict(self.autopilot_sheds),
@@ -1821,6 +1891,7 @@ class FlightRecorder:
             "qos": qos,
             "cost": cost,
             "corpus": corpus,
+            "postmortem": postmortem,
             "batch": {
                 "occupancy": self.batch_occupancy.snapshot(),
                 "queue_wait_s": self.batch_queue_wait.snapshot(),
@@ -1971,6 +2042,9 @@ class FlightRecorder:
             self.brownout_stage = 0
             self.brownout_transitions = {}
             self.brownout_sheds = {}
+            self.postmortem_kept = {}
+            self.postmortem_dropped = 0
+            self.postmortem_pinned = 0
 
 
 RECORDER = FlightRecorder()

@@ -111,6 +111,12 @@ class TraceContext:
     span_id: str
     sampled: bool = True
     puid: str = ""
+    #: tail-capture (postmortem) bit: a sampled-out trace whose root drew
+    #: pm=True still records spans — flagged ``pm_only`` and routed ONLY
+    #: to the postmortem pending buffer (utils/postmortem.py), never the
+    #: tracer ring.  Rides bit 0x02 of the traceparent flags byte; peers
+    #: that predate it read only 0x01 and degrade to local-only capture.
+    pm: bool = False
 
     def child(self, puid: str = "") -> "TraceContext":
         return TraceContext(
@@ -118,6 +124,7 @@ class TraceContext:
             span_id=new_span_id(),
             sampled=self.sampled,
             puid=puid or self.puid,
+            pm=self.pm,
         )
 
 
@@ -146,7 +153,8 @@ def traceparent_header_value() -> Optional[str]:
     ctx = TRACE_VAR.get()
     if ctx is None or not ctx.trace_id or not ctx.span_id:
         return None
-    return "00-%s-%s-%s" % (ctx.trace_id, ctx.span_id, "01" if ctx.sampled else "00")
+    flags = (0x01 if ctx.sampled else 0x00) | (0x02 if ctx.pm else 0x00)
+    return "00-%s-%s-%02x" % (ctx.trace_id, ctx.span_id, flags)
 
 
 def parse_traceparent(raw: Optional[str]) -> Optional[TraceContext]:
@@ -166,10 +174,13 @@ def parse_traceparent(raw: Optional[str]) -> Optional[TraceContext]:
     try:
         if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
             return None
-        sampled = bool(int(flags[:2], 16) & 0x01)
+        bits = int(flags[:2], 16)
+        sampled = bool(bits & 0x01)
+        pm = bool(bits & 0x02)
     except ValueError:
         return None
-    return TraceContext(trace_id=trace_id, span_id=span_id, sampled=sampled)
+    return TraceContext(trace_id=trace_id, span_id=span_id, sampled=sampled,
+                        pm=pm)
 
 
 def trace_scope(ctx: Optional[TraceContext]):
@@ -206,6 +217,10 @@ class Span:
     #: sleeps, breaker-open short-circuits, degradation fallbacks —
     #: [{"name": ..., "ts": epoch_s, "attrs": {...}}]
     events: List[Dict[str, Any]] = field(default_factory=list)
+    #: recorded for the postmortem pending buffer ONLY (the trace was
+    #: head-sampled out) — must never reach the tracer ring, indexes, or
+    #: per-kind span metrics; deliberately absent from ``to_json_dict``
+    pm_only: bool = False
 
     @property
     def end_s(self) -> float:
@@ -311,6 +326,13 @@ class Tracer:
         #: the inline synchronous path (both default None).
         self.sink = None
         self.drain_hook = None
+        #: tail-capture wiring (utils/postmortem.py), set on the global
+        #: TRACER only when postmortem capture is enabled: every folded
+        #: span — sampled or pm_only — is offered to the pending buffer
+        #: so the keep/drop decision can wait for request completion.
+        #: None (the default, and always for local instances) restores
+        #: head-sampling behavior bit-for-bit.
+        self.pm_hook = None
 
     # -- admin -------------------------------------------------------------
 
@@ -358,7 +380,14 @@ class Tracer:
         parent = TRACE_VAR.get()
         if parent is not None:
             if not parent.sampled:
-                return self._null  # the root's head decision governs
+                # the root's head decision governs the RING; a pm-flagged
+                # trace still records, pm_only, into the pending buffer
+                if parent.pm and self.pm_hook is not None:
+                    ctx = parent.child(puid)
+                    return self._record(puid or ctx.puid, name, kind,
+                                        method, attrs, ctx,
+                                        parent.span_id, pm_only=True)
+                return self._null
             ctx = parent.child(puid)
             parent_id = parent.span_id
         else:
@@ -366,10 +395,19 @@ class Tracer:
             # bit rides the traceparent flags to every other process
             if self.sample < 1.0 and self._rng.random() >= self.sample:
                 self.sampled_out_total += 1
+                if self.pm_hook is not None:
+                    # sampled OUT of the ring but INTO tail capture: the
+                    # keep/drop verdict moves to request completion
+                    ctx = TraceContext(
+                        trace_id=new_trace_id(), span_id=new_span_id(),
+                        sampled=False, puid=puid, pm=True,
+                    )
+                    return self._record(puid, name, kind, method, attrs,
+                                        ctx, "", pm_only=True)
                 return self._unsampled(puid)
             ctx = TraceContext(
                 trace_id=new_trace_id(), span_id=new_span_id(),
-                sampled=True, puid=puid,
+                sampled=True, puid=puid, pm=self.pm_hook is not None,
             )
             parent_id = ""
         return self._record(puid or ctx.puid, name, kind, method, attrs,
@@ -391,7 +429,8 @@ class Tracer:
             TRACE_VAR.reset(token)
 
     @contextmanager
-    def _record(self, puid, name, kind, method, attrs, ctx, parent_id):
+    def _record(self, puid, name, kind, method, attrs, ctx, parent_id,
+                pm_only: bool = False):
         handle = SpanHandle(attrs)
         token = TRACE_VAR.set(ctx)
         self._open[ctx.span_id] = handle
@@ -415,6 +454,7 @@ class Tracer:
                     span_id=ctx.span_id,
                     parent_span_id=parent_id,
                     events=handle.events,
+                    pm_only=pm_only,
                 )
             )
 
@@ -422,16 +462,36 @@ class Tracer:
         """Attach a point-in-time event to the ACTIVE span (retry attempt,
         backoff sleep, breaker-open short-circuit, fallback).  Returns
         False (and records nothing) when tracing is off, the trace is
-        sampled out, or no span is open."""
+        sampled out (and not under postmortem capture), or no span is
+        open.  The gate is handle presence, not ``ctx.sampled``: a
+        pm_only span HAS an open handle and its events (preempt, breaker
+        open, retry) are exactly what the postmortem retention policy
+        keys on."""
         if not self.enabled:
             return False
         ctx = TRACE_VAR.get()
-        if ctx is None or not ctx.sampled:
+        if ctx is None:
             return False
         handle = self._open.get(ctx.span_id)
         if handle is None:
             return False
         handle.event(name, **attrs)
+        return True
+
+    def annotate(self, **attrs: Any) -> bool:
+        """Merge attrs into the ACTIVE span (status codes, typed-error
+        names, shed verdicts — stamped at catch sites so the postmortem
+        retention policy can read them at completion).  Same gating as
+        :meth:`event`; returns False when nothing was open to annotate."""
+        if not self.enabled:
+            return False
+        ctx = TRACE_VAR.get()
+        if ctx is None:
+            return False
+        handle = self._open.get(ctx.span_id)
+        if handle is None:
+            return False
+        handle.update(attrs)
         return True
 
     def record_span(
@@ -452,9 +512,12 @@ class Tracer:
         ctx records nothing."""
         if not self.enabled:
             return
+        pm_only = False
         if ctx is not None:
             if not ctx.sampled:
-                return
+                if not (ctx.pm and self.pm_hook is not None):
+                    return
+                pm_only = True  # pending buffer only, never the ring
             trace_id, parent_id = ctx.trace_id, ctx.span_id
             puid = puid or ctx.puid
         else:
@@ -466,7 +529,7 @@ class Tracer:
                 puid=puid, name=name, kind=kind, method=method,
                 start_s=start_s, duration_ms=duration_ms, attrs=attrs,
                 trace_id=trace_id, span_id=new_span_id(),
-                parent_span_id=parent_id,
+                parent_span_id=parent_id, pm_only=pm_only,
             )
         )
 
@@ -482,6 +545,16 @@ class Tracer:
         self._fold(span)
 
     def _fold(self, span: Span) -> None:
+        hook = self.pm_hook
+        if hook is not None:
+            try:
+                hook(span)  # tail-capture pending buffer (postmortem)
+            except Exception:  # noqa: BLE001 - capture must never fail a fold
+                pass
+        if span.pm_only:
+            # head-sampled-out span: it exists ONLY for the pending
+            # buffer — ring, indexes, and span metrics stay untouched
+            return
         with self._lock:
             self._spans.append(span)
             if span.puid:
@@ -630,6 +703,8 @@ _PHASE_BY_KIND = {
     "client": "network_ms",
     "dispatch": "dispatch_ms",
     "batch": "dispatch_ms",
+    "kv_handoff": "kv_handoff_ms",
+    "kv_import": "kv_handoff_ms",
 }
 
 
@@ -637,11 +712,13 @@ def phase_decomposition(segments: List[Tuple[Span, float]]) -> Dict[str, float]:
     """Bucket critical-path segments into the phases perf work steers by:
     queue (micro-batch wait) / retry+backoff (sleeps between attempts) /
     network (client-span self time: wire + remote queueing we can't see) /
-    dispatch (device) / decode (token generation) / other (host logic).
+    dispatch (device) / decode (token generation) / kv_handoff (fenced
+    KV-block streaming between prefill and decode) / other (host logic).
     Sums to the root duration."""
     phases = {
         "queue_ms": 0.0, "retry_backoff_ms": 0.0, "network_ms": 0.0,
-        "dispatch_ms": 0.0, "decode_ms": 0.0, "other_ms": 0.0,
+        "dispatch_ms": 0.0, "decode_ms": 0.0, "kv_handoff_ms": 0.0,
+        "other_ms": 0.0,
     }
     for sp, self_ms in segments:
         if sp.method in ("generate_stream", "decode"):

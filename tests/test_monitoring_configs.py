@@ -177,5 +177,28 @@ def test_new_tpu_families_are_dashboarded():
         "seldon_tpu_corpus_bytes",
         "seldon_tpu_corpus_warm_keys",
         "seldon_tpu_fleet_burn_rate",
+        # tail-sampled postmortem recorder (utils/postmortem.py)
+        "seldon_tpu_postmortem_kept_total",
+        "seldon_tpu_postmortem_dropped_total",
+        "seldon_tpu_postmortem_pinned_spans",
     ):
         assert family in text, f"{family} missing from every dashboard"
+
+
+def test_postmortem_flood_alert_defined():
+    """The SeldonTPUPostmortemFlood alert must page off the kept-total
+    rate and hand the operator the runbook anchor — a retention policy
+    matching the common case is an observability outage, not a win."""
+    yaml = pytest.importorskip("yaml")
+    with open(os.path.join(MONITORING, "alerts.yml")) as f:
+        doc = yaml.safe_load(f)
+    alerts = {
+        rule["alert"]: rule
+        for group in doc.get("groups", [])
+        for rule in group.get("rules", [])
+        if "alert" in rule
+    }
+    assert "SeldonTPUPostmortemFlood" in alerts
+    rule = alerts["SeldonTPUPostmortemFlood"]
+    assert "seldon_tpu_postmortem_kept_total" in rule["expr"]
+    assert "reading-a-postmortem" in rule["annotations"]["runbook"]

@@ -1,0 +1,562 @@
+"""Tail-sampled postmortem recorder (utils/postmortem.py).
+
+Covers: every retention trigger (error / shed / SLO-by-tier /
+autopilot-excess / preemption / breaker / out-of-band notes /
+reservoir baseline), the explainer's phase-excess math against
+hand-computed numbers, pending-buffer and reservoir bounds, the
+copy-out-at-keep-time immutability contract (pin vs a 1-entry ring),
+the traceparent pm bit (bit 0x02) end-to-end including old-peer
+degradation, the gateway-federated worst-of-fleet merge, and both kill
+switches (``SELDON_TPU_POSTMORTEM=0`` and a disabled tracer)."""
+
+import asyncio
+import json
+import random
+from types import SimpleNamespace
+
+from seldon_core_tpu.utils.postmortem import (
+    POSTMORTEM,
+    PostmortemRecorder,
+    postmortem_enabled,
+)
+from seldon_core_tpu.utils.tracing import (
+    Span,
+    TraceContext,
+    Tracer,
+    parse_traceparent,
+    trace_scope,
+    traceparent_header_value,
+)
+
+
+def _span(tid, *, span_id, puid="", name="engine", kind="request",
+          method="predict", start=1000.0, dur=10.0, parent="",
+          attrs=None, events=None):
+    return Span(puid=puid or f"p-{tid}", name=name, kind=kind,
+                method=method, start_s=start, duration_ms=dur,
+                attrs=dict(attrs or {}), trace_id=tid, span_id=span_id,
+                parent_span_id=parent, events=list(events or ()))
+
+
+def _request(rec, tid, *, root_ms=10.0, root_attrs=None, child=None):
+    """Feed one synthetic request (optional child first, root last —
+    fold order) and return the root span."""
+    if child is not None:
+        rec.offer(child)
+    root = _span(tid, span_id="r" + tid, dur=root_ms, attrs=root_attrs)
+    rec.offer(root)
+    return root
+
+
+def _recorder(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("slo_ms", 0.0)
+    return PostmortemRecorder(**kw)
+
+
+# -- retention triggers ----------------------------------------------------
+
+
+def test_healthy_request_is_dropped():
+    rec = _recorder(baseline=0)
+    _request(rec, "t1")
+    assert rec.document()["kept"] == []
+    assert rec.completed_total == 1
+
+
+def test_error_trigger_5xx_status():
+    rec = _recorder()
+    _request(rec, "t1", root_attrs={"status": 500})
+    kept = rec.document()["kept"]
+    assert [k["reason"] for k in kept] == ["error"]
+
+
+def test_error_trigger_typed_error_attr():
+    rec = _recorder()
+    _request(rec, "t1", root_attrs={"error": "TimeoutError"})
+    assert rec.document()["kept"][0]["reason"] == "error"
+
+
+def test_shed_trigger_outranks_error():
+    # a policy shed is flow control, not a failure — it must label the
+    # exemplar "shed" even though it travels as a 503
+    rec = _recorder()
+    _request(rec, "t1", root_attrs={"shed": True, "status": 503})
+    assert rec.document()["kept"][0]["reason"] == "shed"
+
+
+def test_slo_trigger_respects_tier_budgets():
+    rec = _recorder(slo_ms=100.0)
+    # interactive (default tier): budget 100 ms -> 150 ms is anomalous
+    _request(rec, "t1", root_ms=150.0)
+    # batch: budget 4x -> the same 150 ms is fine, 450 ms is not
+    _request(rec, "t2", root_ms=150.0, root_attrs={"tier": "batch"})
+    _request(rec, "t3", root_ms=450.0, root_attrs={"tier": "batch"})
+    kept = {k["trace_id"]: k for k in rec.document()["kept"]}
+    assert "t1" in kept and kept["t1"]["reason"] == "slo"
+    assert "t2" not in kept
+    assert "t3" in kept and kept["t3"]["reason"] == "slo"
+
+
+def test_slo_zero_is_inert():
+    rec = _recorder(slo_ms=0.0, baseline=0)
+    _request(rec, "t1", root_ms=10_000.0)
+    assert rec.document()["kept"] == []
+
+
+def test_autopilot_excess_trigger():
+    rec = _recorder(excess_x=3.0)
+    slow = _span("t1", span_id="d1", name="dispatch", kind="dispatch",
+                 parent="rt1", dur=40.0,
+                 attrs={"autopilot_predicted_ms": 10.0})
+    _request(rec, "t1", child=slow)
+    kept = rec.document()["kept"]
+    assert kept and kept[0]["reason"] == "autopilot_excess"
+    # within 3x of predicted: not anomalous
+    rec2 = _recorder(excess_x=3.0, baseline=0)
+    ok = _span("t2", span_id="d2", name="dispatch", kind="dispatch",
+               parent="rt2", dur=25.0,
+               attrs={"autopilot_predicted_ms": 10.0})
+    _request(rec2, "t2", child=ok)
+    assert rec2.document()["kept"] == []
+
+
+def test_preemption_trigger_via_gen_seq_event():
+    rec = _recorder()
+    seq = _span("t1", span_id="g1", name="gen_sequence", kind="gen_seq",
+                parent="rt1", dur=50.0,
+                events=[{"name": "preempt", "ts": 1000.01}])
+    _request(rec, "t1", child=seq)
+    assert rec.document()["kept"][0]["reason"] == "preemption"
+
+
+def test_breaker_trigger_via_span_event():
+    rec = _recorder()
+    hop = _span("t1", span_id="c1", name="engine", kind="client",
+                parent="rt1", dur=5.0,
+                events=[{"name": "breaker_open", "ts": 1000.0}])
+    _request(rec, "t1", child=hop)
+    assert rec.document()["kept"][0]["reason"] == "breaker"
+
+
+def test_native_plane_batch_is_a_completable_unit():
+    """The native C++ data plane never surfaces request boundaries to
+    Python — its per-BATCH "plane" root span must still complete the
+    trace, so a failed or over-SLO native dispatch is retained instead
+    of TTL-rotting in the pending buffer forever."""
+    rec = _recorder(slo_ms=50.0, baseline=0)
+    # healthy batch: judged and dropped
+    rec.offer(_span("b1", span_id="pb1", name="plane_batch", kind="plane",
+                    dur=5.0))
+    assert rec.completed_total == 1 and rec.document()["kept"] == []
+    # dispatch blew up inside the batch: typed error on the plane root
+    rec.offer(_span("b2", span_id="pb2", name="plane_batch", kind="plane",
+                    dur=7.0, attrs={"status": 500,
+                                    "error": "XlaRuntimeError"}))
+    # cold-compile batch: over the SLO budget
+    rec.offer(_span("b3", span_id="pb3", name="plane_batch", kind="plane",
+                    dur=400.0))
+    kept = {k["trace_id"]: k["reason"] for k in rec.document()["kept"]}
+    assert kept == {"b2": "error", "b3": "slo"}
+
+
+def test_note_rescues_an_already_dropped_trace():
+    # the pending buffer is TTL-evicted, NOT cleared on a drop verdict,
+    # exactly so a late failover signal can still rescue the trace
+    rec = _recorder(baseline=0)
+    _request(rec, "t1")
+    assert rec.document()["kept"] == []
+    rec.note("t1", "failover", lane="unary", recovered=True)
+    kept = rec.document()["kept"]
+    assert kept and kept[0]["reason"] == "failover"
+    detail = rec.document(puid="p-t1")["postmortem"]
+    assert detail["explain"]["notes"][0]["attrs"]["recovered"] is True
+
+
+def test_traceless_note_becomes_bounded_synthetic_exemplar():
+    rec = _recorder()
+    for i in range(20):
+        rec.note("", "lease", endpoint=f"http://e{i}",
+                 transition="live->dead")
+    doc = rec.document()
+    assert doc["kept"] == []  # a lease flap must not evict real exemplars
+    assert 0 < len(doc["synthetic"]) <= 8
+    assert doc["synthetic"][0]["synthetic"] is True
+    assert rec.kept_total["lease"] == 20
+
+
+def test_reservoir_baseline_bounds():
+    rec = _recorder(baseline=4)
+    rec._rng = random.Random(7)
+    for i in range(200):
+        _request(rec, f"t{i}")
+    doc = rec.document()
+    assert len(doc["baseline"]) == 4  # full, never over
+    assert all(b["reason"] == "baseline" for b in doc["baseline"])
+    assert rec.completed_total == 200
+    assert doc["kept"] == []  # healthy traffic never lands in kept
+
+
+# -- explainer math --------------------------------------------------------
+
+
+def _tree(tid, root_ms, disp_ms, attrs=None):
+    root = _span(tid, span_id="r" + tid, dur=root_ms, attrs=attrs)
+    disp = _span(tid, span_id="d" + tid, name="dispatch", kind="dispatch",
+                 parent="r" + tid, start=1000.010, dur=disp_ms)
+    return root, disp
+
+
+def test_phase_excess_hand_computed():
+    """3 healthy requests establish dispatch p50 = 40 ms / other = 60 ms;
+    the outlier (90 ms dispatch inside a 160 ms root) must be blamed on
+    dispatch with EXACTLY 90 - 40 = 50 ms of excess — the baselines fold
+    AFTER judgement, so the outlier is measured against its
+    predecessors, never softened by its own contribution."""
+    rec = _recorder(slo_ms=120.0, baseline=0)
+    for i in range(3):
+        root, disp = _tree(f"t{i}", 100.0, 40.0)
+        rec.offer(disp)
+        rec.offer(root)
+    root, disp = _tree("tx", 160.0, 90.0)
+    rec.offer(disp)
+    rec.offer(root)
+    pm = rec.document(puid="p-tx")["postmortem"]
+    assert pm["reasons"] == ["slo"]
+    assert pm["phases"]["dispatch_ms"] == 90.0
+    assert pm["phases"]["other_ms"] == 70.0
+    ex = pm["explain"]
+    assert ex["guilty_phase"] == "dispatch_ms"
+    assert ex["excess_ms"] == 50.0
+    assert ex["phase_excess_ms"]["dispatch_ms"] == 50.0
+    assert ex["phase_excess_ms"]["other_ms"] == 10.0
+    assert ex["baseline_p50_ms"]["dispatch_ms"] == 40.0
+    assert ex["baseline_p50_ms"]["other_ms"] == 60.0
+
+
+def test_fast_fail_names_biggest_phase():
+    # an instant error beats every baseline — no positive excess, so the
+    # explainer falls back to the biggest phase instead of None
+    rec = _recorder()
+    for i in range(2):
+        root, disp = _tree(f"t{i}", 100.0, 40.0)
+        rec.offer(disp)
+        rec.offer(root)
+    _request(rec, "te", root_ms=1.0, root_attrs={"status": 500})
+    pm = rec.document(puid="p-te")["postmortem"]
+    assert pm["reason"] == "error"
+    assert pm["explain"]["guilty_phase"] == "other_ms"
+    assert pm["explain"]["excess_ms"] <= 0.0
+
+
+def test_explainer_carries_autopilot_p2c_and_gen_ledger():
+    rec = _recorder()
+    root = _span("t1", span_id="rt1", dur=90.0, attrs={
+        "status": 500, "replica": "rep-2",
+        "p2c_candidates": "rep-1,rep-2", "p2c_scores": "5.0,2.0",
+    })
+    disp = _span("t1", span_id="d1", name="dispatch", kind="dispatch",
+                 parent="rt1", dur=80.0,
+                 attrs={"autopilot_predicted_ms": 20.0})
+    seq = _span("t1", span_id="g1", name="gen_sequence", kind="gen_seq",
+                parent="rt1", dur=70.0,
+                events=[{"name": "admitted", "ts": 1000.0}])
+    rec.offer(disp)
+    rec.offer(seq)
+    rec.offer(root)
+    ex = rec.document(puid="p-t1")["postmortem"]["explain"]
+    assert ex["autopilot"] == [{
+        "name": "dispatch", "kind": "dispatch",
+        "predicted_ms": 20.0, "actual_ms": 80.0, "ratio": 4.0,
+    }]
+    assert ex["p2c"]["replica"] == "rep-2"
+    assert ex["p2c"]["p2c_scores"] == "5.0,2.0"
+    assert ex["gen_ledger"][0]["name"] == "gen_sequence"
+    assert ex["gen_ledger"][0]["events"][0]["name"] == "admitted"
+
+
+# -- bounds ----------------------------------------------------------------
+
+
+def test_pending_buffer_bounded_lru():
+    rec = _recorder(pending_traces=4)
+    for i in range(10):
+        rec.offer(_span(f"t{i}", span_id=f"q{i}", kind="queue",
+                        name="batch_queue"))
+    doc = rec.document()
+    assert doc["pending"]["traces"] == 4
+    assert rec.dropped_total == 6
+
+
+def test_per_trace_span_cap_truncates_and_reports():
+    rec = _recorder(pending_spans=3, slo_ms=1.0)
+    for i in range(5):
+        rec.offer(_span("t1", span_id=f"c{i}", kind="dispatch",
+                        name="dispatch", parent="rt1"))
+    _request(rec, "t1", root_ms=50.0)  # over the 1 ms budget -> kept
+    pm = rec.document(puid="p-t1")["postmortem"]
+    assert pm["pinned_spans"] == 3
+    assert pm["truncated_spans"] == 3  # 2 extra children + the root
+    assert pm["partial"] is True  # the assembler flags the missing root
+
+
+def test_kept_ring_bounded_worst_first():
+    rec = _recorder(keep=2)
+    for i in range(5):
+        _request(rec, f"t{i}", root_attrs={"status": 500})
+    doc = rec.document()
+    assert len(doc["kept"]) == 2
+    assert sum(rec.kept_total.values()) == 5
+
+
+# -- pin vs eviction (copy-out immutability) -------------------------------
+
+
+def test_kept_document_survives_one_entry_ring_eviction():
+    """Regression for kept-exemplar decay: with a 1-entry tracer ring,
+    the spans of a kept trace are evicted from the ring almost
+    immediately — the postmortem document must NOT degrade, because it
+    copied the spans out at keep time."""
+    tracer = Tracer(capacity=1, enabled=True, sample=1.0)
+    rec = _recorder(slo_ms=1.0)
+    tracer.pm_hook = rec.offer
+    with tracer.span("p-slow", "engine", kind="request", method="predict",
+                     status=500):
+        with tracer.span("p-slow", "dispatch", kind="dispatch",
+                         method="predict"):
+            pass
+    pm1 = rec.document(puid="p-slow")["postmortem"]
+    assert pm1 is not None and pm1["pinned_spans"] == 2
+    frozen = json.dumps(pm1, sort_keys=True, default=str)
+    # churn the 1-entry ring until nothing of the original trace remains
+    for i in range(10):
+        with tracer.span(f"p-noise-{i}", "engine", kind="node"):
+            pass
+    assert tracer.snapshot()["spans"] == 1
+    pm2 = rec.document(puid="p-slow")["postmortem"]
+    assert json.dumps(pm2, sort_keys=True, default=str) == frozen
+    assert pm2["partial"] is False
+
+
+# -- the pm flags bit (head-sampling blind spot, end to end) ---------------
+
+
+def test_traceparent_pm_bit_roundtrip():
+    ctx = TraceContext(trace_id="ab" * 16, span_id="cd" * 8,
+                       sampled=False, pm=True)
+    with trace_scope(ctx):
+        hdr = traceparent_header_value()
+    assert hdr.endswith("-02")
+    back = parse_traceparent(hdr)
+    assert back.sampled is False and back.pm is True
+    # sampled + pm -> 03; plain sampled stays exactly the old 01
+    ctx2 = TraceContext(trace_id="ab" * 16, span_id="cd" * 8,
+                        sampled=True, pm=True)
+    with trace_scope(ctx2):
+        assert traceparent_header_value().endswith("-03")
+    ctx3 = TraceContext(trace_id="ab" * 16, span_id="cd" * 8, sampled=True)
+    with trace_scope(ctx3):
+        assert traceparent_header_value().endswith("-01")
+
+
+def test_unsampled_root_feeds_child_process_pending_buffer():
+    """The blind-spot fix end to end: a sampled-OUT root in the gateway
+    process still produces a postmortem in the engine process — the pm
+    bit rides the flags byte, the child records pm_only spans into its
+    own pending buffer, and its ring stays empty."""
+    gw_tracer = Tracer(enabled=True, sample=0.0)
+    gw_rec = _recorder()
+    gw_tracer.pm_hook = gw_rec.offer
+    hdr = [None]
+    with gw_tracer.span("p-x", "gateway", kind="request", method="predict",
+                        status=500):
+        hdr[0] = traceparent_header_value()
+    assert hdr[0].endswith("-02")
+    assert gw_rec.document()["kept"][0]["reason"] == "error"
+
+    # engine process: adopts the remote context off the wire
+    eng_tracer = Tracer(enabled=True, sample=1.0)
+    eng_rec = _recorder()
+    eng_tracer.pm_hook = eng_rec.offer
+    with trace_scope(parse_traceparent(hdr[0])):
+        with eng_tracer.span("p-x", "engine", kind="request",
+                             method="predict", status=500):
+            pass
+    assert eng_tracer.snapshot()["spans"] == 0  # ring untouched
+    assert eng_rec.document()["kept"][0]["reason"] == "error"
+
+
+def test_old_peer_degrades_to_local_only():
+    """A peer that predates the pm bit forwards flags 00 for an
+    unsampled trace — the downstream process records nothing (local-only
+    postmortems at the old peer's callers), and an old peer RECEIVING
+    02 reads bit 0x01 only and stays silent too."""
+    ctx = parse_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-00")
+    assert ctx.sampled is False and ctx.pm is False
+    tracer = Tracer(enabled=True, sample=1.0)
+    rec = _recorder()
+    tracer.pm_hook = rec.offer
+    with trace_scope(ctx):
+        with tracer.span("p-x", "engine", kind="request"):
+            pass
+    assert rec.document()["kept"] == []
+    assert rec.offer_total == 0
+    # the 02 byte keeps bit 0x01 clear: an old peer parses it unsampled
+    assert parse_traceparent(
+        "00-" + "ab" * 16 + "-" + "cd" * 8 + "-02").sampled is False
+
+
+# -- kill switches ---------------------------------------------------------
+
+
+def test_killswitch_postmortem_env(monkeypatch):
+    monkeypatch.setenv("SELDON_TPU_POSTMORTEM", "0")
+    assert postmortem_enabled() is False
+    rec = PostmortemRecorder()
+    assert rec.enabled is False
+    rec.offer(_span("t1", span_id="r1"))
+    rec.note("", "lease")
+    doc = rec.document()
+    assert doc["enabled"] is False
+    assert doc["kept"] == [] and doc["synthetic"] == []
+    assert rec.offer_total == 0 and rec.noted_total == 0
+
+
+def test_killswitch_no_hook_is_bit_for_bit():
+    # pm_hook None (what SELDON_TPU_POSTMORTEM=0 leaves behind): a
+    # sampled-out root records nothing anywhere and forwards plain 00
+    tracer = Tracer(enabled=True, sample=0.0)
+    flags = [None]
+    with tracer.span("p-x", "engine", kind="request") as h:
+        assert h is None  # the pre-postmortem unsampled null handle
+        flags[0] = traceparent_header_value()
+    assert flags[0].endswith("-00")
+    assert tracer.snapshot()["spans"] == 0
+    assert tracer.sampled_out_total == 1
+
+
+def test_killswitch_tracer_disabled():
+    tracer = Tracer(enabled=False)
+    offered = []
+    tracer.pm_hook = offered.append
+    with tracer.span("p-x", "engine", kind="request"):
+        pass
+    assert offered == []
+
+
+# -- federated merge -------------------------------------------------------
+
+
+def _fleet_summary(name, excess):
+    return {
+        "enabled": True,
+        "kept": [{
+            "puid": f"p-{name}", "trace_id": f"t-{name}",
+            "reason": "slo", "reasons": ["slo"], "duration_ms": 100.0,
+            "guilty_phase": "dispatch_ms", "excess_ms": excess,
+            "kept_at_s": 1.0, "pinned_spans": 2, "synthetic": False,
+        }],
+        "synthetic": [],
+        "counters": {"completed": 10, "kept": {"slo": 1}, "dropped": 2,
+                     "noted": 0, "offers": 20, "truncated_spans": 0},
+    }
+
+
+def test_federated_merge_worst_of_fleet(monkeypatch):
+    from seldon_core_tpu.gateway import fleet
+
+    POSTMORTEM.reset()
+    try:
+        # local exemplar with a modest excess
+        root, disp = _tree("tl", 160.0, 90.0, attrs={"status": 500})
+        POSTMORTEM.offer(disp)
+        POSTMORTEM.offer(root)
+        ep = SimpleNamespace(fleet_docs={
+            "postmortems": _fleet_summary("remote", 500.0), "ts": 1.0})
+        src = fleet.FleetSource(name="rep-1", set_name="d/p", lane="http",
+                                base_url="http://x", endpoint=ep)
+        monkeypatch.setattr(fleet, "gather_sources", lambda gw: [src])
+        doc = asyncio.run(fleet.postmortems_document(object()))
+    finally:
+        POSTMORTEM.reset()
+    assert doc["federated"] is True
+    assert {s["source"] for s in doc["sources"]} == {"gateway", "rep-1"}
+    # worst first: the remote 500 ms excess beats the local exemplar
+    assert doc["kept"][0]["puid"] == "p-remote"
+    assert doc["kept"][0]["source"] == "rep-1"
+    assert any(k["source"] == "gateway" for k in doc["kept"])
+    # counters sum across the fleet
+    assert doc["counters"]["completed"] >= 11
+    assert doc["counters"]["kept"]["slo"] >= 1
+
+
+def test_federated_puid_chase_falls_through_to_replicas(monkeypatch):
+    from seldon_core_tpu.gateway import fleet
+
+    POSTMORTEM.reset()
+    src = fleet.FleetSource(name="rep-2", set_name="d/p", lane="http",
+                            base_url="http://x")
+    monkeypatch.setattr(fleet, "gather_sources", lambda gw: [src])
+
+    async def fake_fetch(gw, url):
+        assert url == "http://x/postmortems?puid=p-far"
+        return {"found": True, "puid": "p-far",
+                "postmortem": {"puid": "p-far", "reason": "slo"}}
+
+    monkeypatch.setattr(fleet, "_fetch_json", fake_fetch)
+    doc = asyncio.run(fleet.postmortems_document(object(), puid="p-far"))
+    assert doc["found"] is True and doc["source"] == "rep-2"
+    assert doc["postmortem"]["reason"] == "slo"
+
+
+def test_federated_killswitch_local_only(monkeypatch):
+    from seldon_core_tpu.gateway import fleet
+
+    monkeypatch.setenv("SELDON_TPU_FLEET", "0")
+    POSTMORTEM.reset()
+    try:
+        monkeypatch.setattr(
+            fleet, "gather_sources",
+            lambda gw: (_ for _ in ()).throw(AssertionError("fanned out")))
+        doc = asyncio.run(fleet.postmortems_document(object()))
+    finally:
+        POSTMORTEM.reset()
+    assert doc["federated"] is False
+    assert [s["source"] for s in doc["sources"]] == ["gateway"]
+
+
+# -- metrics + evidence ----------------------------------------------------
+
+
+def test_metric_mirrors_flow():
+    from seldon_core_tpu.utils.telemetry import RECORDER
+
+    before = RECORDER.snapshot()["postmortem"]
+    RECORDER.record_postmortem_kept("slo")
+    RECORDER.record_postmortem_dropped(3)
+    RECORDER.set_postmortem_pinned(17)
+    after = RECORDER.snapshot()["postmortem"]
+    assert after["kept"].get("slo", 0) == before["kept"].get("slo", 0) + 1
+    assert after["dropped"] == before["dropped"] + 3
+    assert after["pinned_spans"] == 17
+
+
+def test_exemplar_puids_prefer_deployment():
+    rec = _recorder()
+    _request(rec, "t1", root_attrs={"status": 500, "deployment": "dep-a"})
+    _request(rec, "t2", root_attrs={"status": 500, "deployment": "dep-b"})
+    _request(rec, "t3", root_attrs={"status": 500, "deployment": "dep-b"})
+    assert rec.exemplar_puids(deployment="dep-a") == ["p-t1"]
+    assert rec.exemplar_puids(deployment="dep-b") == ["p-t3", "p-t2"]
+    # no match -> most recent anomalies, bounded
+    assert rec.exemplar_puids(deployment="dep-z", limit=2) == \
+        ["p-t3", "p-t2"]
+
+
+def test_snapshot_axes_null_guarded():
+    rec = _recorder()
+    snap = rec.snapshot()
+    assert snap["enabled"] is True
+    assert snap["offer_p50_ms"] is None  # no offers measured yet
+    off = PostmortemRecorder(enabled=False)
+    assert off.snapshot()["enabled"] is False
